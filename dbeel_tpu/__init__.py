@@ -1,0 +1,28 @@
+"""dbeel_tpu — a TPU-native distributed thread-per-core document database.
+
+A from-scratch rebuild of the capabilities of tontinton/dbeel
+(/root/reference): msgpack document API over TCP, LSM-tree storage
+(capacity-bounded memtable, WAL, SSTables, bloom filters, size-tiered
+compaction), page cache, shard-per-core placement on a consistent hash
+ring, UDP gossip membership, leaderless replication with tunable
+consistency, failure detection, and data migration.
+
+The TPU-native twist: the bulk sorted-data compute — compaction's k-way
+merge + dedup and the memtable-flush sort — runs as batched, data-parallel
+JAX/XLA programs on the device (``dbeel_tpu.ops``), behind a pluggable
+``CompactionStrategy`` seam, while an asyncio + native-code host runtime
+owns I/O, networking and the LSM state machine (the roles Rust/glommio
+plays in the reference).
+
+Layer map (mirrors SURVEY.md §1):
+  L7 client   dbeel_tpu.client
+  L6 doc API  dbeel_tpu.server.db_server
+  L5 cluster  dbeel_tpu.cluster (ring, gossip, replication, migration)
+  L4 comm     dbeel_tpu.cluster.{local_comm,remote_comm,gossip}
+  L3 storage  dbeel_tpu.storage.lsm_tree
+  L2 io/cache dbeel_tpu.storage.{page_cache,file_io,entry_writer}
+  L1 runtime  dbeel_tpu.server.{shard,run}
+  device ops  dbeel_tpu.ops, dbeel_tpu.parallel
+"""
+
+__version__ = "0.1.0"

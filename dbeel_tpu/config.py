@@ -1,0 +1,160 @@
+"""Node configuration.
+
+Mirrors the reference's flag surface (/root/reference/src/args.rs:5-186):
+same knobs, same defaults, same per-shard port arithmetic
+(db/remote/gossip port bases, each +shard_id).  Parsed once per process
+and shared (read-only) by every shard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+# Reference defaults (args.rs:36-172).
+DEFAULT_DB_PORT = 10000
+DEFAULT_REMOTE_SHARD_PORT = 20000
+DEFAULT_GOSSIP_PORT = 30000
+
+
+@dataclass
+class Config:
+    name: str = "dbeel"
+    seed_nodes: List[str] = field(default_factory=list)
+    ip: str = "127.0.0.1"
+    port: int = DEFAULT_DB_PORT
+    dir: str = "/tmp/dbeel_tpu"
+    default_replication_factor: int = 1
+    remote_shard_port: int = DEFAULT_REMOTE_SHARD_PORT
+    remote_shard_connect_timeout_ms: int = 5000
+    remote_shard_write_timeout_ms: int = 15000
+    remote_shard_read_timeout_ms: int = 15000
+    gossip_port: int = DEFAULT_GOSSIP_PORT
+    gossip_fanout: int = 3
+    gossip_max_seen_count: int = 3
+    failure_detection_interval_ms: int = 500
+    compaction_factor: int = 2
+    page_cache_size: int = 1 << 30
+    wal_sync_delay_us: int = 0
+    wal_sync: bool = False
+    sstable_bloom_min_size: int = 1 << 20
+    foreground_tasks_shares: int = 1000
+    background_tasks_shares: int = 250
+
+    # Rebuild-specific knobs (no reference analog).
+    shards: int = 0  # 0 = one shard per online CPU core.
+    compaction_backend: str = "auto"  # auto | device | cpu | native
+    memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def db_port(self, shard_id: int) -> int:
+        return self.port + shard_id
+
+    def remote_port(self, shard_id: int) -> int:
+        return self.remote_shard_port + shard_id
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dbeel_tpu", description="A TPU-native distributed document DB."
+    )
+    d = Config()
+    p.add_argument("--name", default=d.name, help="Unique node name.")
+    p.add_argument(
+        "--seed-nodes",
+        nargs="*",
+        default=[],
+        help="Seed nodes (<host>:<remote_shard_port>) for discovery.",
+    )
+    p.add_argument("--ip", default=d.ip)
+    p.add_argument("--port", type=int, default=d.port)
+    p.add_argument("--dir", default=d.dir)
+    p.add_argument(
+        "--default-replication-factor", type=int,
+        default=d.default_replication_factor,
+    )
+    p.add_argument(
+        "--remote-shard-port", type=int, default=d.remote_shard_port
+    )
+    p.add_argument(
+        "--remote-shard-connect-timeout", type=int,
+        default=d.remote_shard_connect_timeout_ms,
+    )
+    p.add_argument(
+        "--remote-shard-write-timeout", type=int,
+        default=d.remote_shard_write_timeout_ms,
+    )
+    p.add_argument(
+        "--remote-shard-read-timeout", type=int,
+        default=d.remote_shard_read_timeout_ms,
+    )
+    p.add_argument("--gossip-port", type=int, default=d.gossip_port)
+    p.add_argument("--gossip-fanout", type=int, default=d.gossip_fanout)
+    p.add_argument(
+        "--gossip-max-seen-count", type=int, default=d.gossip_max_seen_count
+    )
+    p.add_argument(
+        "--failure-detection-interval", type=int,
+        default=d.failure_detection_interval_ms,
+    )
+    p.add_argument(
+        "--compaction-factor", type=int, default=d.compaction_factor
+    )
+    p.add_argument("--page-cache-size", type=int, default=d.page_cache_size)
+    p.add_argument("--wal-sync-delay", type=int, default=d.wal_sync_delay_us)
+    p.add_argument("--wal-sync", action="store_true", default=d.wal_sync)
+    p.add_argument(
+        "--sstable-bloom-min-size", type=int, default=d.sstable_bloom_min_size
+    )
+    p.add_argument(
+        "--foreground-tasks-shares", type=int,
+        default=d.foreground_tasks_shares,
+    )
+    p.add_argument(
+        "--background-tasks-shares", type=int,
+        default=d.background_tasks_shares,
+    )
+    p.add_argument("--shards", type=int, default=d.shards)
+    p.add_argument(
+        "--compaction-backend",
+        choices=("auto", "device", "cpu", "native"),
+        default=d.compaction_backend,
+    )
+    p.add_argument(
+        "--memtable-capacity", type=int, default=d.memtable_capacity
+    )
+    return p
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    return Config(
+        name=ns.name,
+        seed_nodes=list(ns.seed_nodes),
+        ip=ns.ip,
+        port=ns.port,
+        dir=ns.dir,
+        default_replication_factor=ns.default_replication_factor,
+        remote_shard_port=ns.remote_shard_port,
+        remote_shard_connect_timeout_ms=ns.remote_shard_connect_timeout,
+        remote_shard_write_timeout_ms=ns.remote_shard_write_timeout,
+        remote_shard_read_timeout_ms=ns.remote_shard_read_timeout,
+        gossip_port=ns.gossip_port,
+        gossip_fanout=ns.gossip_fanout,
+        gossip_max_seen_count=ns.gossip_max_seen_count,
+        failure_detection_interval_ms=ns.failure_detection_interval,
+        compaction_factor=ns.compaction_factor,
+        page_cache_size=ns.page_cache_size,
+        wal_sync_delay_us=ns.wal_sync_delay,
+        wal_sync=ns.wal_sync,
+        sstable_bloom_min_size=ns.sstable_bloom_min_size,
+        foreground_tasks_shares=ns.foreground_tasks_shares,
+        background_tasks_shares=ns.background_tasks_shares,
+        shards=ns.shards,
+        compaction_backend=ns.compaction_backend,
+        memtable_capacity=ns.memtable_capacity,
+    )

@@ -1,0 +1,76 @@
+"""Error taxonomy.
+
+Mirrors the reference's error system (/root/reference/src/error.rs:8-74 and
+db_server.rs:34-48): every error has a stable *kind name* that crosses the
+wire as ``ResponseError{name, message}`` so clients compare by kind, never
+by message text.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+class DbeelError(Exception):
+    """Base error. ``kind`` is the stable wire name."""
+
+    kind = "Internal"
+
+    def to_wire(self) -> List[Any]:
+        # rmp-serde encodes the reference's ResponseError struct as a
+        # 2-array [name, message]; keep that shape for client parity.
+        return [self.kind, str(self)]
+
+
+def _mk(kind_name: str, doc: str) -> type:
+    return type(
+        kind_name, (DbeelError,), {"kind": kind_name, "__doc__": doc}
+    )
+
+
+ShardStopped = _mk("ShardStopped", "The shard is shutting down.")
+CollectionNotFound = _mk("CollectionNotFound", "No such collection.")
+CollectionAlreadyExists = _mk(
+    "CollectionAlreadyExists", "Collection already exists."
+)
+KeyNotFound = _mk("KeyNotFound", "No live entry for key (or tombstoned).")
+KeyNotOwnedByShard = _mk(
+    "KeyNotOwnedByShard",
+    "This shard is not an owner of the key's hash ring range.",
+)
+MissingField = _mk("MissingField", "Required request field is missing.")
+BadFieldType = _mk("BadFieldType", "Request field has the wrong type.")
+UnsupportedField = _mk("UnsupportedField", "Unknown request type.")
+MemtableCapacityReached = _mk(
+    "MemtableCapacityReached", "Arena memtable is at capacity."
+)
+Timeout = _mk("Timeout", "Operation timed out.")
+ConnectionError_ = _mk("ConnectionError", "Network failure talking to shard.")
+ProtocolError = _mk("ProtocolError", "Malformed frame or message.")
+CorruptedFile = _mk("CorruptedFile", "On-disk structure failed validation.")
+NoRemoteShardsFound = _mk(
+    "NoRemoteShardsFound", "Not enough distinct nodes for replication."
+)
+TooManyWalFiles = _mk(
+    "TooManyWalFiles", "More than two WAL files found on open."
+)
+
+_BY_KIND = {
+    cls.kind: cls
+    for cls in list(globals().values())
+    if isinstance(cls, type) and issubclass(cls, DbeelError)
+}
+
+
+def from_wire(payload: Any) -> DbeelError:
+    """Rebuild a typed error from a wire ``[name, message]`` payload."""
+    try:
+        name, message = payload[0], payload[1]
+    except Exception:
+        return DbeelError(f"unparseable error payload: {payload!r}")
+    cls = _BY_KIND.get(name)
+    if cls is None:
+        err = DbeelError(message)
+        err.kind = name
+        return err
+    return cls(message)

@@ -1,0 +1,70 @@
+"""Flow events — deterministic test synchronization hooks.
+
+Mirrors the reference's feature-gated flow-events pub/sub
+(/root/reference/src/flow_events.rs:5-14, shards.rs:1202-1223): tests never
+sleep; they subscribe to named code-path milestones and block on them.
+Disabled (near-zero cost) unless ``enable()`` is called — the analog of the
+reference compiling the macro out of release builds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from collections import defaultdict
+from typing import Dict, List
+
+
+class FlowEvent(enum.Enum):
+    # Reference milestones (flow_events.rs:7-14).
+    START_TASKS = "StartTasks"
+    ALIVE_NODE_GOSSIP = "AliveNodeGossip"
+    DEAD_NODE_REMOVED = "DeadNodeRemoved"
+    COLLECTION_CREATED = "CollectionCreated"
+    COLLECTION_DROPPED = "CollectionDropped"
+    DONE_MIGRATION = "DoneMigration"
+    ITEM_SET_FROM_SHARD_MESSAGE = "ItemSetFromShardMessage"
+    # Rebuild-specific milestones.
+    MEMTABLE_FLUSH_DONE = "MemtableFlushDone"
+    COMPACTION_DONE = "CompactionDone"
+    WAL_SYNCED = "WalSynced"
+
+
+_enabled = False
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class FlowEventNotifier:
+    """Per-shard notifier. Sticky per subscription: each ``subscribe()``
+    returns a fresh future resolved by the next ``notify`` of that event."""
+
+    def __init__(self) -> None:
+        self._waiters: Dict[FlowEvent, List[asyncio.Future]] = defaultdict(
+            list
+        )
+
+    def subscribe(self, event: FlowEvent) -> "asyncio.Future[None]":
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters[event].append(fut)
+        return fut
+
+    def notify(self, event: FlowEvent) -> None:
+        if not _enabled:
+            return
+        waiters = self._waiters.pop(event, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)

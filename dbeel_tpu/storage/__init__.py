@@ -1,0 +1,15 @@
+"""L2/L3: I/O substrate + LSM-tree storage engine."""
+
+from .entry import (  # noqa: F401
+    ENTRY_HEADER,
+    INDEX_ENTRY,
+    INDEX_ENTRY_SIZE,
+    PAGE_SIZE,
+    TOMBSTONE,
+    decode_entry,
+    encode_entry,
+)
+from .lsm_tree import LSMTree  # noqa: F401
+
+DEFAULT_TREE_CAPACITY = 8192  # reference storage_engine/mod.rs:18
+DEFAULT_SSTABLE_BLOOM_MIN_SIZE = 1 << 20  # mod.rs:19

@@ -1,0 +1,264 @@
+"""CompactionStrategy seam — pluggable merge backends.
+
+The reference hard-codes a single-threaded k-way BinaryHeap merge
+(/root/reference/src/storage_engine/lsm_tree.rs:1003-1066).  Here the
+merge is a strategy (SURVEY.md §7 stage 3):
+
+  * HeapMergeStrategy    — the reference-semantics oracle: per-entry heap
+                           pop/push, streamed through EntryWriter.
+  * ColumnarMergeStrategy — vectorized host path: bulk columnarize, one
+                           numpy lexsort + dedup mask, range-gather, bulk
+                           write.
+  * DeviceMergeStrategy  — (dbeel_tpu.ops.device_compaction) same pipeline
+                           with the sort+dedup kernel jitted on the TPU.
+  * NativeMergeStrategy  — (dbeel_tpu.storage.native) C++ k-way merge.
+
+All strategies must produce byte-identical SSTable files — golden tests
+enforce it.  A strategy writes the ``compact_*`` triplet; the LSM tree
+owns the journal/rename/swap choreography around it.
+"""
+
+from __future__ import annotations
+
+import heapq
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import columnar
+from .bloom import BloomFilter
+from .entry import (
+    COMPACT_BLOOM_FILE_EXT,
+    COMPACT_DATA_FILE_EXT,
+    COMPACT_INDEX_FILE_EXT,
+    ENTRY_HEADER_SIZE,
+    INDEX_ENTRY,
+    file_name,
+)
+from .entry_writer import EntryWriter
+from .file_io import PageMirroringWriter
+from .page_cache import PartitionPageCache
+from .sstable import SSTable
+
+
+@dataclass
+class MergeResult:
+    entry_count: int
+    data_size: int
+    wrote_bloom: bool
+
+
+class CompactionStrategy(ABC):
+    name = "abstract"
+
+    @abstractmethod
+    def merge(
+        self,
+        sources: Sequence[SSTable],
+        dir_path: str,
+        output_index: int,
+        cache: Optional[PartitionPageCache],
+        keep_tombstones: bool,
+        bloom_min_size: int,
+    ) -> MergeResult:
+        """Merge ``sources`` (oldest→newest) into the compact_* triplet at
+        ``output_index``. Bloom file written iff final data size >=
+        ``bloom_min_size`` (lsm_tree.rs:1026-1034)."""
+
+
+class HeapMergeStrategy(CompactionStrategy):
+    """Reference-semantics oracle (lsm_tree.rs:1038-1066): min-heap by
+    (key, newest-ts-first, newest-source-first); pop, write first per key,
+    skip the rest; optional tombstone drop."""
+
+    name = "heap"
+
+    def merge(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ) -> MergeResult:
+        writer = EntryWriter(
+            dir_path,
+            output_index,
+            cache,
+            data_ext=COMPACT_DATA_FILE_EXT,
+            index_ext=COMPACT_INDEX_FILE_EXT,
+        )
+        iters = [iter(t.entries()) for t in sources]
+        heap: List[Tuple] = []
+        for i, it in enumerate(iters):
+            for key, value, ts in it:
+                # (~ts, -i): newest timestamp first, tie toward the
+                # newer (higher-positioned) source.
+                heapq.heappush(heap, (key, ~ts, -i, value, i))
+                break
+        keys: List[bytes] = []
+        last_key: Optional[bytes] = None
+        while heap:
+            key, _nts, _ni, value, i = heapq.heappop(heap)
+            for nkey, nvalue, nts in iters[i]:
+                heapq.heappush(heap, (nkey, ~nts, -i, nvalue, i))
+                break
+            if key == last_key:
+                continue  # dedup: first occurrence was the newest
+            last_key = key
+            if value == b"" and not keep_tombstones:
+                continue
+            writer.write(key, value, ~_nts)
+            keys.append(key)
+        data_size = writer.close()
+        wrote_bloom = False
+        if data_size >= bloom_min_size:
+            bloom = BloomFilter.with_capacity(max(1, len(keys)))
+            bloom.add_batch(keys)
+            _write_bloom(dir_path, output_index, bloom)
+            wrote_bloom = True
+        return MergeResult(writer.entries_written, data_size, wrote_bloom)
+
+
+class ColumnarMergeStrategy(CompactionStrategy):
+    """Vectorized host path; also the template the device strategy fills
+    in (it overrides ``sort_and_dedup``)."""
+
+    name = "columnar"
+
+    def sort_and_dedup(
+        self, cols: columnar.MergeColumns
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        perm = columnar.sort_columns_numpy(cols)
+        perm = columnar.fixup_long_key_ties(cols, perm)
+        return perm, columnar.dedup_mask(cols, perm)
+
+    def merge(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ) -> MergeResult:
+        cols = columnar.load_columns(sources)
+        perm, keep = self.sort_and_dedup(cols)
+        if not keep_tombstones:
+            keep = keep & ~cols.is_tombstone[perm]
+        order = perm[keep]
+        return write_output_columnar(
+            cols, order, dir_path, output_index, cache, bloom_min_size
+        )
+
+
+def write_output_columnar(
+    cols: columnar.MergeColumns,
+    order: np.ndarray,
+    dir_path: str,
+    output_index: int,
+    cache: Optional[PartitionPageCache],
+    bloom_min_size: int,
+) -> MergeResult:
+    """Bulk-write the compact_* triplet from a surviving-record order."""
+    full_sizes = cols.full_size[order].astype(np.uint64)
+    data_size = int(full_sizes.sum())
+    n = int(order.size)
+
+    # Index columns: offsets are the running sum of record sizes.
+    offsets = np.zeros(n, dtype=np.uint64)
+    if n > 1:
+        np.cumsum(full_sizes[:-1], out=offsets[1:])
+    index_arr = np.zeros(
+        n,
+        dtype=np.dtype(
+            [("offset", "<u8"), ("key_size", "<u4"), ("full_size", "<u4")]
+        ),
+    )
+    index_arr["offset"] = offsets
+    index_arr["key_size"] = cols.key_size[order]
+    index_arr["full_size"] = cols.full_size[order]
+
+    data_bytes = columnar.gather_records(cols, order)
+
+    from .entry import DATA_FILE_EXT, INDEX_FILE_EXT
+
+    data_w = PageMirroringWriter(
+        f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}",
+        (DATA_FILE_EXT, output_index),
+        cache,
+    )
+    data_w.write(data_bytes)
+    data_w.close()
+    index_w = PageMirroringWriter(
+        f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}",
+        (INDEX_FILE_EXT, output_index),
+        cache,
+    )
+    index_w.write(index_arr.tobytes())
+    index_w.close()
+
+    wrote_bloom = False
+    if data_size >= bloom_min_size:
+        key_pos = columnar.ranges_to_positions(
+            cols.start[order] + np.uint64(ENTRY_HEADER_SIZE),
+            cols.key_size[order],
+        )
+        key_blob = cols.data[key_pos].tobytes()
+        key_sizes = cols.key_size[order]
+        bounds = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(key_sizes, out=bounds[1:])
+        keys = [
+            key_blob[bounds[i] : bounds[i + 1]] for i in range(n)
+        ]
+        bloom = BloomFilter.with_capacity(max(1, n))
+        bloom.add_batch(keys)
+        _write_bloom(dir_path, output_index, bloom)
+        wrote_bloom = True
+    return MergeResult(n, data_size, wrote_bloom)
+
+
+def _write_bloom(dir_path: str, output_index: int, bloom: BloomFilter):
+    path = f"{dir_path}/{file_name(output_index, COMPACT_BLOOM_FILE_EXT)}"
+    import os
+
+    with open(path, "wb") as f:
+        f.write(bloom.serialize())
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def get_strategy(name: str) -> CompactionStrategy:
+    """Resolve a strategy by config name (config.compaction_backend)."""
+    if name == "heap":
+        return HeapMergeStrategy()
+    if name == "cpu" or name == "columnar":
+        return ColumnarMergeStrategy()
+    if name == "native":
+        try:
+            from .native import NativeMergeStrategy, native_available
+        except ImportError:
+            return ColumnarMergeStrategy()
+        if native_available():
+            return NativeMergeStrategy()
+        return ColumnarMergeStrategy()
+    if name == "device":
+        try:
+            from ..ops.device_compaction import DeviceMergeStrategy
+        except ImportError:
+            return ColumnarMergeStrategy()
+        return DeviceMergeStrategy()
+    if name == "auto":
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+        if platform != "cpu":
+            return get_strategy("device")
+        return get_strategy("native")
+    raise ValueError(f"unknown compaction backend {name!r}")

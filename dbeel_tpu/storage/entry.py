@@ -1,0 +1,69 @@
+"""On-disk entry formats.
+
+Role parity with /root/reference/src/storage_engine/mod.rs:14-95 (Entry /
+EntryValue / EntryOffset / TOMBSTONE / file-extension registry), with our
+own fixed-width little-endian layout chosen for zero-copy numpy views —
+the whole data or index file parses into column arrays in one
+``np.frombuffer`` for the device compaction path.
+
+Data file record:
+    [u32 key_len][u32 value_len][i64 timestamp_ns][key bytes][value bytes]
+Index file record (16 bytes, like the reference's INDEX_ENTRY_SIZE):
+    [u64 offset][u32 key_size][u32 full_size]
+
+``full_size`` covers the whole data record including its 16-byte header.
+An empty value is the tombstone (reference TOMBSTONE = vec![]; legitimate
+document values are msgpack-encoded and therefore never empty).
+
+Ordering invariant (mod.rs:75-81): entries sort by key, ties by timestamp;
+within one file keys are unique and ascending.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+PAGE_SIZE = 4096  # reference page_cache.rs:10
+
+ENTRY_HEADER = struct.Struct("<IIq")  # key_len, value_len, timestamp_ns
+ENTRY_HEADER_SIZE = ENTRY_HEADER.size  # 16
+INDEX_ENTRY = struct.Struct("<QII")  # offset, key_size, full_size
+INDEX_ENTRY_SIZE = INDEX_ENTRY.size  # 16
+
+TOMBSTONE = b""
+
+# File extensions (mod.rs:23-30).
+MEMTABLE_FILE_EXT = "memtable"
+DATA_FILE_EXT = "data"
+INDEX_FILE_EXT = "index"
+BLOOM_FILE_EXT = "bloom"
+COMPACT_DATA_FILE_EXT = "compact_data"
+COMPACT_INDEX_FILE_EXT = "compact_index"
+COMPACT_BLOOM_FILE_EXT = "compact_bloom"
+COMPACT_ACTION_FILE_EXT = "compact_action"
+
+# Zero-padded index in file names so lexicographic order == numeric order
+# (reference INDEX_PADDING = 20, mod.rs:21).
+INDEX_PADDING = 20
+
+
+def file_name(index: int, ext: str) -> str:
+    return f"{index:0{INDEX_PADDING}}.{ext}"
+
+
+def encode_entry(key: bytes, value: bytes, timestamp: int) -> bytes:
+    return ENTRY_HEADER.pack(len(key), len(value), timestamp) + key + value
+
+
+def decode_entry(buf, offset: int = 0) -> Tuple[bytes, bytes, int, int]:
+    """Returns (key, value, timestamp, total_size)."""
+    key_len, value_len, ts = ENTRY_HEADER.unpack_from(buf, offset)
+    ko = offset + ENTRY_HEADER_SIZE
+    key = bytes(buf[ko : ko + key_len])
+    value = bytes(buf[ko + key_len : ko + key_len + value_len])
+    return key, value, ts, ENTRY_HEADER_SIZE + key_len + value_len
+
+
+def entry_size(key: bytes, value: bytes) -> int:
+    return ENTRY_HEADER_SIZE + len(key) + len(value)

@@ -1,0 +1,548 @@
+"""LSM tree — the single-shard storage engine.
+
+Role parity with /root/reference/src/storage_engine/lsm_tree.rs:
+memtable(active + flushing) / WAL / SSTable(data+index+bloom) triplets;
+get via memtables → bloom → per-sstable binary search newest→oldest;
+set → WAL (page-padded record) + memtable with auto-flush at capacity;
+pluggable merge compaction (strategy seam) with tombstone drop on the
+bottom level; crash safety via (1) WAL replay, (2) the two-WAL flush
+protocol, (3) an idempotent compact-action journal; snapshot-consistent
+iteration with reader-drain before input deletion.
+
+Index numbering follows the reference: flushed sstables take even indices
+0,2,4,…; a flush first creates WAL index+2, writes sstable ``index``,
+then deletes WAL ``index`` (lsm_tree.rs:854-921); compaction outputs take
+``max(inputs)+1`` (odd), which ranks them correctly between the remaining
+older and newer tables.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import re
+import shutil
+from typing import AsyncIterator, Callable, List, Optional, Sequence, Tuple
+
+import msgpack
+
+from .. import flow_events
+from ..errors import CorruptedFile, MemtableCapacityReached, TooManyWalFiles
+from ..utils.event import LocalEvent
+from ..utils.timestamps import now_nanos
+from . import wal as wal_mod
+from .bloom import BloomFilter
+from .compaction import CompactionStrategy, HeapMergeStrategy, MergeResult
+from .entry import (
+    BLOOM_FILE_EXT,
+    COMPACT_ACTION_FILE_EXT,
+    COMPACT_BLOOM_FILE_EXT,
+    COMPACT_DATA_FILE_EXT,
+    COMPACT_INDEX_FILE_EXT,
+    DATA_FILE_EXT,
+    INDEX_FILE_EXT,
+    MEMTABLE_FILE_EXT,
+    TOMBSTONE,
+    file_name,
+)
+from .entry_writer import EntryWriter
+from .memtable import Memtable
+from .page_cache import PartitionPageCache
+from .sstable import SSTable
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TREE_CAPACITY = 8192  # reference mod.rs:18
+DEFAULT_BLOOM_MIN_SIZE = 1 << 20
+
+_FILE_RE = re.compile(r"^(\d{20})\.(\w+)$")
+
+
+class SSTableList:
+    """Refcounted sstable vector: compaction swaps the list and waits
+    until readers drain before deleting inputs (lsm_tree.rs:1141-1145)."""
+
+    def __init__(self, tables: List[SSTable]) -> None:
+        self.tables = sorted(tables, key=lambda t: t.index)
+        self.readers = 0
+        self.drained = LocalEvent()
+
+    def acquire(self) -> None:
+        self.readers += 1
+
+    def release(self) -> None:
+        self.readers -= 1
+        if self.readers == 0:
+            self.drained.notify()
+
+
+class LSMTree:
+    def __init__(
+        self,
+        dir_path: str,
+        cache: Optional[PartitionPageCache] = None,
+        capacity: int = DEFAULT_TREE_CAPACITY,
+        wal_sync: bool = False,
+        wal_sync_delay_us: int = 0,
+        bloom_min_size: int = DEFAULT_BLOOM_MIN_SIZE,
+        strategy: Optional[CompactionStrategy] = None,
+    ) -> None:
+        self.dir_path = dir_path
+        self.cache = cache
+        self.capacity = capacity
+        self.wal_sync = wal_sync
+        self.wal_sync_delay_us = wal_sync_delay_us
+        self.bloom_min_size = bloom_min_size
+        self.strategy = strategy or HeapMergeStrategy()
+
+        self._active: Memtable = Memtable(capacity)
+        self._flushing: Optional[Memtable] = None
+        self._sstables = SSTableList([])
+        self._wal: Optional[wal_mod.Wal] = None
+        self._index = 0  # next flush sstable index (even)
+        self._is_flushing = False
+        # (flush_index, old_wal) of a swap whose sstable write hasn't
+        # committed yet; survives a failed attempt so the next flush()
+        # retries it instead of clobbering the flushing memtable.
+        self._pending_flush: Optional[Tuple[int, wal_mod.Wal]] = None
+
+        self.flush_start_event = LocalEvent()
+        self.flush_done_event = LocalEvent()
+        self.flow = flow_events.FlowEventNotifier()
+
+    # ------------------------------------------------------------------
+    # Open / recovery (lsm_tree.rs:401-545)
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def open_or_create(cls, dir_path: str, **kwargs) -> "LSMTree":
+        tree = cls(dir_path, **kwargs)
+        tree._open()
+        return tree
+
+    def _scan_dir(self):
+        by_ext: dict = {}
+        for name in os.listdir(self.dir_path):
+            m = _FILE_RE.match(name)
+            if m:
+                by_ext.setdefault(m.group(2), []).append(int(m.group(1)))
+        return by_ext
+
+    def _open(self) -> None:
+        os.makedirs(self.dir_path, exist_ok=True)
+
+        # (1) Idempotent compact-action journal replay (424-438).
+        for name in sorted(os.listdir(self.dir_path)):
+            if name.endswith("." + COMPACT_ACTION_FILE_EXT):
+                self._replay_compact_action(
+                    os.path.join(self.dir_path, name)
+                )
+
+        # Orphaned compact_* outputs (crash before the journal was
+        # written) are garbage: delete them.
+        for name in os.listdir(self.dir_path):
+            m = _FILE_RE.match(name)
+            if m and m.group(2) in (
+                COMPACT_DATA_FILE_EXT,
+                COMPACT_INDEX_FILE_EXT,
+                COMPACT_BLOOM_FILE_EXT,
+            ):
+                os.unlink(os.path.join(self.dir_path, name))
+
+        by_ext = self._scan_dir()
+        data_indices = sorted(
+            set(by_ext.get(DATA_FILE_EXT, []))
+            & set(by_ext.get(INDEX_FILE_EXT, []))
+        )
+        wal_indices = sorted(by_ext.get(MEMTABLE_FILE_EXT, []))
+
+        if len(wal_indices) > 2:
+            raise TooManyWalFiles(
+                f"{len(wal_indices)} WAL files in {self.dir_path}"
+            )
+
+        # (2) Two-WAL flush protocol (478-513): two WALs mean a flush of
+        # the older one was interrupted — complete it now.
+        if len(wal_indices) == 2:
+            older, newer = wal_indices
+            if newer != older + 2:
+                raise CorruptedFile(
+                    f"unexpected WAL pair {wal_indices} in {self.dir_path}"
+                )
+            recovered = Memtable(max(self.capacity, 1 << 30))
+            for key, value, ts in wal_mod.replay(self._wal_path(older)):
+                recovered.set(key, value, ts)
+            if len(recovered):
+                self._write_sstable_from_items(
+                    older, list(recovered.items())
+                )
+                if older not in data_indices:
+                    data_indices.append(older)
+                    data_indices.sort()
+            os.unlink(self._wal_path(older))
+            wal_indices = [newer]
+
+        # (3) Load sstables.
+        self._sstables = SSTableList(
+            [
+                SSTable(self.dir_path, i, self.cache)
+                for i in data_indices
+            ]
+        )
+
+        # (4) WAL replay into the active memtable (552-574).
+        if wal_indices:
+            self._index = wal_indices[0]
+            replayed = Memtable(max(self.capacity, 1 << 30))
+            for key, value, ts in wal_mod.replay(
+                self._wal_path(self._index)
+            ):
+                replayed.set(key, value, ts)
+            self._active = Memtable(
+                max(self.capacity, len(replayed) + 1)
+            )
+            for key, (value, ts) in replayed.items():
+                self._active.set(key, value, ts)
+        else:
+            self._index = (
+                (max(data_indices) // 2 + 1) * 2 if data_indices else 0
+            )
+        self._wal = wal_mod.Wal(
+            self._wal_path(self._index),
+            sync=self.wal_sync,
+            sync_delay_us=self.wal_sync_delay_us,
+        )
+
+    def _wal_path(self, index: int) -> str:
+        return os.path.join(
+            self.dir_path, file_name(index, MEMTABLE_FILE_EXT)
+        )
+
+    def _replay_compact_action(self, path: str) -> None:
+        try:
+            with open(path, "rb") as f:
+                action = msgpack.unpackb(f.read(), raw=False)
+        except Exception:
+            os.unlink(path)  # torn journal write: compaction never
+            return  # committed; inputs are all still live.
+        for src, dst in action.get("renames", []):
+            if os.path.exists(src):
+                os.replace(src, dst)
+        for victim in action.get("deletes", []):
+            if os.path.exists(victim):
+                os.unlink(victim)
+        os.unlink(path)
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+        for t in self._sstables.tables:
+            t.close()
+
+    # ------------------------------------------------------------------
+    # Reads (lsm_tree.rs:674-723)
+    # ------------------------------------------------------------------
+
+    def get_entry_sync(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        """(value, timestamp) including tombstones, or None."""
+        hit = self._active.get(key)
+        if hit is not None:
+            return hit
+        if self._flushing is not None:
+            hit = self._flushing.get(key)
+            if hit is not None:
+                return hit
+        for table in reversed(self._sstables.tables):
+            if not table.maybe_contains(key):
+                continue
+            hit = table.get(key)
+            if hit is not None:
+                return hit
+        return None
+
+    async def get_entry(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        return self.get_entry_sync(key)
+
+    async def get(self, key: bytes) -> Optional[bytes]:
+        """Live value or None (tombstone = None)."""
+        hit = self.get_entry_sync(key)
+        if hit is None or hit[0] == TOMBSTONE:
+            return None
+        return hit[0]
+
+    # ------------------------------------------------------------------
+    # Writes (lsm_tree.rs:731-837)
+    # ------------------------------------------------------------------
+
+    async def set(self, key: bytes, value: bytes) -> None:
+        await self.set_with_timestamp(key, value, now_nanos())
+
+    async def set_with_timestamp(
+        self, key: bytes, value: bytes, timestamp: int
+    ) -> None:
+        while True:
+            try:
+                self._active.set(key, value, timestamp)
+                break
+            except MemtableCapacityReached:
+                # Wait for a flush to swap in a fresh memtable
+                # (lsm_tree.rs:747-755).
+                waiter = self.flush_start_event.listen()
+                self._spawn_flush()
+                await waiter
+        assert self._wal is not None
+        await self._wal.append(key, value, timestamp)
+        if self._active.is_full():
+            self._spawn_flush()
+
+    async def delete(self, key: bytes) -> None:
+        await self.set_with_timestamp(key, TOMBSTONE, now_nanos())
+
+    async def delete_with_timestamp(self, key: bytes, timestamp: int):
+        await self.set_with_timestamp(key, TOMBSTONE, timestamp)
+
+    # ------------------------------------------------------------------
+    # Flush (lsm_tree.rs:844-946)
+    # ------------------------------------------------------------------
+
+    def _spawn_flush(self) -> None:
+        asyncio.ensure_future(self.flush())
+
+    async def flush(self) -> None:
+        while self._is_flushing:
+            await self.flush_done_event.listen()
+        if self._pending_flush is None and len(self._active) == 0:
+            return
+        self._is_flushing = True
+        try:
+            if self._pending_flush is None:
+                flush_index = self._index
+                next_index = flush_index + 2
+                # Two-WAL protocol: the next WAL must exist before the
+                # sstable write starts (lsm_tree.rs:854-873).
+                new_wal = wal_mod.Wal(
+                    self._wal_path(next_index),
+                    sync=self.wal_sync,
+                    sync_delay_us=self.wal_sync_delay_us,
+                )
+                assert self._wal is not None
+                self._pending_flush = (flush_index, self._wal)
+                self._flushing = self._active
+                self._active = Memtable(self.capacity)
+                self._wal = new_wal
+                self._index = next_index
+                self.flush_start_event.notify()
+
+            flush_index, old_wal = self._pending_flush
+            assert self._flushing is not None
+            items = list(self._flushing.items())
+            await asyncio.get_event_loop().run_in_executor(
+                None, self._write_sstable_from_items, flush_index, items
+            )
+            table = SSTable(self.dir_path, flush_index, self.cache)
+            self._sstables = SSTableList(
+                self._sstables.tables + [table]
+            )
+            self._flushing = None
+            self._pending_flush = None
+            old_wal.delete()
+        finally:
+            self._is_flushing = False
+            self.flush_done_event.notify()
+            self.flow.notify(flow_events.FlowEvent.MEMTABLE_FLUSH_DONE)
+
+    def _write_sstable_from_items(
+        self, index: int, items: Sequence[Tuple[bytes, Tuple[bytes, int]]]
+    ) -> None:
+        """Write a live (non-compact) sstable triplet from sorted items.
+        Runs off-loop during flush: mirrors no pages (cache is loop-owned);
+        the freshly-written table warms on first read instead."""
+        writer = EntryWriter(self.dir_path, index, cache=None)
+        data_size = sum(16 + len(k) + len(v) for k, (v, _) in items)
+        bloom = (
+            BloomFilter.with_capacity(max(1, len(items)))
+            if data_size >= self.bloom_min_size
+            else None
+        )
+        for key, (value, ts) in items:
+            writer.write(key, value, ts)
+        writer.close()
+        if bloom is not None:
+            bloom.add_batch([k for k, _ in items])
+            with open(
+                os.path.join(
+                    self.dir_path, file_name(index, BLOOM_FILE_EXT)
+                ),
+                "wb",
+            ) as f:
+                f.write(bloom.serialize())
+                f.flush()
+                os.fsync(f.fileno())
+
+    # ------------------------------------------------------------------
+    # Compaction (lsm_tree.rs:950-1156)
+    # ------------------------------------------------------------------
+
+    def sstable_indices_and_sizes(self) -> List[Tuple[int, int]]:
+        return [
+            (t.index, t.data_size) for t in self._sstables.tables
+        ]
+
+    async def compact(
+        self,
+        indices: Sequence[int],
+        output_index: int,
+        keep_tombstones: bool,
+    ) -> None:
+        index_set = set(indices)
+        inputs = [
+            t for t in self._sstables.tables if t.index in index_set
+        ]
+        if len(inputs) != len(index_set):
+            raise ValueError(
+                f"compact: missing inputs {index_set} in "
+                f"{[t.index for t in self._sstables.tables]}"
+            )
+        if not inputs:
+            return
+
+        # Merge runs off-loop so reads/writes stay responsive; it gets
+        # cache-free sstable handles (the page cache is loop-owned).
+        inputs_nocache = [
+            SSTable(self.dir_path, t.index, None) for t in inputs
+        ]
+        try:
+            result = await asyncio.get_event_loop().run_in_executor(
+                None,
+                self.strategy.merge,
+                inputs_nocache,
+                self.dir_path,
+                output_index,
+                None,
+                keep_tombstones,
+                self.bloom_min_size,
+            )
+        finally:
+            for t in inputs_nocache:
+                t.close()
+
+        # Journal {renames, deletes}, fsync, then apply (1090-1111).
+        renames = [
+            [
+                os.path.join(
+                    self.dir_path,
+                    file_name(output_index, COMPACT_DATA_FILE_EXT),
+                ),
+                os.path.join(
+                    self.dir_path, file_name(output_index, DATA_FILE_EXT)
+                ),
+            ],
+            [
+                os.path.join(
+                    self.dir_path,
+                    file_name(output_index, COMPACT_INDEX_FILE_EXT),
+                ),
+                os.path.join(
+                    self.dir_path, file_name(output_index, INDEX_FILE_EXT)
+                ),
+            ],
+        ]
+        if result.wrote_bloom:
+            renames.append(
+                [
+                    os.path.join(
+                        self.dir_path,
+                        file_name(output_index, COMPACT_BLOOM_FILE_EXT),
+                    ),
+                    os.path.join(
+                        self.dir_path,
+                        file_name(output_index, BLOOM_FILE_EXT),
+                    ),
+                ]
+            )
+        deletes = [p for t in inputs for p in t.paths()]
+        action_path = os.path.join(
+            self.dir_path, file_name(output_index, COMPACT_ACTION_FILE_EXT)
+        )
+        with open(action_path, "wb") as f:
+            f.write(
+                msgpack.packb(
+                    {"renames": renames, "deletes": deletes},
+                    use_bin_type=True,
+                )
+            )
+            f.flush()
+            os.fsync(f.fileno())
+
+        for src, dst in renames:
+            os.replace(src, dst)
+
+        old_list = self._sstables
+        survivors = [
+            t for t in self._sstables.tables if t.index not in index_set
+        ]
+        survivors.append(SSTable(self.dir_path, output_index, self.cache))
+        self._sstables = SSTableList(survivors)
+
+        # Reader drain before deleting inputs (1141-1145).
+        while old_list.readers > 0:
+            await old_list.drained.listen()
+        for t in inputs:
+            t.close()
+            if self.cache is not None:
+                self.cache.invalidate_file((DATA_FILE_EXT, t.index))
+                self.cache.invalidate_file((INDEX_FILE_EXT, t.index))
+        for victim in deletes:
+            if os.path.exists(victim):
+                os.unlink(victim)
+        os.unlink(action_path)
+        self.flow.notify(flow_events.FlowEvent.COMPACTION_DONE)
+
+    # ------------------------------------------------------------------
+    # Iteration (lsm_tree.rs:141-282) — sstables oldest→newest, then the
+    # memtables; duplicates possible, consumers resolve by timestamp.
+    # ------------------------------------------------------------------
+
+    async def iter_filter(
+        self,
+        filter_fn: Optional[Callable[[bytes, bytes, int], bool]] = None,
+    ) -> AsyncIterator[Tuple[bytes, bytes, int]]:
+        # Snapshot the memtables NOW, before any await, exactly like the
+        # reference snapshots them at AsyncIter construction (lsm_tree.rs
+        # :155-172) — a flush completing mid-iteration must not make
+        # entries vanish from the view.
+        memtable_items: List[Tuple[bytes, bytes, int]] = []
+        if self._flushing is not None:
+            memtable_items.extend(
+                (k, v, ts) for k, (v, ts) in self._flushing.items()
+            )
+        memtable_items.extend(
+            (k, v, ts) for k, (v, ts) in self._active.items()
+        )
+        snapshot = self._sstables
+        snapshot.acquire()
+        try:
+            for table in snapshot.tables:
+                count = 0
+                for key, value, ts in table.entries():
+                    if filter_fn is None or filter_fn(key, value, ts):
+                        yield key, value, ts
+                    count += 1
+                    if count % 256 == 0:
+                        await asyncio.sleep(0)
+            for key, value, ts in memtable_items:
+                if filter_fn is None or filter_fn(key, value, ts):
+                    yield key, value, ts
+        finally:
+            snapshot.release()
+
+    def iter(self) -> AsyncIterator[Tuple[bytes, bytes, int]]:
+        return self.iter_filter(None)
+
+    # ------------------------------------------------------------------
+
+    async def purge(self) -> None:
+        """Delete the tree from disk (drop collection, shards.rs:369-381)."""
+        self.close()
+        shutil.rmtree(self.dir_path, ignore_errors=True)

@@ -1,0 +1,69 @@
+"""Capacity-bounded sorted memtable.
+
+Role parity with the reference's arena red-black tree
+(/root/reference/rbtree_arena/src/lib.rs:308-649): sorted in-memory map
+with a hard capacity that drives the flush trigger (set errors / waits at
+capacity, lsm_tree.rs:747-755), in-order forward iteration, and a
+consuming drain for flush.
+
+The idiomatic rebuild uses ``sortedcontainers.SortedDict`` (B-tree-ish
+list-of-lists — the same cache-friendly contiguous-storage idea as the
+arena).  The flush *sort* itself is a no-op here because the structure is
+kept sorted; the device flush path instead drains insertion order and
+sorts on the TPU (ops.sort) — both produce identical SSTables.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from sortedcontainers import SortedDict
+
+from ..errors import MemtableCapacityReached
+
+Item = Tuple[bytes, Tuple[bytes, int]]  # key -> (value, timestamp_ns)
+
+
+class Memtable:
+    def __init__(self, capacity: int) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._map: SortedDict = SortedDict()
+        self.data_bytes = 0  # approximate on-disk size of contents
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def is_full(self) -> bool:
+        return len(self._map) >= self.capacity
+
+    def set(self, key: bytes, value: bytes, timestamp: int) -> None:
+        """Insert/overwrite; errors at capacity for *new* keys, mirroring
+        the arena's capacity error (rbtree_arena/src/lib.rs:7-10)."""
+        prev = self._map.get(key)
+        if prev is None:
+            if len(self._map) >= self.capacity:
+                raise MemtableCapacityReached(
+                    f"memtable at capacity {self.capacity}"
+                )
+            self._map[key] = (value, timestamp)
+            self.data_bytes += 16 + len(key) + len(value)
+        else:
+            # Keep the newest timestamp (reference updates in place).
+            if timestamp >= prev[1]:
+                self._map[key] = (value, timestamp)
+                self.data_bytes += len(value) - len(prev[0])
+
+    def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        return self._map.get(key)
+
+    def items(self) -> Iterator[Item]:
+        """Key-ascending iteration (rbtree in-order iterator)."""
+        return iter(self._map.items())
+
+    def range(
+        self, lo: Optional[bytes] = None, hi: Optional[bytes] = None
+    ) -> Iterator[Item]:
+        for key in self._map.irange(lo, hi):
+            yield key, self._map[key]

@@ -1,0 +1,184 @@
+"""W-TinyLFU page cache of 4 KiB pages.
+
+Role parity with /root/reference/src/storage_engine/page_cache.rs:10-67:
+one cache per shard sized ``page_cache_size / PAGE_SIZE / num_shards``
+pages, partitioned per collection by murmur3 name-hash; cache key =
+(partition-name-hash, (file-type, file-index), page-address).
+
+This is a real W-TinyLFU (same family as the reference's ``wtinylfu``
+crate): a small admission window (LRU) in front of a segmented-LRU main
+region (probation/protected), with a 4-bit count-min sketch deciding
+admission on window eviction and periodic halving ("reset") to age the
+sketch.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .entry import PAGE_SIZE
+from ..utils.murmur import murmur3_32
+
+CacheKey = Tuple[int, Tuple[str, int], int]  # (partition, file id, page addr)
+
+
+def align_down(n: int) -> int:
+    return n & ~(PAGE_SIZE - 1)
+
+
+def align_up(n: int) -> int:
+    return (n + PAGE_SIZE - 1) & ~(PAGE_SIZE - 1)
+
+
+class _CountMinSketch:
+    """4-bit frequency sketch with conservative reset, a la TinyLFU."""
+
+    def __init__(self, capacity: int) -> None:
+        size = 1
+        while size < max(64, capacity):
+            size <<= 1
+        self._mask = size - 1
+        self._table = np.zeros((4, size), dtype=np.uint8)
+        self._ops = 0
+        self._reset_at = 10 * size
+
+    _ROW_SEEDS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F)
+
+    def _rows(self, h: int):
+        for row in range(4):
+            mixed = (h ^ self._ROW_SEEDS[row]) * 0x9E3779B1 & 0xFFFFFFFF
+            yield row, (mixed >> 12) & self._mask
+
+    def increment(self, h: int) -> None:
+        for row, idx in self._rows(h):
+            if self._table[row, idx] < 15:
+                self._table[row, idx] += 1
+        self._ops += 1
+        if self._ops >= self._reset_at:
+            self._table >>= 1
+            self._ops //= 2
+
+    def estimate(self, h: int) -> int:
+        return min(int(self._table[row, idx]) for row, idx in self._rows(h))
+
+
+class PageCache:
+    """Shard-global W-TinyLFU over immutable 4 KiB pages."""
+
+    def __init__(self, capacity_pages: int) -> None:
+        capacity_pages = max(8, capacity_pages)
+        self.capacity = capacity_pages
+        self._window_cap = max(1, capacity_pages // 100)
+        main_cap = capacity_pages - self._window_cap
+        self._protected_cap = max(1, (main_cap * 4) // 5)
+        self._probation_cap = max(1, main_cap - self._protected_cap)
+        self._window: "OrderedDict[CacheKey, bytes]" = OrderedDict()
+        self._probation: "OrderedDict[CacheKey, bytes]" = OrderedDict()
+        self._protected: "OrderedDict[CacheKey, bytes]" = OrderedDict()
+        self._sketch = _CountMinSketch(capacity_pages)
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._window) + len(self._probation) + len(self._protected)
+
+    @staticmethod
+    def _hash(key: CacheKey) -> int:
+        return hash(key) & 0xFFFFFFFFFFFF
+
+    def get(self, key: CacheKey) -> Optional[bytes]:
+        self._sketch.increment(self._hash(key))
+        page = self._window.get(key)
+        if page is not None:
+            self._window.move_to_end(key)
+            self.hits += 1
+            return page
+        page = self._protected.pop(key, None)
+        if page is not None:
+            self._protected[key] = page
+            self.hits += 1
+            return page
+        page = self._probation.pop(key, None)
+        if page is not None:
+            # Promote probation -> protected (SLRU).
+            self._protected[key] = page
+            if len(self._protected) > self._protected_cap:
+                demoted, dpage = self._protected.popitem(last=False)
+                self._insert_probation(demoted, dpage)
+            self.hits += 1
+            return page
+        self.misses += 1
+        return None
+
+    def set(self, key: CacheKey, page: bytes) -> None:
+        assert len(page) == PAGE_SIZE, len(page)
+        if (
+            key in self._window
+            or key in self._probation
+            or key in self._protected
+        ):
+            # Overwrite in place (writers mirror freshly-written pages).
+            for seg in (self._window, self._probation, self._protected):
+                if key in seg:
+                    seg[key] = page
+                    return
+        self._sketch.increment(self._hash(key))
+        self._window[key] = page
+        if len(self._window) > self._window_cap:
+            cand_key, cand_page = self._window.popitem(last=False)
+            self._admit(cand_key, cand_page)
+
+    def _admit(self, key: CacheKey, page: bytes) -> None:
+        if len(self._probation) + len(self._protected) < (
+            self._probation_cap + self._protected_cap
+        ):
+            self._insert_probation(key, page)
+            return
+        victim_key = next(iter(self._probation), None)
+        if victim_key is None:
+            self._insert_probation(key, page)
+            return
+        # TinyLFU admission: candidate must beat the SLRU victim.
+        if self._sketch.estimate(self._hash(key)) > self._sketch.estimate(
+            self._hash(victim_key)
+        ):
+            self._probation.pop(victim_key, None)
+            self._insert_probation(key, page)
+        # else: candidate dropped.
+
+    def _insert_probation(self, key: CacheKey, page: bytes) -> None:
+        self._probation[key] = page
+        while len(self._probation) > self._probation_cap:
+            self._probation.popitem(last=False)
+
+    def invalidate_file(self, partition: int, file_id: Tuple[str, int]):
+        for seg in (self._window, self._probation, self._protected):
+            dead = [k for k in seg if k[0] == partition and k[1] == file_id]
+            for k in dead:
+                del seg[k]
+
+
+class PartitionPageCache:
+    """Per-collection view of the shard cache, keyed by name hash
+    (page_cache.rs:27-67)."""
+
+    def __init__(self, name: str, cache: PageCache) -> None:
+        self._partition = murmur3_32(name.encode("utf-8"), 0)
+        self._cache = cache
+
+    def full_key(self, file_id: Tuple[str, int], address: int) -> CacheKey:
+        return (self._partition, file_id, address)
+
+    def get_copied(
+        self, file_id: Tuple[str, int], address: int
+    ) -> Optional[bytes]:
+        return self._cache.get(self.full_key(file_id, address))
+
+    def set(self, file_id: Tuple[str, int], address: int, page: bytes):
+        self._cache.set(self.full_key(file_id, address), page)
+
+    def invalidate_file(self, file_id: Tuple[str, int]) -> None:
+        self._cache.invalidate_file(self._partition, file_id)

@@ -1,0 +1,135 @@
+"""SSTable reader: data + 16-byte-record index + optional bloom.
+
+Role parity with the reference's SSTable triplet and binary-search read
+path (/root/reference/src/storage_engine/lsm_tree.rs:86-99 struct,
+605-670 binary_search, 690-696 bloom gate).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .bloom import BloomFilter
+from .entry import (
+    BLOOM_FILE_EXT,
+    DATA_FILE_EXT,
+    ENTRY_HEADER,
+    ENTRY_HEADER_SIZE,
+    INDEX_ENTRY,
+    INDEX_ENTRY_SIZE,
+    INDEX_FILE_EXT,
+    decode_entry,
+    file_name,
+)
+from .file_io import CachedFileReader
+from .page_cache import PartitionPageCache
+
+
+class SSTable:
+    def __init__(
+        self,
+        dir_path: str,
+        index: int,
+        cache: Optional[PartitionPageCache],
+    ) -> None:
+        self.dir_path = dir_path
+        self.index = index
+        self.data_path = os.path.join(
+            dir_path, file_name(index, DATA_FILE_EXT)
+        )
+        self.index_path = os.path.join(
+            dir_path, file_name(index, INDEX_FILE_EXT)
+        )
+        self.bloom_path = os.path.join(
+            dir_path, file_name(index, BLOOM_FILE_EXT)
+        )
+        self._data = CachedFileReader(
+            self.data_path, (DATA_FILE_EXT, index), cache
+        )
+        self._index = CachedFileReader(
+            self.index_path, (INDEX_FILE_EXT, index), cache
+        )
+        self.entry_count = self._index.size // INDEX_ENTRY_SIZE
+        self.data_size = self._data.size
+        self.bloom: Optional[BloomFilter] = None
+        try:
+            with open(self.bloom_path, "rb") as f:
+                self.bloom = BloomFilter.deserialize(f.read())
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        self._data.close()
+        self._index.close()
+
+    def paths(self) -> Tuple[str, ...]:
+        return (self.data_path, self.index_path, self.bloom_path)
+
+    # -- point lookup ---------------------------------------------------
+
+    def maybe_contains(self, key: bytes) -> bool:
+        return self.bloom is None or self.bloom.check(key)
+
+    def _index_record(self, i: int) -> Tuple[int, int, int]:
+        raw = self._index.read_at(i * INDEX_ENTRY_SIZE, INDEX_ENTRY_SIZE)
+        return INDEX_ENTRY.unpack(raw)
+
+    def _key_at(self, i: int) -> Tuple[bytes, int, int, int]:
+        offset, key_size, full_size = self._index_record(i)
+        key = self._data.read_at(offset + ENTRY_HEADER_SIZE, key_size)
+        return key, offset, key_size, full_size
+
+    def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        """Binary search (lsm_tree.rs:605-670); returns (value, ts)."""
+        lo, hi = 0, self.entry_count - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            mid_key, offset, key_size, full_size = self._key_at(mid)
+            if mid_key == key:
+                record = self._data.read_at(offset, full_size)
+                _, value, ts, _ = decode_entry(record)
+                return value, ts
+            if mid_key < key:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return None
+
+    # -- sequential access ---------------------------------------------
+
+    def entries(self) -> Iterator[Tuple[bytes, bytes, int]]:
+        """Stream (key, value, ts) in file order via the cached readers
+        (AsyncIter's per-entry walk, lsm_tree.rs:241-271)."""
+        for i in range(self.entry_count):
+            offset, _key_size, full_size = self._index_record(i)
+            record = self._data.read_at(offset, full_size)
+            key, value, ts, _ = decode_entry(record)
+            yield key, value, ts
+
+    # -- bulk columnar access (device compaction path) ------------------
+
+    def read_index_columns(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Whole index file as (offsets u64, key_sizes u32, full_sizes u32)
+        column arrays in one read — the host→device staging format."""
+        with open(self.index_path, "rb") as f:
+            raw = f.read(self.entry_count * INDEX_ENTRY_SIZE)
+        rec = np.frombuffer(
+            raw,
+            dtype=np.dtype(
+                [("offset", "<u8"), ("key_size", "<u4"), ("full_size", "<u4")]
+            ),
+        )
+        return (
+            rec["offset"].copy(),
+            rec["key_size"].copy(),
+            rec["full_size"].copy(),
+        )
+
+    def read_data_bytes(self) -> bytes:
+        """Whole data file in one bulk read (bypasses the page cache on
+        purpose — compaction inputs are about to be deleted)."""
+        with open(self.data_path, "rb") as f:
+            return f.read(self.data_size)

@@ -1,0 +1,3 @@
+from .murmur import hash_bytes, hash_string, murmur3_32  # noqa: F401
+from .event import LocalEvent  # noqa: F401
+from .timestamps import now_nanos  # noqa: F401

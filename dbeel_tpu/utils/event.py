@@ -1,0 +1,38 @@
+"""LocalEvent — single-consumer-loop async event with listen-before-notify
+sticky semantics.
+
+Mirrors the reference's LocalEvent (/root/reference/src/utils/local_event.rs
+:17-100): a listener created *before* a notify observes that notify even if
+it only awaits afterwards; a listener created after misses it.  Used for
+flush start/done, WAL-sync coalescing, and collections-changed signaling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+
+class LocalEvent:
+    def __init__(self) -> None:
+        self._futures: List[asyncio.Future] = []
+
+    def listen(self) -> "asyncio.Future[None]":
+        """Arm a listener now; await the returned future later."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._futures.append(fut)
+        return fut
+
+    async def wait(self) -> None:
+        """Arm and await in one step (misses earlier notifies)."""
+        await self.listen()
+
+    def notify(self) -> int:
+        """Wake every currently-armed listener; returns how many."""
+        woken = 0
+        for fut in self._futures:
+            if not fut.done():
+                fut.set_result(None)
+                woken += 1
+        self._futures.clear()
+        return woken

@@ -1,0 +1,134 @@
+"""MurmurHash3 (32-bit, x86 variant).
+
+The reference places shards and routes keys with ``murmur3_32(bytes, 0)``
+(/root/reference/src/shards.rs:95-101) and partitions the page cache by
+collection-name hash (page_cache.rs:41).  This is an independent
+implementation of the public MurmurHash3 spec (Austin Appleby, public
+domain), plus a numpy-vectorized batch variant used by migration range
+filters and the device compaction path.
+
+A C++ implementation in ``native/`` overrides the scalar path when the
+native library is built (see dbeel_tpu.storage.native).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_M = 0xFFFFFFFF
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    h = seed & _M
+    n = len(data)
+    nblocks = n >> 2
+    for i in range(nblocks):
+        k = int.from_bytes(data[i * 4 : i * 4 + 4], "little")
+        k = (k * _C1) & _M
+        k = ((k << 15) | (k >> 17)) & _M
+        k = (k * _C2) & _M
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & _M
+        h = (h * 5 + 0xE6546B64) & _M
+    tail = data[nblocks * 4 :]
+    k = 0
+    t = len(tail)
+    if t >= 3:
+        k ^= tail[2] << 16
+    if t >= 2:
+        k ^= tail[1] << 8
+    if t >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _M
+        k = ((k << 15) | (k >> 17)) & _M
+        k = (k * _C2) & _M
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M
+    h ^= h >> 16
+    return h
+
+
+def hash_string(s: str, seed: int = 0) -> int:
+    """Ring position of a node/shard name (shards.rs:95-97)."""
+    return murmur3_32(s.encode("utf-8"), seed)
+
+
+def hash_bytes(b: bytes, seed: int = 0) -> int:
+    """Ring position of a msgpack-encoded key (shards.rs:99-101)."""
+    return murmur3_32(b, seed)
+
+
+def murmur3_32_batch(keys: Iterable[bytes], seed: int = 0) -> np.ndarray:
+    """Vectorized murmur3_32 over many byte strings.
+
+    Used by migration (hash every key of an iterator against ring ranges)
+    and the bloom-filter build in the device compaction path.  Groups keys
+    by length so each group hashes as one numpy pipeline.
+    """
+    keys = list(keys)
+    out = np.zeros(len(keys), dtype=np.uint32)
+    by_len: dict = {}
+    for i, k in enumerate(keys):
+        by_len.setdefault(len(k), []).append(i)
+    for n, idxs in by_len.items():
+        buf = np.frombuffer(
+            b"".join(keys[i] for i in idxs), dtype=np.uint8
+        ).reshape(len(idxs), n)
+        out[np.array(idxs)] = _murmur3_32_same_len(buf, seed)
+    return out
+
+
+def _rotl(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _murmur3_32_same_len(buf: np.ndarray, seed: int) -> np.ndarray:
+    """buf: (B, n) uint8 rows, all the same length n."""
+    b, n = buf.shape
+    h = np.full(b, seed, dtype=np.uint32)
+    nblocks = n >> 2
+    with np.errstate(over="ignore"):
+        if nblocks:
+            blocks = (
+                buf[:, : nblocks * 4]
+                .reshape(b, nblocks, 4)
+                .astype(np.uint32)
+            )
+            ks = (
+                blocks[:, :, 0]
+                | (blocks[:, :, 1] << np.uint32(8))
+                | (blocks[:, :, 2] << np.uint32(16))
+                | (blocks[:, :, 3] << np.uint32(24))
+            )
+            for i in range(nblocks):
+                k = ks[:, i] * np.uint32(_C1)
+                k = _rotl(k, 15) * np.uint32(_C2)
+                h ^= k
+                h = _rotl(h, 13) * np.uint32(5) + np.uint32(0xE6546B64)
+        tail = buf[:, nblocks * 4 :]
+        t = tail.shape[1]
+        if t:
+            k = np.zeros(b, dtype=np.uint32)
+            if t >= 3:
+                k ^= tail[:, 2].astype(np.uint32) << np.uint32(16)
+            if t >= 2:
+                k ^= tail[:, 1].astype(np.uint32) << np.uint32(8)
+            k ^= tail[:, 0].astype(np.uint32)
+            k *= np.uint32(_C1)
+            k = _rotl(k, 15) * np.uint32(_C2)
+            h ^= k
+        h ^= np.uint32(n)
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> np.uint32(13)
+        h *= np.uint32(0xC2B2AE35)
+        h ^= h >> np.uint32(16)
+    return h

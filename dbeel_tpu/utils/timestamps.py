@@ -1,0 +1,16 @@
+"""Server-assigned timestamps.
+
+The reference stamps every write with nanosecond UTC time on the receiving
+shard and resolves replica conflicts by max timestamp
+(/root/reference/src/utils/timestamp_nanos.rs:6-24, db_server.rs:353-363).
+We represent timestamps as int64 nanoseconds since the Unix epoch — the
+same total order, and directly usable as a device sort column.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def now_nanos() -> int:
+    return time.time_ns()

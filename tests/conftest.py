@@ -1,0 +1,43 @@
+"""Test environment: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding tests run anywhere (the driver separately dry-runs the
+multi-chip path), and give every test a scratch dir."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+import pytest  # noqa: E402
+
+from dbeel_tpu import flow_events  # noqa: E402
+
+flow_events.enable()
+
+
+@pytest.fixture
+def tmp_dir():
+    d = tempfile.mkdtemp(prefix="dbeel_tpu_test_")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def run(coro, timeout: float = 10.0):
+    """Run a test coroutine under a global timeout (the reference bounds
+    every harness run at 10s, test_utils/src/lib.rs:20,74)."""
+    async def _wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(_wrapped())
+
+
+@pytest.fixture
+def arun():
+    return run

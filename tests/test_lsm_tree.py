@@ -1,0 +1,302 @@
+"""Tier-1 LSM engine tests, mirroring the reference's in-module suite
+(/root/reference/src/storage_engine/lsm_tree.rs:1192-1557): memtable
+set/get + reopen persistence, flush to sstable + reopen, delete,
+compaction invariants incl. index bookkeeping, and the EntryWriter
+cache-equals-disk property."""
+
+import os
+
+import pytest
+
+from dbeel_tpu.storage.compaction import (
+    ColumnarMergeStrategy,
+    HeapMergeStrategy,
+)
+from dbeel_tpu.storage.entry import PAGE_SIZE
+from dbeel_tpu.storage.entry_writer import EntryWriter
+from dbeel_tpu.storage.lsm_tree import LSMTree
+from dbeel_tpu.storage.page_cache import PageCache, PartitionPageCache
+
+from conftest import run
+
+# Tiny capacity to force flushes cheaply (reference TEST_TREE_CAPACITY=32,
+# lsm_tree.rs:1208).
+CAP = 32
+
+
+def make_tree(tmp_dir, **kw):
+    kw.setdefault("capacity", CAP)
+    return LSMTree.open_or_create(f"{tmp_dir}/tree", **kw)
+
+
+def test_set_get_memtable_and_reopen(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        await tree.set(b"key1", b"value1")
+        await tree.set(b"key2", b"value2")
+        assert await tree.get(b"key1") == b"value1"
+        assert await tree.get(b"missing") is None
+        tree.close()
+        # Reopen: WAL replay restores the memtable.
+        tree2 = make_tree(tmp_dir)
+        assert await tree2.get(b"key1") == b"value1"
+        assert await tree2.get(b"key2") == b"value2"
+        tree2.close()
+
+    run(main())
+
+
+def test_flush_to_sstable_and_reopen(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), f"val{i}".encode())
+        await tree.flush()
+        assert [i for i, _ in tree.sstable_indices_and_sizes()] == [0]
+        assert await tree.get(b"key0000") == b"val0"
+        assert await tree.get(b"key0031") == b"val31"
+        tree.close()
+        tree2 = make_tree(tmp_dir)
+        assert await tree2.get(b"key0007") == b"val7"
+        assert [i for i, _ in tree2.sstable_indices_and_sizes()] == [0]
+        tree2.close()
+
+    run(main())
+
+
+def test_overwrite_and_delete(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        await tree.set(b"k", b"v1")
+        await tree.set(b"k", b"v2")
+        assert await tree.get(b"k") == b"v2"
+        await tree.delete(b"k")
+        assert await tree.get(b"k") is None
+        # Entry-level read still sees the tombstone (replication needs it).
+        entry = await tree.get_entry(b"k")
+        assert entry is not None and entry[0] == b""
+        tree.close()
+
+    run(main())
+
+
+def test_auto_flush_at_capacity(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        for i in range(CAP * 3):
+            await tree.set(f"key{i:05}".encode(), b"x" * 10)
+        await tree.flush()
+        # All keys remain visible across memtable + sstables.
+        for i in range(CAP * 3):
+            assert await tree.get(f"key{i:05}".encode()) == b"x" * 10
+        indices = [i for i, _ in tree.sstable_indices_and_sizes()]
+        assert indices == sorted(indices)
+        assert all(i % 2 == 0 for i in indices)  # flush indices are even
+        tree.close()
+
+    run(main())
+
+
+@pytest.mark.parametrize(
+    "strategy", [HeapMergeStrategy(), ColumnarMergeStrategy()]
+)
+def test_compaction_merges_and_dedups(tmp_dir, strategy):
+    async def main():
+        tree = make_tree(tmp_dir, strategy=strategy)
+        # Two overlapping generations of the same keys.
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), b"old")
+        await tree.flush()
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), b"new")
+        await tree.flush()
+        assert [i for i, _ in tree.sstable_indices_and_sizes()] == [0, 2]
+        await tree.compact([0, 2], 3, keep_tombstones=False)
+        assert [i for i, _ in tree.sstable_indices_and_sizes()] == [3]
+        for i in range(CAP):
+            assert await tree.get(f"key{i:04}".encode()) == b"new"
+        # Input files are gone; no stray compact files remain.
+        leftovers = [
+            f
+            for f in os.listdir(tree.dir_path)
+            if "compact" in f or f.startswith("0" * 19 + "0.")
+        ]
+        assert leftovers == []
+        tree.close()
+
+    run(main())
+
+
+@pytest.mark.parametrize(
+    "strategy", [HeapMergeStrategy(), ColumnarMergeStrategy()]
+)
+def test_compaction_drops_tombstones_on_bottom_level(tmp_dir, strategy):
+    async def main():
+        tree = make_tree(tmp_dir, strategy=strategy)
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), b"v")
+        await tree.flush()
+        for i in range(0, CAP, 2):
+            await tree.delete(f"key{i:04}".encode())
+        await tree.flush()
+        await tree.compact([0, 2], 3, keep_tombstones=False)
+        for i in range(CAP):
+            expect = None if i % 2 == 0 else b"v"
+            assert await tree.get(f"key{i:04}".encode()) == expect
+        # Bottom-level compaction: tombstones physically gone.
+        entries = []
+        async for k, v, ts in tree.iter():
+            entries.append((k, v))
+        assert all(v != b"" for _, v in entries)
+        assert len(entries) == CAP // 2
+        tree.close()
+
+    run(main())
+
+
+def test_keep_tombstones_above_bottom_level(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        await tree.set(b"a", b"1")
+        for i in range(CAP - 1):
+            await tree.set(f"k{i:04}".encode(), b"v")
+        await tree.flush()
+        await tree.delete(b"a")
+        for i in range(CAP - 1):
+            await tree.set(f"m{i:04}".encode(), b"v")
+        await tree.flush()
+        await tree.compact([0, 2], 3, keep_tombstones=True)
+        # Tombstone preserved: a still reads as deleted after compaction.
+        assert await tree.get(b"a") is None
+        entry = await tree.get_entry(b"a")
+        assert entry is not None and entry[0] == b""
+        tree.close()
+
+    run(main())
+
+
+def test_iter_is_sorted_within_sstable_and_complete(tmp_dir):
+    async def main():
+        tree = make_tree(tmp_dir)
+        import random
+
+        rng = random.Random(3)
+        keys = [f"key{i:05}".encode() for i in range(CAP)]
+        shuffled = keys[:]
+        rng.shuffle(shuffled)
+        for k in shuffled:
+            await tree.set(k, b"v-" + k)
+        await tree.flush()
+        seen = []
+        async for k, v, ts in tree.iter():
+            seen.append(k)
+            assert v == b"v-" + k
+        assert seen == keys  # sorted on disk despite shuffled inserts
+        tree.close()
+
+    run(main())
+
+
+def test_entry_writer_cache_equals_disk(tmp_dir):
+    """Property test mirroring lsm_tree.rs:1453-1556: pages mirrored into
+    the cache while writing equal what the file holds."""
+    cache = PageCache(1024)
+    part = PartitionPageCache("t", cache)
+    writer = EntryWriter(tmp_dir, 0, part)
+    import random
+
+    rng = random.Random(5)
+    for i in range(200):
+        key = f"key{i:06}".encode()
+        value = bytes(rng.randrange(256) for _ in range(rng.randrange(200)))
+        writer.write(key, value, i)
+    writer.close()
+
+    with open(writer.data_path, "rb") as f:
+        disk = f.read()
+    for address in range(0, len(disk), PAGE_SIZE):
+        page = part.get_copied(("data", 0), address)
+        assert page is not None, f"page {address} missing from cache"
+        expect = disk[address : address + PAGE_SIZE]
+        assert page[: len(expect)] == expect
+
+
+def test_two_wal_flush_recovery(tmp_dir):
+    """Simulate a crash between new-WAL creation and sstable completion:
+    reopen must complete the interrupted flush (lsm_tree.rs:478-513)."""
+
+    async def main():
+        tree = make_tree(tmp_dir)
+        for i in range(10):
+            await tree.set(f"key{i}".encode(), b"v")
+        # Fake the interrupted flush: create WAL index+2 and stop.
+        from dbeel_tpu.storage import wal as wal_mod
+
+        wal_mod.Wal(tree._wal_path(2)).close()
+        tree.close()
+
+        tree2 = make_tree(tmp_dir)
+        # Interrupted flush completed into sstable 0.
+        assert [i for i, _ in tree2.sstable_indices_and_sizes()] == [0]
+        for i in range(10):
+            assert await tree2.get(f"key{i}".encode()) == b"v"
+        tree2.close()
+
+    run(main())
+
+
+def test_compact_action_journal_replay(tmp_dir):
+    """A journal left on disk (crash after journal write, before cleanup)
+    is replayed idempotently on open (lsm_tree.rs:424-438)."""
+
+    async def main():
+        tree = make_tree(tmp_dir)
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), b"a")
+        await tree.flush()
+        for i in range(CAP):
+            await tree.set(f"key{i:04}".encode(), b"b")
+        await tree.flush()
+        tree.close()
+
+        # Run the merge by hand, write the journal, "crash" before
+        # renames/deletes.
+        import msgpack
+
+        from dbeel_tpu.storage.compaction import HeapMergeStrategy
+        from dbeel_tpu.storage.entry import (
+            COMPACT_ACTION_FILE_EXT,
+            COMPACT_DATA_FILE_EXT,
+            COMPACT_INDEX_FILE_EXT,
+            DATA_FILE_EXT,
+            INDEX_FILE_EXT,
+            file_name,
+        )
+        from dbeel_tpu.storage.sstable import SSTable
+
+        d = f"{tmp_dir}/tree"
+        inputs = [SSTable(d, 0, None), SSTable(d, 2, None)]
+        HeapMergeStrategy().merge(inputs, d, 3, None, False, 1 << 30)
+        renames = [
+            [
+                f"{d}/{file_name(3, COMPACT_DATA_FILE_EXT)}",
+                f"{d}/{file_name(3, DATA_FILE_EXT)}",
+            ],
+            [
+                f"{d}/{file_name(3, COMPACT_INDEX_FILE_EXT)}",
+                f"{d}/{file_name(3, INDEX_FILE_EXT)}",
+            ],
+        ]
+        deletes = [p for t in inputs for p in t.paths()]
+        for t in inputs:
+            t.close()
+        with open(f"{d}/{file_name(3, COMPACT_ACTION_FILE_EXT)}", "wb") as f:
+            f.write(msgpack.packb({"renames": renames, "deletes": deletes}))
+
+        tree2 = make_tree(tmp_dir)
+        assert [i for i, _ in tree2.sstable_indices_and_sizes()] == [3]
+        for i in range(CAP):
+            assert await tree2.get(f"key{i:04}".encode()) == b"b"
+        tree2.close()
+
+    run(main())

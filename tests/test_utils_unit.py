@@ -1,0 +1,166 @@
+"""Unit tests: murmur3, LocalEvent, bloom, page cache, WAL."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dbeel_tpu.storage.bloom import BloomFilter
+from dbeel_tpu.storage.entry import PAGE_SIZE
+from dbeel_tpu.storage.page_cache import PageCache, PartitionPageCache
+from dbeel_tpu.storage import wal as wal_mod
+from dbeel_tpu.utils.event import LocalEvent
+from dbeel_tpu.utils.murmur import murmur3_32, murmur3_32_batch
+
+from conftest import run
+
+
+# Public murmur3_32 test vectors (seed 0).
+VECTORS = [
+    (b"", 0x00000000),
+    (b"a", 0x3C2569B2),
+    (b"hello", 0x248BFA47),
+    (b"hello, world", 0x149BBB7F),
+    (b"The quick brown fox jumps over the lazy dog", 0x2E4FF723),
+]
+
+
+def test_murmur3_vectors():
+    for data, expect in VECTORS:
+        assert murmur3_32(data, 0) == expect, data
+
+
+def test_murmur3_batch_matches_scalar():
+    rng = np.random.default_rng(7)
+    keys = [
+        bytes(rng.integers(0, 256, size=int(n), dtype=np.uint8))
+        for n in rng.integers(0, 40, size=200)
+    ]
+    batch = murmur3_32_batch(keys, 0)
+    for k, h in zip(keys, batch):
+        assert murmur3_32(k, 0) == int(h)
+
+
+def test_local_event_sticky_semantics():
+    async def main():
+        ev = LocalEvent()
+        # Listener armed before notify sees it.
+        fut = ev.listen()
+        assert ev.notify() == 1
+        await fut
+        # Listener armed after misses it.
+        fut2 = ev.listen()
+        assert not fut2.done()
+        ev.notify()
+        await fut2
+
+    run(main())
+
+
+def test_bloom_no_false_negatives():
+    bf = BloomFilter.with_capacity(1000, 0.01)
+    keys = [f"key-{i}".encode() for i in range(1000)]
+    bf.add_batch(keys)
+    for k in keys:
+        assert bf.check(k)
+    fp = sum(bf.check(f"other-{i}".encode()) for i in range(2000))
+    assert fp < 100  # ~1% expected
+
+
+def test_bloom_roundtrip():
+    bf = BloomFilter.with_capacity(100)
+    bf.add(b"abc")
+    bf2 = BloomFilter.deserialize(bf.serialize())
+    assert bf2 is not None
+    assert bf2.check(b"abc")
+    assert bf2.num_bits == bf.num_bits
+
+
+def test_page_cache_basics():
+    cache = PageCache(64)
+    part = PartitionPageCache("col", cache)
+    page = bytes(range(256)) * 16
+    assert len(page) == PAGE_SIZE
+    part.set(("data", 0), 0, page)
+    assert part.get_copied(("data", 0), 0) == page
+    assert part.get_copied(("data", 0), PAGE_SIZE) is None
+    # Other partitions don't collide.
+    other = PartitionPageCache("col2", cache)
+    assert other.get_copied(("data", 0), 0) is None
+
+
+def test_page_cache_eviction_bounded():
+    cache = PageCache(16)
+    part = PartitionPageCache("col", cache)
+    for i in range(1000):
+        part.set(("data", 0), i * PAGE_SIZE, b"\x01" * PAGE_SIZE)
+    assert len(cache) <= 16 + 1
+
+
+def test_wal_roundtrip_and_torn_tail(tmp_dir):
+    path = f"{tmp_dir}/0.memtable"
+
+    async def write():
+        w = wal_mod.Wal(path)
+        await w.append(b"k1", b"v1", 11)
+        await w.append(b"k2", b"", 22)  # tombstone
+        await w.append(b"k3", b"v3" * 3000, 33)  # multi-page record
+        w.close()
+
+    run(write())
+    records = list(wal_mod.replay(path))
+    assert records == [
+        (b"k1", b"v1", 11),
+        (b"k2", b"", 22),
+        (b"k3", b"v3" * 3000, 33),
+    ]
+    # Corrupt the tail record's payload: replay stops before it.
+    with open(path, "r+b") as f:
+        f.seek(2 * PAGE_SIZE + 20)
+        f.write(b"\xff")
+    records = list(wal_mod.replay(path))
+    assert records == [(b"k1", b"v1", 11), (b"k2", b"", 22)]
+
+
+def test_wal_append_after_torn_tail_recovers(tmp_dir):
+    """Post-recovery appends must overwrite the torn record, not land
+    beyond it where replay would never reach them."""
+    path = f"{tmp_dir}/0.memtable"
+
+    async def write_then_crash():
+        w = wal_mod.Wal(path)
+        await w.append(b"k1", b"v1", 1)
+        await w.append(b"k2", b"v2", 2)
+        w.close()
+
+    run(write_then_crash())
+    with open(path, "r+b") as f:
+        f.seek(PAGE_SIZE + 20)  # corrupt record 2's payload
+        f.write(b"\xff")
+
+    async def reopen_and_append():
+        w = wal_mod.Wal(path)
+        await w.append(b"k3", b"v3", 3)
+        w.close()
+
+    run(reopen_and_append())
+    assert list(wal_mod.replay(path)) == [
+        (b"k1", b"v1", 1),
+        (b"k3", b"v3", 3),
+    ]
+
+
+def test_wal_sync_delay_coalesces(tmp_dir):
+    path = f"{tmp_dir}/0.memtable"
+
+    async def main():
+        w = wal_mod.Wal(path, sync=True, sync_delay_us=1000)
+        await asyncio.gather(
+            w.append(b"a", b"1", 1),
+            w.append(b"b", b"2", 2),
+            w.append(b"c", b"3", 3),
+        )
+        w.close()
+
+    run(main())
+    assert len(list(wal_mod.replay(path))) == 3
