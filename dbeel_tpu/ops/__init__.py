@@ -1,0 +1,3 @@
+"""Device (JAX/XLA/Pallas) kernels: the bulk sorted-data compute of the
+storage engine — compaction merge+dedup and flush sort — expressed as
+batched, statically-shaped, jit-compiled array programs."""

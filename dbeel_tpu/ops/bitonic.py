@@ -1,0 +1,232 @@
+"""Bitonic merge network — the TPU-native compaction merge kernel.
+
+Why not ``lax.sort``: XLA's TPU sort with a multi-operand comparator is
+pathological for this workload (measured on TPU v5e: 8-key sort of 2^18
+rows = 202 s compile + 41 ms/run, vs 0.2 ms for 1 key).  Compaction
+doesn't need a full sort anyway — its inputs are K *already-sorted* runs
+(SSTables are sorted by construction).  A bitonic merge network does the
+k-way merge in ``log2(K)`` batched pairwise rounds of ``log2(L)``
+elementwise compare-exchange stages: only static reshapes, compares and
+selects — tiny HLO, fast compile, HBM-bandwidth-bound execution.  This is
+the "batched bitonic merge expressed in jax.jit" the north star names
+(BASELINE.json), replacing the reference's per-entry heap loop
+(/root/reference/src/storage_engine/lsm_tree.rs:1038-1066).
+
+Row format is the 9-column uint32 entry stack of parallel/dist_merge.py:
+  cols 0-3 k0..k3 (16B big-endian key prefix), 4 key_len,
+  5-6 ~ts hi/lo, 7 ~src, 8 carried entry index.
+Lexicographic comparator over cols 0-7; sentinel rows (all 0xFFFFFFFF)
+sort last.  Equal full tuples cannot occur for distinct entries except
+keys longer than the 16-byte prefix, which the host fixes up afterwards
+(storage/columnar.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage import columnar
+
+NUM_COLS = 9
+NUM_KEY_COLS = 8
+NUM_EQ_COLS = 5  # key identity = prefix words + key_len
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def _lex_gt(a: jnp.ndarray, b: jnp.ndarray, ncmp: int = NUM_KEY_COLS):
+    """a > b lexicographically over the first ``ncmp`` columns.
+    a, b: (..., C)."""
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq = jnp.ones(a.shape[:-1], dtype=bool)
+    for c in range(ncmp):
+        ac, bc = a[..., c], b[..., c]
+        gt = gt | (eq & (ac > bc))
+        eq = eq & (ac == bc)
+    return gt
+
+
+def _bitonic_to_sorted(x: jnp.ndarray, ncmp: int) -> jnp.ndarray:
+    """(B, L, C) rows that are bitonic along axis 1 → ascending rows.
+    Classic bitonic merge: stages with strides L/2, L/4, …, 1, each a
+    static reshape + compare-exchange."""
+    b, l, c = x.shape
+    s = l // 2
+    while s >= 1:
+        y = x.reshape(b, l // (2 * s), 2, s, c)
+        lo, hi = y[:, :, 0], y[:, :, 1]
+        swap = _lex_gt(lo, hi, ncmp)[..., None]
+        nlo = jnp.where(swap, hi, lo)
+        nhi = jnp.where(swap, lo, hi)
+        x = jnp.stack([nlo, nhi], axis=2).reshape(b, l, c)
+        s //= 2
+    return x
+
+
+def _merge_level(x: jnp.ndarray, ncmp: int = NUM_KEY_COLS) -> jnp.ndarray:
+    """(K, P, C) sorted runs → (K/2, 2P, C) sorted runs: concat each even
+    run with its odd neighbour reversed (ascending+descending = bitonic),
+    then merge — all K/2 pairs in one batched op."""
+    a = x[0::2]
+    b_rev = x[1::2][:, ::-1]
+    return _bitonic_to_sorted(
+        jnp.concatenate([a, b_rev], axis=1), ncmp
+    )
+
+
+def _merged_with_same(stacks: jnp.ndarray):
+    x = stacks
+    while x.shape[0] > 1:
+        x = _merge_level(x, NUM_KEY_COLS)
+    out = x[0]
+    eq = jnp.ones(out.shape[0] - 1, dtype=bool)
+    for c in range(NUM_EQ_COLS):
+        eq = eq & (out[1:, c] == out[:-1, c])
+    eq = eq & (out[1:, 4] != SENTINEL)
+    same = jnp.concatenate([jnp.zeros((1,), bool), eq])
+    return out, same
+
+
+@jax.jit
+def merge_runs_kernel(
+    stacks: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(K, P, NUM_COLS) sorted (sentinel-padded) runs, K and P powers of
+    two → (K*P, NUM_COLS) globally sorted stack + same-key flags."""
+    return _merged_with_same(stacks)
+
+
+@jax.jit
+def merge_runs_perm_kernel(
+    stacks: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Like merge_runs_kernel but returns only (sorted entry indices,
+    same flags) — a ~9x smaller device→host transfer, which matters on
+    tunneled/remote TPUs."""
+    out, same = _merged_with_same(stacks)
+    return out[:, 8], same
+
+
+def sort_stack_kernel(stack: jnp.ndarray):
+    """Full bitonic sort of an unsorted (N, NUM_COLS) stack (N pow2):
+    every row is a 1-length run, then the merge tournament."""
+    return merge_runs_kernel(stack[:, None, :])
+
+
+# ----------------------------------------------------------------------
+# Prefix kernel — the transfer-minimal device path.
+#
+# On tunneled/remote TPUs (this environment: ~45 MB/s h2d, ~35 MB/s d2h)
+# PCIe-sized transfers dominate, so the hot path ships only the 8-byte
+# big-endian key prefix per entry (2 uint32 words) and receives a single
+# packed uint32 order index back.  Timestamps/sources never leave the
+# host: any entries tying on the 8-byte prefix (same key, shared prefix,
+# or key longer than 8 bytes with equal head) are re-ordered on the host
+# by (full key, ~ts, ~src) — which also subsumes long-key handling, so
+# this path is fully general.  Comparator = (k0, k1, idx) where idx is a
+# device-built unique iota (sentinel rows get idx=MAX and therefore sort
+# strictly last, making a static top-slice safe).
+# ----------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows",))
+def merge_runs_prefix_kernel(
+    prefixes: jnp.ndarray,  # (K, P, 2) uint32
+    counts: jnp.ndarray,  # (K,) uint32 valid rows per run
+    out_rows: int,
+):
+    k, p, _ = prefixes.shape
+    iota = (
+        jnp.arange(k, dtype=jnp.uint32)[:, None] * jnp.uint32(p)
+        + jnp.arange(p, dtype=jnp.uint32)[None, :]
+    )
+    valid = jnp.arange(p, dtype=jnp.uint32)[None, :] < counts[:, None]
+    idx = jnp.where(valid, iota, jnp.uint32(0xFFFFFFFF))
+    x = jnp.concatenate([prefixes, idx[:, :, None]], axis=2)
+    while x.shape[0] > 1:
+        x = _merge_level(x, ncmp=3)
+    return x[0, :out_rows, 2]
+
+
+def device_merge_prefix_order(
+    cols: columnar.MergeColumns, run_counts: List[int]
+) -> np.ndarray:
+    """Device order of ``cols`` by 8-byte key prefix (ties by staging
+    position — resolve with columnar.fixup_prefix_ties afterwards).
+    Returns perm as int64 entry indices."""
+    n = len(cols)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    k = _pow2(max(1, len(run_counts)))
+    p = _pow2(max(8, max(run_counts) if run_counts else 8))
+    prefixes = np.full((k, p, 2), SENTINEL, dtype=np.uint32)
+    counts = np.zeros(k, dtype=np.uint32)
+    base = 0
+    bases = np.zeros(k, dtype=np.int64)
+    for r, cnt in enumerate(run_counts):
+        prefixes[r, :cnt, 0] = cols.key_words[base : base + cnt, 0]
+        prefixes[r, :cnt, 1] = cols.key_words[base : base + cnt, 1]
+        counts[r] = cnt
+        bases[r] = base
+        base += cnt
+    # Bucketize the output slice (64Ki granularity) so jit traces stay
+    # few while the d2h transfer stays ~n, not K*P.
+    out_rows = min(k * p, ((n + 65535) >> 16) << 16)
+    packed = merge_runs_prefix_kernel(prefixes, counts, out_rows)
+    packed = np.asarray(packed)[:n]
+    run = packed >> np.uint32(p.bit_length() - 1)
+    pos = packed & np.uint32(p - 1)
+    return bases[run.astype(np.int64)] + pos.astype(np.int64)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def build_run_stacks(
+    cols: columnar.MergeColumns, run_counts: List[int]
+) -> np.ndarray:
+    """Stage merge columns as a (K, P, 9) sentinel-padded uint32 tensor,
+    one sorted run per input sstable."""
+    k = _pow2(max(1, len(run_counts)))
+    p = _pow2(max(8, max(run_counts) if run_counts else 8))
+    stacks = np.full((k, p, NUM_COLS), SENTINEL, dtype=np.uint32)
+    ts_inv = ~cols.timestamp
+    base = 0
+    for r, cnt in enumerate(run_counts):
+        sl = slice(base, base + cnt)
+        stacks[r, :cnt, 0] = cols.key_words[sl, 0]
+        stacks[r, :cnt, 1] = cols.key_words[sl, 1]
+        stacks[r, :cnt, 2] = cols.key_words[sl, 2]
+        stacks[r, :cnt, 3] = cols.key_words[sl, 3]
+        stacks[r, :cnt, 4] = cols.key_size[sl]
+        stacks[r, :cnt, 5] = (ts_inv[sl] >> np.uint64(32)).astype(np.uint32)
+        stacks[r, :cnt, 6] = (
+            ts_inv[sl] & np.uint64(0xFFFFFFFF)
+        ).astype(np.uint32)
+        stacks[r, :cnt, 7] = ~cols.src[sl]
+        stacks[r, :cnt, 8] = np.arange(base, base + cnt, dtype=np.uint32)
+        base += cnt
+    return stacks
+
+
+def device_merge_sorted_runs(
+    cols: columnar.MergeColumns, run_counts: List[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host wrapper: returns (perm, same) over ``cols`` like
+    ops.merge.device_sort_dedup, via the bitonic merge network."""
+    n = len(cols)
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, bool)
+    stacks = build_run_stacks(cols, run_counts)
+    idx, same = merge_runs_perm_kernel(stacks)
+    perm = np.asarray(idx[:n]).astype(np.int64)
+    same_np = np.asarray(same[:n])
+    return perm, same_np

@@ -1,0 +1,68 @@
+"""DeviceMergeStrategy — compaction with the sort+dedup on the TPU.
+
+Drops into the CompactionStrategy seam (storage/compaction.py): the host
+stages columns (storage/columnar.py), the device runs the batched
+lexicographic sort + duplicate marking (ops/merge.py), and the host
+finishes with the variable-length record gather and file writes.  Output
+bytes are identical to the heap and columnar strategies (golden-tested).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..storage import columnar
+from ..storage.compaction import ColumnarMergeStrategy
+from .bitonic import device_merge_prefix_order, device_merge_sorted_runs
+
+
+class DeviceMergeStrategy(ColumnarMergeStrategy):
+    """Default device path: the transfer-minimal 8-byte-prefix bitonic
+    merge (ops/bitonic.py) + host tie refinement.  Fully general — any
+    prefix tie (same key, shared prefix, long keys) is re-ordered and
+    dedup-confirmed on the host with full-key compares."""
+
+    name = "device"
+
+    def sort_and_dedup(
+        self, cols: columnar.MergeColumns
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        # Input sstables are sorted: recover per-run lengths from the
+        # (contiguous, ascending) src column and hand the k-way merge to
+        # the bitonic network.
+        run_counts = (
+            np.bincount(cols.src).tolist() if len(cols) else []
+        )
+        perm = device_merge_prefix_order(cols, run_counts)
+        perm = columnar.fixup_prefix_ties(cols, perm, words=2)
+        keep = columnar.dedup_mask_prefix(cols, perm, words=2)
+        return perm, keep
+
+
+class DeviceFullMergeStrategy(ColumnarMergeStrategy):
+    """All-columns device path: ships the full 9-column stack (16B key
+    prefix, key_len, ~ts, ~src, idx) and orders everything on-device.
+    More device work and ~4.5x the transfer volume of the prefix path —
+    preferable when the device link is PCIe-fast and keys cluster under
+    shared 8-byte prefixes."""
+
+    name = "device_full"
+
+    def sort_and_dedup(
+        self, cols: columnar.MergeColumns
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        run_counts = (
+            np.bincount(cols.src).tolist() if len(cols) else []
+        )
+        perm, same = device_merge_sorted_runs(cols, run_counts)
+        # Keys longer than the 16-byte device prefix both alias (equal
+        # prefix+len ≠ equal key) and mis-order (the length column is not
+        # lexicographic across different-length same-prefix keys): any
+        # long key means the host re-sorts prefix-tie blocks and redoes
+        # the dedup mask.  No-op when all keys fit the prefix.
+        if (cols.key_size > columnar.KEY_PREFIX_BYTES).any():
+            perm = columnar.fixup_long_key_ties(cols, perm)
+            return perm, columnar.dedup_mask(cols, perm)
+        return perm, ~same

@@ -1,0 +1,9 @@
+"""Multi-chip parallelism: device meshes and the distributed merge.
+
+The reference scales by shard-per-core over a hash ring; the TPU-native
+analog scales the *bulk compute* (compaction merge) over a device mesh
+with XLA collectives riding ICI — per-shard compaction jobs coalesce into
+one sharded launch (BASELINE.json north star).
+"""
+
+from .mesh import shard_mesh  # noqa: F401

@@ -1,0 +1,23 @@
+"""Device mesh helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+def shard_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh over the ``shards`` axis — the compaction-coalescing /
+    key-space data-parallel axis of this framework."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), axis_names=("shards",))

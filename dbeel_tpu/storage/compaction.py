@@ -251,6 +251,12 @@ def get_strategy(name: str) -> CompactionStrategy:
         except ImportError:
             return ColumnarMergeStrategy()
         return DeviceMergeStrategy()
+    if name == "device_full":
+        try:
+            from ..ops.device_compaction import DeviceFullMergeStrategy
+        except ImportError:
+            return ColumnarMergeStrategy()
+        return DeviceFullMergeStrategy()
     if name == "auto":
         try:
             import jax

@@ -11,6 +11,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# The axon TPU plugin ignores JAX_PLATFORMS; force CPU via config too.
+jax.config.update("jax_platforms", "cpu")
+
 import asyncio  # noqa: E402
 import shutil  # noqa: E402
 import tempfile  # noqa: E402
