@@ -1,0 +1,281 @@
+"""Smart client for dbeel_tpu (and wire-compatible with dbeel servers).
+
+Role parity with /root/reference/dbeel_client/src/lib.rs: bootstrap from
+seed db addresses, pull cluster metadata, build the client-side hash
+ring, route each key to the first ring shard at/after its hash, walk
+replicas across distinct nodes injecting ``replica_index``, resync the
+ring and retry on ``KeyNotOwnedByShard``, and offer per-op consistency
+(fixed / quorum / all).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import msgpack
+
+from ..errors import (
+    ConnectionError_,
+    DbeelError,
+    KeyNotOwnedByShard,
+    ProtocolError,
+    from_wire,
+)
+from ..cluster.messages import ClusterMetadata, NodeMetadata
+from ..utils.murmur import hash_bytes, hash_string
+
+RESPONSE_ERR = 0
+RESPONSE_OK = 1
+RESPONSE_BYTES = 2
+
+
+class Consistency:
+    """dbeel_client/src/lib.rs:465-480."""
+
+    @staticmethod
+    def fixed(n: int):
+        return ("fixed", n)
+
+    QUORUM = ("quorum", 0)
+    ALL = ("all", 0)
+
+    @staticmethod
+    def resolve(c, replication_factor: int) -> int:
+        kind, n = c
+        if kind == "fixed":
+            return n
+        if kind == "quorum":
+            return replication_factor // 2 + 1
+        return replication_factor
+
+
+@dataclass
+class _RingShard:
+    node_name: str
+    hash: int
+    ip: str
+    db_port: int  # already shard-specific (base + id)
+
+
+class DbeelClient:
+    def __init__(self, seed_addresses: Sequence[Tuple[str, int]]):
+        self._seeds = list(seed_addresses)
+        self._ring: List[_RingShard] = []
+        self._collections: dict = {}
+
+    # -- bootstrap / metadata sync (lib.rs:85-152) ---------------------
+
+    @classmethod
+    async def from_seed_nodes(
+        cls, addresses: Sequence[Tuple[str, int]]
+    ) -> "DbeelClient":
+        client = cls(addresses)
+        await client.sync_metadata()
+        return client
+
+    async def sync_metadata(self) -> None:
+        last_error: Optional[Exception] = None
+        for host, port in self._seeds:
+            try:
+                raw = await self._send_to(
+                    host, port, {"type": "get_cluster_metadata"}
+                )
+                metadata = ClusterMetadata.from_wire(
+                    msgpack.unpackb(raw, raw=False)
+                )
+                self._apply_metadata(metadata)
+                return
+            except (DbeelError, OSError) as e:
+                last_error = e
+        raise ConnectionError_(
+            f"no seed reachable: {last_error!r}"
+        )
+
+    def _apply_metadata(self, metadata: ClusterMetadata) -> None:
+        ring: List[_RingShard] = []
+        for node in metadata.nodes:
+            for sid in node.ids:
+                ring.append(
+                    _RingShard(
+                        node_name=node.name,
+                        hash=hash_string(f"{node.name}-{sid}"),
+                        ip=node.ip,
+                        db_port=node.db_port + sid,
+                    )
+                )
+        ring.sort(key=lambda s: s.hash)
+        self._ring = ring
+        self._collections = {
+            name: rf for name, rf in metadata.collections
+        }
+
+    # -- raw protocol --------------------------------------------------
+
+    @staticmethod
+    async def _send_to(host: str, port: int, request: dict) -> bytes:
+        """One request/response round trip (u16-len request; u32-len
+        response + trailing type byte)."""
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            buf = msgpack.packb(request, use_bin_type=True)
+            writer.write(struct.pack("<H", len(buf)) + buf)
+            await writer.drain()
+            header = await reader.readexactly(4)
+            (size,) = struct.unpack("<I", header)
+            payload = await reader.readexactly(size)
+        finally:
+            writer.close()
+        if not payload:
+            raise ProtocolError("empty response")
+        body, rtype = payload[:-1], payload[-1]
+        if rtype == RESPONSE_ERR:
+            raise from_wire(msgpack.unpackb(body, raw=False))
+        return body
+
+    # -- routing (lib.rs:336-417) ---------------------------------------
+
+    def _shards_for_key(self, key_hash: int, rf: int) -> List[_RingShard]:
+        """First ring shard at/after the hash, then the next shards on
+        distinct nodes — the replica walk."""
+        if not self._ring:
+            raise ConnectionError_("empty ring; sync_metadata first")
+        start = next(
+            (
+                i
+                for i, s in enumerate(self._ring)
+                if s.hash >= key_hash
+            ),
+            0,
+        )
+        out: List[_RingShard] = []
+        seen_nodes: set = set()
+        for off in range(len(self._ring)):
+            s = self._ring[(start + off) % len(self._ring)]
+            if s.node_name in seen_nodes:
+                continue
+            seen_nodes.add(s.node_name)
+            out.append(s)
+            if len(out) >= rf:
+                break
+        return out
+
+    async def _sharded_request(
+        self, key: Any, request: dict, rf: int
+    ) -> bytes:
+        key_encoded = msgpack.packb(key, use_bin_type=True)
+        key_hash = hash_bytes(key_encoded)
+        request = dict(request)
+        request["hash"] = key_hash
+
+        for attempt in (0, 1):
+            replicas = self._shards_for_key(key_hash, max(1, rf))
+            last_error: Optional[Exception] = None
+            for replica_index, shard in enumerate(replicas):
+                request["replica_index"] = replica_index
+                try:
+                    return await self._send_to(
+                        shard.ip, shard.db_port, request
+                    )
+                except KeyNotOwnedByShard as e:
+                    # Stale ring: resync and retry (lib.rs:392-409).
+                    last_error = e
+                    break
+                except (DbeelError, OSError) as e:
+                    last_error = e
+                    continue
+            if attempt == 0 and isinstance(
+                last_error, KeyNotOwnedByShard
+            ):
+                await self.sync_metadata()
+                continue
+            raise last_error if last_error else ConnectionError_(
+                "no replica reachable"
+            )
+        raise ConnectionError_("unreachable")
+
+    # -- public API (lib.rs:482-619) -------------------------------------
+
+    async def create_collection(
+        self, name: str, replication_factor: Optional[int] = None
+    ) -> "DbeelCollection":
+        request = {"type": "create_collection", "name": name}
+        if replication_factor is not None:
+            request["replication_factor"] = replication_factor
+        host, port = self._seeds[0]
+        await self._send_to(host, port, request)
+        await self.sync_metadata()
+        return self.collection(name)
+
+    async def drop_collection(self, name: str) -> None:
+        host, port = self._seeds[0]
+        await self._send_to(
+            host, port, {"type": "drop_collection", "name": name}
+        )
+        await self.sync_metadata()
+
+    def collection(self, name: str) -> "DbeelCollection":
+        rf = self._collections.get(name, 1)
+        return DbeelCollection(self, name, rf)
+
+    async def get_cluster_metadata(self) -> ClusterMetadata:
+        host, port = self._seeds[0]
+        raw = await self._send_to(
+            host, port, {"type": "get_cluster_metadata"}
+        )
+        return ClusterMetadata.from_wire(msgpack.unpackb(raw, raw=False))
+
+
+class DbeelCollection:
+    def __init__(self, client: DbeelClient, name: str, rf: int):
+        self.client = client
+        self.name = name
+        self.replication_factor = rf
+
+    async def set(
+        self, key: Any, value: Any, consistency=None
+    ) -> None:
+        request = {
+            "type": "set",
+            "collection": self.name,
+            "key": key,
+            "value": value,
+        }
+        if consistency is not None:
+            request["consistency"] = Consistency.resolve(
+                consistency, self.replication_factor
+            )
+        await self.client._sharded_request(
+            key, request, self.replication_factor
+        )
+
+    async def get(self, key: Any, consistency=None) -> Any:
+        request = {
+            "type": "get",
+            "collection": self.name,
+            "key": key,
+        }
+        if consistency is not None:
+            request["consistency"] = Consistency.resolve(
+                consistency, self.replication_factor
+            )
+        raw = await self.client._sharded_request(
+            key, request, self.replication_factor
+        )
+        return msgpack.unpackb(raw, raw=False)
+
+    async def delete(self, key: Any, consistency=None) -> None:
+        request = {
+            "type": "delete",
+            "collection": self.name,
+            "key": key,
+        }
+        if consistency is not None:
+            request["consistency"] = Consistency.resolve(
+                consistency, self.replication_factor
+            )
+        await self.client._sharded_request(
+            key, request, self.replication_factor
+        )

@@ -1,0 +1,234 @@
+"""Shard message schema — the L4 wire vocabulary.
+
+Role parity with /root/reference/src/messages.rs:11-121 and gossip.rs:
+9-40: ``ShardMessage = Event | Request | Response`` plus NodeMetadata /
+ClusterMetadata, and the four gossip events.  The reference serializes
+with bincode; we use msgpack arrays with a leading tag string — self-
+describing, language-neutral, and the natural fit for a msgpack document
+database.  NodeMetadata keeps the reference's field order so the public
+``get_cluster_metadata`` response matches what rmp-serde produces for
+the reference's client (dbeel_client/src/lib.rs:85-152).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+from ..errors import DbeelError, ProtocolError, from_wire
+
+
+@dataclass(frozen=True)
+class NodeMetadata:
+    name: str
+    ip: str
+    remote_shard_base_port: int
+    ids: List[int]
+    gossip_port: int
+    db_port: int
+
+    def to_wire(self) -> list:
+        return [
+            self.name,
+            self.ip,
+            self.remote_shard_base_port,
+            list(self.ids),
+            self.gossip_port,
+            self.db_port,
+        ]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "NodeMetadata":
+        return cls(w[0], w[1], w[2], list(w[3]), w[4], w[5])
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+@dataclass
+class ClusterMetadata:
+    nodes: List[NodeMetadata]
+    collections: List[Tuple[str, int]]  # (name, replication_factor)
+
+    def to_wire(self) -> list:
+        return [
+            [n.to_wire() for n in self.nodes],
+            [[name, rf] for name, rf in self.collections],
+        ]
+
+    @classmethod
+    def from_wire(cls, w: list) -> "ClusterMetadata":
+        return cls(
+            [NodeMetadata.from_wire(n) for n in w[0]],
+            [(c[0], c[1]) for c in w[1]],
+        )
+
+
+# ---------------------------------------------------------------------
+# Events / Requests / Responses as tagged msgpack arrays.
+# Timestamps travel as int64 nanoseconds.
+# ---------------------------------------------------------------------
+
+
+class ShardEvent:
+    GOSSIP = "gossip"
+    SET = "set"
+
+    @staticmethod
+    def gossip(gossip_event: list) -> list:
+        return ["event", ShardEvent.GOSSIP, gossip_event]
+
+    @staticmethod
+    def set(collection: str, key: bytes, value: bytes, ts: int) -> list:
+        return ["event", ShardEvent.SET, collection, key, value, ts]
+
+
+class ShardRequest:
+    PING = "ping"
+    GET_METADATA = "get_metadata"
+    GET_COLLECTIONS = "get_collections"
+    CREATE_COLLECTION = "create_collection"
+    DROP_COLLECTION = "drop_collection"
+    SET = "set"
+    DELETE = "delete"
+    GET = "get"
+
+    @staticmethod
+    def ping() -> list:
+        return ["request", ShardRequest.PING]
+
+    @staticmethod
+    def get_metadata() -> list:
+        return ["request", ShardRequest.GET_METADATA]
+
+    @staticmethod
+    def get_collections() -> list:
+        return ["request", ShardRequest.GET_COLLECTIONS]
+
+    @staticmethod
+    def create_collection(name: str, rf: int) -> list:
+        return ["request", ShardRequest.CREATE_COLLECTION, name, rf]
+
+    @staticmethod
+    def drop_collection(name: str) -> list:
+        return ["request", ShardRequest.DROP_COLLECTION, name]
+
+    @staticmethod
+    def set(collection: str, key: bytes, value: bytes, ts: int) -> list:
+        return ["request", ShardRequest.SET, collection, key, value, ts]
+
+    @staticmethod
+    def delete(collection: str, key: bytes, ts: int) -> list:
+        return ["request", ShardRequest.DELETE, collection, key, ts]
+
+    @staticmethod
+    def get(collection: str, key: bytes) -> list:
+        return ["request", ShardRequest.GET, collection, key]
+
+
+class ShardResponse:
+    PONG = "pong"
+    GET_METADATA = "get_metadata"
+    GET_COLLECTIONS = "get_collections"
+    CREATE_COLLECTION = "create_collection"
+    DROP_COLLECTION = "drop_collection"
+    SET = "set"
+    DELETE = "delete"
+    GET = "get"
+    ERROR = "error"
+
+    @staticmethod
+    def pong() -> list:
+        return ["response", ShardResponse.PONG]
+
+    @staticmethod
+    def get_metadata(nodes: List[NodeMetadata]) -> list:
+        return [
+            "response",
+            ShardResponse.GET_METADATA,
+            [n.to_wire() for n in nodes],
+        ]
+
+    @staticmethod
+    def get_collections(cols: List[Tuple[str, int]]) -> list:
+        return [
+            "response",
+            ShardResponse.GET_COLLECTIONS,
+            [[n, rf] for n, rf in cols],
+        ]
+
+    @staticmethod
+    def empty(kind: str) -> list:
+        return ["response", kind]
+
+    @staticmethod
+    def get(entry: Optional[Tuple[bytes, int]]) -> list:
+        # entry = (value_bytes, timestamp_ns) including tombstones.
+        return [
+            "response",
+            ShardResponse.GET,
+            list(entry) if entry is not None else None,
+        ]
+
+    @staticmethod
+    def error(err: DbeelError) -> list:
+        return ["response", ShardResponse.ERROR, err.kind, str(err)]
+
+
+def response_to_result(response: list, expected_kind: str) -> Any:
+    """Reference's response_to_result! macros (messages.rs:60-84)."""
+    if not isinstance(response, (list, tuple)) or response[0] != "response":
+        raise ProtocolError(f"not a response: {response!r}")
+    kind = response[1]
+    if kind == ShardResponse.ERROR:
+        raise from_wire(response[2:4])
+    if kind != expected_kind:
+        raise ProtocolError(
+            f"expected {expected_kind} response, got {kind}"
+        )
+    return response[2] if len(response) > 2 else None
+
+
+# Gossip events (gossip.rs:9-40).
+
+
+class GossipEvent:
+    ALIVE = "alive"
+    DEAD = "dead"
+    CREATE_COLLECTION = "create_collection"
+    DROP_COLLECTION = "drop_collection"
+
+    @staticmethod
+    def alive(node: NodeMetadata) -> list:
+        return [GossipEvent.ALIVE, node.to_wire()]
+
+    @staticmethod
+    def dead(node_name: str) -> list:
+        return [GossipEvent.DEAD, node_name]
+
+    @staticmethod
+    def create_collection(name: str, rf: int) -> list:
+        return [GossipEvent.CREATE_COLLECTION, name, rf]
+
+    @staticmethod
+    def drop_collection(name: str) -> list:
+        return [GossipEvent.DROP_COLLECTION, name]
+
+
+def serialize_gossip_message(source: str, event: list) -> bytes:
+    return msgpack.packb([source, event], use_bin_type=True)
+
+
+def deserialize_gossip_message(buf: bytes) -> Tuple[str, list]:
+    msg = msgpack.unpackb(buf, raw=False)
+    return msg[0], msg[1]
+
+
+def pack_message(message: list) -> bytes:
+    return msgpack.packb(message, use_bin_type=True)
+
+
+def unpack_message(buf: bytes) -> list:
+    return msgpack.unpackb(buf, raw=False)

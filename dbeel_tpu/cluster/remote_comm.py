@@ -1,0 +1,156 @@
+"""Inter-node shard RPC plane: msgpack over TCP.
+
+Role parity with /root/reference/src/remote_shard_connection.rs:15-120:
+connect-per-request with connect/read/write timeouts, 4-byte LE length
+framing, typed helpers (ping / get_metadata / get_collections /
+send_request), plus a persistent stream for migration
+(migration.rs:70-72).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, List, Optional, Tuple
+
+from ..errors import ConnectionError_, ProtocolError, Timeout
+from . import messages
+from .messages import (
+    NodeMetadata,
+    ShardRequest,
+    ShardResponse,
+    pack_message,
+    response_to_result,
+    unpack_message,
+)
+
+_LEN = struct.Struct("<I")
+MAX_MESSAGE = 64 << 20
+
+
+async def send_message_to_stream(
+    writer: asyncio.StreamWriter, message: list
+) -> None:
+    buf = pack_message(message)
+    writer.write(_LEN.pack(len(buf)) + buf)
+    await writer.drain()
+
+
+async def get_message_from_stream(reader: asyncio.StreamReader) -> list:
+    header = await reader.readexactly(_LEN.size)
+    (size,) = _LEN.unpack(header)
+    if size > MAX_MESSAGE:
+        raise ProtocolError(f"frame too large: {size}")
+    return unpack_message(await reader.readexactly(size))
+
+
+class RemoteShardConnection:
+    def __init__(
+        self,
+        address: str,  # "<ip>:<port>"
+        connect_timeout_ms: int = 5000,
+        read_timeout_ms: int = 15000,
+        write_timeout_ms: int = 15000,
+    ) -> None:
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self.host = host
+        self.port = int(port)
+        self.connect_timeout = connect_timeout_ms / 1000
+        self.read_timeout = read_timeout_ms / 1000
+        self.write_timeout = write_timeout_ms / 1000
+
+    @classmethod
+    def from_config(cls, address: str, cfg) -> "RemoteShardConnection":
+        return cls(
+            address,
+            cfg.remote_shard_connect_timeout_ms,
+            cfg.remote_shard_read_timeout_ms,
+            cfg.remote_shard_write_timeout_ms,
+        )
+
+    async def _connect(self):
+        try:
+            return await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self.connect_timeout,
+            )
+        except asyncio.TimeoutError as e:
+            raise Timeout(f"connect to {self.address}") from e
+        except OSError as e:
+            raise ConnectionError_(
+                f"connect to {self.address}: {e}"
+            ) from e
+
+    async def send_message(self, message: list) -> list:
+        """Connect, send one message, read one reply, close
+        (remote_shard_connection.rs:50-72)."""
+        reader, writer = await self._connect()
+        try:
+            try:
+                await asyncio.wait_for(
+                    send_message_to_stream(writer, message),
+                    self.write_timeout,
+                )
+                return await asyncio.wait_for(
+                    get_message_from_stream(reader), self.read_timeout
+                )
+            except asyncio.TimeoutError as e:
+                raise Timeout(f"rpc to {self.address}") from e
+            except (OSError, asyncio.IncompleteReadError) as e:
+                raise ConnectionError_(
+                    f"rpc to {self.address}: {e}"
+                ) from e
+        finally:
+            writer.close()
+
+    async def send_request(self, request: list) -> list:
+        """Send a ShardRequest, return the ShardResponse payload list."""
+        return await self.send_message(request)
+
+    async def ping(self) -> None:
+        response_to_result(
+            await self.send_request(ShardRequest.ping()),
+            ShardResponse.PONG,
+        )
+
+    async def get_metadata(self) -> List[NodeMetadata]:
+        nodes = response_to_result(
+            await self.send_request(ShardRequest.get_metadata()),
+            ShardResponse.GET_METADATA,
+        )
+        return [NodeMetadata.from_wire(n) for n in nodes]
+
+    async def get_collections(self) -> List[Tuple[str, int]]:
+        cols = response_to_result(
+            await self.send_request(ShardRequest.get_collections()),
+            ShardResponse.GET_COLLECTIONS,
+        )
+        return [(c[0], c[1]) for c in cols]
+
+    async def open_stream(self) -> "RemoteShardStream":
+        """Persistent multi-message connection (migration uses one
+        stream for a whole range hand-off, migration.rs:70-112)."""
+        reader, writer = await self._connect()
+        return RemoteShardStream(self, reader, writer)
+
+
+class RemoteShardStream:
+    def __init__(self, conn, reader, writer) -> None:
+        self.conn = conn
+        self.reader = reader
+        self.writer = writer
+
+    async def send(self, message: list) -> None:
+        await asyncio.wait_for(
+            send_message_to_stream(self.writer, message),
+            self.conn.write_timeout,
+        )
+
+    async def recv(self) -> list:
+        return await asyncio.wait_for(
+            get_message_from_stream(self.reader), self.conn.read_timeout
+        )
+
+    def close(self) -> None:
+        self.writer.close()

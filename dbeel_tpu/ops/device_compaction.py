@@ -22,9 +22,17 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
     """Default device path: the transfer-minimal 8-byte-prefix bitonic
     merge (ops/bitonic.py) + host tie refinement.  Fully general — any
     prefix tie (same key, shared prefix, long keys) is re-ordered and
-    dedup-confirmed on the host with full-key compares."""
+    dedup-confirmed on the host with full-key compares.  Keyspaces where
+    many keys share one 8-byte prefix (e.g. everything under b"user:...")
+    would push that refinement into interpreted Python, so past a tie
+    threshold the merge re-routes to the full 16-byte-column device path
+    instead of paying the cliff."""
 
     name = "device"
+
+    # Above this fraction of adjacent 8-byte-prefix ties, re-sort on the
+    # device with full key columns rather than fix up row-by-row on host.
+    TIE_FALLBACK_FRACTION = 0.02
 
     def sort_and_dedup(
         self, cols: columnar.MergeColumns
@@ -36,6 +44,17 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
             np.bincount(cols.src).tolist() if len(cols) else []
         )
         perm = device_merge_prefix_order(cols, run_counts)
+        if len(cols) > 1:
+            kw = cols.key_words[perm]
+            ties = int(
+                np.all(kw[1:, :2] == kw[:-1, :2], axis=1).sum()
+            )
+            if ties > max(
+                1024, self.TIE_FALLBACK_FRACTION * len(cols)
+            ):
+                return DeviceFullMergeStrategy.sort_and_dedup(
+                    self, cols
+                )
         perm = columnar.fixup_prefix_ties(cols, perm, words=2)
         keep = columnar.dedup_mask_prefix(cols, perm, words=2)
         return perm, keep

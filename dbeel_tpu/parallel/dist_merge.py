@@ -46,24 +46,24 @@ def _pow2(n: int) -> int:
     return p
 
 
-def _local_sort(stack: jnp.ndarray) -> jnp.ndarray:
+def _local_sort(stack: jnp.ndarray):
     """Sort rows of an (M, NUM_COLS) stack by the first 8 columns via the
     bitonic network (lax.sort's multi-key TPU comparator is pathological;
     see ops/bitonic.py).  Pads to a power of two with sentinel rows that
-    sort last, then slices back."""
+    sort last, then slices back.  Returns (sorted, same-key flags)."""
     m = stack.shape[0]
     p = _pow2(m)
     if p != m:
         pad = jnp.full((p - m, NUM_COLS), _SENTINEL)
         stack = jnp.concatenate([stack, pad], axis=0)
-    out, _ = bitonic.sort_stack_kernel(stack)
-    return out[:m]
+    out, same = bitonic.sort_stack_kernel(stack)
+    return out[:m], same[:m]
 
 
 def _per_device(stack: jnp.ndarray, capacity: int, n_dev: int):
     """shard_map body. stack: (M, NUM_COLS) local slice."""
     m = stack.shape[0]
-    local = _local_sort(stack)  # (M, NUM_COLS), sorted
+    local, _ = _local_sort(stack)  # (M, NUM_COLS), sorted
 
     # -- splitters: sample k0 evenly, gather everywhere ---------------
     k0 = local[:, 0]
@@ -103,12 +103,7 @@ def _per_device(stack: jnp.ndarray, capacity: int, n_dev: int):
 
     # -- final local sort over this device's key range ----------------
     flat = recv.reshape(n_dev * capacity, NUM_COLS)
-    out = _local_sort(flat)
-    eq = jnp.ones(out.shape[0] - 1, dtype=bool)
-    for c in range(5):
-        eq = eq & (out[1:, c] == out[:-1, c])
-    eq = eq & (out[1:, 4] != _SENTINEL)
-    same = jnp.concatenate([jnp.zeros((1,), bool), eq])
+    out, same = _local_sort(flat)
     return out, same, overflow[None]
 
 
@@ -196,26 +191,25 @@ def _single_device_fallback(cols: columnar.MergeColumns):
     return bitonic.device_merge_sorted_runs(cols, run_counts)
 
 
-class DistributedMergeStrategy:
-    """CompactionStrategy running the sort across the whole mesh."""
+def DistributedMergeStrategy(mesh: Mesh):
+    """CompactionStrategy running the sort across the whole mesh.
+    Factory (rather than top-level subclass) so this module stays
+    importable without dragging the storage stack in at import time."""
+    from ..storage.compaction import ColumnarMergeStrategy
 
-    name = "distributed"
+    class _DistributedMergeStrategy(ColumnarMergeStrategy):
+        name = "distributed"
 
-    def __init__(self, mesh: Mesh) -> None:
-        self.mesh = mesh
+        def __init__(self, mesh_: Mesh) -> None:
+            self.mesh = mesh_
 
-    def sort_and_dedup(self, cols):
-        perm, same = distributed_sort_dedup(cols, self.mesh)
-        # Long keys: see DeviceMergeStrategy — host fixes order + dedup.
-        if (cols.key_size > columnar.KEY_PREFIX_BYTES).any():
-            perm = columnar.fixup_long_key_ties(cols, perm)
-            return perm, columnar.dedup_mask(cols, perm)
-        return perm, ~same
+        def sort_and_dedup(self, cols):
+            perm, same = distributed_sort_dedup(cols, self.mesh)
+            # Long keys: host fixes order + dedup (see
+            # DeviceMergeStrategy).
+            if (cols.key_size > columnar.KEY_PREFIX_BYTES).any():
+                perm = columnar.fixup_long_key_ties(cols, perm)
+                return perm, columnar.dedup_mask(cols, perm)
+            return perm, ~same
 
-    # Delegate the file-level merge to the columnar template.
-    def merge(self, *args, **kwargs):
-        from ..storage.compaction import ColumnarMergeStrategy
-
-        tmpl = ColumnarMergeStrategy()
-        tmpl.sort_and_dedup = self.sort_and_dedup  # type: ignore
-        return tmpl.merge(*args, **kwargs)
+    return _DistributedMergeStrategy(mesh)

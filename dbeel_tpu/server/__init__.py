@@ -1,0 +1,3 @@
+"""L1/L5/L6: shard runtime, cluster hub, and the public document API."""
+
+from .shard import MyShard, Shard, ShardConnection  # noqa: F401

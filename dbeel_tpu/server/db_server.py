@@ -1,0 +1,293 @@
+"""The public document API server — msgpack over TCP.
+
+Wire-compatible with /root/reference/src/tasks/db_server.rs: one listener
+per shard at ``port + shard_id``; requests are u16-LE length-prefixed
+msgpack maps; responses are u32-LE length-prefixed payloads with one
+trailing type byte (Err=0, Ok=1, Bytes=2); errors cross as
+``[name, message]``; the connection closes after each response.  The
+reference's own 49-line python client (/root/reference/dbeel.py) works
+against this server unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional
+
+import msgpack
+
+from ..errors import (
+    BadFieldType,
+    DbeelError,
+    KeyNotFound,
+    KeyNotOwnedByShard,
+    MissingField,
+    Timeout,
+    UnsupportedField,
+)
+from ..cluster import messages as msgs
+from ..cluster.messages import ShardRequest, ShardResponse
+from ..storage.entry import TOMBSTONE
+from ..utils.murmur import hash_bytes
+from ..utils.timestamps import now_nanos
+from .shard import MyShard
+
+log = logging.getLogger(__name__)
+
+RESPONSE_ERR = 0
+RESPONSE_OK = 1
+RESPONSE_BYTES = 2
+
+DEFAULT_SET_TIMEOUT_MS = 5000  # db_server.rs:31-32
+DEFAULT_GET_TIMEOUT_MS = 5000
+
+
+def _extract(map_: dict, field: str):
+    if field not in map_:
+        raise MissingField(field)
+    return map_[field]
+
+
+def _encode_field(value) -> bytes:
+    """Keys/values are stored as their msgpack encoding
+    (db_server.rs:93-104)."""
+    return msgpack.packb(value, use_bin_type=True)
+
+
+def extract_key(my_shard: MyShard, map_: dict, replica_index: int) -> bytes:
+    key = _encode_field(_extract(map_, "key"))
+    key_hash = map_.get("hash")
+    if not isinstance(key_hash, int):
+        key_hash = hash_bytes(key)
+    if not my_shard.owns_key(key_hash, replica_index):
+        raise KeyNotOwnedByShard(
+            f"shard {my_shard.shard_name} does not own hash {key_hash}"
+        )
+    return key
+
+
+async def handle_request(
+    my_shard: MyShard, buffer: bytes
+) -> Optional[bytes]:
+    """Returns the response payload (None => plain 'OK')."""
+    try:
+        request = msgpack.unpackb(buffer, raw=False)
+    except Exception as e:
+        raise BadFieldType(f"document: {e}") from e
+    if not isinstance(request, dict):
+        raise BadFieldType("document")
+
+    timestamp = now_nanos()
+    rtype = request.get("type")
+
+    if rtype == "get_cluster_metadata":
+        return msgpack.packb(
+            my_shard.get_cluster_metadata().to_wire(), use_bin_type=True
+        )
+
+    if rtype == "create_collection":
+        name = _extract(request, "name")
+        rf = request.get("replication_factor")
+        if not isinstance(rf, int):
+            rf = my_shard.config.default_replication_factor
+        from ..errors import CollectionAlreadyExists
+
+        if name in my_shard.collections:
+            raise CollectionAlreadyExists(name)
+        await my_shard.create_collection(name, rf)
+        await my_shard.send_request_to_local_shards(
+            ShardRequest.create_collection(name, rf),
+            ShardResponse.CREATE_COLLECTION,
+        )
+        await my_shard.gossip(
+            msgs.GossipEvent.create_collection(name, rf)
+        )
+        return None
+
+    if rtype == "get_collection":
+        name = _extract(request, "name")
+        col = my_shard.get_collection(name)
+        return msgpack.packb(
+            {"replication_factor": col.replication_factor},
+            use_bin_type=True,
+        )
+
+    if rtype == "drop_collection":
+        name = _extract(request, "name")
+        await my_shard.drop_collection(name)
+        await my_shard.send_request_to_local_shards(
+            ShardRequest.drop_collection(name),
+            ShardResponse.DROP_COLLECTION,
+        )
+        await my_shard.gossip(msgs.GossipEvent.drop_collection(name))
+        return None
+
+    if rtype in ("set", "delete"):
+        collection_name = _extract(request, "collection")
+        timeout_ms = request.get("timeout") or DEFAULT_SET_TIMEOUT_MS
+        replica_index = request.get("replica_index") or 0
+        col = my_shard.get_collection(collection_name)
+        key = extract_key(my_shard, request, replica_index)
+        rf = col.replication_factor
+
+        if rtype == "set":
+            value = _encode_field(_extract(request, "value"))
+        else:
+            value = TOMBSTONE
+
+        consistency = request.get("consistency")
+        if not isinstance(consistency, int):
+            consistency = rf
+        consistency = min(consistency, rf)
+
+        async def local_write():
+            await col.tree.set_with_timestamp(key, value, timestamp)
+
+        if rf > 1:
+            remote_request = (
+                ShardRequest.set(collection_name, key, value, timestamp)
+                if rtype == "set"
+                else ShardRequest.delete(collection_name, key, timestamp)
+            )
+            expected = (
+                ShardResponse.SET
+                if rtype == "set"
+                else ShardResponse.DELETE
+            )
+            remote = my_shard.send_request_to_replicas(
+                remote_request,
+                consistency - 1,
+                rf - replica_index - 1,
+                expected,
+            )
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(local_write(), remote),
+                    timeout_ms / 1000,
+                )
+            except asyncio.TimeoutError as e:
+                raise Timeout(rtype) from e
+        else:
+            try:
+                await asyncio.wait_for(local_write(), timeout_ms / 1000)
+            except asyncio.TimeoutError as e:
+                raise Timeout(rtype) from e
+        return None
+
+    if rtype == "get":
+        collection_name = _extract(request, "collection")
+        timeout_ms = request.get("timeout") or DEFAULT_GET_TIMEOUT_MS
+        replica_index = request.get("replica_index") or 0
+        col = my_shard.get_collection(collection_name)
+        key = extract_key(my_shard, request, replica_index)
+        rf = col.replication_factor
+
+        consistency = request.get("consistency")
+        if not isinstance(consistency, int):
+            consistency = rf
+        consistency = min(consistency, rf)
+
+        if rf > 1:
+            remote = my_shard.send_request_to_replicas(
+                ShardRequest.get(collection_name, key),
+                consistency - 1,
+                rf - replica_index - 1,
+                ShardResponse.GET,
+            )
+            try:
+                local_value, values = await asyncio.wait_for(
+                    asyncio.gather(col.tree.get_entry(key), remote),
+                    timeout_ms / 1000,
+                )
+            except asyncio.TimeoutError as e:
+                raise Timeout("get") from e
+            entries = [
+                (bytes(v[0]), v[1]) for v in values if v is not None
+            ]
+            if local_value is not None:
+                entries.append(local_value)
+            # Conflict resolution: max server timestamp wins
+            # (db_server.rs:353-363).
+            if entries:
+                value = max(entries, key=lambda e: e[1])[0]
+                if value != TOMBSTONE:
+                    return value
+            raise KeyNotFound(repr(key))
+        try:
+            value = await asyncio.wait_for(
+                col.tree.get(key), timeout_ms / 1000
+            )
+        except asyncio.TimeoutError as e:
+            raise Timeout("get") from e
+        if value is None:
+            raise KeyNotFound(repr(key))
+        return value
+
+    if isinstance(rtype, str):
+        raise UnsupportedField(rtype)
+    raise BadFieldType("type")
+
+
+async def _send_response(writer: asyncio.StreamWriter, buf: bytes):
+    writer.write(struct.pack("<I", len(buf)) + buf)
+    await writer.drain()
+
+
+async def handle_client(
+    my_shard: MyShard,
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+) -> None:
+    try:
+        size_buf = await reader.readexactly(2)
+        (size,) = struct.unpack("<H", size_buf)
+        request_buf = await reader.readexactly(size)
+    except (asyncio.IncompleteReadError, OSError):
+        writer.close()
+        return
+
+    try:
+        payload = await handle_request(my_shard, request_buf)
+        if payload is None:
+            buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
+        else:
+            buf = payload + bytes([RESPONSE_OK])
+    except DbeelError as e:
+        if not isinstance(e, KeyNotFound):
+            log.error("error handling request: %r", e)
+        buf = msgpack.packb(e.to_wire(), use_bin_type=True) + bytes(
+            [RESPONSE_ERR]
+        )
+    except Exception as e:  # defensive: never kill the accept loop
+        log.exception("unexpected error handling request")
+        buf = msgpack.packb(
+            ["Internal", str(e)], use_bin_type=True
+        ) + bytes([RESPONSE_ERR])
+
+    try:
+        await _send_response(writer, buf)
+    except OSError:
+        pass
+    writer.close()
+
+
+async def bind_db_server(my_shard: MyShard) -> asyncio.Server:
+    port = my_shard.config.db_port(my_shard.id)
+    server = await asyncio.start_server(
+        lambda r, w: my_shard.spawn(handle_client(my_shard, r, w)),
+        my_shard.config.ip,
+        port,
+    )
+    log.info("listening for clients on %s:%d", my_shard.config.ip, port)
+    return server
+
+
+async def run_db_server(
+    my_shard: MyShard, server: Optional[asyncio.Server] = None
+) -> None:
+    if server is None:
+        server = await bind_db_server(my_shard)
+    async with server:
+        await server.serve_forever()

@@ -1,0 +1,80 @@
+"""Hash-range migration executor.
+
+Role parity with /root/reference/src/tasks/migration.rs:19-169: given a
+collection tree and (start, end] ring ranges with actions, stream every
+matching entry as a Set event over one persistent TCP stream (remote) or
+the local packet channel, or tombstone-delete the range.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from ..cluster.local_comm import LocalShardConnection
+from ..cluster.messages import ShardEvent
+from ..cluster.remote_comm import RemoteShardConnection
+from ..storage.lsm_tree import LSMTree
+from ..utils.murmur import hash_bytes
+
+log = logging.getLogger(__name__)
+
+
+def _between(hash_: int, start: int, end: int) -> bool:
+    """Half-open wrap-around range [start, end).
+
+    Deliberate deviation: the reference's between_cmp
+    (migration.rs:54-60) inverts the wrap branch
+    (``hash < start || hash >= end``), which matches EVERY hash once a
+    migration range wraps the ring origin — a delete action then wipes
+    the whole collection on that shard.  We use the same semantics as
+    the ring's is_between (shards.rs:103-109) instead."""
+    if end < start:
+        return hash_ >= start or hash_ < end
+    return start <= hash_ < end
+
+
+async def migrate_actions(
+    my_shard,
+    collection_name: str,
+    tree: LSMTree,
+    ranges_and_actions: List,
+) -> None:
+    from .shard import MigrationAction
+
+    streams = []
+    for ra in ranges_and_actions:
+        if ra.action == MigrationAction.SEND and isinstance(
+            ra.connection, RemoteShardConnection
+        ):
+            streams.append(await ra.connection.open_stream())
+        else:
+            streams.append(None)
+
+    ranges = [(ra.start, ra.end) for ra in ranges_and_actions]
+
+    try:
+        async for key, value, ts in tree.iter_filter(
+            lambda k, v, t: any(
+                _between(hash_bytes(k), s, e) for s, e in ranges
+            )
+        ):
+            h = hash_bytes(key)
+            index = next(
+                i
+                for i, (s, e) in enumerate(ranges)
+                if _between(h, s, e)
+            )
+            ra = ranges_and_actions[index]
+            if ra.action == MigrationAction.DELETE:
+                await tree.delete(key)
+                continue
+            msg = ShardEvent.set(collection_name, key, value, ts)
+            if streams[index] is not None:
+                await streams[index].send(msg)
+            elif isinstance(ra.connection, LocalShardConnection):
+                await ra.connection.send_message(my_shard.id, msg)
+    finally:
+        for stream in streams:
+            if stream is not None:
+                stream.close()

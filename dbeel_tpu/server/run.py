@@ -1,0 +1,191 @@
+"""Node bootstrap: create shards, discover the cluster, run task sets.
+
+Role parity with /root/reference/src/main.rs:17-72 and run_shard.rs:
+one shard per core (or --shards N), shard 0 is the "node managing" shard
+that additionally runs the gossip server and failure detector; each
+shard discovers collections (disk scan + seed query) and nodes (seed
+get_metadata), announces itself via Alive gossip, then serves until a
+stop event cancels the whole task set.
+
+The reference pins one glommio executor per core; here every shard is a
+cooperative task group on one asyncio loop (shared-nothing by
+discipline: shards interact only through their packet queues), and a
+multi-process core-pinned launcher can wrap this module per-core.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+from typing import List, Optional
+
+from ..config import Config, parse_args
+from ..errors import DbeelError, ShardStopped
+from ..flow_events import FlowEvent
+from ..cluster import messages as msgs
+from ..cluster.local_comm import LocalShardConnection
+from ..cluster.remote_comm import RemoteShardConnection
+from ..storage.entry import PAGE_SIZE
+from ..storage.page_cache import PageCache
+from . import tasks
+from .db_server import run_db_server
+from .shard import MyShard, Shard
+
+log = logging.getLogger(__name__)
+
+
+def create_shard(
+    config: Config,
+    shard_id: int,
+    connections: List[LocalShardConnection],
+) -> MyShard:
+    """run_shard.rs:174-213."""
+    num_shards = max(1, len(connections))
+    cache = PageCache(
+        max(8, config.page_cache_size // PAGE_SIZE // num_shards)
+    )
+    shards = [
+        Shard(
+            node_name=config.name,
+            name=f"{config.name}-{c.id}",
+            connection=c,
+        )
+        for c in connections
+    ]
+    local = next(c for c in connections if c.id == shard_id)
+    return MyShard(config, shard_id, shards, cache, local)
+
+
+async def discover_collections(my_shard: MyShard) -> None:
+    """run_shard.rs:42-63: disk scan + seed query."""
+    for name, rf in my_shard.get_collections_from_disk():
+        try:
+            await my_shard.create_collection(name, rf)
+        except DbeelError:
+            pass
+    for seed in my_shard.config.seed_nodes:
+        try:
+            conn = RemoteShardConnection.from_config(
+                seed, my_shard.config
+            )
+            for name, rf in await conn.get_collections():
+                if name not in my_shard.collections:
+                    await my_shard.create_collection(name, rf)
+            return
+        except DbeelError as e:
+            log.error("seed %s collection discovery failed: %s", seed, e)
+
+
+async def discover_nodes(my_shard: MyShard) -> None:
+    """run_shard.rs:80-108: seed get_metadata → nodes map + ring."""
+    if not my_shard.config.seed_nodes:
+        return
+    for seed in my_shard.config.seed_nodes:
+        try:
+            conn = RemoteShardConnection.from_config(
+                seed, my_shard.config
+            )
+            nodes = await conn.get_metadata()
+            new_nodes = [
+                n
+                for n in nodes
+                if n.name != my_shard.config.name
+                and n.name not in my_shard.nodes
+            ]
+            for n in new_nodes:
+                my_shard.nodes[n.name] = n
+            my_shard.add_shards_of_nodes(new_nodes)
+            return
+        except DbeelError as e:
+            log.error("seed %s node discovery failed: %s", seed, e)
+    log.warning("no seed node reachable; starting standalone")
+
+
+async def run_shard(
+    my_shard: MyShard, is_node_managing: bool
+) -> None:
+    """run_shard.rs:110-172: discover, spawn task set, announce, serve."""
+    await discover_collections(my_shard)
+    await discover_nodes(my_shard)
+
+    from .db_server import bind_db_server
+
+    # Bind listeners before declaring the shard started, so a client
+    # connecting right after START_TASKS never sees refused connections.
+    remote_server = await tasks.bind_remote_shard_server(my_shard)
+    db_server = await bind_db_server(my_shard)
+
+    coros = [
+        tasks.run_remote_shard_server(my_shard, remote_server),
+        tasks.run_local_shard_server(my_shard),
+        tasks.run_compaction_loop(my_shard),
+        run_db_server(my_shard, db_server),
+        tasks.wait_for_stop(my_shard),
+    ]
+    if is_node_managing:
+        coros.append(tasks.run_gossip_server(my_shard))
+        coros.append(tasks.run_failure_detector(my_shard))
+
+    task_set = [asyncio.ensure_future(c) for c in coros]
+
+    my_shard.flow.notify(FlowEvent.START_TASKS)
+
+    # Announce ourselves (run_shard.rs:141-144).
+    try:
+        await my_shard.gossip(
+            msgs.GossipEvent.alive(my_shard.get_node_metadata())
+        )
+    except Exception as e:
+        log.error("alive gossip failed: %s", e)
+
+    try:
+        done, pending = await asyncio.wait(
+            task_set, return_when=asyncio.FIRST_EXCEPTION
+        )
+        for t in done:
+            exc = t.exception()
+            if exc is not None and not isinstance(exc, ShardStopped):
+                log.error("shard task died: %r", exc)
+    finally:
+        for t in task_set:
+            t.cancel()
+        await asyncio.gather(*task_set, return_exceptions=True)
+        # Announce our death (run_shard.rs:158-166).
+        if is_node_managing:
+            try:
+                await my_shard.gossip(
+                    msgs.GossipEvent.dead(my_shard.config.name)
+                )
+            except Exception:
+                pass
+        my_shard.close()
+
+
+async def run_node(
+    config: Config, num_shards: Optional[int] = None
+) -> None:
+    """main.rs:17-72: one shard per core on a single loop."""
+    n = num_shards or config.shards or os.cpu_count() or 1
+    connections = [LocalShardConnection(i) for i in range(n)]
+    shards = [create_shard(config, i, connections) for i in range(n)]
+    await asyncio.gather(
+        *[run_shard(s, i == 0) for i, s in enumerate(shards)]
+    )
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(
+        level=os.environ.get("DBEEL_LOG", "INFO"),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    config = parse_args(argv)
+    try:
+        asyncio.run(run_node(config))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,808 @@
+"""MyShard — the per-core cluster state hub.
+
+Role parity with /root/reference/src/shards.rs: one instance per shard
+holding config, the consistent hash ring (rotated so this shard sees
+itself as origin), known nodes, collections (one LSM tree per
+(collection, shard)), the page cache, and gossip dedup counts; plus the
+ownership math, replica fan-out with early-ack, gossip send, membership
+handling and hash-range migration planning.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import re
+import socket
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import msgpack
+
+from .. import flow_events
+from ..config import Config
+from ..errors import (
+    CollectionAlreadyExists,
+    CollectionNotFound,
+    DbeelError,
+    NoRemoteShardsFound,
+)
+from ..flow_events import FlowEvent
+from ..storage import DEFAULT_TREE_CAPACITY
+from ..storage.compaction import get_strategy
+from ..storage.lsm_tree import LSMTree
+from ..storage.page_cache import PageCache, PartitionPageCache
+from ..utils.event import LocalEvent
+from ..utils.murmur import hash_bytes, hash_string
+from ..utils.timestamps import now_nanos
+from ..cluster import messages as msgs
+from ..cluster.local_comm import LocalShardConnection, ShardPacket
+from ..cluster.messages import (
+    ClusterMetadata,
+    GossipEvent,
+    NodeMetadata,
+    ShardEvent,
+    ShardRequest,
+    ShardResponse,
+)
+from ..cluster.remote_comm import RemoteShardConnection
+
+log = logging.getLogger(__name__)
+
+# Grace period before migrating to a newly-announced node
+# (shards.rs:64-65, NEW_NODE_MIGARTION_DELAY = 500ms).
+NEW_NODE_MIGRATION_DELAY_S = 0.5
+
+
+def is_between(item: int, start: int, end: int) -> bool:
+    """Half-open wrap-around ring range [start, end)
+    (shards.rs:103-109)."""
+    if end < start:
+        return item >= start or item < end
+    return start <= item < end
+
+
+ShardConnection = Union[LocalShardConnection, RemoteShardConnection]
+
+
+@dataclass
+class Shard:
+    """Ring entry (shards.rs:80-92)."""
+
+    node_name: str
+    name: str
+    connection: ShardConnection
+    hash: int = -1
+
+    def __post_init__(self):
+        if self.hash < 0:
+            self.hash = hash_string(self.name)
+
+    @property
+    def is_local(self) -> bool:
+        return isinstance(self.connection, LocalShardConnection)
+
+
+@dataclass
+class Collection:
+    tree: LSMTree
+    replication_factor: int
+
+
+class MigrationAction:
+    SEND = "send"
+    DELETE = "delete"
+
+
+@dataclass
+class RangeAndAction:
+    start: int
+    end: int
+    action: str  # MigrationAction
+    connection: Optional[ShardConnection] = None
+
+
+class MyShard:
+    def __init__(
+        self,
+        config: Config,
+        shard_id: int,
+        shards: List[Shard],
+        cache: PageCache,
+        local_connection: LocalShardConnection,
+    ) -> None:
+        self.config = config
+        self.id = shard_id
+        self.shard_name = f"{config.name}-{shard_id}"
+        self.hash = hash_string(self.shard_name)
+        self.shards: List[Shard] = list(shards)
+        self.nodes: Dict[str, NodeMetadata] = {}
+        self.gossip_requests: Dict[Tuple[str, str], int] = {}
+        self.collections: Dict[str, Collection] = {}
+        self.collections_change_event = LocalEvent()
+        self.cache = cache
+        self.local_connection = local_connection
+        self.stop_event = local_connection.stop_event
+        self.flow = flow_events.FlowEventNotifier()
+        self._background_tasks: set = set()
+        self.sort_consistent_hash_ring()
+
+    # ------------------------------------------------------------------
+    # Ring (shards.rs:657-670)
+    # ------------------------------------------------------------------
+
+    def sort_consistent_hash_ring(self) -> None:
+        """Ascending by hash, rotated so hashes >= self.hash come first —
+        shards[0] is this shard, shards[-1] its ring predecessor."""
+        threshold = self.hash
+        self.shards.sort(
+            key=lambda s: (s.hash < threshold, s.hash)
+        )
+
+    def add_shards_of_nodes(self, nodes: List[NodeMetadata]) -> None:
+        for node in nodes:
+            for sid in node.ids:
+                address = f"{node.ip}:{node.remote_shard_base_port + sid}"
+                self.shards.append(
+                    Shard(
+                        node_name=node.name,
+                        name=f"{node.name}-{sid}",
+                        connection=RemoteShardConnection.from_config(
+                            address, self.config
+                        ),
+                    )
+                )
+        self.sort_consistent_hash_ring()
+
+    def owns_key(self, key_hash: int, replica_index: int = 0) -> bool:
+        """shards.rs:586-618 — replica r owns ranges offset by r distinct-
+        node predecessors."""
+        shards = self.shards
+        if len(shards) < 2:
+            return True
+        if replica_index == 0:
+            return is_between(
+                key_hash, shards[-1].hash, shards[0].hash
+            )
+        nodes = set()
+        for i in range(len(shards) - 1, 0, -1):
+            shard = shards[i]
+            prev = shards[i - 1]
+            if shard.node_name == prev.node_name or (
+                shard.node_name in nodes
+            ):
+                continue
+            nodes.add(shard.node_name)
+            if len(nodes) == replica_index:
+                return is_between(key_hash, prev.hash, shard.hash)
+        return False
+
+    @staticmethod
+    def get_last_owning_shard(
+        shards: List[Shard], start_shard_hash: int, replication_factor: int
+    ) -> Optional[Shard]:
+        """shards.rs:1074-1101: walk the ring from the first shard with
+        hash >= start, collecting distinct nodes; the RF-th is the last
+        owner of this range."""
+        if not shards:
+            return None
+        start = next(
+            (
+                i
+                for i, s in enumerate(shards)
+                if s.hash >= start_shard_hash
+            ),
+            0,
+        )
+        nodes = set()
+        found = 0
+        i = 0
+        index = start % len(shards)
+        while i == 0 or index != start:
+            shard = shards[index]
+            if shard.node_name not in nodes:
+                found += 1
+                if found == replication_factor:
+                    return shard
+                nodes.add(shard.node_name)
+            i += 1
+            index = (start + i) % len(shards)
+        return None
+
+    def is_owning_shard(
+        self, start_shard_index: int, replication_factor: int
+    ) -> bool:
+        """shards.rs:1103-1129: is this shard among the RF distinct-node
+        owners of the range starting at ring position start_shard_index?"""
+        shards = self.shards
+        nodes = set()
+        found = 0
+        i = 0
+        index = start_shard_index % len(shards)
+        while i == 0 or index != start_shard_index:
+            shard = shards[index]
+            if shard.node_name not in nodes:
+                if shard.hash == self.hash:
+                    return True
+                found += 1
+                if found == replication_factor:
+                    break
+                nodes.add(shard.node_name)
+            i += 1
+            index = (start_shard_index + i) % len(shards)
+        return False
+
+    # ------------------------------------------------------------------
+    # Node metadata
+    # ------------------------------------------------------------------
+
+    def get_node_metadata(self) -> NodeMetadata:
+        ids = [
+            s.connection.id for s in self.shards if s.is_local
+        ]
+        return NodeMetadata(
+            name=self.config.name,
+            ip=self.config.ip,
+            remote_shard_base_port=self.config.remote_shard_port,
+            ids=sorted(ids),
+            gossip_port=self.config.gossip_port,
+            db_port=self.config.port,
+        )
+
+    def get_nodes(self) -> List[NodeMetadata]:
+        nodes = list(self.nodes.values())
+        nodes.append(self.get_node_metadata())
+        return nodes
+
+    def get_cluster_metadata(self) -> ClusterMetadata:
+        return ClusterMetadata(
+            nodes=self.get_nodes(),
+            collections=[
+                (name, c.replication_factor)
+                for name, c in self.collections.items()
+            ],
+        )
+
+    # ------------------------------------------------------------------
+    # Collections (shards.rs:259-381)
+    # ------------------------------------------------------------------
+
+    def _collection_metadata_path(self, name: str) -> str:
+        return os.path.join(self.config.dir, f"{name}.metadata")
+
+    def _collection_dir(self, name: str) -> str:
+        return os.path.join(self.config.dir, f"{name}-{self.id}")
+
+    def get_collection(self, name: str) -> Collection:
+        col = self.collections.get(name)
+        if col is None:
+            raise CollectionNotFound(name)
+        return col
+
+    def _create_lsm_tree(self, name: str) -> LSMTree:
+        capacity = self.config.memtable_capacity or DEFAULT_TREE_CAPACITY
+        return LSMTree.open_or_create(
+            self._collection_dir(name),
+            cache=PartitionPageCache(name, self.cache),
+            capacity=capacity,
+            wal_sync=self.config.wal_sync,
+            wal_sync_delay_us=self.config.wal_sync_delay_us,
+            bloom_min_size=self.config.sstable_bloom_min_size,
+            strategy=get_strategy(self.config.compaction_backend),
+        )
+
+    async def create_collection(
+        self, name: str, replication_factor: int
+    ) -> None:
+        if name in self.collections:
+            raise CollectionAlreadyExists(name)
+        os.makedirs(self.config.dir, exist_ok=True)
+        tree = self._create_lsm_tree(name)
+        path = self._collection_metadata_path(name)
+        if not os.path.exists(path):
+            with open(path, "wb") as f:
+                f.write(
+                    msgpack.packb(
+                        {"replication_factor": replication_factor}
+                    )
+                )
+                f.flush()
+                os.fsync(f.fileno())
+        self.collections[name] = Collection(tree, replication_factor)
+        self.collections_change_event.notify()
+        self.flow.notify(FlowEvent.COLLECTION_CREATED)
+
+    async def drop_collection(self, name: str) -> None:
+        try:
+            os.unlink(self._collection_metadata_path(name))
+        except OSError:
+            pass
+        col = self.collections.pop(name, None)
+        if col is None:
+            raise CollectionNotFound(name)
+        await col.tree.purge()
+        self.collections_change_event.notify()
+        self.flow.notify(FlowEvent.COLLECTION_DROPPED)
+
+    def get_collections_from_disk(self) -> List[Tuple[str, int]]:
+        """Disk discovery by '<name>-<id>' directory scan
+        (shards.rs:265-311)."""
+        if not os.path.isdir(self.config.dir):
+            return []
+        pattern = re.compile(rf"^(.*?)\-{self.id}$")
+        out = []
+        for entry in os.listdir(self.config.dir):
+            m = pattern.match(entry)
+            if not m or not os.path.isdir(
+                os.path.join(self.config.dir, entry)
+            ):
+                continue
+            name = m.group(1)
+            meta_path = self._collection_metadata_path(name)
+            try:
+                with open(meta_path, "rb") as f:
+                    meta = msgpack.unpackb(f.read(), raw=False)
+                out.append((name, meta["replication_factor"]))
+            except FileNotFoundError:
+                log.error(
+                    "collection %r has no metadata file on disk", name
+                )
+        return out
+
+    # ------------------------------------------------------------------
+    # Local shard comm (shards.rs:398-460)
+    # ------------------------------------------------------------------
+
+    def local_connections(self) -> List[LocalShardConnection]:
+        return [
+            s.connection
+            for s in self.shards
+            if s.is_local and s.connection.id != self.id
+        ]
+
+    async def broadcast_message_to_local_shards(self, message: list):
+        await asyncio.gather(
+            *[
+                c.send_message(self.id, message)
+                for c in self.local_connections()
+            ]
+        )
+
+    async def send_request_to_local_shards(
+        self, request: list, expected_kind: str
+    ) -> List:
+        results = await asyncio.gather(
+            *[
+                c.send_request(self.id, request)
+                for c in self.local_connections()
+            ]
+        )
+        return [
+            msgs.response_to_result(r, expected_kind) for r in results
+        ]
+
+    # ------------------------------------------------------------------
+    # Replica fan-out (shards.rs:463-543)
+    # ------------------------------------------------------------------
+
+    async def send_request_to_replicas(
+        self,
+        request: list,
+        number_of_acks: int,
+        number_of_nodes: int,
+        expected_kind: str,
+    ) -> List:
+        """Send to the first ``number_of_nodes`` distinct-node remote
+        shards on the ring; return after ``number_of_acks`` successes,
+        drain the rest in the background."""
+        nodes: set = set()
+        connections: List[RemoteShardConnection] = []
+        for s in self.shards:
+            if s.is_local or s.node_name in nodes:
+                continue
+            nodes.add(s.node_name)
+            connections.append(s.connection)
+            if len(connections) >= number_of_nodes:
+                break
+
+        result_future: asyncio.Future = (
+            asyncio.get_event_loop().create_future()
+        )
+
+        async def fan_out():
+            pending = {
+                asyncio.ensure_future(c.send_request(request))
+                for c in connections
+            }
+            results: List = []
+            acks = 0
+            try:
+                # Like the reference (shards.rs:500-528): gather up to
+                # number_of_acks successes; when replicas run out early,
+                # return what we have rather than erroring.
+                while pending and acks < number_of_acks:
+                    done, pending = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for fut in done:
+                        try:
+                            response = fut.result()
+                            results.append(
+                                msgs.response_to_result(
+                                    response, expected_kind
+                                )
+                            )
+                            acks += 1
+                        except DbeelError as e:
+                            log.error(
+                                "failed response from replica: %s", e
+                            )
+            finally:
+                if not result_future.done():
+                    result_future.set_result(results)
+            # Drain stragglers in the background (shards.rs:530-539).
+            for fut in pending:
+                try:
+                    await fut
+                except Exception as e:
+                    log.error("replica request in background: %s", e)
+
+        self.spawn(fan_out())
+        return await result_future
+
+    def spawn(self, coro) -> asyncio.Task:
+        """Detached background task tied to this shard."""
+        task = asyncio.ensure_future(coro)
+        self._background_tasks.add(task)
+        task.add_done_callback(self._background_tasks.discard)
+        return task
+
+    # ------------------------------------------------------------------
+    # Message dispatch (shards.rs:695-790)
+    # ------------------------------------------------------------------
+
+    async def handle_shard_message(
+        self, message: list
+    ) -> Optional[list]:
+        tag = message[0]
+        if tag == "event":
+            await self.handle_shard_event(message)
+            return None
+        if tag == "request":
+            try:
+                return await self.handle_shard_request(message)
+            except DbeelError as e:
+                return ShardResponse.error(e)
+        return None
+
+    async def handle_shard_event(self, event: list) -> None:
+        kind = event[1]
+        if kind == ShardEvent.GOSSIP:
+            await self.handle_gossip_event(event[2])
+        elif kind == ShardEvent.SET:
+            _, _, collection, key, value, ts = event
+            await self.handle_shard_set_message(
+                collection, bytes(key), bytes(value), ts
+            )
+
+    async def handle_shard_set_message(
+        self, collection: str, key: bytes, value: bytes, ts: int
+    ) -> None:
+        col = self.get_collection(collection)
+        await col.tree.set_with_timestamp(key, value, ts)
+        self.flow.notify(FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
+
+    async def handle_shard_request(self, request: list) -> list:
+        kind = request[1]
+        if kind == ShardRequest.PING:
+            return ShardResponse.pong()
+        if kind == ShardRequest.GET_METADATA:
+            return ShardResponse.get_metadata(self.get_nodes())
+        if kind == ShardRequest.GET_COLLECTIONS:
+            return ShardResponse.get_collections(
+                [
+                    (n, c.replication_factor)
+                    for n, c in self.collections.items()
+                ]
+            )
+        if kind == ShardRequest.CREATE_COLLECTION:
+            await self.create_collection(request[2], request[3])
+            return ShardResponse.empty(ShardResponse.CREATE_COLLECTION)
+        if kind == ShardRequest.DROP_COLLECTION:
+            await self.drop_collection(request[2])
+            return ShardResponse.empty(ShardResponse.DROP_COLLECTION)
+        if kind == ShardRequest.SET:
+            await self.handle_shard_set_message(
+                request[2], bytes(request[3]), bytes(request[4]), request[5]
+            )
+            return ShardResponse.empty(ShardResponse.SET)
+        if kind == ShardRequest.DELETE:
+            col = self.collections.get(request[2])
+            if col is not None:
+                await col.tree.delete_with_timestamp(
+                    bytes(request[3]), request[4]
+                )
+            return ShardResponse.empty(ShardResponse.DELETE)
+        if kind == ShardRequest.GET:
+            col = self.collections.get(request[2])
+            entry = None
+            if col is not None:
+                entry = await col.tree.get_entry(bytes(request[3]))
+            return ShardResponse.get(entry)
+        raise DbeelError(f"unknown shard request {kind!r}")
+
+    # ------------------------------------------------------------------
+    # Gossip (shards.rs:791-827, 1131-1200)
+    # ------------------------------------------------------------------
+
+    async def gossip(self, event: list) -> None:
+        await self.broadcast_message_to_local_shards(
+            ShardEvent.gossip(event)
+        )
+        buf = msgs.serialize_gossip_message(self.config.name, event)
+        await self.gossip_buffer(buf)
+
+    async def gossip_buffer(self, buf: bytes) -> None:
+        """Fire-and-forget UDP to gossip_fanout random nodes."""
+        import random
+
+        nodes = list(self.nodes.values())
+        random.shuffle(nodes)
+        targets = nodes[: self.config.gossip_fanout]
+        loop = asyncio.get_event_loop()
+        for node in targets:
+            try:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock.setblocking(False)
+                await loop.sock_sendto(
+                    sock, buf, (node.ip, node.gossip_port)
+                )
+                sock.close()
+            except OSError as e:
+                log.error("gossip send to %s failed: %s", node.name, e)
+
+    async def handle_gossip_event(self, event: list) -> bool:
+        """Returns True when the event should continue propagating
+        (shards.rs:1131-1200 returns !another_gossip_sent)."""
+        kind = event[0]
+        another_gossip_sent = False
+        if kind == GossipEvent.ALIVE:
+            node = NodeMetadata.from_wire(event[1])
+            if node.name != self.config.name:
+                if node.name not in self.nodes:
+                    self.nodes[node.name] = node
+                    self.add_shards_of_nodes([node])
+                self.flow.notify(FlowEvent.ALIVE_NODE_GOSSIP)
+                added = [
+                    s
+                    for s in self.shards
+                    if s.node_name == node.name
+                ]
+                self.migrate_data_on_node_addition(added)
+        elif kind == GossipEvent.DEAD:
+            node_name = event[1]
+            if node_name == self.config.name:
+                # Self-defense: we're alive — re-announce (1165-1172).
+                await self.gossip(
+                    GossipEvent.alive(self.get_node_metadata())
+                )
+                another_gossip_sent = True
+            else:
+                await self.handle_dead_node(node_name)
+        elif kind == GossipEvent.CREATE_COLLECTION:
+            try:
+                await self.create_collection(event[1], event[2])
+            except CollectionAlreadyExists:
+                pass
+        elif kind == GossipEvent.DROP_COLLECTION:
+            try:
+                await self.drop_collection(event[1])
+            except CollectionNotFound:
+                pass
+        return not another_gossip_sent
+
+    async def handle_dead_node(self, node_name: str) -> None:
+        if self.nodes.pop(node_name, None) is None:
+            return
+        removed = [s for s in self.shards if s.node_name == node_name]
+        self.shards = [
+            s for s in self.shards if s.node_name != node_name
+        ]
+        log.info(
+            "after death of %s: %d nodes, %d shards",
+            node_name,
+            len(self.nodes),
+            len(self.shards),
+        )
+        self.flow.notify(FlowEvent.DEAD_NODE_REMOVED)
+        await self.migrate_data_on_node_removal(removed)
+
+    # ------------------------------------------------------------------
+    # Migration planning (shards.rs:853-1072)
+    # ------------------------------------------------------------------
+
+    async def migrate_data_on_node_removal(
+        self, removed_shards: List[Shard]
+    ) -> None:
+        assert removed_shards
+        actions: List[Tuple[str, List[RangeAndAction]]] = []
+        for name, collection in list(self.collections.items()):
+            rf = collection.replication_factor
+            if rf <= 1:
+                return
+            if len(self.nodes) + 1 < rf:
+                return
+            migrate_to = self.get_last_owning_shard(
+                self.shards, self.hash, rf
+            )
+            if migrate_to is None:
+                return
+            if not any(
+                is_between(s.hash, self.hash, migrate_to.hash)
+                for s in removed_shards
+            ):
+                return
+            start = self.shards[-1].hash
+            candidates = [
+                s.hash
+                for s in removed_shards
+                if is_between(s.hash, start, self.hash)
+            ]
+            end = (
+                min(
+                    candidates,
+                    key=lambda h: (self.hash - h) & 0xFFFFFFFF,
+                )
+                if candidates
+                else self.hash
+            )
+            actions.append(
+                (
+                    name,
+                    [
+                        RangeAndAction(
+                            start,
+                            end,
+                            MigrationAction.SEND,
+                            migrate_to.connection,
+                        )
+                    ],
+                )
+            )
+        self.spawn_migration_tasks(actions, delay=None)
+
+    def migrate_data_on_node_addition(
+        self, added_shards: List[Shard]
+    ) -> None:
+        assert added_shards
+        all_actions: List[Tuple[str, List[RangeAndAction]]] = []
+        added_names = {s.name for s in added_shards}
+        for name, collection in list(self.collections.items()):
+            rf = collection.replication_factor
+            if rf <= 1:
+                continue
+            if len(self.nodes) + 1 < rf:
+                continue
+            col_actions: List[RangeAndAction] = []
+            last_owning = self.get_last_owning_shard(
+                self.shards, self.hash, rf
+            )
+            if last_owning is None:
+                continue
+            prev_hashes = [
+                s.hash
+                for s in reversed(self.shards)
+                if s.name not in added_names
+            ]
+            if not prev_hashes:
+                return
+            previous_shard_hash = prev_hashes[0]
+
+            # Step 1: send (prev, me] range to the closest added shard
+            # within this shard's replica span.
+            in_span = [
+                s
+                for s in added_shards
+                if is_between(s.hash, self.hash, last_owning.hash)
+                or s.hash == last_owning.hash
+            ]
+            if in_span:
+                migrate_to = min(
+                    in_span,
+                    key=lambda s: (s.hash - self.hash) & 0xFFFFFFFF,
+                )
+                col_actions.append(
+                    RangeAndAction(
+                        previous_shard_hash,
+                        self.hash,
+                        MigrationAction.SEND,
+                        migrate_to.connection,
+                    )
+                )
+
+            # Step 2: chain ranges between added shards that landed
+            # between my predecessor and me.
+            between = [
+                s
+                for s in added_shards
+                if is_between(s.hash, previous_shard_hash, self.hash)
+            ]
+            if len(between) > 1:
+                between.sort(
+                    key=lambda s: (s.hash - self.hash) & 0xFFFFFFFF
+                )
+                for a, b in zip(between, between[1:]):
+                    col_actions.append(
+                        RangeAndAction(
+                            a.hash,
+                            b.hash,
+                            MigrationAction.SEND,
+                            b.connection,
+                        )
+                    )
+
+            # Step 3: delete ranges this shard no longer owns.
+            seen: set = set()
+            for i in range(len(self.shards) - 1, -1, -1):
+                shard = self.shards[i]
+                if shard.name in added_names:
+                    continue
+                seen.add(shard.name)
+                if len(seen) == rf:
+                    break
+                if not self.is_owning_shard(i, rf):
+                    prev_index = (
+                        len(self.shards) - 1 if i == 0 else i - 1
+                    )
+                    col_actions.append(
+                        RangeAndAction(
+                            self.shards[prev_index].hash,
+                            shard.hash,
+                            MigrationAction.DELETE,
+                        )
+                    )
+
+            if col_actions:
+                all_actions.append((name, col_actions))
+
+        self.spawn_migration_tasks(
+            all_actions, delay=NEW_NODE_MIGRATION_DELAY_S
+        )
+
+    def spawn_migration_tasks(
+        self,
+        actions: List[Tuple[str, List[RangeAndAction]]],
+        delay: Optional[float],
+    ) -> None:
+        from .migration import migrate_actions
+
+        for collection_name, ranges in actions:
+            col = self.collections.get(collection_name)
+            if col is None:
+                continue
+
+            async def run(name=collection_name, tree=col.tree, r=ranges):
+                if delay:
+                    await asyncio.sleep(delay)
+                try:
+                    await migrate_actions(self, name, tree, r)
+                except Exception as e:
+                    log.error("error migrating %s: %s", name, e)
+                self.flow.notify(FlowEvent.DONE_MIGRATION)
+
+            self.spawn(run())
+
+    # ------------------------------------------------------------------
+
+    async def stop(self) -> None:
+        self.local_connection.send_stop()
+
+    def try_to_stop_local_shards(self) -> None:
+        for s in self.shards:
+            if s.is_local:
+                s.connection.send_stop()
+
+    def close(self) -> None:
+        for col in self.collections.values():
+            col.tree.close()

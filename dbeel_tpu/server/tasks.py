@@ -1,0 +1,311 @@
+"""Per-shard background tasks.
+
+Role parity with /root/reference/src/tasks/: local shard server
+(local_shard_server.rs), remote shard server (remote_shard_server.rs),
+compaction scheduler (compaction.rs), gossip server (gossip_server.rs),
+failure detector (failure_detector.rs), and the stop-event waiter
+(stop_event_waiter.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import socket
+from typing import List
+
+from ..errors import DbeelError, ShardStopped
+from ..cluster import messages as msgs
+from ..cluster.local_comm import ShardPacket
+from ..cluster.messages import ShardEvent, ShardResponse
+from ..cluster.remote_comm import (
+    RemoteShardConnection,
+    get_message_from_stream,
+    send_message_to_stream,
+)
+from .shard import MyShard
+
+log = logging.getLogger(__name__)
+
+GOSSIP_REQUEST_EXPIRATION_S = 30.0  # gossip_server.rs:17
+UDP_PACKET_BUFFER_SIZE = 65536
+MIN_COMPACTION_FACTOR = 2  # compaction.rs:13
+
+
+# ----------------------------------------------------------------------
+# Local shard server (local_shard_server.rs:8-66)
+# ----------------------------------------------------------------------
+
+
+async def run_local_shard_server(my_shard: MyShard) -> None:
+    queue = my_shard.local_connection.queue
+    while True:
+        packet: ShardPacket = await queue.get()
+        try:
+            response = await my_shard.handle_shard_message(packet.message)
+        except DbeelError as e:
+            response = msgs.ShardResponse.error(e)
+        except Exception as e:
+            log.exception("local shard message failed")
+            response = ["response", ShardResponse.ERROR, "Internal", str(e)]
+        if packet.response_future is not None:
+            if not packet.response_future.done():
+                packet.response_future.set_result(
+                    response
+                    if response is not None
+                    else ShardResponse.pong()
+                )
+
+
+# ----------------------------------------------------------------------
+# Remote shard server (remote_shard_server.rs:19-102)
+# ----------------------------------------------------------------------
+
+
+async def _handle_remote_client(my_shard, reader, writer):
+    try:
+        while True:
+            try:
+                message = await get_message_from_stream(reader)
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                break
+            try:
+                response = await my_shard.handle_shard_message(message)
+                if response is not None:
+                    await send_message_to_stream(writer, response)
+            except DbeelError as e:
+                await send_message_to_stream(
+                    writer, msgs.ShardResponse.error(e)
+                )
+            except Exception as e:
+                log.exception("remote shard message failed")
+                await send_message_to_stream(
+                    writer,
+                    ["response", ShardResponse.ERROR, "Internal", str(e)],
+                )
+    finally:
+        writer.close()
+
+
+async def bind_remote_shard_server(my_shard: MyShard) -> asyncio.Server:
+    port = my_shard.config.remote_port(my_shard.id)
+    server = await asyncio.start_server(
+        lambda r, w: my_shard.spawn(
+            _handle_remote_client(my_shard, r, w)
+        ),
+        my_shard.config.ip,
+        port,
+    )
+    log.info(
+        "listening for distributed messages on %s:%d",
+        my_shard.config.ip,
+        port,
+    )
+    return server
+
+
+async def run_remote_shard_server(my_shard: MyShard, server=None) -> None:
+    if server is None:
+        server = await bind_remote_shard_server(my_shard)
+    async with server:
+        await server.serve_forever()
+
+
+# ----------------------------------------------------------------------
+# Compaction scheduler (compaction.rs:13-153)
+# ----------------------------------------------------------------------
+
+
+def _leading_zeros64(n: int) -> int:
+    return 64 - n.bit_length() if n else 64
+
+
+async def compact_tree(tree, compaction_factor: int) -> None:
+    """Size-tiered grouping by size order (leading_zeros) with cascade
+    merge of adjacent orders (compaction.rs:35-102)."""
+    indices_and_sizes = tree.sstable_indices_and_sizes()
+
+    odd = [i for i, _ in indices_and_sizes if i % 2 != 0]
+    index_to_compact = (max(odd) + 2) if odd else 1
+
+    groups: dict = {}
+    for i, size in indices_and_sizes:
+        groups.setdefault(_leading_zeros64(size), []).append((i, size))
+
+    # Largest sstables first (smallest leading_zeros first).
+    ordered = sorted(groups.items())
+    optimized: dict = {}
+    for size_order, items in ordered:
+        if size_order in optimized:
+            items = items + optimized.pop(size_order)
+        estimated = _leading_zeros64(sum(s for _, s in items))
+        target = min(estimated, size_order)
+        optimized.setdefault(target, []).extend(items)
+
+    for i, items in enumerate(optimized.values()):
+        if len(items) < MIN_COMPACTION_FACTOR or len(
+            items
+        ) < compaction_factor:
+            continue
+        indices = [idx for idx, _ in items]
+        # Drop tombstones only on the final (largest) level
+        # (compaction.rs:90-92).
+        keep_tombstones = i > 0
+        try:
+            await tree.compact(indices, index_to_compact, keep_tombstones)
+        except Exception as e:
+            log.error("failed to compact files: %s", e)
+        index_to_compact += 2
+
+
+async def run_compaction_loop(my_shard: MyShard) -> None:
+    compaction_factor = my_shard.config.compaction_factor
+    if compaction_factor < MIN_COMPACTION_FACTOR:
+        return
+
+    async def trees_and_listeners():
+        while not my_shard.collections:
+            await my_shard.collections_change_event.listen()
+        trees = [c.tree for c in my_shard.collections.values()]
+        listeners = [t.flush_done_event.listen() for t in trees]
+        return trees, listeners
+
+    trees, listeners = await trees_and_listeners()
+
+    # Compact once on startup (crash may have left ungrouped files).
+    await asyncio.gather(
+        *[compact_tree(t, compaction_factor) for t in trees]
+    )
+
+    while True:
+        change = asyncio.ensure_future(
+            my_shard.collections_change_event.wait()
+        )
+        done, _pending = await asyncio.wait(
+            [change, *listeners], return_when=asyncio.FIRST_COMPLETED
+        )
+        if change.done():
+            for fut in listeners:
+                fut.cancel()
+            trees, listeners = await trees_and_listeners()
+            continue
+        change.cancel()
+        for i, fut in enumerate(listeners):
+            if fut.done():
+                listeners[i] = trees[i].flush_done_event.listen()
+                await compact_tree(trees[i], compaction_factor)
+
+
+# ----------------------------------------------------------------------
+# Gossip server (gossip_server.rs:16-112) — node-managing shard only
+# ----------------------------------------------------------------------
+
+
+class _GossipProtocol(asyncio.DatagramProtocol):
+    def __init__(self, my_shard: MyShard) -> None:
+        self.my_shard = my_shard
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.my_shard.spawn(handle_gossip_packet(self.my_shard, data))
+
+
+async def handle_gossip_packet(my_shard: MyShard, buf: bytes) -> None:
+    try:
+        source, event = msgs.deserialize_gossip_message(buf)
+    except Exception as e:
+        log.error("bad gossip packet: %s", e)
+        return
+
+    key = (source, event[0])
+    seen = my_shard.gossip_requests.get(key, 0)
+    if seen >= my_shard.config.gossip_max_seen_count:
+        if seen == my_shard.config.gossip_max_seen_count:
+            my_shard.gossip_requests[key] = seen + 1
+
+            async def expire():
+                await asyncio.sleep(GOSSIP_REQUEST_EXPIRATION_S)
+                my_shard.gossip_requests.pop(key, None)
+
+            my_shard.spawn(expire())
+        return
+    my_shard.gossip_requests[key] = seen + 1
+    seen_first_time = seen == 0
+
+    continue_with_gossip = True
+    if seen_first_time:
+        log.debug("gossip: %r from %s", event, source)
+        await my_shard.broadcast_message_to_local_shards(
+            ShardEvent.gossip(event)
+        )
+        continue_with_gossip = await my_shard.handle_gossip_event(event)
+
+    if continue_with_gossip:
+        await my_shard.gossip_buffer(buf)
+
+
+async def run_gossip_server(my_shard: MyShard) -> None:
+    loop = asyncio.get_event_loop()
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: _GossipProtocol(my_shard),
+        local_addr=(my_shard.config.ip, my_shard.config.gossip_port),
+    )
+    log.info(
+        "listening for gossip on %s:%d",
+        my_shard.config.ip,
+        my_shard.config.gossip_port,
+    )
+    try:
+        await asyncio.Event().wait()  # runs until cancelled
+    finally:
+        transport.close()
+
+
+# ----------------------------------------------------------------------
+# Failure detector (failure_detector.rs:17-105) — managing shard only
+# ----------------------------------------------------------------------
+
+
+async def run_failure_detector(my_shard: MyShard) -> None:
+    interval = my_shard.config.failure_detection_interval_ms / 1000
+    while True:
+        await asyncio.sleep(interval)
+        candidates = [
+            n for n in my_shard.nodes.values() if n.ids
+        ]
+        if not candidates:
+            continue
+        node = random.choice(candidates)
+        await asyncio.sleep(interval)
+        port = node.remote_shard_base_port + random.choice(node.ids)
+        connection = RemoteShardConnection.from_config(
+            f"{node.ip}:{port}", my_shard.config
+        )
+        try:
+            await connection.ping()
+        except DbeelError as e:
+            log.info(
+                "failed to ping %s (%s): %s",
+                node.name,
+                connection.address,
+                e,
+            )
+            await my_shard.handle_dead_node(node.name)
+            event = msgs.GossipEvent.dead(node.name)
+            try:
+                await my_shard.broadcast_message_to_local_shards(
+                    ShardEvent.gossip(event)
+                )
+                await my_shard.gossip(event)
+            except Exception as e2:
+                log.error("failed to gossip node death: %s", e2)
+
+
+# ----------------------------------------------------------------------
+# Stop event waiter (stop_event_waiter.rs:11-27)
+# ----------------------------------------------------------------------
+
+
+async def wait_for_stop(my_shard: MyShard) -> None:
+    await my_shard.stop_event.wait()
+    raise ShardStopped(my_shard.shard_name)

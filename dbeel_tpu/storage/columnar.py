@@ -87,6 +87,12 @@ def load_columns(sources: Sequence) -> MergeColumns:
         and data.size == n * int(full_size[0])
         and (full_size == full_size[0]).all()
         and (key_size == key_size[0]).all()
+        # Record i must actually live at row i (same guard as
+        # gather_records) — duck-typed sources could order differently.
+        and (
+            start
+            == np.arange(n, dtype=np.uint64) * np.uint64(full_size[0])
+        ).all()
     )
     if uniform:
         # Fixed-size records: the whole data blob is an (N, record)

@@ -1,0 +1,107 @@
+"""In-process cluster harness.
+
+Role parity with /root/reference/test_utils/src/lib.rs:44-182: run 1..N
+real shards (and multiple "nodes") inside the test process, with port
+arithmetic per node, flow-event subscription helpers, and crash-at-end
+mode (cancel instead of graceful stop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import List, Optional
+
+from dbeel_tpu.config import Config
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.cluster.local_comm import LocalShardConnection
+from dbeel_tpu.server.run import create_shard, run_shard
+from dbeel_tpu.server.shard import MyShard
+
+_port_block = itertools.count(0)
+
+
+def make_config(tmp_dir: str, **kw) -> Config:
+    """Fresh config with a unique port block (peace between tests)."""
+    block = next(_port_block) * 64 + 11000
+    defaults = dict(
+        name="dbeel-test",
+        dir=f"{tmp_dir}/db",
+        port=block,
+        remote_shard_port=block + 20000,
+        gossip_port=block + 40000,
+        failure_detection_interval_ms=50,
+        memtable_capacity=64,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def next_node_config(cfg: Config, offset: int, tmp_dir: str) -> Config:
+    """Port/dir/name offsets for an extra node on one host
+    (test_utils/src/lib.rs:172-182)."""
+    # Stride by 8: per-shard ports are base+shard_id, so nodes need
+    # non-overlapping blocks (up to 8 shards per test node).
+    return cfg.replace(
+        name=f"{cfg.name}-n{offset}",
+        dir=f"{tmp_dir}/db-n{offset}",
+        port=cfg.port + offset * 8,
+        remote_shard_port=cfg.remote_shard_port + offset * 8,
+        gossip_port=cfg.gossip_port + offset * 8,
+    )
+
+
+class ClusterNode:
+    """All shards of one node, running as tasks on the current loop."""
+
+    def __init__(self, config: Config, num_shards: int = 1) -> None:
+        self.config = config
+        self.num_shards = num_shards
+        self.shards: List[MyShard] = []
+        self.tasks: List[asyncio.Task] = []
+
+    async def start(self, wait_started: bool = True) -> "ClusterNode":
+        connections = [
+            LocalShardConnection(i) for i in range(self.num_shards)
+        ]
+        self.shards = [
+            create_shard(self.config, i, connections)
+            for i in range(self.num_shards)
+        ]
+        started = [
+            s.flow.subscribe(FlowEvent.START_TASKS) for s in self.shards
+        ]
+        self.tasks = [
+            asyncio.ensure_future(run_shard(s, i == 0))
+            for i, s in enumerate(self.shards)
+        ]
+        if wait_started:
+            await asyncio.gather(*started)
+            await asyncio.sleep(0)  # let listeners settle
+        return self
+
+    async def stop(self) -> None:
+        """Graceful stop: death gossip is sent."""
+        for s in self.shards:
+            await s.stop()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+
+    async def crash(self) -> None:
+        """Hard crash (test_utils/src/lib.rs:159-170): cancel without
+        stop events — no death gossip, sockets just vanish."""
+        for t in self.tasks:
+            t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        for s in self.shards:
+            s.close()
+
+    def flow_event(self, shard_index: int, event: FlowEvent):
+        return self.shards[shard_index].flow.subscribe(event)
+
+    @property
+    def db_address(self):
+        return (self.config.ip, self.config.port)
+
+    @property
+    def seed_address(self) -> str:
+        return f"{self.config.ip}:{self.config.remote_shard_port}"

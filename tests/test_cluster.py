@@ -1,0 +1,189 @@
+"""Tier-3 distributed tests: multiple nodes as extra task groups in one
+process, mirroring /root/reference/tests/{node_discovery,replication,
+migration}.rs.  No sleeps — synchronization via flow events."""
+
+import asyncio
+
+import pytest
+
+from dbeel_tpu.client import DbeelClient, Consistency
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu import errors
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+def test_two_node_discovery_and_graceful_death(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir)
+        node1 = await ClusterNode(cfg, num_shards=2).start()
+        try:
+            cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+                seed_nodes=[node1.seed_address]
+            )
+            alive_seen = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            node2 = await ClusterNode(cfg2, num_shards=2).start()
+            await alive_seen
+            # Node 1 now knows node 2 (and vice versa through discovery).
+            assert cfg2.name in node1.shards[0].nodes
+            assert cfg.name in node2.shards[0].nodes
+            # 2 local + 2 remote shards in each ring.
+            assert len(node1.shards[0].shards) == 4
+
+            # Graceful stop → Dead gossip removes the node.
+            dead_seen = node1.flow_event(0, FlowEvent.DEAD_NODE_REMOVED)
+            await node2.stop()
+            await dead_seen
+            assert cfg2.name not in node1.shards[0].nodes
+        finally:
+            await node1.stop()
+
+    run(main(), timeout=30)
+
+
+def test_crash_detected_by_failure_detector(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, failure_detection_interval_ms=10)
+        node1 = await ClusterNode(cfg).start()
+        node2 = None
+        try:
+            cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+                seed_nodes=[node1.seed_address],
+                failure_detection_interval_ms=10,
+            )
+            alive_seen = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            node2 = await ClusterNode(cfg2).start()
+            await alive_seen
+
+            dead_seen = node1.flow_event(0, FlowEvent.DEAD_NODE_REMOVED)
+            await node2.crash()  # no death gossip — detector must notice
+            node2 = None
+            await dead_seen
+            assert cfg2.name not in node1.shards[0].nodes
+        finally:
+            await node1.stop()
+            if node2 is not None:
+                await node2.crash()
+
+    run(main(), timeout=30)
+
+
+def _three_nodes(tmp_dir, **kw):
+    cfg = make_config(tmp_dir, **kw)
+    cfgs = [cfg]
+    for i in (1, 2):
+        cfgs.append(
+            next_node_config(cfg, i, tmp_dir).replace(
+                seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"], **kw
+            )
+        )
+    return cfgs
+
+
+def test_replication_quorum_matrix(tmp_dir):
+    """tests/replication.rs:171-181: RF=3, W=3/R=1 and W=1/R=3."""
+
+    async def main():
+        cfgs = _three_nodes(tmp_dir)
+        nodes = []
+        nodes.append(await ClusterNode(cfgs[0]).start())
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            col = await client.create_collection(
+                "replicated", replication_factor=3
+            )
+            # Collection must exist on every node (gossiped).
+            for n in nodes:
+                for attempt in range(100):
+                    if "replicated" in n.shards[0].collections:
+                        break
+                    await asyncio.sleep(0.01)
+                assert "replicated" in n.shards[0].collections
+
+            # W=3 / R=1.
+            await col.set("alpha", {"v": 1}, consistency=Consistency.ALL)
+            assert await col.get(
+                "alpha", consistency=Consistency.fixed(1)
+            ) == {"v": 1}
+            # Every node holds the item locally.
+            holders = 0
+            for n in nodes:
+                tree = n.shards[0].collections["replicated"].tree
+                if await tree.get(b"\xa5alpha") is not None:
+                    holders += 1
+            assert holders == 3
+
+            # W=1 / R=3: read quorum sees the newest write.
+            await col.set(
+                "alpha", {"v": 2}, consistency=Consistency.fixed(1)
+            )
+            assert await col.get(
+                "alpha", consistency=Consistency.ALL
+            ) == {"v": 2}
+
+            # Quorum write / quorum read.
+            await col.set(
+                "beta", "quorum-val", consistency=Consistency.QUORUM
+            )
+            assert (
+                await col.get("beta", consistency=Consistency.QUORUM)
+                == "quorum-val"
+            )
+
+            # Delete propagates with quorum.
+            await col.delete("alpha", consistency=Consistency.ALL)
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("alpha", consistency=Consistency.ALL)
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_replicated_set_reaches_replica_trees(tmp_dir):
+    """ItemSetFromShardMessage flow event fires on replicas
+    (tests/replication.rs style)."""
+
+    async def main():
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            col = await client.create_collection("r", replication_factor=3)
+            for n in nodes:
+                while "r" not in n.shards[0].collections:
+                    await asyncio.sleep(0.01)
+            waiters = [
+                n.flow_event(0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
+                for n in nodes
+            ]
+            await col.set("k", 7, consistency=Consistency.ALL)
+            # Exactly 2 of the 3 nodes receive a shard Set message (the
+            # owner writes locally).
+            done = 0
+            for w in waiters:
+                try:
+                    await asyncio.wait_for(asyncio.shield(w), 2)
+                    done += 1
+                except asyncio.TimeoutError:
+                    pass
+            assert done == 2, f"expected 2 replica sets, saw {done}"
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)

@@ -1,0 +1,153 @@
+"""Tier-2 integration tests against the public client API, mirroring
+/root/reference/tests/db_server.rs: collection CRUD, set/get/delete,
+persistence across restart, multi-collection isolation, wire error
+kinds."""
+
+import pytest
+
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu import errors
+
+from conftest import run
+from harness import ClusterNode, make_config
+
+
+def test_collection_crud_and_errors(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes([node.db_address])
+            await client.create_collection("users")
+            # Creating again → CollectionAlreadyExists by wire kind.
+            with pytest.raises(errors.CollectionAlreadyExists):
+                await client.create_collection("users")
+            await client.drop_collection("users")
+            with pytest.raises(errors.CollectionNotFound):
+                await client.collection("users").get("niels")
+            # Dropping a missing collection errors too.
+            with pytest.raises(errors.CollectionNotFound):
+                await client.drop_collection("users")
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_set_get_delete(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes([node.db_address])
+            col = await client.create_collection("docs")
+            await col.set("key", {"name": "tony", "age": 42})
+            assert await col.get("key") == {"name": "tony", "age": 42}
+            # Overwrite.
+            await col.set("key", [1, 2, 3])
+            assert await col.get("key") == [1, 2, 3]
+            # Missing key.
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("missing")
+            # Delete → KeyNotFound afterwards.
+            await col.delete("key")
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("key")
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_persistence_across_restart(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        col = await client.create_collection("docs")
+        for i in range(100):
+            await col.set(f"key{i}", {"i": i})
+        await node.stop()
+
+        node2 = await ClusterNode(cfg).start()
+        try:
+            client2 = await DbeelClient.from_seed_nodes(
+                [node2.db_address]
+            )
+            col2 = client2.collection("docs")
+            for i in range(100):
+                assert await col2.get(f"key{i}") == {"i": i}
+        finally:
+            await node2.stop()
+
+    run(main(), timeout=30)
+
+
+def test_multi_collection_isolation(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes([node.db_address])
+            a = await client.create_collection("a")
+            b = await client.create_collection("b")
+            await a.set("k", "from-a")
+            await b.set("k", "from-b")
+            assert await a.get("k") == "from-a"
+            assert await b.get("k") == "from-b"
+            await a.delete("k")
+            with pytest.raises(errors.KeyNotFound):
+                await a.get("k")
+            assert await b.get("k") == "from-b"
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_multi_shard_routing(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir), num_shards=4).start()
+        try:
+            client = await DbeelClient.from_seed_nodes([node.db_address])
+            col = await client.create_collection("docs")
+            for i in range(64):
+                await col.set(f"key{i}", i)
+            for i in range(64):
+                assert await col.get(f"key{i}") == i
+            # Keys actually spread across shards.
+            with_data = sum(
+                1
+                for s in node.shards
+                if "docs" in s.collections
+                and (
+                    len(s.collections["docs"].tree._active) > 0
+                    or s.collections["docs"].tree.sstable_indices_and_sizes()
+                )
+            )
+            assert with_data >= 2
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_collection_discovery_after_restart(tmp_dir):
+    """tests/collection_discovery.rs: collections rediscovered from disk
+    without client recreation."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        await client.create_collection("rediscovered")
+        await node.stop()
+
+        node2 = await ClusterNode(cfg).start()
+        try:
+            client2 = await DbeelClient.from_seed_nodes(
+                [node2.db_address]
+            )
+            meta = await client2.get_cluster_metadata()
+            assert ("rediscovered", 1) in meta.collections
+        finally:
+            await node2.stop()
+
+    run(main(), timeout=30)

@@ -17,13 +17,17 @@ from dbeel_tpu.ops.merge import device_sort_dedup
 from conftest import run
 
 
-def _build_and_compact(d, strategy_name, keep, seed=42, long_keys=True):
+def _build_and_compact(d, strategy, keep, seed=42, long_keys=True):
     async def main():
         rng = random.Random(seed)
         tree = LSMTree.open_or_create(
             d,
             capacity=300,
-            strategy=get_strategy(strategy_name),
+            strategy=(
+                get_strategy(strategy)
+                if isinstance(strategy, str)
+                else strategy
+            ),
             bloom_min_size=1000,
         )
         keys = [f"user:{rng.randrange(400):04}".encode() for _ in range(900)]
@@ -51,16 +55,61 @@ def _build_and_compact(d, strategy_name, keep, seed=42, long_keys=True):
     return run(main(), timeout=120)
 
 
+@pytest.mark.parametrize("strategy", ["device", "device_full", "cpu"])
 @pytest.mark.parametrize("keep", [False, True])
 @pytest.mark.parametrize("long_keys", [False, True])
-def test_device_merge_byte_identical_to_heap(tmp_dir, keep, long_keys):
+def test_merge_strategies_byte_identical_to_heap(
+    tmp_dir, keep, long_keys, strategy
+):
     a = _build_and_compact(
         f"{tmp_dir}/heap", "heap", keep, long_keys=long_keys
     )
     b = _build_and_compact(
-        f"{tmp_dir}/dev", "device", keep, long_keys=long_keys
+        f"{tmp_dir}/{strategy}", strategy, keep, long_keys=long_keys
     )
     assert a == b
+
+
+@pytest.mark.parametrize("keep", [False, True])
+def test_distributed_strategy_byte_identical_to_heap(tmp_dir, keep):
+    from dbeel_tpu.parallel.dist_merge import DistributedMergeStrategy
+    from dbeel_tpu.parallel.mesh import shard_mesh
+
+    a = _build_and_compact(f"{tmp_dir}/heap", "heap", keep)
+    b = _build_and_compact(
+        f"{tmp_dir}/dist", DistributedMergeStrategy(shard_mesh(4)), keep
+    )
+    assert a == b
+
+
+def test_device_tie_fallback_on_shared_prefix_keyspace(tmp_dir):
+    """A keyspace where every key shares one 8-byte prefix must route to
+    the full-column device path and still be byte-identical."""
+    def build(d, strategy):
+        async def main():
+            tree = LSMTree.open_or_create(
+                d, capacity=500, strategy=get_strategy(strategy)
+            )
+            for i in range(1200):
+                await tree.set_with_timestamp(
+                    f"user:{i % 400:06}".encode(), f"v{i}".encode(), i
+                )
+            await tree.flush()
+            idx = [i for i, _ in tree.sstable_indices_and_sizes()]
+            await tree.compact(idx, max(idx) + 1, keep_tombstones=False)
+            out = {}
+            for f in sorted(os.listdir(d)):
+                if f.endswith((".data", ".index")):
+                    with open(os.path.join(d, f), "rb") as fh:
+                        out[f] = hashlib.sha256(fh.read()).hexdigest()
+            tree.close()
+            return out
+
+        return run(main(), timeout=120)
+
+    assert build(f"{tmp_dir}/h", "heap") == build(
+        f"{tmp_dir}/d", "device"
+    )
 
 
 def test_device_sort_dedup_matches_numpy():

@@ -1,0 +1,111 @@
+"""Elastic re-partitioning tests, mirroring /root/reference/tests/
+migration.rs behaviorally: on node addition, ranges stream to the new
+owner and no-longer-owned ranges are tombstoned; on node death, data
+re-replicates to restore RF."""
+
+import asyncio
+
+from dbeel_tpu.client import DbeelClient, Consistency
+from dbeel_tpu.flow_events import FlowEvent
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+N_KEYS = 60
+
+
+async def _count_keys(node, collection):
+    count = 0
+    for shard in node.shards:
+        col = shard.collections.get(collection)
+        if col is None:
+            continue
+        async for _k, v, _ts in col.tree.iter():
+            if v != b"":
+                count += 1
+    return count
+
+
+def test_node_addition_migrates_and_node_death_restores_rf(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir)
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"]
+        )
+        cfg3 = next_node_config(cfg, 2, tmp_dir).replace(
+            seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"]
+        )
+
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        nodes = [node1, node2]
+
+        client = await DbeelClient.from_seed_nodes([node1.db_address])
+        col = await client.create_collection("m", replication_factor=2)
+        for n in nodes:
+            while "m" not in n.shards[0].collections:
+                await asyncio.sleep(0.01)
+
+        for i in range(N_KEYS):
+            await col.set(f"key{i:03}", i, consistency=Consistency.ALL)
+
+        # RF=2 on 2 nodes: both hold everything.
+        assert await _count_keys(node1, "m") == N_KEYS
+        assert await _count_keys(node2, "m") == N_KEYS
+
+        # Add a third node → existing shards plan migrations
+        # (send-to-new-owner + delete-unowned).
+        migrations = [
+            n.flow_event(0, FlowEvent.DONE_MIGRATION) for n in nodes
+        ]
+        node3 = await ClusterNode(cfg3).start()
+        nodes.append(node3)
+        done, _ = await asyncio.wait(migrations, timeout=10)
+        assert done, "no migration ran on node addition"
+        while "m" not in node3.shards[0].collections:
+            await asyncio.sleep(0.01)
+
+        # Give the streamed sets a moment to land, then check the new
+        # node received data and every key still reads back.
+        for _ in range(200):
+            if await _count_keys(node3, "m") > 0:
+                break
+            await asyncio.sleep(0.02)
+        assert await _count_keys(node3, "m") > 0, (
+            "new node received no migrated data"
+        )
+        await client.sync_metadata()
+        col = client.collection("m")
+        for i in range(N_KEYS):
+            assert (
+                await col.get(f"key{i:03}", consistency=Consistency.QUORUM)
+                == i
+            )
+
+        # Kill node1 gracefully → death gossip → removal migration
+        # restores RF=2 across survivors.
+        dead_seen = node3.flow_event(0, FlowEvent.DEAD_NODE_REMOVED)
+        await node1.stop()
+        await dead_seen
+
+        client2 = await DbeelClient.from_seed_nodes([node2.db_address])
+        col2 = client2.collection("m")
+        for _ in range(200):
+            total = await _count_keys(node2, "m") + await _count_keys(
+                node3, "m"
+            )
+            if total >= N_KEYS:
+                break
+            await asyncio.sleep(0.02)
+        for i in range(N_KEYS):
+            assert (
+                await col2.get(f"key{i:03}", consistency=Consistency.fixed(1))
+                == i
+            ), f"key{i:03} lost after node death"
+
+        await node2.stop()
+        await node3.stop()
+
+    run(main(), timeout=120)
