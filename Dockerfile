@@ -1,0 +1,13 @@
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY . .
+RUN pip install --no-cache-dir "jax[cpu]" numpy msgpack sortedcontainers \
+    && make -C native
+
+EXPOSE 10000 20000 30000/udp
+ENTRYPOINT ["python", "-m", "dbeel_tpu.server.run"]
+CMD ["--ip", "0.0.0.0", "--dir", "/data"]

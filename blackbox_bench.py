@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Black-box load generator.
+
+Role parity with /root/reference/blackbox_bench/src/main.rs: N concurrent
+clients x M requests each against a running cluster, shuffled key order,
+a Set phase then a Get phase, and a min/p50/p90/p99/p999/max latency
+report per phase (the README numbers in BASELINE.md come from this
+shape of run: 20 clients x 5000 requests).
+
+Usage:
+    python -m dbeel_tpu.server.run --dir /tmp/bb --shards 4 &
+    python blackbox_bench.py --clients 20 --requests 5000
+"""
+
+import argparse
+import asyncio
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from dbeel_tpu.client import DbeelClient  # noqa: E402
+
+
+def percentiles(samples):
+    samples = sorted(samples)
+    n = len(samples)
+
+    def at(q):
+        return samples[min(n - 1, int(q * n))] * 1000  # ms
+
+    return (
+        f"min: {samples[0]*1000:.3f}ms "
+        f"p50: {at(0.50):.3f}ms p90: {at(0.90):.3f}ms "
+        f"p99: {at(0.99):.3f}ms p999: {at(0.999):.3f}ms "
+        f"max: {samples[-1]*1000:.3f}ms"
+    )
+
+
+async def run_phase(client, collection, op, keys, n_clients, value):
+    latencies = []
+
+    async def worker(worker_keys):
+        col = client.collection(collection)
+        for k in worker_keys:
+            t0 = time.perf_counter()
+            if op == "set":
+                await col.set(k, value)
+            else:
+                await col.get(k)
+            latencies.append(time.perf_counter() - t0)
+
+    chunk = (len(keys) + n_clients - 1) // n_clients
+    t0 = time.perf_counter()
+    await asyncio.gather(
+        *[
+            worker(keys[i * chunk : (i + 1) * chunk])
+            for i in range(n_clients)
+        ]
+    )
+    total = time.perf_counter() - t0
+    return total, latencies
+
+
+async def main_async(args):
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)]
+    )
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    try:
+        await client.create_collection(args.collection)
+    except CollectionAlreadyExists:
+        pass
+
+    keys = [f"key-{i:08}" for i in range(args.clients * args.requests)]
+    rng = random.Random(args.seed)
+    rng.shuffle(keys)
+    value = {"blob": "x" * args.value_size}
+
+    total, lat = await run_phase(
+        client, args.collection, "set", keys, args.clients, value
+    )
+    print(
+        f"set: total {total:.3f}s "
+        f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
+    )
+
+    rng.shuffle(keys)
+    total, lat = await run_phase(
+        client, args.collection, "get", keys, args.clients, value
+    )
+    print(
+        f"get: total {total:.3f}s "
+        f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10000)
+    ap.add_argument("--clients", type=int, default=20)
+    ap.add_argument("--requests", type=int, default=5000)
+    ap.add_argument("--collection", default="blackbox")
+    ap.add_argument("--value-size", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    asyncio.run(main_async(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,7 @@ from ..errors import (
     ProtocolError,
     from_wire,
 )
-from ..cluster.messages import ClusterMetadata, NodeMetadata
+from ..cluster.messages import ClusterMetadata
 from ..utils.murmur import hash_bytes, hash_string
 
 RESPONSE_ERR = 0

@@ -13,7 +13,7 @@ the reference's client (dbeel_client/src/lib.rs:85-152).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import msgpack
 

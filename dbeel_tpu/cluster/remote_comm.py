@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
-from typing import Any, List, Optional, Tuple
+from typing import List, Tuple
 
 from ..errors import ConnectionError_, ProtocolError, Timeout
 from . import messages

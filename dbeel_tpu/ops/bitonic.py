@@ -152,6 +152,29 @@ def merge_runs_prefix_kernel(
     return x[0, :out_rows, 2]
 
 
+def stage_prefixes(
+    cols: columnar.MergeColumns, run_counts: List[int]
+):
+    """Host staging for the prefix kernel: sentinel-padded (K, P, 2)
+    prefix words, per-run counts, per-run base offsets, and the
+    64Ki-bucketed output row count (few jit traces, ~n d2h bytes)."""
+    n = len(cols)
+    k = _pow2(max(1, len(run_counts)))
+    p = _pow2(max(8, max(run_counts) if run_counts else 8))
+    prefixes = np.full((k, p, 2), SENTINEL, dtype=np.uint32)
+    counts = np.zeros(k, dtype=np.uint32)
+    bases = np.zeros(k, dtype=np.int64)
+    base = 0
+    for r, cnt in enumerate(run_counts):
+        prefixes[r, :cnt, 0] = cols.key_words[base : base + cnt, 0]
+        prefixes[r, :cnt, 1] = cols.key_words[base : base + cnt, 1]
+        counts[r] = cnt
+        bases[r] = base
+        base += cnt
+    out_rows = min(k * p, ((n + 65535) >> 16) << 16)
+    return prefixes, counts, bases, out_rows
+
+
 def device_merge_prefix_order(
     cols: columnar.MergeColumns, run_counts: List[int]
 ) -> np.ndarray:
@@ -161,21 +184,8 @@ def device_merge_prefix_order(
     n = len(cols)
     if n == 0:
         return np.zeros(0, np.int64)
-    k = _pow2(max(1, len(run_counts)))
-    p = _pow2(max(8, max(run_counts) if run_counts else 8))
-    prefixes = np.full((k, p, 2), SENTINEL, dtype=np.uint32)
-    counts = np.zeros(k, dtype=np.uint32)
-    base = 0
-    bases = np.zeros(k, dtype=np.int64)
-    for r, cnt in enumerate(run_counts):
-        prefixes[r, :cnt, 0] = cols.key_words[base : base + cnt, 0]
-        prefixes[r, :cnt, 1] = cols.key_words[base : base + cnt, 1]
-        counts[r] = cnt
-        bases[r] = base
-        base += cnt
-    # Bucketize the output slice (64Ki granularity) so jit traces stay
-    # few while the d2h transfer stays ~n, not K*P.
-    out_rows = min(k * p, ((n + 65535) >> 16) << 16)
+    prefixes, counts, bases, out_rows = stage_prefixes(cols, run_counts)
+    p = prefixes.shape[1]
     packed = merge_runs_prefix_kernel(prefixes, counts, out_rows)
     packed = np.asarray(packed)[:n]
     run = packed >> np.uint32(p.bit_length() - 1)

@@ -16,7 +16,7 @@ import os
 import re
 import socket
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple, Union
+from typing import Dict, List, Optional, Tuple, Union
 
 import msgpack
 
@@ -26,7 +26,6 @@ from ..errors import (
     CollectionAlreadyExists,
     CollectionNotFound,
     DbeelError,
-    NoRemoteShardsFound,
 )
 from ..flow_events import FlowEvent
 from ..storage import DEFAULT_TREE_CAPACITY
@@ -34,10 +33,9 @@ from ..storage.compaction import get_strategy
 from ..storage.lsm_tree import LSMTree
 from ..storage.page_cache import PageCache, PartitionPageCache
 from ..utils.event import LocalEvent
-from ..utils.murmur import hash_bytes, hash_string
-from ..utils.timestamps import now_nanos
+from ..utils.murmur import hash_string
 from ..cluster import messages as msgs
-from ..cluster.local_comm import LocalShardConnection, ShardPacket
+from ..cluster.local_comm import LocalShardConnection
 from ..cluster.messages import (
     ClusterMetadata,
     GossipEvent,

@@ -12,9 +12,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-import socket
-from typing import List
-
 from ..errors import DbeelError, ShardStopped
 from ..cluster import messages as msgs
 from ..cluster.local_comm import ShardPacket

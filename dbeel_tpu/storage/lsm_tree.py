@@ -33,7 +33,7 @@ from ..utils.event import LocalEvent
 from ..utils.timestamps import now_nanos
 from . import wal as wal_mod
 from .bloom import BloomFilter
-from .compaction import CompactionStrategy, HeapMergeStrategy, MergeResult
+from .compaction import CompactionStrategy, HeapMergeStrategy
 from .entry import (
     BLOOM_FILE_EXT,
     COMPACT_ACTION_FILE_EXT,

@@ -1,0 +1,209 @@
+"""ctypes bindings for the C++ native runtime (native/).
+
+Builds ``native/build/libdbeel_native.so`` on first use (make) and
+exposes NativeMergeStrategy — the reference-grade CPU k-way heap merge
+(the honest CPU baseline for BASELINE.md's ≥5x target) with native
+bloom building — plus a murmur3_32 parity hook used by tests.
+
+Everything degrades gracefully to the pure-Python/numpy implementations
+when no C++ toolchain is available (get_strategy('native') then
+resolves to the columnar strategy).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .bloom import BloomFilter, _SEED1, _SEED2
+from .compaction import (
+    CompactionStrategy,
+    MergeResult,
+    _write_bloom,
+)
+from .entry import (
+    COMPACT_DATA_FILE_EXT,
+    COMPACT_INDEX_FILE_EXT,
+    file_name,
+)
+from .file_io import PageMirroringWriter
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libdbeel_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception as e:
+            log.info("native build unavailable: %s", e)
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        log.info("native lib load failed: %s", e)
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.dbeel_murmur3_32.restype = ctypes.c_uint32
+    lib.dbeel_murmur3_32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+    ]
+    lib.dbeel_murmur3_32_batch.restype = None
+    lib.dbeel_murmur3_32_batch.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.dbeel_bloom_add_batch.restype = None
+    lib.dbeel_merge.restype = ctypes.c_int64
+    lib.dbeel_merge.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint32,
+        ctypes.c_int,
+        u8p,
+        ctypes.POINTER(ctypes.c_uint64),
+        u8p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def murmur3_32_native(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        from ..utils.murmur import murmur3_32
+
+        return murmur3_32(data, seed)
+    return lib.dbeel_murmur3_32(data, len(data), seed)
+
+
+class NativeMergeStrategy(CompactionStrategy):
+    """C++ k-way heap merge — reference semantics at native speed."""
+
+    name = "native"
+
+    def merge(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ) -> MergeResult:
+        lib = _load()
+        assert lib is not None
+
+        datas = [s.read_data_bytes() for s in sources]
+        indexes = []
+        counts = []
+        for s in sources:
+            with open(s.index_path, "rb") as f:
+                indexes.append(f.read(s.entry_count * 16))
+            counts.append(s.entry_count)
+
+        total_data = sum(len(d) for d in datas)
+        total_count = sum(counts)
+        out_data = np.zeros(max(1, total_data), dtype=np.uint8)
+        out_index = np.zeros(max(1, total_count * 16), dtype=np.uint8)
+        out_size = ctypes.c_uint64(0)
+
+        DataArr = ctypes.c_char_p * len(sources)
+        CountArr = ctypes.c_uint64 * len(sources)
+        n_out = lib.dbeel_merge(
+            DataArr(*datas),
+            DataArr(*indexes),
+            CountArr(*counts),
+            len(sources),
+            1 if keep_tombstones else 0,
+            out_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.byref(out_size),
+            out_index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        data_size = out_size.value
+
+        from .entry import DATA_FILE_EXT, INDEX_FILE_EXT
+
+        data_w = PageMirroringWriter(
+            f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}",
+            (DATA_FILE_EXT, output_index),
+            cache,
+        )
+        data_w.write(out_data[:data_size].tobytes())
+        data_w.close()
+        index_w = PageMirroringWriter(
+            f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}",
+            (INDEX_FILE_EXT, output_index),
+            cache,
+        )
+        index_w.write(out_index[: n_out * 16].tobytes())
+        index_w.close()
+
+        wrote_bloom = False
+        if data_size >= bloom_min_size and n_out > 0:
+            rec = np.frombuffer(
+                out_index[: n_out * 16].tobytes(),
+                dtype=np.dtype(
+                    [
+                        ("offset", "<u8"),
+                        ("key_size", "<u4"),
+                        ("full_size", "<u4"),
+                    ]
+                ),
+            )
+            bloom = BloomFilter.with_capacity(int(n_out))
+            key_offsets = (rec["offset"] + 16).astype(np.uint64)
+            key_lens = rec["key_size"].astype(np.uint32)
+            lib.dbeel_bloom_add_batch(
+                bloom.bits.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)
+                ),
+                ctypes.c_uint64(bloom.num_bits),
+                ctypes.c_uint32(bloom.num_hashes),
+                out_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                key_offsets.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint64)
+                ),
+                key_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                ctypes.c_uint64(n_out),
+                ctypes.c_uint32(_SEED1),
+                ctypes.c_uint32(_SEED2),
+            )
+            _write_bloom(dir_path, output_index, bloom)
+            wrote_bloom = True
+
+        return MergeResult(int(n_out), int(data_size), wrote_bloom)

@@ -19,7 +19,7 @@ import asyncio
 import os
 import struct
 import zlib
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 from .entry import PAGE_SIZE, decode_entry, encode_entry
 from ..utils.event import LocalEvent

@@ -7,13 +7,13 @@ implementation of the public MurmurHash3 spec (Austin Appleby, public
 domain), plus a numpy-vectorized batch variant used by migration range
 filters and the device compaction path.
 
-A C++ implementation in ``native/`` overrides the scalar path when the
-native library is built (see dbeel_tpu.storage.native).
+A C++ implementation lives in ``native/`` (dbeel_tpu.storage.native
+exposes it as ``murmur3_32_native``; tests assert parity with this one).
 """
 
 from __future__ import annotations
 
-from typing import Iterable, List
+from typing import Iterable
 
 import numpy as np
 

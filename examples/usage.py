@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""End-to-end usage sample (parity with /root/reference/tokio_example):
+create an RF=3 collection on a running cluster, quorum set/get, drop.
+
+Start a cluster first, e.g. three single-shard nodes on one host:
+    python -m dbeel_tpu.server.run --dir /tmp/n1 --name n1 &
+    python -m dbeel_tpu.server.run --dir /tmp/n2 --name n2 \
+        --port 10008 --remote-shard-port 20008 --gossip-port 30008 \
+        --seed-nodes 127.0.0.1:20000 &
+    python -m dbeel_tpu.server.run --dir /tmp/n3 --name n3 \
+        --port 10016 --remote-shard-port 20016 --gossip-port 30016 \
+        --seed-nodes 127.0.0.1:20000 &
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dbeel_tpu.client import Consistency, DbeelClient
+
+
+async def main():
+    client = await DbeelClient.from_seed_nodes([("127.0.0.1", 10000)])
+
+    collection = await client.create_collection(
+        "grades", replication_factor=3
+    )
+
+    await collection.set(
+        "niels", {"math": 97, "chemistry": 88},
+        consistency=Consistency.QUORUM,
+    )
+    doc = await collection.get("niels", consistency=Consistency.QUORUM)
+    print("niels:", doc)
+
+    await client.drop_collection("grades")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

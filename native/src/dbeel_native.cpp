@@ -1,0 +1,198 @@
+// dbeel_tpu native runtime — hot host-side ops in C++.
+//
+// Role parity with the reference's native (Rust) storage hot loops:
+//   * murmur3_32 (scalar + batch)      — ring placement / bloom hashing
+//     (reference: murmur3 crate, src/shards.rs:95-101)
+//   * k-way heap merge of sorted runs  — the reference-semantics CPU
+//     compaction merge (src/storage_engine/lsm_tree.rs:1003-1066):
+//     min-heap by (key, newest-ts-first, newest-source-first), dedup
+//     keeps the first (newest) copy per key, optional tombstone drop
+//   * bloom batch add                  — double-hashed bit set
+//
+// Record layout (dbeel_tpu/storage/entry.py):
+//   [u32 key_len][u32 value_len][i64 timestamp_ns][key][value]
+// Index entry (16B): [u64 offset][u32 key_size][u32 full_size]
+//
+// Exposed with a plain C ABI for ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+uint32_t murmur3_32(const uint8_t* data, uint64_t len, uint32_t seed) {
+  uint32_t h = seed;
+  const uint64_t nblocks = len / 4;
+  for (uint64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);
+    k *= 0xcc9e2d51u;
+    k = rotl32(k, 15);
+    k *= 0x1b873593u;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5u + 0xe6546b64u;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3:
+      k1 ^= (uint32_t)tail[2] << 16;
+      [[fallthrough]];
+    case 2:
+      k1 ^= (uint32_t)tail[1] << 8;
+      [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= 0xcc9e2d51u;
+      k1 = rotl32(k1, 15);
+      k1 *= 0x1b873593u;
+      h ^= k1;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+struct IndexEntry {
+  uint64_t offset;
+  uint32_t key_size;
+  uint32_t full_size;
+} __attribute__((packed));
+
+struct HeapItem {
+  const uint8_t* key;
+  uint32_t key_len;
+  int64_t ts;
+  uint32_t src;        // source position (higher == newer sstable)
+  uint64_t entry_pos;  // index entry position within the source
+};
+
+// a "less" that makes the heap a MIN-heap on
+// (key asc, ts DESC, src DESC) — i.e. for equal keys the newest
+// timestamp pops first, ties toward the newer source.
+inline bool item_greater(const HeapItem& a, const HeapItem& b) {
+  const uint32_t n = a.key_len < b.key_len ? a.key_len : b.key_len;
+  const int c = std::memcmp(a.key, b.key, n);
+  if (c != 0) return c > 0;
+  if (a.key_len != b.key_len) return a.key_len > b.key_len;
+  if (a.ts != b.ts) return a.ts < b.ts;
+  return a.src < b.src;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t dbeel_murmur3_32(const uint8_t* data, uint64_t len,
+                          uint32_t seed) {
+  return murmur3_32(data, len, seed);
+}
+
+void dbeel_murmur3_32_batch(const uint8_t* data, const uint64_t* offsets,
+                            const uint32_t* lens, uint64_t n,
+                            uint32_t seed, uint32_t* out) {
+  for (uint64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(data + offsets[i], lens[i], seed);
+  }
+}
+
+void dbeel_bloom_add_batch(uint8_t* bits, uint64_t num_bits,
+                           uint32_t num_hashes, const uint8_t* data,
+                           const uint64_t* offsets, const uint32_t* lens,
+                           uint64_t n, uint32_t seed1, uint32_t seed2) {
+  for (uint64_t i = 0; i < n; i++) {
+    const uint8_t* key = data + offsets[i];
+    const uint64_t h1 = murmur3_32(key, lens[i], seed1);
+    const uint64_t h2 = murmur3_32(key, lens[i], seed2) | 1ull;
+    for (uint32_t j = 0; j < num_hashes; j++) {
+      const uint64_t bit = (h1 + (uint64_t)j * h2) % num_bits;
+      bits[bit >> 3] |= (uint8_t)(1u << (bit & 7));
+    }
+  }
+}
+
+// k-way merge. Returns the number of output entries; fills out_data
+// (records) and out_index (16B entries), sets *out_data_size.
+// The caller sizes out_data/out_index at the sum of the inputs.
+int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
+                    const uint64_t* counts, uint32_t nsrc,
+                    int keep_tombstones, uint8_t* out_data,
+                    uint64_t* out_data_size, uint8_t* out_index) {
+  std::vector<HeapItem> heap;
+  heap.reserve(nsrc);
+
+  auto load = [&](uint32_t src, uint64_t pos) -> HeapItem {
+    const IndexEntry* ie =
+        reinterpret_cast<const IndexEntry*>(indexes[src]) + pos;
+    const uint8_t* rec = datas[src] + ie->offset;
+    HeapItem item;
+    item.key = rec + 16;
+    item.key_len = ie->key_size;
+    std::memcpy(&item.ts, rec + 8, 8);
+    item.src = src;
+    item.entry_pos = pos;
+    return item;
+  };
+
+  for (uint32_t s = 0; s < nsrc; s++) {
+    if (counts[s] > 0) heap.push_back(load(s, 0));
+  }
+  std::make_heap(heap.begin(), heap.end(), item_greater);
+
+  uint64_t out_off = 0;
+  int64_t out_count = 0;
+  const uint8_t* last_key = nullptr;
+  uint32_t last_key_len = 0;
+  IndexEntry* oindex = reinterpret_cast<IndexEntry*>(out_index);
+
+  while (!heap.empty()) {
+    std::pop_heap(heap.begin(), heap.end(), item_greater);
+    HeapItem item = heap.back();
+    heap.pop_back();
+
+    const IndexEntry* ie =
+        reinterpret_cast<const IndexEntry*>(indexes[item.src]) +
+        item.entry_pos;
+    const uint8_t* rec = datas[item.src] + ie->offset;
+
+    const bool dup =
+        last_key != nullptr && last_key_len == item.key_len &&
+        std::memcmp(last_key, item.key, item.key_len) == 0;
+
+    if (!dup) {
+      last_key = item.key;
+      last_key_len = item.key_len;
+      const bool tombstone = ie->full_size == 16u + ie->key_size;
+      if (keep_tombstones || !tombstone) {
+        std::memcpy(out_data + out_off, rec, ie->full_size);
+        oindex[out_count].offset = out_off;
+        oindex[out_count].key_size = ie->key_size;
+        oindex[out_count].full_size = ie->full_size;
+        out_off += ie->full_size;
+        out_count++;
+      }
+    }
+
+    const uint64_t next = item.entry_pos + 1;
+    if (next < counts[item.src]) {
+      heap.push_back(load(item.src, next));
+      std::push_heap(heap.begin(), heap.end(), item_greater);
+    }
+  }
+
+  *out_data_size = out_off;
+  return out_count;
+}
+
+}  // extern "C"

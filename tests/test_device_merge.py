@@ -55,7 +55,23 @@ def _build_and_compact(d, strategy, keep, seed=42, long_keys=True):
     return run(main(), timeout=120)
 
 
-@pytest.mark.parametrize("strategy", ["device", "device_full", "cpu"])
+@pytest.mark.parametrize(
+    "strategy",
+    [
+        "device",
+        "device_full",
+        "cpu",
+        pytest.param(
+            "native",
+            marks=pytest.mark.skipif(
+                not __import__(
+                    "dbeel_tpu.storage.native", fromlist=["x"]
+                ).native_available(),
+                reason="no C++ toolchain",
+            ),
+        ),
+    ],
+)
 @pytest.mark.parametrize("keep", [False, True])
 @pytest.mark.parametrize("long_keys", [False, True])
 def test_merge_strategies_byte_identical_to_heap(
