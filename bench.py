@@ -39,9 +39,17 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_runs(dir_path: str, total_keys: int, n_runs: int, seed: int = 7):
+def build_runs(
+    dir_path: str,
+    total_keys: int,
+    n_runs: int,
+    seed: int = 7,
+    variable_values: bool = False,
+):
     """Synthesize n_runs sorted SSTables totalling total_keys entries,
-    written in bulk (vectorized record assembly)."""
+    written in bulk (vectorized record assembly).  ``variable_values``
+    reproduces BASELINE config 4's shape (variable-length msgpack-ish
+    values), which exercises the non-uniform columnar path."""
     rng = np.random.default_rng(seed)
     per_run = total_keys // n_runs
     for r in range(n_runs):
@@ -51,32 +59,75 @@ def build_runs(dir_path: str, total_keys: int, n_runs: int, seed: int = 7):
         ).reshape(per_run)
         order = np.argsort(kv, order=("a", "b"))
         keys = keys[order]
-
-        arr = np.zeros((per_run, RECORD), dtype=np.uint8)
-        hdr = arr[:, :16].view("<u4")
-        hdr[:, 0] = KEY_BYTES
-        hdr[:, 1] = VALUE_BYTES
         ts = (np.int64(r) * total_keys + np.arange(per_run)).astype("<i8")
-        arr[:, 8:16] = ts.view(np.uint8).reshape(per_run, 8)
-        arr[:, 16:32] = keys
-        val = (keys[:, :8].astype(np.uint16).sum(axis=1) % 251).astype(
-            np.uint8
-        )
-        arr[:, 32:] = val[:, None]
 
-        index = np.zeros(
-            per_run,
-            dtype=np.dtype(
-                [("offset", "<u8"), ("key_size", "<u4"), ("full_size", "<u4")]
-            ),
-        )
-        index["offset"] = np.arange(per_run, dtype=np.uint64) * RECORD
-        index["key_size"] = KEY_BYTES
-        index["full_size"] = RECORD
+        if variable_values:
+            vlens = rng.integers(8, 160, size=per_run).astype(np.uint32)
+            full = (16 + KEY_BYTES + vlens).astype(np.uint64)
+            offsets = np.zeros(per_run, dtype=np.uint64)
+            np.cumsum(full[:-1], out=offsets[1:])
+            total = int(full.sum())
+            arr = np.zeros(total, dtype=np.uint8)
+            hdr = np.zeros((per_run, 16), dtype=np.uint8)
+            hdr[:, 0:4] = (
+                np.full(per_run, KEY_BYTES, "<u4")
+                .view(np.uint8)
+                .reshape(per_run, 4)
+            )
+            hdr[:, 4:8] = vlens.astype("<u4").view(np.uint8).reshape(
+                per_run, 4
+            )
+            hdr[:, 8:16] = ts.view(np.uint8).reshape(per_run, 8)
+            for i in range(per_run):
+                o = int(offsets[i])
+                arr[o : o + 16] = hdr[i]
+                arr[o + 16 : o + 32] = keys[i]
+                arr[o + 32 : o + 32 + int(vlens[i])] = (i + r) % 251
+            index = np.zeros(
+                per_run,
+                dtype=np.dtype(
+                    [
+                        ("offset", "<u8"),
+                        ("key_size", "<u4"),
+                        ("full_size", "<u4"),
+                    ]
+                ),
+            )
+            index["offset"] = offsets
+            index["key_size"] = KEY_BYTES
+            index["full_size"] = full
+            blob = arr.tobytes()
+        else:
+            arr = np.zeros((per_run, RECORD), dtype=np.uint8)
+            hdr = arr[:, :16].view("<u4")
+            hdr[:, 0] = KEY_BYTES
+            hdr[:, 1] = VALUE_BYTES
+            arr[:, 8:16] = ts.view(np.uint8).reshape(per_run, 8)
+            arr[:, 16:32] = keys
+            val = (
+                keys[:, :8].astype(np.uint16).sum(axis=1) % 251
+            ).astype(np.uint8)
+            arr[:, 32:] = val[:, None]
+            index = np.zeros(
+                per_run,
+                dtype=np.dtype(
+                    [
+                        ("offset", "<u8"),
+                        ("key_size", "<u4"),
+                        ("full_size", "<u4"),
+                    ]
+                ),
+            )
+            index["offset"] = (
+                np.arange(per_run, dtype=np.uint64) * RECORD
+            )
+            index["key_size"] = KEY_BYTES
+            index["full_size"] = RECORD
+            blob = arr.tobytes()
 
         idx = r * 2  # even flush-style indices
         with open(f"{dir_path}/{file_name(idx, DATA_FILE_EXT)}", "wb") as f:
-            f.write(arr.tobytes())
+            f.write(blob)
         with open(f"{dir_path}/{file_name(idx, INDEX_FILE_EXT)}", "wb") as f:
             f.write(index.tobytes())
         log(f"  built run {idx}: {per_run} keys")
@@ -147,6 +198,12 @@ def main():
     )
     ap.add_argument("--device", default="device")
     ap.add_argument("--dir", default=None)
+    ap.add_argument(
+        "--variable-values",
+        action="store_true",
+        help="BASELINE config 4: variable-length values (wide k-way "
+        "merge shape; pair with --runs 64)",
+    )
     args = ap.parse_args()
 
     d = args.dir or tempfile.mkdtemp(prefix="dbeel_bench_")
@@ -163,7 +220,10 @@ def main():
         log(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}")
         log(f"building {args.runs} runs x {args.keys // args.runs} keys ...")
         t0 = time.perf_counter()
-        indices = build_runs(d, args.keys, args.runs)
+        indices = build_runs(
+            d, args.keys, args.runs,
+            variable_values=args.variable_values,
+        )
         log(f"  build took {time.perf_counter() - t0:.1f}s")
 
         log(f"CPU baseline ({args.baseline}) ...")

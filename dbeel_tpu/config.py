@@ -121,7 +121,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
-        choices=("auto", "device", "cpu", "native"),
+        choices=(
+            "auto",
+            "device",
+            "device_full",
+            "coalesced",
+            "cpu",
+            "native",
+            "heap",
+        ),
         default=d.compaction_backend,
     )
     p.add_argument(

@@ -133,11 +133,8 @@ def sort_stack_kernel(stack: jnp.ndarray):
 # ----------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("out_rows",))
-def merge_runs_prefix_kernel(
-    prefixes: jnp.ndarray,  # (K, P, 2) uint32
-    counts: jnp.ndarray,  # (K,) uint32 valid rows per run
-    out_rows: int,
+def _prefix_merge_body(
+    prefixes: jnp.ndarray, counts: jnp.ndarray, out_rows: int
 ):
     k, p, _ = prefixes.shape
     iota = (
@@ -152,15 +149,43 @@ def merge_runs_prefix_kernel(
     return x[0, :out_rows, 2]
 
 
+@functools.partial(jax.jit, static_argnames=("out_rows",))
+def merge_runs_prefix_kernel(
+    prefixes: jnp.ndarray,  # (K, P, 2) uint32
+    counts: jnp.ndarray,  # (K,) uint32 valid rows per run
+    out_rows: int,
+):
+    return _prefix_merge_body(prefixes, counts, out_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows",))
+def merge_runs_prefix_batch_kernel(
+    prefixes: jnp.ndarray,  # (J, K, P, 2) — J independent merge jobs
+    counts: jnp.ndarray,  # (J, K)
+    out_rows: int,
+):
+    """Coalesced launch: J shards' compaction merges in ONE device
+    program via vmap over the job axis (the BASELINE.json north star —
+    'coalesce per-shard compaction jobs into one TPU launch')."""
+    return jax.vmap(
+        lambda p, c: _prefix_merge_body(p, c, out_rows)
+    )(prefixes, counts)
+
+
 def stage_prefixes(
-    cols: columnar.MergeColumns, run_counts: List[int]
+    cols: columnar.MergeColumns,
+    run_counts: List[int],
+    k: int = 0,
+    p: int = 0,
 ):
     """Host staging for the prefix kernel: sentinel-padded (K, P, 2)
     prefix words, per-run counts, per-run base offsets, and the
-    64Ki-bucketed output row count (few jit traces, ~n d2h bytes)."""
+    64Ki-bucketed output row count (few jit traces, ~n d2h bytes).
+    ``k``/``p`` may be forced larger for coalesced batches that need a
+    common shape."""
     n = len(cols)
-    k = _pow2(max(1, len(run_counts)))
-    p = _pow2(max(8, max(run_counts) if run_counts else 8))
+    k = max(k, _pow2(max(1, len(run_counts))))
+    p = max(p, _pow2(max(8, max(run_counts) if run_counts else 8)))
     prefixes = np.full((k, p, 2), SENTINEL, dtype=np.uint32)
     counts = np.zeros(k, dtype=np.uint32)
     bases = np.zeros(k, dtype=np.int64)

@@ -1,0 +1,194 @@
+"""Compaction coalescer — many shards' merges, one device launch.
+
+The BASELINE.json north star asks that "local_shard's compaction task
+scheduler learns to coalesce per-shard compaction jobs into one TPU
+launch".  Shards submit their staged merge columns here; jobs arriving
+within a small window (or up to ``max_batch``) are padded to a common
+(K, P) shape and dispatched as ONE ``vmap``-batched bitonic-merge kernel
+call (ops/bitonic.py: merge_runs_prefix_batch_kernel).  Each shard gets
+back its own permutation.
+
+One coalescer is shared per process (all shards of a node run on one
+loop), matching the reference's one-TPU-per-host deployment picture.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import bitonic
+from ..storage import columnar
+
+log = logging.getLogger(__name__)
+
+
+class CompactionCoalescer:
+    def __init__(
+        self, window_s: float = 0.01, max_batch: int = 16
+    ) -> None:
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._pending: List[Tuple] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self.launches = 0  # batched kernel launches (observability)
+        self.jobs_coalesced = 0
+
+    async def submit(
+        self, cols: columnar.MergeColumns, run_counts: List[int]
+    ) -> np.ndarray:
+        """Returns the merged permutation for this job (8B-prefix order;
+        ties resolved by the caller via columnar.fixup_prefix_ties)."""
+        if len(cols) == 0:
+            return np.zeros(0, np.int64)
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((cols, run_counts, fut))
+        if len(self._pending) >= self.max_batch:
+            self._trigger()
+        elif self._flush_task is None:
+            self._flush_task = asyncio.ensure_future(
+                self._flush_after_window()
+            )
+        return await fut
+
+    def _trigger(self) -> None:
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        asyncio.ensure_future(self._flush())
+
+    async def _flush_after_window(self) -> None:
+        try:
+            await asyncio.sleep(self.window_s)
+        except asyncio.CancelledError:
+            return
+        self._flush_task = None
+        await self._flush()
+
+    async def _flush(self) -> None:
+        jobs, self._pending = self._pending, []
+        if not jobs:
+            return
+        try:
+            # Common batch shape.
+            k = max(
+                bitonic._pow2(max(1, len(rc))) for _, rc, _ in jobs
+            )
+            p = max(
+                bitonic._pow2(max(8, max(rc) if rc else 8))
+                for _, rc, _ in jobs
+            )
+            out_rows = 0
+            staged = []
+            for cols, rc, _ in jobs:
+                prefixes, counts, bases, rows = bitonic.stage_prefixes(
+                    cols, rc, k=k, p=p
+                )
+                staged.append((prefixes, counts, bases))
+                out_rows = max(out_rows, rows)
+            batch_prefixes = np.stack([s[0] for s in staged])
+            batch_counts = np.stack([s[1] for s in staged])
+
+            def run() -> np.ndarray:
+                return np.asarray(
+                    bitonic.merge_runs_prefix_batch_kernel(
+                        batch_prefixes, batch_counts, out_rows
+                    )
+                )
+
+            packed = await asyncio.get_event_loop().run_in_executor(
+                None, run
+            )
+            self.launches += 1
+            self.jobs_coalesced += len(jobs)
+
+            shift = np.uint32(p.bit_length() - 1)
+            mask = np.uint32(p - 1)
+            for j, (cols, _rc, fut) in enumerate(jobs):
+                n = len(cols)
+                row = packed[j, :n]
+                run_ids = (row >> shift).astype(np.int64)
+                pos = (row & mask).astype(np.int64)
+                perm = staged[j][2][run_ids] + pos
+                if not fut.done():
+                    fut.set_result(perm)
+        except Exception as e:
+            log.exception("coalesced merge launch failed")
+            for _, _, fut in jobs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+_default: Optional[CompactionCoalescer] = None
+
+
+def default_coalescer() -> CompactionCoalescer:
+    global _default
+    if _default is None:
+        _default = CompactionCoalescer()
+    return _default
+
+
+class CoalescedDeviceMergeStrategy:
+    """CompactionStrategy whose sort rides the shared coalescer.
+    Exposes ``merge_async`` (the LSM tree prefers it when present) so
+    concurrent shard compactions rendezvous in one launch."""
+
+    name = "coalesced"
+
+    def __init__(
+        self, coalescer: Optional[CompactionCoalescer] = None
+    ) -> None:
+        self.coalescer = coalescer or default_coalescer()
+
+    # Sync fallback (e.g. recovery paths before a loop exists).
+    def merge(self, *args, **kwargs):
+        from ..ops.device_compaction import DeviceMergeStrategy
+
+        return DeviceMergeStrategy().merge(*args, **kwargs)
+
+    async def merge_async(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ):
+        from ..storage.compaction import write_output_columnar
+
+        loop = asyncio.get_event_loop()
+        cols = await loop.run_in_executor(
+            None, columnar.load_columns, sources
+        )
+        run_counts = (
+            np.bincount(cols.src).tolist() if len(cols) else []
+        )
+        try:
+            perm = await self.coalescer.submit(cols, run_counts)
+        except Exception as e:
+            log.warning(
+                "coalesced device launch failed (%s); host merge", e
+            )
+            perm = await loop.run_in_executor(
+                None, columnar.sort_columns_numpy, cols
+            )
+            perm = columnar.fixup_long_key_ties(cols, perm)
+
+        def finish():
+            p = columnar.fixup_prefix_ties(cols, perm, words=2)
+            keep = columnar.dedup_mask_prefix(cols, p, words=2)
+            if not keep_tombstones:
+                keep = keep & ~cols.is_tombstone[p]
+            order = p[keep]
+            return write_output_columnar(
+                cols, order, dir_path, output_index, cache,
+                bloom_min_size,
+            )
+
+        return await loop.run_in_executor(None, finish)

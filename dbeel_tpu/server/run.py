@@ -167,6 +167,26 @@ async def run_node(
     config: Config, num_shards: Optional[int] = None
 ) -> None:
     """main.rs:17-72: one shard per core on a single loop."""
+    if config.compaction_backend in (
+        "auto",
+        "device",
+        "device_full",
+        "coalesced",
+    ):
+        # Initialize the jax backend on the MAIN thread before any
+        # executor-thread kernel dispatch: TPU platform plugins (e.g.
+        # the tunneled 'axon' backend) fail to register when first
+        # touched from a worker thread.
+        try:
+            import jax
+
+            log.info("jax devices: %s", jax.devices())
+        except Exception as e:
+            log.warning(
+                "jax backend unavailable (%s); device compaction "
+                "backends will fall back to host merges",
+                e,
+            )
     n = num_shards or config.shards or os.cpu_count() or 1
     connections = [LocalShardConnection(i) for i in range(n)]
     shards = [create_shard(config, i, connections) for i in range(n)]

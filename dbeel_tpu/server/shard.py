@@ -137,6 +137,8 @@ class MyShard:
         self.shards.sort(
             key=lambda s: (s.hash < threshold, s.hash)
         )
+        self._hash_sorted = sorted(self.shards, key=lambda s: s.hash)
+        self._sorted_hashes = [s.hash for s in self._hash_sorted]
 
     def add_shards_of_nodes(self, nodes: List[NodeMetadata]) -> None:
         for node in nodes:
@@ -154,26 +156,37 @@ class MyShard:
         self.sort_consistent_hash_ring()
 
     def owns_key(self, key_hash: int, replica_index: int = 0) -> bool:
-        """shards.rs:586-618 — replica r owns ranges offset by r distinct-
-        node predecessors."""
-        shards = self.shards
-        if len(shards) < 2:
+        """Am I the replica_index-th distinct-node owner of this hash?
+
+        Deliberate deviation: the reference's owns_key
+        (shards.rs:586-618) walks the rotated ring BACKWARD collecting
+        distinct nodes, which disagrees with the client's FORWARD
+        replica walk (dbeel_client/src/lib.rs:343-395) whenever a node
+        has multiple shards interleaved on the ring — correctly-routed
+        replica requests then bounce with KeyNotOwnedByShard and the
+        client resyncs forever (latent upstream: its tests run one
+        shard per node).  We define ownership as the exact mirror of
+        the client walk: start at the first shard with hash >= key_hash
+        and take the replica_index-th shard on a distinct-node walk.
+        Property-tested in tests/test_ring_properties.py."""
+        ring = self._hash_sorted
+        if len(ring) < 2:
             return True
-        if replica_index == 0:
-            return is_between(
-                key_hash, shards[-1].hash, shards[0].hash
-            )
-        nodes = set()
-        for i in range(len(shards) - 1, 0, -1):
-            shard = shards[i]
-            prev = shards[i - 1]
-            if shard.node_name == prev.node_name or (
-                shard.node_name in nodes
-            ):
+        import bisect
+
+        start = bisect.bisect_left(
+            self._sorted_hashes, key_hash
+        ) % len(ring)
+        nodes: set = set()
+        found = 0
+        for off in range(len(ring)):
+            s = ring[(start + off) % len(ring)]
+            if s.node_name in nodes:
                 continue
-            nodes.add(shard.node_name)
-            if len(nodes) == replica_index:
-                return is_between(key_hash, prev.hash, shard.hash)
+            if found == replica_index:
+                return s.hash == self.hash
+            found += 1
+            nodes.add(s.node_name)
         return False
 
     @staticmethod
@@ -607,6 +620,7 @@ class MyShard:
         self.shards = [
             s for s in self.shards if s.node_name != node_name
         ]
+        self.sort_consistent_hash_ring()
         log.info(
             "after death of %s: %d nodes, %d shards",
             node_name,

@@ -251,6 +251,12 @@ def get_strategy(name: str) -> CompactionStrategy:
         except ImportError:
             return ColumnarMergeStrategy()
         return DeviceMergeStrategy()
+    if name == "coalesced":
+        try:
+            from ..server.coalescer import CoalescedDeviceMergeStrategy
+        except ImportError:
+            return ColumnarMergeStrategy()
+        return CoalescedDeviceMergeStrategy()
     if name == "device_full":
         try:
             from ..ops.device_compaction import DeviceFullMergeStrategy

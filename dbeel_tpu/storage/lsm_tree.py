@@ -409,20 +409,33 @@ class LSMTree:
 
         # Merge runs off-loop so reads/writes stay responsive; it gets
         # cache-free sstable handles (the page cache is loop-owned).
+        # Strategies exposing merge_async (the coalescer) coordinate on
+        # the loop instead and offload their heavy stages themselves.
         inputs_nocache = [
             SSTable(self.dir_path, t.index, None) for t in inputs
         ]
         try:
-            result = await asyncio.get_event_loop().run_in_executor(
-                None,
-                self.strategy.merge,
-                inputs_nocache,
-                self.dir_path,
-                output_index,
-                None,
-                keep_tombstones,
-                self.bloom_min_size,
-            )
+            merge_async = getattr(self.strategy, "merge_async", None)
+            if merge_async is not None:
+                result = await merge_async(
+                    inputs_nocache,
+                    self.dir_path,
+                    output_index,
+                    None,
+                    keep_tombstones,
+                    self.bloom_min_size,
+                )
+            else:
+                result = await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    self.strategy.merge,
+                    inputs_nocache,
+                    self.dir_path,
+                    output_index,
+                    None,
+                    keep_tombstones,
+                    self.bloom_min_size,
+                )
         finally:
             for t in inputs_nocache:
                 t.close()

@@ -148,6 +148,59 @@ def test_replication_quorum_matrix(tmp_dir):
     run(main(), timeout=60)
 
 
+def test_replication_with_multiple_shards_per_node(tmp_dir):
+    """RF=2 on 2 nodes x 3 shards: replica routing must work when node
+    shards interleave on the ring (the reference's backward owns_key
+    walk rejects correctly-routed replicas in this topology — our
+    forward-walk fix is what makes this test pass)."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node1 = await ClusterNode(cfg, num_shards=3).start()
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[node1.seed_address]
+        )
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2, num_shards=3).start()
+        await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            col = await client.create_collection(
+                "ms", replication_factor=2
+            )
+            for n in (node1, node2):
+                for s in n.shards:
+                    while "ms" not in s.collections:
+                        await asyncio.sleep(0.01)
+            for i in range(80):
+                await col.set(
+                    f"key{i:03}", i, consistency=Consistency.ALL
+                )
+            for i in range(80):
+                assert (
+                    await col.get(
+                        f"key{i:03}", consistency=Consistency.ALL
+                    )
+                    == i
+                )
+            # Every key is held by BOTH nodes (RF=2, 2 nodes).
+            for n in (node1, node2):
+                held = 0
+                for s in n.shards:
+                    tree = s.collections["ms"].tree
+                    async for _k, v, _ts in tree.iter():
+                        if v != b"":
+                            held += 1
+                assert held == 80, f"{n.config.name} holds {held}/80"
+        finally:
+            await node2.stop()
+            await node1.stop()
+
+    run(main(), timeout=60)
+
+
 def test_replicated_set_reaches_replica_trees(tmp_dir):
     """ItemSetFromShardMessage flow event fires on replicas
     (tests/replication.rs style)."""

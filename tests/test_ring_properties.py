@@ -1,0 +1,146 @@
+"""Property tests for the ring / replica-ownership math.
+
+SURVEY.md §7 ("Hard parts"): the reference's ownership edge cases
+(wrap-around is_between, replica-index-offset ownership, distinct-node
+walks) deserve property tests over random clusters, not just
+hand-computed-hash cases.
+
+Invariants checked over random clusters and random key hashes:
+  1. Primary (replica_index 0) ownership tiles the ring exactly: one
+     owner per hash, no holes, no overlaps.
+  2. Every (shard, replica_index) the CLIENT's replica walk routes to is
+     accepted by that shard's owns_key — no KeyNotOwnedByShard for
+     correctly-routed requests, at any replica index.
+
+Note a deliberate non-invariant: for replica_index > 0 with multiple
+shards per node, owns_key can return True on shards the client never
+routes to (the reference's backward distinct-node walk claims ranges
+for same-node siblings of the primary).  That spurious acceptance is
+reference behavior; the client walk is what defines correctness.
+"""
+
+import random
+
+import pytest
+
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu.cluster.local_comm import LocalShardConnection
+from dbeel_tpu.cluster.messages import NodeMetadata
+from dbeel_tpu.config import Config
+from dbeel_tpu.server.shard import MyShard, Shard, is_between
+from dbeel_tpu.storage.page_cache import PageCache
+from dbeel_tpu.utils.murmur import hash_string
+
+from conftest import run
+
+
+def _build_cluster(rng):
+    """Random cluster: 2-5 nodes x 1-4 shards; returns one MyShard view
+    per shard (each node's shards are Local to that node's views)."""
+    n_nodes = rng.randint(2, 5)
+    nodes = {
+        f"node{chr(97 + i)}{rng.randrange(1000)}": rng.randint(1, 4)
+        for i in range(n_nodes)
+    }
+    views = []
+    for node_name, n_shards in nodes.items():
+        config = Config(name=node_name)
+        connections = [
+            LocalShardConnection(i) for i in range(n_shards)
+        ]
+        for sid in range(n_shards):
+            shards = [
+                Shard(
+                    node_name=node_name,
+                    name=f"{node_name}-{i}",
+                    connection=c,
+                )
+                for i, c in enumerate(connections)
+            ]
+            view = MyShard(
+                config, sid, shards, PageCache(8), connections[sid]
+            )
+            # Add every other node's shards as remote ring entries.
+            view.add_shards_of_nodes(
+                [
+                    NodeMetadata(
+                        name=other,
+                        ip="127.0.0.1",
+                        remote_shard_base_port=20000,
+                        ids=list(range(cnt)),
+                        gossip_port=30000,
+                        db_port=10000,
+                    )
+                    for other, cnt in nodes.items()
+                    if other != node_name
+                ]
+            )
+            views.append(view)
+    return nodes, views
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_primary_ownership_tiles_the_ring(seed):
+    async def main():
+        rng = random.Random(seed)
+        _nodes, views = _build_cluster(rng)
+        for _ in range(100):
+            h = rng.randrange(1 << 32)
+            owners = [v for v in views if v.owns_key(h, 0)]
+            assert len(owners) == 1, (
+                f"hash {h}: {[o.shard_name for o in owners]}"
+            )
+
+    run(main())
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_server_owners_match_client_replica_walk(seed):
+    async def main():
+        rng = random.Random(seed)
+        nodes, views = _build_cluster(rng)
+        n_nodes = len(nodes)
+
+        # Client-side ring over the same cluster.
+        client = DbeelClient([])
+        from dbeel_tpu.cluster.messages import ClusterMetadata
+
+        client._apply_metadata(
+            ClusterMetadata(
+                nodes=[
+                    NodeMetadata(
+                        name=name,
+                        ip="127.0.0.1",
+                        remote_shard_base_port=20000,
+                        ids=list(range(cnt)),
+                        gossip_port=30000,
+                        db_port=10000,
+                    )
+                    for name, cnt in nodes.items()
+                ],
+                collections=[],
+            )
+        )
+
+        by_hash = {hash_string(v.shard_name): v for v in views}
+        for _ in range(50):
+            h = rng.randrange(1 << 32)
+            walk = client._shards_for_key(h, n_nodes)
+            for r, client_shard in enumerate(walk):
+                view = by_hash[client_shard.hash]
+                assert view.owns_key(h, r), (
+                    f"hash {h} replica {r}: client routes to "
+                    f"{view.shard_name} but it rejects ownership"
+                )
+
+    run(main())
+
+
+def test_is_between_wraparound():
+    assert is_between(5, 3, 10)
+    assert not is_between(10, 3, 10)  # half-open
+    assert is_between(3, 3, 10)
+    # Wrap: [10, 3) covers high values and low values.
+    assert is_between(11, 10, 3)
+    assert is_between(2, 10, 3)
+    assert not is_between(5, 10, 3)
