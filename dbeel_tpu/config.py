@@ -47,6 +47,7 @@ class Config:
     shards: int = 0  # 0 = one shard per online CPU core.
     compaction_backend: str = "auto"  # auto | device | cpu | native
     memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
+    memtable_kind: str = "sorted"  # sorted | hash (device flush sort)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -135,6 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--memtable-capacity", type=int, default=d.memtable_capacity
     )
+    p.add_argument(
+        "--memtable-kind",
+        choices=("sorted", "hash"),
+        default=d.memtable_kind,
+    )
     return p
 
 
@@ -165,4 +171,5 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,
+        memtable_kind=ns.memtable_kind,
     )

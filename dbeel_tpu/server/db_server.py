@@ -87,6 +87,10 @@ async def handle_request(
             my_shard.get_cluster_metadata().to_wire(), use_bin_type=True
         )
 
+    if rtype == "get_stats":
+        # Observability extension (no reference analog).
+        return msgpack.packb(my_shard.get_stats(), use_bin_type=True)
+
     if rtype == "create_collection":
         name = _extract(request, "name")
         rf = request.get("replication_factor")

@@ -301,7 +301,31 @@ class MyShard:
             wal_sync_delay_us=self.config.wal_sync_delay_us,
             bloom_min_size=self.config.sstable_bloom_min_size,
             strategy=get_strategy(self.config.compaction_backend),
+            memtable_kind=self.config.memtable_kind,
         )
+
+    def get_stats(self) -> dict:
+        """Per-shard observability snapshot (no reference analog —
+        SURVEY.md §5 marks tracing/metrics as a gap to improve on)."""
+        collections = {}
+        for name, col in self.collections.items():
+            tree = col.tree
+            collections[name] = {
+                "memtable_entries": tree.memtable_entries,
+                "sstables": tree.sstable_indices_and_sizes(),
+                "replication_factor": col.replication_factor,
+            }
+        return {
+            "shard": self.shard_name,
+            "nodes_known": len(self.nodes),
+            "ring_size": len(self.shards),
+            "cache": {
+                "pages": len(self.cache),
+                "hits": self.cache.hits,
+                "misses": self.cache.misses,
+            },
+            "collections": collections,
+        }
 
     async def create_collection(
         self, name: str, replication_factor: int

@@ -47,7 +47,7 @@ from .entry import (
     file_name,
 )
 from .entry_writer import EntryWriter
-from .memtable import Memtable
+from .memtable import HashMemtable, Memtable
 from .page_cache import PartitionPageCache
 from .sstable import SSTable
 
@@ -87,6 +87,7 @@ class LSMTree:
         wal_sync_delay_us: int = 0,
         bloom_min_size: int = DEFAULT_BLOOM_MIN_SIZE,
         strategy: Optional[CompactionStrategy] = None,
+        memtable_kind: str = "sorted",
     ) -> None:
         self.dir_path = dir_path
         self.cache = cache
@@ -95,8 +96,20 @@ class LSMTree:
         self.wal_sync_delay_us = wal_sync_delay_us
         self.bloom_min_size = bloom_min_size
         self.strategy = strategy or HeapMergeStrategy()
+        # "sorted" = SortedDict kept ordered per insert (reference's
+        # rbtree contract); "hash" = O(1) dict, ordered once at flush by
+        # the device sort (ops/sort.py) — the north-star flush path.
+        if memtable_kind not in ("sorted", "hash"):
+            raise ValueError(
+                f"memtable_kind must be 'sorted' or 'hash', "
+                f"got {memtable_kind!r}"
+            )
+        self.memtable_kind = memtable_kind
+        self._memtable_cls = (
+            HashMemtable if memtable_kind == "hash" else Memtable
+        )
 
-        self._active: Memtable = Memtable(capacity)
+        self._active = self._memtable_cls(capacity)
         self._flushing: Optional[Memtable] = None
         self._sstables = SSTableList([])
         self._wal: Optional[wal_mod.Wal] = None
@@ -175,7 +188,7 @@ class LSMTree:
                 recovered.set(key, value, ts)
             if len(recovered):
                 self._write_sstable_from_items(
-                    older, list(recovered.items())
+                    older, recovered.sorted_items()
                 )
                 if older not in data_indices:
                     data_indices.append(older)
@@ -199,7 +212,7 @@ class LSMTree:
                 self._wal_path(self._index)
             ):
                 replayed.set(key, value, ts)
-            self._active = Memtable(
+            self._active = self._memtable_cls(
                 max(self.capacity, len(replayed) + 1)
             )
             for key, (value, ts) in replayed.items():
@@ -329,16 +342,22 @@ class LSMTree:
                 assert self._wal is not None
                 self._pending_flush = (flush_index, self._wal)
                 self._flushing = self._active
-                self._active = Memtable(self.capacity)
+                self._active = self._memtable_cls(self.capacity)
                 self._wal = new_wal
                 self._index = next_index
                 self.flush_start_event.notify()
 
             flush_index, old_wal = self._pending_flush
-            assert self._flushing is not None
-            items = list(self._flushing.items())
+            flushing = self._flushing
+            assert flushing is not None
+            # Sort (a no-op for the sorted memtable, a device sort for
+            # the hash memtable) AND write off-loop: the flushing
+            # memtable is no longer mutated, so the worker may read it.
             await asyncio.get_event_loop().run_in_executor(
-                None, self._write_sstable_from_items, flush_index, items
+                None,
+                lambda: self._write_sstable_from_items(
+                    flush_index, flushing.sorted_items()
+                ),
             )
             table = SSTable(self.dir_path, flush_index, self.cache)
             self._sstables = SSTableList(
@@ -383,6 +402,14 @@ class LSMTree:
     # ------------------------------------------------------------------
     # Compaction (lsm_tree.rs:950-1156)
     # ------------------------------------------------------------------
+
+    @property
+    def memtable_entries(self) -> int:
+        """Entries living only in memory (active + in-flight flush)."""
+        n = len(self._active)
+        if self._flushing is not None:
+            n += len(self._flushing)
+        return n
 
     def sstable_indices_and_sizes(self) -> List[Tuple[int, int]]:
         return [
@@ -528,10 +555,11 @@ class LSMTree:
         memtable_items: List[Tuple[bytes, bytes, int]] = []
         if self._flushing is not None:
             memtable_items.extend(
-                (k, v, ts) for k, (v, ts) in self._flushing.items()
+                (k, v, ts)
+                for k, (v, ts) in self._flushing.sorted_items()
             )
         memtable_items.extend(
-            (k, v, ts) for k, (v, ts) in self._active.items()
+            (k, v, ts) for k, (v, ts) in self._active.sorted_items()
         )
         snapshot = self._sstables
         snapshot.acquire()

@@ -1,21 +1,25 @@
-"""Capacity-bounded sorted memtable.
+"""Capacity-bounded memtables.
 
 Role parity with the reference's arena red-black tree
 (/root/reference/rbtree_arena/src/lib.rs:308-649): sorted in-memory map
 with a hard capacity that drives the flush trigger (set errors / waits at
-capacity, lsm_tree.rs:747-755), in-order forward iteration, and a
-consuming drain for flush.
+capacity, lsm_tree.rs:747-755), in-order iteration, and a consuming
+drain for flush.
 
-The idiomatic rebuild uses ``sortedcontainers.SortedDict`` (B-tree-ish
-list-of-lists — the same cache-friendly contiguous-storage idea as the
-arena).  The flush *sort* itself is a no-op here because the structure is
-kept sorted; the device flush path instead drains insertion order and
-sorts on the TPU (ops.sort) — both produce identical SSTables.
+Two implementations share one contract (and produce byte-identical
+SSTables):
+
+* ``Memtable`` — ``sortedcontainers.SortedDict`` kept ordered per insert
+  (the idiomatic analog of the reference's cache-friendly arena tree).
+* ``HashMemtable`` — the TPU-first variant: a plain hash map (O(1)
+  set/get, no per-insert ordering work) whose ordering debt is paid once
+  at flush by the device sort (ops/sort.py) — the north star's
+  "memtable flush becomes a single-run device sort" (BASELINE.json).
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 from sortedcontainers import SortedDict
 
@@ -24,13 +28,19 @@ from ..errors import MemtableCapacityReached
 Item = Tuple[bytes, Tuple[bytes, int]]  # key -> (value, timestamp_ns)
 
 
-class Memtable:
+class MemtableBase:
+    """Shared capacity / conflict semantics; subclasses choose the map
+    type and the ordering strategy."""
+
     def __init__(self, capacity: int) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
-        self._map: SortedDict = SortedDict()
+        self._map = self._new_map()
         self.data_bytes = 0  # approximate on-disk size of contents
+
+    def _new_map(self):
+        raise NotImplementedError
 
     def __len__(self) -> int:
         return len(self._map)
@@ -49,21 +59,61 @@ class Memtable:
                 )
             self._map[key] = (value, timestamp)
             self.data_bytes += 16 + len(key) + len(value)
-        else:
+        elif timestamp >= prev[1]:
             # Keep the newest timestamp (reference updates in place).
-            if timestamp >= prev[1]:
-                self._map[key] = (value, timestamp)
-                self.data_bytes += len(value) - len(prev[0])
+            self._map[key] = (value, timestamp)
+            self.data_bytes += len(value) - len(prev[0])
 
     def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
         return self._map.get(key)
 
     def items(self) -> Iterator[Item]:
-        """Key-ascending iteration (rbtree in-order iterator)."""
         return iter(self._map.items())
+
+    def sorted_items(self) -> List[Item]:
+        raise NotImplementedError
+
+    def range(
+        self, lo: Optional[bytes] = None, hi: Optional[bytes] = None
+    ) -> Iterator[Item]:
+        raise NotImplementedError
+
+
+class Memtable(MemtableBase):
+    def _new_map(self):
+        return SortedDict()
+
+    def sorted_items(self) -> List[Item]:
+        return list(self._map.items())
 
     def range(
         self, lo: Optional[bytes] = None, hi: Optional[bytes] = None
     ) -> Iterator[Item]:
         for key in self._map.irange(lo, hi):
             yield key, self._map[key]
+
+
+class HashMemtable(MemtableBase):
+    def _new_map(self):
+        self._sorted_cache: Optional[List[Item]] = None
+        return {}
+
+    def set(self, key: bytes, value: bytes, timestamp: int) -> None:
+        self._sorted_cache = None
+        super().set(key, value, timestamp)
+
+    def sorted_items(self) -> List[Item]:
+        if self._sorted_cache is None:
+            from ..ops.sort import sort_items
+
+            self._sorted_cache = sort_items(list(self._map.items()))
+        return self._sorted_cache
+
+    def range(
+        self, lo: Optional[bytes] = None, hi: Optional[bytes] = None
+    ) -> Iterator[Item]:
+        # O(n log n) on first call after a write (cached after); the
+        # sorted Memtable is the right choice for range-heavy loads.
+        for key, val in self.sorted_items():
+            if (lo is None or key >= lo) and (hi is None or key <= hi):
+                yield key, val

@@ -129,6 +129,28 @@ def test_multi_shard_routing(tmp_dir):
     run(main(), timeout=30)
 
 
+def test_get_stats(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes([node.db_address])
+            col = await client.create_collection("s")
+            await col.set("k", 1)
+            import msgpack
+
+            raw = await client._send_to(
+                *node.db_address, {"type": "get_stats"}
+            )
+            stats = msgpack.unpackb(raw, raw=False)
+            assert stats["shard"] == "dbeel-test-0"
+            assert "s" in stats["collections"]
+            assert stats["collections"]["s"]["memtable_entries"] == 1
+        finally:
+            await node.stop()
+
+    run(main())
+
+
 def test_collection_discovery_after_restart(tmp_dir):
     """tests/collection_discovery.rs: collections rediscovered from disk
     without client recreation."""
