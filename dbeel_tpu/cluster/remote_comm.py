@@ -108,6 +108,23 @@ class RemoteShardConnection:
         """Send a ShardRequest, return the ShardResponse payload list."""
         return await self.send_message(request)
 
+    async def send_event(self, event: list) -> None:
+        """Fire one ShardEvent (no reply expected) and close."""
+        reader, writer = await self._connect()
+        try:
+            await asyncio.wait_for(
+                send_message_to_stream(writer, event),
+                self.write_timeout,
+            )
+        except asyncio.TimeoutError as e:
+            raise Timeout(f"event to {self.address}") from e
+        except OSError as e:
+            raise ConnectionError_(
+                f"event to {self.address}: {e}"
+            ) from e
+        finally:
+            writer.close()
+
     async def ping(self) -> None:
         response_to_result(
             await self.send_request(ShardRequest.ping()),

@@ -48,6 +48,7 @@ class Config:
     compaction_backend: str = "auto"  # auto | device | cpu | native
     memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
     memtable_kind: str = "sorted"  # sorted | hash (device flush sort)
+    processes: bool = False  # one pinned OS process per shard
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
@@ -141,6 +142,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("sorted", "hash"),
         default=d.memtable_kind,
     )
+    p.add_argument(
+        "--processes",
+        action="store_true",
+        default=d.processes,
+        help="One pinned OS process per shard (thread-per-core shape).",
+    )
     return p
 
 
@@ -172,4 +179,5 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,
         memtable_kind=ns.memtable_kind,
+        processes=ns.processes,
     )

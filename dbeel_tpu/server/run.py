@@ -163,6 +163,92 @@ async def run_shard(
         my_shard.close()
 
 
+def create_shard_for_process(
+    config: Config, shard_id: int, total_shards: int
+) -> MyShard:
+    """Per-core process mode: this process hosts ONE shard; sibling
+    shards of the same node appear as loopback remote ring entries."""
+    from ..cluster.remote_comm import RemoteShardConnection
+
+    cache = PageCache(
+        max(8, config.page_cache_size // PAGE_SIZE // total_shards)
+    )
+    local = LocalShardConnection(shard_id)
+    shards = []
+    for i in range(total_shards):
+        if i == shard_id:
+            shards.append(
+                Shard(
+                    node_name=config.name,
+                    name=f"{config.name}-{i}",
+                    connection=local,
+                )
+            )
+        else:
+            shards.append(
+                Shard(
+                    node_name=config.name,
+                    name=f"{config.name}-{i}",
+                    connection=RemoteShardConnection.from_config(
+                        f"{config.ip}:{config.remote_port(i)}", config
+                    ),
+                )
+            )
+    return MyShard(config, shard_id, shards, cache, local)
+
+
+async def run_shard_process(
+    config: Config, shard_id: int, total_shards: int
+) -> None:
+    """Entry for one pinned per-core process (glommio
+    Placement::Fixed(cpu) analog, main.rs:48-64)."""
+    try:
+        os.sched_setaffinity(0, {shard_id % (os.cpu_count() or 1)})
+    except (AttributeError, OSError):
+        pass
+    my_shard = create_shard_for_process(config, shard_id, total_shards)
+    await run_shard(my_shard, is_node_managing=shard_id == 0)
+
+
+def _process_entry(config: Config, shard_id: int, total: int) -> None:
+    logging.basicConfig(
+        level=os.environ.get("DBEEL_LOG", "INFO"),
+        format=f"%(asctime)s %(levelname).1s shard{shard_id} "
+        "%(name)s: %(message)s",
+    )
+    try:
+        asyncio.run(run_shard_process(config, shard_id, total))
+    except KeyboardInterrupt:
+        pass
+
+
+def run_node_processes(config: Config, num_shards: int) -> None:
+    """Spawn one OS process per shard, each pinned to a core — the
+    thread-per-core deployment shape of the reference (main.rs:39-64),
+    with the intra-node plane riding loopback TCP."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=_process_entry,
+            args=(config, i, num_shards),
+            name=f"dbeel-shard-{i}",
+        )
+        for i in range(num_shards)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        for p in procs:
+            p.join()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.join()
+
+
 async def run_node(
     config: Config, num_shards: Optional[int] = None
 ) -> None:
@@ -201,6 +287,10 @@ def main(argv=None) -> None:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     config = parse_args(argv)
+    n = config.shards or os.cpu_count() or 1
+    if config.processes and n > 1:
+        run_node_processes(config, n)
+        return
     try:
         asyncio.run(run_node(config))
     except KeyboardInterrupt:

@@ -249,8 +249,12 @@ class MyShard:
     # ------------------------------------------------------------------
 
     def get_node_metadata(self) -> NodeMetadata:
+        # All shards of THIS node — local queues in single-process mode,
+        # same-node remote entries in the per-core process launcher.
         ids = [
-            s.connection.id for s in self.shards if s.is_local
+            int(s.name.rsplit("-", 1)[1])
+            for s in self.shards
+            if s.node_name == self.config.name
         ]
         return NodeMetadata(
             name=self.config.name,
@@ -389,28 +393,48 @@ class MyShard:
     # Local shard comm (shards.rs:398-460)
     # ------------------------------------------------------------------
 
-    def local_connections(self) -> List[LocalShardConnection]:
+    def sibling_connections(self) -> List[ShardConnection]:
+        """Other shards of this node: asyncio queues when co-located in
+        one process, loopback TCP in the per-core process launcher."""
         return [
             s.connection
             for s in self.shards
-            if s.is_local and s.connection.id != self.id
+            if s.node_name == self.config.name
+            and s.name != self.shard_name
         ]
 
+    async def _send_sibling_message(self, conn, message: list) -> None:
+        if isinstance(conn, LocalShardConnection):
+            await conn.send_message(self.id, message)
+        else:
+            await conn.send_event(message)
+
+    async def _send_sibling_request(self, conn, request: list):
+        if isinstance(conn, LocalShardConnection):
+            return await conn.send_request(self.id, request)
+        return await conn.send_request(request)
+
     async def broadcast_message_to_local_shards(self, message: list):
-        await asyncio.gather(
+        # Per-sibling failures must not abort the whole broadcast (in
+        # per-core process mode a sibling may still be binding).
+        results = await asyncio.gather(
             *[
-                c.send_message(self.id, message)
-                for c in self.local_connections()
-            ]
+                self._send_sibling_message(c, message)
+                for c in self.sibling_connections()
+            ],
+            return_exceptions=True,
         )
+        for r in results:
+            if isinstance(r, Exception):
+                log.debug("sibling broadcast failed: %s", r)
 
     async def send_request_to_local_shards(
         self, request: list, expected_kind: str
     ) -> List:
         results = await asyncio.gather(
             *[
-                c.send_request(self.id, request)
-                for c in self.local_connections()
+                self._send_sibling_request(c, request)
+                for c in self.sibling_connections()
             ]
         )
         return [
@@ -434,7 +458,9 @@ class MyShard:
         nodes: set = set()
         connections: List[RemoteShardConnection] = []
         for s in self.shards:
-            if s.is_local or s.node_name in nodes:
+            # Replicas live on OTHER nodes (same-node shards may be
+            # remote connections under the per-core process launcher).
+            if s.node_name == self.config.name or s.node_name in nodes:
                 continue
             nodes.add(s.node_name)
             connections.append(s.connection)
