@@ -163,13 +163,35 @@ async def run_shard(
         my_shard.close()
 
 
+def _eager_jax_init(config: Config) -> None:
+    """Initialize the jax backend on the MAIN thread before any
+    executor-thread kernel dispatch: TPU platform plugins (e.g. the
+    tunneled 'axon' backend) fail to register when first touched from a
+    worker thread."""
+    if config.compaction_backend not in (
+        "auto",
+        "device",
+        "device_full",
+        "coalesced",
+    ):
+        return
+    try:
+        import jax
+
+        log.info("jax devices: %s", jax.devices())
+    except Exception as e:
+        log.warning(
+            "jax backend unavailable (%s); device compaction backends "
+            "will fall back to host merges",
+            e,
+        )
+
+
 def create_shard_for_process(
     config: Config, shard_id: int, total_shards: int
 ) -> MyShard:
     """Per-core process mode: this process hosts ONE shard; sibling
     shards of the same node appear as loopback remote ring entries."""
-    from ..cluster.remote_comm import RemoteShardConnection
-
     cache = PageCache(
         max(8, config.page_cache_size // PAGE_SIZE // total_shards)
     )
@@ -206,6 +228,7 @@ async def run_shard_process(
         os.sched_setaffinity(0, {shard_id % (os.cpu_count() or 1)})
     except (AttributeError, OSError):
         pass
+    _eager_jax_init(config)
     my_shard = create_shard_for_process(config, shard_id, total_shards)
     await run_shard(my_shard, is_node_managing=shard_id == 0)
 
@@ -247,32 +270,17 @@ def run_node_processes(config: Config, num_shards: int) -> None:
             p.terminate()
         for p in procs:
             p.join()
+    failed = [p.name for p in procs if p.exitcode not in (0, None)]
+    if failed:
+        log.error("shard processes failed: %s", failed)
+        sys.exit(1)
 
 
 async def run_node(
     config: Config, num_shards: Optional[int] = None
 ) -> None:
     """main.rs:17-72: one shard per core on a single loop."""
-    if config.compaction_backend in (
-        "auto",
-        "device",
-        "device_full",
-        "coalesced",
-    ):
-        # Initialize the jax backend on the MAIN thread before any
-        # executor-thread kernel dispatch: TPU platform plugins (e.g.
-        # the tunneled 'axon' backend) fail to register when first
-        # touched from a worker thread.
-        try:
-            import jax
-
-            log.info("jax devices: %s", jax.devices())
-        except Exception as e:
-            log.warning(
-                "jax backend unavailable (%s); device compaction "
-                "backends will fall back to host merges",
-                e,
-            )
+    _eager_jax_init(config)
     n = num_shards or config.shards or os.cpu_count() or 1
     connections = [LocalShardConnection(i) for i in range(n)]
     shards = [create_shard(config, i, connections) for i in range(n)]

@@ -412,7 +412,17 @@ class MyShard:
     async def _send_sibling_request(self, conn, request: list):
         if isinstance(conn, LocalShardConnection):
             return await conn.send_request(self.id, request)
-        return await conn.send_request(request)
+        # Loopback TCP sibling (per-core process mode): tolerate the
+        # startup bind race with brief retries before surfacing.
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                return await conn.send_request(request)
+            except DbeelError as e:
+                last = e
+                await asyncio.sleep(0.2 * (attempt + 1))
+        assert last is not None
+        raise last
 
     async def broadcast_message_to_local_shards(self, message: list):
         # Per-sibling failures must not abort the whole broadcast (in
@@ -426,7 +436,7 @@ class MyShard:
         )
         for r in results:
             if isinstance(r, Exception):
-                log.debug("sibling broadcast failed: %s", r)
+                log.warning("sibling broadcast failed: %s", r)
 
     async def send_request_to_local_shards(
         self, request: list, expected_kind: str
