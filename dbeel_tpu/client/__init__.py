@@ -279,3 +279,50 @@ class DbeelCollection:
         await self.client._sharded_request(
             key, request, self.replication_factor
         )
+
+
+class DbeelClientSync:
+    """Blocking convenience wrapper (the reference ships a 49-line
+    synchronous python client, /root/reference/dbeel.py — this is its
+    batteries-included equivalent)."""
+
+    def __init__(self, seed_addresses: Sequence[Tuple[str, int]]):
+        import asyncio as _asyncio
+
+        self._loop = _asyncio.new_event_loop()
+        self._client = self._run(
+            DbeelClient.from_seed_nodes(seed_addresses)
+        )
+
+    def _run(self, coro):
+        return self._loop.run_until_complete(coro)
+
+    def create_collection(self, name, replication_factor=None):
+        self._run(
+            self._client.create_collection(name, replication_factor)
+        )
+        return SyncCollection(self, self._client.collection(name))
+
+    def drop_collection(self, name):
+        self._run(self._client.drop_collection(name))
+
+    def collection(self, name):
+        return SyncCollection(self, self._client.collection(name))
+
+    def close(self):
+        self._loop.close()
+
+
+class SyncCollection:
+    def __init__(self, sync_client, collection):
+        self._c = sync_client
+        self._col = collection
+
+    def set(self, key, value, consistency=None):
+        self._c._run(self._col.set(key, value, consistency))
+
+    def get(self, key, consistency=None):
+        return self._c._run(self._col.get(key, consistency))
+
+    def delete(self, key, consistency=None):
+        self._c._run(self._col.delete(key, consistency))

@@ -28,6 +28,7 @@ class FlowEvent(enum.Enum):
     MEMTABLE_FLUSH_DONE = "MemtableFlushDone"
     COMPACTION_DONE = "CompactionDone"
     WAL_SYNCED = "WalSynced"
+    READ_REPAIR = "ReadRepair"
 
 
 _enabled = False

@@ -210,14 +210,37 @@ async def handle_request(
             entries = [
                 (bytes(v[0]), v[1]) for v in values if v is not None
             ]
+            stale_acks = sum(1 for v in values if v is None)
             if local_value is not None:
                 entries.append(local_value)
+            else:
+                stale_acks += 1
             # Conflict resolution: max server timestamp wins
             # (db_server.rs:353-363).
             if entries:
-                value = max(entries, key=lambda e: e[1])[0]
-                if value != TOMBSTONE:
-                    return value
+                win_value, win_ts = max(entries, key=lambda e: e[1])
+                # Read repair (improvement over the reference, which
+                # has none — SURVEY §5): any replica that answered with
+                # a missing or older entry gets the winning version
+                # re-propagated in the background.  Idempotent: replicas
+                # keep the newest timestamp; duplicates collapse at
+                # compaction.
+                if stale_acks or any(
+                    ts != win_ts for _v, ts in entries
+                ):
+                    my_shard.spawn(
+                        _read_repair(
+                            my_shard,
+                            collection_name,
+                            col,
+                            key,
+                            win_value,
+                            win_ts,
+                            rf - replica_index - 1,
+                        )
+                    )
+                if win_value != TOMBSTONE:
+                    return win_value
             raise KeyNotFound(repr(key))
         try:
             value = await asyncio.wait_for(
@@ -232,6 +255,31 @@ async def handle_request(
     if isinstance(rtype, str):
         raise UnsupportedField(rtype)
     raise BadFieldType("type")
+
+
+async def _read_repair(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    key: bytes,
+    value: bytes,
+    ts: int,
+    number_of_nodes: int,
+) -> None:
+    from ..flow_events import FlowEvent
+
+    try:
+        await col.tree.set_with_timestamp(key, value, ts)
+        if number_of_nodes > 0:
+            await my_shard.send_request_to_replicas(
+                ShardRequest.set(collection_name, key, value, ts),
+                number_of_acks=0,
+                number_of_nodes=number_of_nodes,
+                expected_kind=ShardResponse.SET,
+            )
+        my_shard.flow.notify(FlowEvent.READ_REPAIR)
+    except Exception as e:
+        log.warning("read repair for %r failed: %s", key, e)
 
 
 async def _send_response(writer: asyncio.StreamWriter, buf: bytes):

@@ -644,6 +644,13 @@ class MyShard:
                 if node.name not in self.nodes:
                     self.nodes[node.name] = node
                     self.add_shards_of_nodes([node])
+                # State transition resets the opposite epidemic counter
+                # (improvement over the reference: without this, a node
+                # that dies and rejoins within the dedup window has its
+                # fresh announcements suppressed and never reappears).
+                self.gossip_requests.pop(
+                    (node.name, GossipEvent.DEAD), None
+                )
                 self.flow.notify(FlowEvent.ALIVE_NODE_GOSSIP)
                 added = [
                     s
@@ -676,6 +683,12 @@ class MyShard:
     async def handle_dead_node(self, node_name: str) -> None:
         if self.nodes.pop(node_name, None) is None:
             return
+        # Allow the node's next Alive announcement through the gossip
+        # dedup immediately (see the matching reset in
+        # handle_gossip_event).
+        self.gossip_requests.pop(
+            (node_name, GossipEvent.ALIVE), None
+        )
         removed = [s for s in self.shards if s.node_name == node_name]
         self.shards = [
             s for s in self.shards if s.node_name != node_name

@@ -201,6 +201,70 @@ def test_replication_with_multiple_shards_per_node(tmp_dir):
     run(main(), timeout=60)
 
 
+def test_read_repair_heals_stale_replica(tmp_dir):
+    """Improvement over the reference (which has no read repair): a
+    replica that missed a write converges after a quorum read observes
+    the divergence."""
+
+    async def main():
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        client = await DbeelClient.from_seed_nodes([nodes[0].db_address])
+        col = await client.create_collection("rr", replication_factor=3)
+        for n in nodes:
+            while "rr" not in n.shards[0].collections:
+                await asyncio.sleep(0.01)
+
+        await col.set("k", "v1", consistency=Consistency.ALL)
+
+        # Node 3 misses the second write: crash it (no death gossip),
+        # write with W=1, then bring it back with its stale data.
+        await nodes[2].crash()
+        await col.set("k", "v2", consistency=Consistency.fixed(1))
+        alive_again = [
+            nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP),
+            nodes[1].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP),
+        ]
+        nodes[2] = await ClusterNode(cfgs[2]).start()
+        # Survivors must have node 3 back on their rings before the
+        # repairing read fans out.
+        await asyncio.gather(*alive_again)
+        for _ in range(200):
+            if "rr" in nodes[2].shards[0].collections and all(
+                len(n.shards[0].nodes) == 2 for n in nodes[:2]
+            ):
+                break
+            await asyncio.sleep(0.02)
+
+        def stale_tree():
+            return nodes[2].shards[0].collections["rr"].tree
+
+        import msgpack
+
+        key = msgpack.packb("k")
+        entry = await stale_tree().get(key)
+        assert entry == msgpack.packb("v1"), "precondition: stale"
+
+        # A full-consistency read observes the divergence and repairs.
+        assert await col.get("k", consistency=Consistency.ALL) == "v2"
+        for _ in range(300):
+            if await stale_tree().get(key) == msgpack.packb("v2"):
+                break
+            await asyncio.sleep(0.02)
+        assert await stale_tree().get(key) == msgpack.packb("v2"), (
+            "replica not repaired"
+        )
+
+        for n in reversed(nodes):
+            await n.stop()
+
+    run(main(), timeout=60)
+
+
 def test_replicated_set_reaches_replica_trees(tmp_dir):
     """ItemSetFromShardMessage flow event fires on replicas
     (tests/replication.rs style)."""
