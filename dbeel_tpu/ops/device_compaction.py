@@ -34,16 +34,31 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
     # device with full key columns rather than fix up row-by-row on host.
     TIE_FALLBACK_FRACTION = 0.02
 
-    def sort_and_dedup(
-        self, cols: columnar.MergeColumns
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        # Input sstables are sorted: recover per-run lengths from the
-        # (contiguous, ascending) src column and hand the k-way merge to
-        # the bitonic network.
-        run_counts = (
-            np.bincount(cols.src).tolist() if len(cols) else []
+    def merge(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ):
+        """Pipelined override: per-run device uploads overlap the disk
+        reads (each file read once), then the shared finish path."""
+        from ..storage.compaction import write_output_columnar
+        from .bitonic import device_merge_prefix_order_pipelined
+
+        perm, pieces = device_merge_prefix_order_pipelined(sources)
+        cols = columnar.assemble_columns(pieces)
+        perm, keep = self._refine(cols, perm)
+        if not keep_tombstones:
+            keep = keep & ~cols.is_tombstone[perm]
+        return write_output_columnar(
+            cols, perm[keep], dir_path, output_index, cache,
+            bloom_min_size,
         )
-        perm = device_merge_prefix_order(cols, run_counts)
+
+    def _refine(self, cols, perm):
         if len(cols) > 1:
             kw = cols.key_words[perm]
             ties = int(
@@ -58,6 +73,16 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
         perm = columnar.fixup_prefix_ties(cols, perm, words=2)
         keep = columnar.dedup_mask_prefix(cols, perm, words=2)
         return perm, keep
+
+    def sort_and_dedup(
+        self, cols: columnar.MergeColumns
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        # Non-pipelined entry (pre-staged columns, e.g. the coalescer).
+        run_counts = (
+            np.bincount(cols.src).tolist() if len(cols) else []
+        )
+        perm = device_merge_prefix_order(cols, run_counts)
+        return self._refine(cols, perm)
 
 
 class DeviceFullMergeStrategy(ColumnarMergeStrategy):

@@ -53,18 +53,31 @@ class MergeColumns:
         return int(self.start.size)
 
 
+def read_source_pieces(sources: Sequence):
+    """One bulk read per sstable → [(raw, offsets, key_sizes,
+    full_sizes)] for assemble_columns."""
+    return [
+        (table.read_data_bytes(), *table.read_index_columns())
+        for table in sources
+    ]
+
+
 def load_columns(sources: Sequence) -> MergeColumns:
     """sources: SSTable-likes exposing read_index_columns() and
     read_data_bytes()."""
+    return assemble_columns(read_source_pieces(sources))
+
+
+def assemble_columns(pieces) -> MergeColumns:
+    """pieces: [(raw_bytes, offsets u64, key_sizes u32, full_sizes
+    u32)] per source, oldest→newest."""
     datas: List[bytes] = []
     starts: List[np.ndarray] = []
     key_sizes: List[np.ndarray] = []
     full_sizes: List[np.ndarray] = []
     srcs: List[np.ndarray] = []
     base = 0
-    for i, table in enumerate(sources):
-        offs, ks, fs = table.read_index_columns()
-        raw = table.read_data_bytes()
+    for i, (raw, offs, ks, fs) in enumerate(pieces):
         datas.append(raw)
         starts.append(offs.astype(np.uint64) + np.uint64(base))
         key_sizes.append(ks)
@@ -334,18 +347,25 @@ def ranges_to_positions(
     return np.cumsum(step)
 
 
-def gather_records(cols: MergeColumns, order: np.ndarray) -> bytes:
-    """Concatenate the raw records selected by ``order`` (post-dedup)."""
+def gather_records_array(
+    cols: MergeColumns, order: np.ndarray
+) -> np.ndarray:
+    """Raw records selected by ``order`` (post-dedup) as one uint8
+    array (no extra bytes copy — write it in chunks)."""
     if order.size == 0:
-        return b""
+        return np.zeros(0, dtype=np.uint8)
     fs = cols.full_size
     rec = int(fs[0])
     if cols.data.size == fs.size * rec and (fs == fs[0]).all():
         # Uniform records: row-gather of an (N, rec) view — orders of
         # magnitude faster than the per-byte position expansion.
         if (cols.start == np.arange(fs.size, dtype=np.uint64) * rec).all():
-            return cols.data.reshape(-1, rec)[order].tobytes()
+            return cols.data.reshape(-1, rec)[order].reshape(-1)
     pos = ranges_to_positions(
         cols.start[order], cols.full_size[order]
     )
-    return cols.data[pos].tobytes()
+    return cols.data[pos]
+
+
+def gather_records(cols: MergeColumns, order: np.ndarray) -> bytes:
+    return gather_records_array(cols, order).tobytes()

@@ -182,7 +182,7 @@ def write_output_columnar(
     index_arr["key_size"] = cols.key_size[order]
     index_arr["full_size"] = cols.full_size[order]
 
-    data_bytes = columnar.gather_records(cols, order)
+    data_arr = columnar.gather_records_array(cols, order)
 
     from .entry import DATA_FILE_EXT, INDEX_FILE_EXT
 
@@ -191,7 +191,12 @@ def write_output_columnar(
         (DATA_FILE_EXT, output_index),
         cache,
     )
-    data_w.write(data_bytes)
+    # Chunked writes from memoryviews: avoids duplicating the (possibly
+    # ~GB) gathered blob as one bytes object.
+    view = memoryview(data_arr)
+    chunk = 32 << 20
+    for off in range(0, len(view), chunk):
+        data_w.write(view[off : off + chunk])
     data_w.close()
     index_w = PageMirroringWriter(
         f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}",
