@@ -128,6 +128,75 @@ def test_device_tie_fallback_on_shared_prefix_keyspace(tmp_dir):
     )
 
 
+def test_wide_64_way_merge_byte_identical(tmp_dir):
+    """BASELINE config 4 shape at test scale: 64 overlapping runs,
+    variable-length values."""
+
+    async def main():
+        out = {}
+        for strat in ("heap", "device"):
+            d = f"{tmp_dir}/{strat}"
+            rng = random.Random(7)
+            tree = LSMTree.open_or_create(
+                d, capacity=64, strategy=get_strategy(strat)
+            )
+            for j in range(64 * 64):
+                await tree.set_with_timestamp(
+                    f"k{rng.randrange(2000):05}".encode(),
+                    b"v" * rng.randrange(1, 40),
+                    100 + j,
+                )
+            await tree.flush()
+            idx = [i for i, _ in tree.sstable_indices_and_sizes()]
+            assert len(idx) >= 60, f"want ~64 runs, got {len(idx)}"
+            await tree.compact(idx, max(idx) + 1, keep_tombstones=False)
+            h = {}
+            for f in sorted(os.listdir(d)):
+                if f.endswith((".data", ".index")):
+                    with open(os.path.join(d, f), "rb") as fh:
+                        h[f] = hashlib.sha256(fh.read()).hexdigest()
+            out[strat] = h
+            tree.close()
+        assert out["heap"] == out["device"]
+
+    run(main(), timeout=120)
+
+
+def test_crash_mid_compaction_before_journal_keeps_inputs(tmp_dir):
+    """Orphaned compact_* outputs (crash before the journal commits)
+    are discarded on reopen; inputs stay live (lsm_tree.rs:424-438)."""
+
+    async def main():
+        d = f"{tmp_dir}/t"
+        tree = LSMTree.open_or_create(d, capacity=64)
+        for i in range(128):
+            await tree.set_with_timestamp(
+                f"k{i:04}".encode(), b"v", 10 + i
+            )
+        await tree.flush()
+        idx = [i for i, _ in tree.sstable_indices_and_sizes()]
+        # Simulate: merge wrote outputs, then crash before the journal.
+        from dbeel_tpu.storage.compaction import HeapMergeStrategy
+        from dbeel_tpu.storage.sstable import SSTable as S
+
+        inputs = [S(d, i, None) for i in idx]
+        HeapMergeStrategy().merge(inputs, d, 99, None, False, 1 << 30)
+        for t in inputs:
+            t.close()
+        tree.close()
+
+        tree2 = LSMTree.open_or_create(d, capacity=64)
+        assert [i for i, _ in tree2.sstable_indices_and_sizes()] == idx
+        for i in range(128):
+            assert await tree2.get(f"k{i:04}".encode()) == b"v"
+        assert not any(
+            "compact" in f for f in os.listdir(d)
+        ), "orphaned compact outputs must be cleaned"
+        tree2.close()
+
+    run(main(), timeout=60)
+
+
 def test_device_sort_dedup_matches_numpy():
     """Kernel-level equivalence on random columns, including timestamp
     ties broken by source."""

@@ -1,0 +1,64 @@
+"""Memtable unit tests, mirroring the reference's rbtree_arena suite
+(/root/reference/rbtree_arena/src/lib.rs:651-860): ordering, capacity
+errors, overwrite/timestamp-conflict semantics — for both kinds."""
+
+import random
+
+import pytest
+
+from dbeel_tpu.errors import MemtableCapacityReached
+from dbeel_tpu.storage.memtable import HashMemtable, Memtable
+
+
+@pytest.fixture(params=[Memtable, HashMemtable])
+def memtable_cls(request):
+    return request.param
+
+
+def test_capacity_error_on_new_keys_only(memtable_cls):
+    m = memtable_cls(4)
+    for i in range(4):
+        m.set(f"k{i}".encode(), b"v", i)
+    assert m.is_full()
+    with pytest.raises(MemtableCapacityReached):
+        m.set(b"new", b"v", 99)
+    # Overwriting an existing key at capacity is fine (arena updates
+    # in place).
+    m.set(b"k0", b"v2", 100)
+    assert m.get(b"k0") == (b"v2", 100)
+
+
+def test_timestamp_conflict_keeps_newest(memtable_cls):
+    m = memtable_cls(8)
+    m.set(b"k", b"new", 100)
+    m.set(b"k", b"stale", 50)  # older write arrives late (replication)
+    assert m.get(b"k") == (b"new", 100)
+    m.set(b"k", b"same-ts", 100)  # equal ts: last writer wins
+    assert m.get(b"k") == (b"same-ts", 100)
+
+
+def test_sorted_items_ordering(memtable_cls):
+    rng = random.Random(5)
+    m = memtable_cls(512)
+    keys = set()
+    while len(keys) < 300:
+        keys.add(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 24))))
+    for k in keys:
+        m.set(k, b"v", 1)
+    assert [k for k, _ in m.sorted_items()] == sorted(keys)
+
+
+def test_range_queries(memtable_cls):
+    m = memtable_cls(64)
+    for i in range(20):
+        m.set(f"k{i:02}".encode(), b"v", i)
+    got = [k for k, _ in m.range(b"k05", b"k10")]
+    assert got == [f"k{i:02}".encode() for i in range(5, 11)]
+
+
+def test_data_bytes_accounting(memtable_cls):
+    m = memtable_cls(8)
+    m.set(b"abc", b"12345", 1)
+    assert m.data_bytes == 16 + 3 + 5
+    m.set(b"abc", b"1234567", 2)  # value grows by 2
+    assert m.data_bytes == 16 + 3 + 7
