@@ -61,10 +61,22 @@ class _RingShard:
 
 
 class DbeelClient:
-    def __init__(self, seed_addresses: Sequence[Tuple[str, int]]):
+    """``pooled=True`` (default) reuses connections via the keepalive
+    protocol extension; pass False for strict reference behavior
+    (connect per request)."""
+
+    MAX_POOL_PER_TARGET = 8
+
+    def __init__(
+        self,
+        seed_addresses: Sequence[Tuple[str, int]],
+        pooled: bool = True,
+    ):
         self._seeds = list(seed_addresses)
         self._ring: List[_RingShard] = []
         self._collections: dict = {}
+        self._pooled = pooled
+        self._pool: dict = {}  # (host, port) -> [(reader, writer)]
 
     # -- bootstrap / metadata sync (lib.rs:85-152) ---------------------
 
@@ -115,25 +127,68 @@ class DbeelClient:
     # -- raw protocol --------------------------------------------------
 
     @staticmethod
-    async def _send_to(host: str, port: int, request: dict) -> bytes:
+    async def _round_trip(reader, writer, request: dict) -> bytes:
+        buf = msgpack.packb(request, use_bin_type=True)
+        writer.write(struct.pack("<H", len(buf)) + buf)
+        await writer.drain()
+        header = await reader.readexactly(4)
+        (size,) = struct.unpack("<I", header)
+        return await reader.readexactly(size)
+
+    async def _send_to(self, host: str, port: int, request: dict) -> bytes:
         """One request/response round trip (u16-len request; u32-len
-        response + trailing type byte)."""
-        reader, writer = await asyncio.open_connection(host, port)
-        try:
-            buf = msgpack.packb(request, use_bin_type=True)
-            writer.write(struct.pack("<H", len(buf)) + buf)
-            await writer.drain()
-            header = await reader.readexactly(4)
-            (size,) = struct.unpack("<I", header)
-            payload = await reader.readexactly(size)
-        finally:
-            writer.close()
+        response + trailing type byte), over a pooled keepalive
+        connection when enabled."""
+        payload = None
+        if self._pooled:
+            request = dict(request)
+            request["keepalive"] = True
+            key = (host, port)
+            while payload is None and self._pool.get(key):
+                reader, writer = self._pool[key].pop()
+                try:
+                    payload = await self._round_trip(
+                        reader, writer, request
+                    )
+                except (OSError, asyncio.IncompleteReadError):
+                    writer.close()  # stale pooled conn; try another
+                except BaseException:
+                    writer.close()  # cancellation etc: don't leak
+                    raise
+            if payload is not None:
+                self._release(key, reader, writer)
+        if payload is None:
+            reader, writer = await asyncio.open_connection(host, port)
+            try:
+                payload = await self._round_trip(
+                    reader, writer, request
+                )
+            except BaseException:
+                writer.close()
+                raise
+            if self._pooled:
+                self._release((host, port), reader, writer)
+            else:
+                writer.close()
         if not payload:
             raise ProtocolError("empty response")
         body, rtype = payload[:-1], payload[-1]
         if rtype == RESPONSE_ERR:
             raise from_wire(msgpack.unpackb(body, raw=False))
         return body
+
+    def _release(self, key, reader, writer) -> None:
+        pool = self._pool.setdefault(key, [])
+        if len(pool) < self.MAX_POOL_PER_TARGET:
+            pool.append((reader, writer))
+        else:
+            writer.close()
+
+    def close(self) -> None:
+        for conns in self._pool.values():
+            for _r, w in conns:
+                w.close()
+        self._pool.clear()
 
     # -- routing (lib.rs:336-417) ---------------------------------------
 

@@ -69,16 +69,9 @@ def extract_key(my_shard: MyShard, map_: dict, replica_index: int) -> bytes:
 
 
 async def handle_request(
-    my_shard: MyShard, buffer: bytes
+    my_shard: MyShard, request: dict
 ) -> Optional[bytes]:
     """Returns the response payload (None => plain 'OK')."""
-    try:
-        request = msgpack.unpackb(buffer, raw=False)
-    except Exception as e:
-        raise BadFieldType(f"document: {e}") from e
-    if not isinstance(request, dict):
-        raise BadFieldType("document")
-
     timestamp = now_nanos()
     rtype = request.get("type")
 
@@ -292,37 +285,77 @@ async def handle_client(
     reader: asyncio.StreamReader,
     writer: asyncio.StreamWriter,
 ) -> None:
+    """One request per connection like the reference (db_server.rs:
+    395-428) — unless the request opts into ``keepalive`` (protocol
+    extension; absent field keeps exact reference behavior), in which
+    case the connection serves a request loop."""
     try:
-        size_buf = await reader.readexactly(2)
-        (size,) = struct.unpack("<H", size_buf)
-        request_buf = await reader.readexactly(size)
-    except (asyncio.IncompleteReadError, OSError):
-        writer.close()
-        return
+        await _client_loop(my_shard, reader, writer)
+    finally:
+        writer.close()  # even on cancellation (shard shutdown)
 
-    try:
-        payload = await handle_request(my_shard, request_buf)
-        if payload is None:
-            buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
-        else:
-            buf = payload + bytes([RESPONSE_OK])
-    except DbeelError as e:
-        if not isinstance(e, KeyNotFound):
-            log.error("error handling request: %r", e)
-        buf = msgpack.packb(e.to_wire(), use_bin_type=True) + bytes(
-            [RESPONSE_ERR]
-        )
-    except Exception as e:  # defensive: never kill the accept loop
-        log.exception("unexpected error handling request")
-        buf = msgpack.packb(
-            ["Internal", str(e)], use_bin_type=True
-        ) + bytes([RESPONSE_ERR])
 
-    try:
-        await _send_response(writer, buf)
-    except OSError:
-        pass
-    writer.close()
+KEEPALIVE_IDLE_TIMEOUT_S = 300.0  # reap idle keepalive connections
+
+
+async def _client_loop(
+    my_shard: MyShard,
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+) -> None:
+    first = True
+    while True:
+        try:
+            if first:
+                size_buf = await reader.readexactly(2)
+            else:
+                # Idle keepalive connections are reaped so pooled
+                # clients that never close() can't pin fds forever.
+                size_buf = await asyncio.wait_for(
+                    reader.readexactly(2), KEEPALIVE_IDLE_TIMEOUT_S
+                )
+            (size,) = struct.unpack("<H", size_buf)
+            request_buf = await reader.readexactly(size)
+        except (
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+            OSError,
+        ):
+            break
+        first = False
+
+        keepalive = False
+        try:
+            try:
+                req = msgpack.unpackb(request_buf, raw=False)
+            except Exception as e:
+                raise BadFieldType(f"document: {e}") from e
+            if not isinstance(req, dict):
+                raise BadFieldType("document")
+            keepalive = bool(req.get("keepalive"))
+            payload = await handle_request(my_shard, req)
+            if payload is None:
+                buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
+            else:
+                buf = payload + bytes([RESPONSE_OK])
+        except DbeelError as e:
+            if not isinstance(e, KeyNotFound):
+                log.error("error handling request: %r", e)
+            buf = msgpack.packb(e.to_wire(), use_bin_type=True) + bytes(
+                [RESPONSE_ERR]
+            )
+        except Exception as e:  # defensive: never kill the accept loop
+            log.exception("unexpected error handling request")
+            buf = msgpack.packb(
+                ["Internal", str(e)], use_bin_type=True
+            ) + bytes([RESPONSE_ERR])
+
+        try:
+            await _send_response(writer, buf)
+        except OSError:
+            break
+        if not keepalive:
+            break
 
 
 async def bind_db_server(my_shard: MyShard) -> asyncio.Server:

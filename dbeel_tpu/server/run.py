@@ -149,9 +149,16 @@ async def run_shard(
             if exc is not None and not isinstance(exc, ShardStopped):
                 log.error("shard task died: %r", exc)
     finally:
-        for t in task_set:
+        # Cancel detached per-connection handlers TOGETHER with the
+        # server tasks: Server.wait_closed() (py3.12) waits for open
+        # connections, so keepalive handler loops must be torn down
+        # before the db-server task can finish closing.
+        background = list(my_shard._background_tasks)
+        for t in (*task_set, *background):
             t.cancel()
-        await asyncio.gather(*task_set, return_exceptions=True)
+        await asyncio.gather(
+            *task_set, *background, return_exceptions=True
+        )
         # Announce our death (run_shard.rs:158-166).
         if is_node_managing:
             try:

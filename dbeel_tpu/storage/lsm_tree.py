@@ -360,6 +360,11 @@ class LSMTree:
                 ),
             )
             table = SSTable(self.dir_path, flush_index, self.cache)
+            # Pre-warm the in-memory read index off-loop so the first
+            # point lookup doesn't pay the bulk read.
+            asyncio.get_event_loop().run_in_executor(
+                None, table._fast_index
+            )
             self._sstables = SSTableList(
                 self._sstables.tables + [table]
             )
@@ -522,7 +527,11 @@ class LSMTree:
         survivors = [
             t for t in self._sstables.tables if t.index not in index_set
         ]
-        survivors.append(SSTable(self.dir_path, output_index, self.cache))
+        output_table = SSTable(self.dir_path, output_index, self.cache)
+        asyncio.get_event_loop().run_in_executor(
+            None, output_table._fast_index
+        )
+        survivors.append(output_table)
         self._sstables = SSTableList(survivors)
 
         # Reader drain before deleting inputs (1141-1145).

@@ -102,6 +102,16 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def load_if_built() -> Optional[ctypes.CDLL]:
+    """Return the lib only if already built — never runs make (safe to
+    call from latency-sensitive / event-loop contexts)."""
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    return _load()
+
+
 def murmur3_32_native(data: bytes, seed: int = 0) -> int:
     lib = _load()
     if lib is None:

@@ -60,6 +60,9 @@ class SSTable:
                 self.bloom = BloomFilter.deserialize(f.read())
         except FileNotFoundError:
             pass
+        # Lazily-built in-memory prefix index (see _fast_index).
+        self._fast: Optional[tuple] = None
+        self._fast_tried = False
 
     def close(self) -> None:
         self._data.close()
@@ -82,8 +85,73 @@ class SSTable:
         key = self._data.read_at(offset + ENTRY_HEADER_SIZE, key_size)
         return key, offset, key_size, full_size
 
+    # In-memory fast index limits (24B/entry of RAM when built).  The
+    # data cap bounds the synchronous bulk read if the build happens
+    # lazily on a serving path (the LSM tree pre-warms new tables in an
+    # executor, so this is the cold-open worst case only).
+    FAST_INDEX_MAX_ENTRIES = 1 << 20
+    FAST_INDEX_MAX_DATA = 32 << 20
+
+    def _fast_index(self) -> Optional[tuple]:
+        """(prefix_u64_sorted, offsets, key_sizes, full_sizes) — lets a
+        point lookup be ONE numpy searchsorted + usually one data read,
+        instead of ~log2(n) page-cache probes through Python.  Built
+        lazily on first get; skipped for very large tables."""
+        if self._fast_tried:
+            return self._fast
+        self._fast_tried = True
+        if (
+            self.entry_count > self.FAST_INDEX_MAX_ENTRIES
+            or self.data_size > self.FAST_INDEX_MAX_DATA
+            or self.entry_count == 0
+        ):
+            return None
+        from . import columnar
+
+        offs, ks, fs = self.read_index_columns()
+        data = np.frombuffer(self.read_data_bytes(), dtype=np.uint8)
+        words = columnar.prefix_words(data, offs.astype(np.uint64), ks)
+        prefix = (
+            words[:, 0].astype(np.uint64) << np.uint64(32)
+        ) | words[:, 1].astype(np.uint64)
+        self._fast = (prefix, offs, ks, fs)
+        return self._fast
+
+    @staticmethod
+    def _key_prefix64(key: bytes) -> int:
+        return int.from_bytes(key[:8].ljust(8, b"\x00"), "big")
+
     def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
-        """Binary search (lsm_tree.rs:605-670); returns (value, ts)."""
+        """Point lookup; returns (value, ts).  Fast path: in-memory
+        prefix searchsorted; fallback: on-disk binary search through the
+        page cache (lsm_tree.rs:605-670)."""
+        fast = self._fast_index()
+        if fast is not None:
+            prefix, offs, ks, fs = fast
+            w = np.uint64(self._key_prefix64(key))
+            lo = int(np.searchsorted(prefix, w, side="left"))
+            hi = int(np.searchsorted(prefix, w, side="right"))
+            # Binary search on full keys within the prefix-tie range
+            # (realistic keyspaces share prefixes, so hi-lo can be big).
+            while lo < hi:
+                mid = (lo + hi) // 2
+                mid_key = bytes(
+                    self._data.read_at(
+                        int(offs[mid]) + ENTRY_HEADER_SIZE,
+                        int(ks[mid]),
+                    )
+                )
+                if mid_key == key:
+                    record = self._data.read_at(
+                        int(offs[mid]), int(fs[mid])
+                    )
+                    _, value, ts, _ = decode_entry(record)
+                    return value, ts
+                if mid_key < key:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            return None
         lo, hi = 0, self.entry_count - 1
         while lo <= hi:
             mid = (lo + hi) // 2

@@ -21,8 +21,39 @@ _C1 = 0xCC9E2D51
 _C2 = 0x1B873593
 _M = 0xFFFFFFFF
 
+_native = None
+_native_tried = False
+
+
+def _native_fn():
+    """The C++ murmur3 (native/) when ALREADY built — ~30x the
+    pure-Python scalar on hot paths (bloom checks, ring hashing).
+    Never triggers a build: a synchronous `make` from here would block
+    whatever event loop made the first hash call."""
+    global _native, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from ..storage import native as native_mod
+
+            lib = native_mod.load_if_built()
+            if lib is not None:
+                _native = lambda data, seed: lib.dbeel_murmur3_32(
+                    data, len(data), seed
+                )
+        except Exception:
+            _native = None
+    return _native
+
 
 def murmur3_32(data: bytes, seed: int = 0) -> int:
+    fn = _native_fn()
+    if fn is not None:
+        return fn(data, seed)
+    return _murmur3_32_py(data, seed)
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
     h = seed & _M
     n = len(data)
     nblocks = n >> 2
