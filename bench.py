@@ -179,14 +179,24 @@ def _kernel_only_rate(d, args) -> float:
         dev_prefixes, dev_counts, out_rows
     )
     jax.block_until_ready(o)
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
         o = bitonic.merge_runs_prefix_kernel(
             dev_prefixes, dev_counts, out_rows
         )
         jax.block_until_ready(o)
-    return len(cols) / ((time.perf_counter() - t0) / reps)
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[1]  # median
+    rate = len(cols) / dt
+    # Sanity gate: the network moves >= ~70 bytes/key through HBM per
+    # merge; >100M keys/s through this kernel is not physical — treat
+    # it as a broken measurement (flaky tunnel), not a result.
+    if dt < 1e-3 or rate > 100e6:
+        log(f"  kernel-only timing implausible ({dt*1e3:.3f} ms); "
+            "dropping the metric for this run")
+        return 0.0
+    return rate
 
 
 def main():
@@ -256,7 +266,8 @@ def main():
         # link (this environment tunnels the TPU at ~45 MB/s; PCIe-local
         # hosts move the same buffers ~100x faster).
         kernel_rate = _kernel_only_rate(d, args)
-        log(f"device kernel-only: {kernel_rate:,.0f} keys/s")
+        if kernel_rate:
+            log(f"device kernel-only: {kernel_rate:,.0f} keys/s")
 
         print(
             json.dumps(
@@ -266,9 +277,13 @@ def main():
                     "unit": "keys/s",
                     "vs_baseline": round(dev_rate / cpu_rate, 3),
                     "cpu_keys_per_sec": round(cpu_rate),
-                    "kernel_keys_per_sec": round(kernel_rate),
-                    "vs_baseline_kernel": round(
-                        kernel_rate / cpu_rate, 3
+                    "kernel_keys_per_sec": (
+                        round(kernel_rate) if kernel_rate else None
+                    ),
+                    "vs_baseline_kernel": (
+                        round(kernel_rate / cpu_rate, 3)
+                        if kernel_rate
+                        else None
                     ),
                     "byte_identical": identical,
                     "keys": args.keys,
