@@ -21,7 +21,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from dbeel_tpu.client import DbeelClient  # noqa: E402
+from dbeel_tpu.client import Consistency, DbeelClient  # noqa: E402
 
 
 def percentiles(samples):
@@ -39,7 +39,9 @@ def percentiles(samples):
     )
 
 
-async def run_phase(client, collection, op, keys, n_clients, value):
+async def run_phase(
+    client, collection, op, keys, n_clients, value, consistency=None
+):
     latencies = []
 
     async def worker(worker_keys):
@@ -47,9 +49,9 @@ async def run_phase(client, collection, op, keys, n_clients, value):
         for k in worker_keys:
             t0 = time.perf_counter()
             if op == "set":
-                await col.set(k, value)
+                await col.set(k, value, consistency)
             else:
-                await col.get(k)
+                await col.get(k, consistency)
             latencies.append(time.perf_counter() - t0)
 
     chunk = (len(keys) + n_clients - 1) // n_clients
@@ -71,7 +73,9 @@ async def main_async(args):
     from dbeel_tpu.errors import CollectionAlreadyExists
 
     try:
-        await client.create_collection(args.collection)
+        await client.create_collection(
+            args.collection, args.replication_factor
+        )
     except CollectionAlreadyExists:
         pass
 
@@ -80,8 +84,15 @@ async def main_async(args):
     rng.shuffle(keys)
     value = {"blob": "x" * args.value_size}
 
+    consistency = {
+        "default": None,
+        "quorum": Consistency.QUORUM,
+        "all": Consistency.ALL,
+        "one": Consistency.fixed(1),
+    }[args.consistency]
     total, lat = await run_phase(
-        client, args.collection, "set", keys, args.clients, value
+        client, args.collection, "set", keys, args.clients, value,
+        consistency,
     )
     print(
         f"set: total {total:.3f}s "
@@ -90,7 +101,8 @@ async def main_async(args):
 
     rng.shuffle(keys)
     total, lat = await run_phase(
-        client, args.collection, "get", keys, args.clients, value
+        client, args.collection, "get", keys, args.clients, value,
+        consistency,
     )
     print(
         f"get: total {total:.3f}s "
@@ -107,6 +119,15 @@ def main():
     ap.add_argument("--collection", default="blackbox")
     ap.add_argument("--value-size", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replication-factor", type=int, default=None,
+        help="replication factor when creating the collection",
+    )
+    ap.add_argument(
+        "--consistency",
+        choices=("default", "quorum", "all", "one"),
+        default="default",
+    )
     args = ap.parse_args()
     asyncio.run(main_async(args))
 
