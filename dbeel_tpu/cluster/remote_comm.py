@@ -45,12 +45,23 @@ async def get_message_from_stream(reader: asyncio.StreamReader) -> list:
 
 
 class RemoteShardConnection:
+    """``pooled=True`` keeps request/response connections open between
+    calls (the remote shard server is a persistent multi-message loop,
+    remote_shard_server.rs:23-49) — used for ring entries, where the
+    reference's connect-per-request (rs:50-72) dominates quorum
+    latency.  Events stay connect-per-send: an event error produces a
+    server-side error response with no reader, which would desync a
+    pooled stream."""
+
+    MAX_POOL = 4
+
     def __init__(
         self,
         address: str,  # "<ip>:<port>"
         connect_timeout_ms: int = 5000,
         read_timeout_ms: int = 15000,
         write_timeout_ms: int = 15000,
+        pooled: bool = False,
     ) -> None:
         self.address = address
         host, port = address.rsplit(":", 1)
@@ -59,15 +70,25 @@ class RemoteShardConnection:
         self.connect_timeout = connect_timeout_ms / 1000
         self.read_timeout = read_timeout_ms / 1000
         self.write_timeout = write_timeout_ms / 1000
+        self.pooled = pooled
+        self._pool: list = []
 
     @classmethod
-    def from_config(cls, address: str, cfg) -> "RemoteShardConnection":
+    def from_config(
+        cls, address: str, cfg, pooled: bool = False
+    ) -> "RemoteShardConnection":
         return cls(
             address,
             cfg.remote_shard_connect_timeout_ms,
             cfg.remote_shard_read_timeout_ms,
             cfg.remote_shard_write_timeout_ms,
+            pooled=pooled,
         )
+
+    def close_pool(self) -> None:
+        for _r, w in self._pool:
+            w.close()
+        self._pool.clear()
 
     async def _connect(self):
         try:
@@ -82,18 +103,46 @@ class RemoteShardConnection:
                 f"connect to {self.address}: {e}"
             ) from e
 
+    async def _round_trip(self, reader, writer, message: list) -> list:
+        await asyncio.wait_for(
+            send_message_to_stream(writer, message), self.write_timeout
+        )
+        return await asyncio.wait_for(
+            get_message_from_stream(reader), self.read_timeout
+        )
+
     async def send_message(self, message: list) -> list:
-        """Connect, send one message, read one reply, close
+        """Send one message, read one reply — over a pooled persistent
+        stream when enabled, else connect-per-request
         (remote_shard_connection.rs:50-72)."""
+        if self.pooled:
+            while self._pool:
+                reader, writer = self._pool.pop()
+                try:
+                    response = await self._round_trip(
+                        reader, writer, message
+                    )
+                except (OSError, asyncio.IncompleteReadError):
+                    writer.close()  # stale; try another / reconnect
+                    continue
+                except asyncio.TimeoutError as e:
+                    # The stream may carry a late response — never
+                    # reuse it.
+                    writer.close()
+                    raise Timeout(f"rpc to {self.address}") from e
+                except BaseException:
+                    writer.close()
+                    raise
+                if len(self._pool) < self.MAX_POOL:
+                    self._pool.append((reader, writer))
+                else:
+                    writer.close()
+                return response
         reader, writer = await self._connect()
         try:
             try:
-                await asyncio.wait_for(
-                    send_message_to_stream(writer, message),
-                    self.write_timeout,
-                )
-                return await asyncio.wait_for(
-                    get_message_from_stream(reader), self.read_timeout
+                response = await self._round_trip(
+                    reader, writer, message
                 )
             except asyncio.TimeoutError as e:
                 raise Timeout(f"rpc to {self.address}") from e
@@ -101,8 +150,14 @@ class RemoteShardConnection:
                 raise ConnectionError_(
                     f"rpc to {self.address}: {e}"
                 ) from e
-        finally:
+        except BaseException:
             writer.close()
+            raise
+        if self.pooled and len(self._pool) < self.MAX_POOL:
+            self._pool.append((reader, writer))
+        else:
+            writer.close()
+        return response
 
     async def send_request(self, request: list) -> list:
         """Send a ShardRequest, return the ShardResponse payload list."""

@@ -148,8 +148,10 @@ class MyShard:
                     Shard(
                         node_name=node.name,
                         name=f"{node.name}-{sid}",
+                        # Ring entries are long-lived: pool their
+                        # request streams (replication fan-out latency).
                         connection=RemoteShardConnection.from_config(
-                            address, self.config
+                            address, self.config, pooled=True
                         ),
                     )
                 )
@@ -694,6 +696,9 @@ class MyShard:
             s for s in self.shards if s.node_name != node_name
         ]
         self.sort_consistent_hash_ring()
+        for s in removed:
+            if isinstance(s.connection, RemoteShardConnection):
+                s.connection.close_pool()
         log.info(
             "after death of %s: %d nodes, %d shards",
             node_name,
