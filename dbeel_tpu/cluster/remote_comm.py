@@ -72,6 +72,7 @@ class RemoteShardConnection:
         self.write_timeout = write_timeout_ms / 1000
         self.pooled = pooled
         self._pool: list = []
+        self._pool_closed = False
 
     @classmethod
     def from_config(
@@ -86,9 +87,19 @@ class RemoteShardConnection:
         )
 
     def close_pool(self) -> None:
+        """Permanently close: in-flight round trips finishing after this
+        (e.g. background replica drains racing a dead-node removal) must
+        not re-pool their streams."""
+        self._pool_closed = True
         for _r, w in self._pool:
             w.close()
         self._pool.clear()
+
+    def _maybe_pool(self, reader, writer) -> None:
+        if self._pool_closed or len(self._pool) >= self.MAX_POOL:
+            writer.close()
+        else:
+            self._pool.append((reader, writer))
 
     async def _connect(self):
         try:
@@ -122,21 +133,27 @@ class RemoteShardConnection:
                     response = await self._round_trip(
                         reader, writer, message
                     )
-                except (OSError, asyncio.IncompleteReadError):
-                    writer.close()  # stale; try another / reconnect
-                    continue
                 except asyncio.TimeoutError as e:
-                    # The stream may carry a late response — never
-                    # reuse it.
+                    # Must precede OSError: on py3.11+ asyncio
+                    # .TimeoutError IS TimeoutError ⊂ OSError.  A slow
+                    # peer is not a stale stream — surface it, and
+                    # never reuse a stream that may carry a late
+                    # response.
                     writer.close()
                     raise Timeout(f"rpc to {self.address}") from e
+                except (OSError, asyncio.IncompleteReadError):
+                    # Stale pooled stream (idle disconnect, peer
+                    # restart): retry on another.  Re-sending is safe
+                    # even if the peer processed the request — shard
+                    # messages are idempotent by design (reference
+                    # shards.rs:544 "All events should be idempotent";
+                    # writes converge by newest-timestamp).
+                    writer.close()
+                    continue
                 except BaseException:
                     writer.close()
                     raise
-                if len(self._pool) < self.MAX_POOL:
-                    self._pool.append((reader, writer))
-                else:
-                    writer.close()
+                self._maybe_pool(reader, writer)
                 return response
         reader, writer = await self._connect()
         try:
@@ -153,8 +170,8 @@ class RemoteShardConnection:
         except BaseException:
             writer.close()
             raise
-        if self.pooled and len(self._pool) < self.MAX_POOL:
-            self._pool.append((reader, writer))
+        if self.pooled:
+            self._maybe_pool(reader, writer)
         else:
             writer.close()
         return response
