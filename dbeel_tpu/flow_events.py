@@ -29,6 +29,7 @@ class FlowEvent(enum.Enum):
     COMPACTION_DONE = "CompactionDone"
     WAL_SYNCED = "WalSynced"
     READ_REPAIR = "ReadRepair"
+    HINTS_REPLAYED = "HintsReplayed"
 
 
 _enabled = False

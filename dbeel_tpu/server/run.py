@@ -159,8 +159,10 @@ async def run_shard(
         await asyncio.gather(
             *task_set, *background, return_exceptions=True
         )
-        # Announce our death (run_shard.rs:158-166).
-        if is_node_managing:
+        # Announce our death (run_shard.rs:158-166) — unless this is a
+        # simulated crash, which must look like the reference's
+        # executor cancel: no cleanup, no goodbye.
+        if is_node_managing and not my_shard.crashed:
             try:
                 await my_shard.gossip(
                     msgs.GossipEvent.dead(my_shard.config.name)

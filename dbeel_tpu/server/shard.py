@@ -14,7 +14,9 @@ import asyncio
 import logging
 import os
 import re
+import secrets
 import socket
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -25,7 +27,9 @@ from ..config import Config
 from ..errors import (
     CollectionAlreadyExists,
     CollectionNotFound,
+    ConnectionError_,
     DbeelError,
+    Timeout,
 )
 from ..flow_events import FlowEvent
 from ..storage import DEFAULT_TREE_CAPACITY
@@ -119,11 +123,24 @@ class MyShard:
         self.gossip_requests: Dict[Tuple[str, str], int] = {}
         self.collections: Dict[str, Collection] = {}
         self.collections_change_event = LocalEvent()
+        # Hinted handoff (improvement over the reference, which has
+        # none — SURVEY §5): mutations whose replica fan-out failed,
+        # keyed by the unreachable node, replayed on its next Alive.
+        self.hints: Dict[str, deque] = {}
         self.cache = cache
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
         self.flow = flow_events.FlowEventNotifier()
         self._background_tasks: set = set()
+        # Set by crash-simulating harnesses: suppresses graceful-stop
+        # side effects (death gossip) so a "crash" really is silent.
+        self.crashed = False
+        # Per-boot nonce salted into the gossip source: a restarted
+        # node's announcements are a FRESH epidemic, so the seen-count
+        # dedup can never suppress a rejoin (the reference's
+        # name-keyed dedup silently eats re-announcements from nodes
+        # that crash and come back).
+        self.boot_id = secrets.token_hex(4)
         self.sort_consistent_hash_ring()
 
     # ------------------------------------------------------------------
@@ -457,6 +474,58 @@ class MyShard:
     # Replica fan-out (shards.rs:463-543)
     # ------------------------------------------------------------------
 
+    MAX_HINTS_PER_NODE = 10_000
+
+    def _record_hint(self, node_name: str, request: list) -> None:
+        """Queue a failed replica mutation for replay when the node
+        returns (bounded; oldest hints drop first — read repair then
+        covers the remainder)."""
+        kind = request[1] if len(request) > 1 else None
+        if kind not in (ShardRequest.SET, ShardRequest.DELETE):
+            return
+        self.hints.setdefault(
+            node_name, deque(maxlen=self.MAX_HINTS_PER_NODE)
+        ).append(request)
+
+    async def replay_hints(self, node_name: str) -> None:
+        queued = self.hints.pop(node_name, None)
+        if not queued:
+            return
+        shard = next(
+            (s for s in self.shards if s.node_name == node_name), None
+        )
+        replayed = 0
+        pending = list(queued)
+        if shard is not None:
+            while pending:
+                request = pending[0]
+                try:
+                    msgs.response_to_result(
+                        await shard.connection.send_request(request),
+                        ShardResponse.SET
+                        if request[1] == ShardRequest.SET
+                        else ShardResponse.DELETE,
+                    )
+                    pending.pop(0)
+                    replayed += 1
+                except DbeelError as e:
+                    log.warning(
+                        "hint replay to %s stopped after %d: %s",
+                        node_name,
+                        replayed,
+                        e,
+                    )
+                    break
+        # Anything untried or failed goes back on the queue (node raced
+        # back down, shard missing, etc.) — never dropped.
+        for request in pending:
+            self._record_hint(node_name, request)
+        if replayed:
+            log.info(
+                "replayed %d hints to %s", replayed, node_name
+            )
+        self.flow.notify(FlowEvent.HINTS_REPLAYED)
+
     async def send_request_to_replicas(
         self,
         request: list,
@@ -466,16 +535,17 @@ class MyShard:
     ) -> List:
         """Send to the first ``number_of_nodes`` distinct-node remote
         shards on the ring; return after ``number_of_acks`` successes,
-        drain the rest in the background."""
+        drain the rest in the background.  Failed mutations become
+        hints for the unreachable node."""
         nodes: set = set()
-        connections: List[RemoteShardConnection] = []
+        connections: List[tuple] = []
         for s in self.shards:
             # Replicas live on OTHER nodes (same-node shards may be
             # remote connections under the per-core process launcher).
             if s.node_name == self.config.name or s.node_name in nodes:
                 continue
             nodes.add(s.node_name)
-            connections.append(s.connection)
+            connections.append((s.node_name, s.connection))
             if len(connections) >= number_of_nodes:
                 break
 
@@ -484,10 +554,11 @@ class MyShard:
         )
 
         async def fan_out():
-            pending = {
-                asyncio.ensure_future(c.send_request(request))
-                for c in connections
+            fut_node = {
+                asyncio.ensure_future(c.send_request(request)): name
+                for name, c in connections
             }
+            pending = set(fut_node)
             results: List = []
             acks = 0
             try:
@@ -507,7 +578,18 @@ class MyShard:
                                 )
                             )
                             acks += 1
+                        except (Timeout, ConnectionError_) as e:
+                            # Unreachable replica: hand off later.
+                            log.error(
+                                "unreachable replica: %s", e
+                            )
+                            self._record_hint(
+                                fut_node[fut], request
+                            )
                         except DbeelError as e:
+                            # Application-level error from a LIVE
+                            # replica (e.g. CollectionNotFound during
+                            # gossip propagation) — not a handoff case.
                             log.error(
                                 "failed response from replica: %s", e
                             )
@@ -518,6 +600,9 @@ class MyShard:
             for fut in pending:
                 try:
                     await fut
+                except (Timeout, ConnectionError_) as e:
+                    log.error("replica request in background: %s", e)
+                    self._record_hint(fut_node[fut], request)
                 except Exception as e:
                     log.error("replica request in background: %s", e)
 
@@ -613,7 +698,9 @@ class MyShard:
         await self.broadcast_message_to_local_shards(
             ShardEvent.gossip(event)
         )
-        buf = msgs.serialize_gossip_message(self.config.name, event)
+        buf = msgs.serialize_gossip_message(
+            f"{self.config.name}#{self.boot_id}", event
+        )
         await self.gossip_buffer(buf)
 
     async def gossip_buffer(self, buf: bytes) -> None:
@@ -646,13 +733,13 @@ class MyShard:
                 if node.name not in self.nodes:
                     self.nodes[node.name] = node
                     self.add_shards_of_nodes([node])
-                # State transition resets the opposite epidemic counter
-                # (improvement over the reference: without this, a node
-                # that dies and rejoins within the dedup window has its
-                # fresh announcements suppressed and never reappears).
-                self.gossip_requests.pop(
-                    (node.name, GossipEvent.DEAD), None
+                # State transition resets the opposite epidemic
+                # counters (sources are name#boot_id salted).
+                self._reset_gossip_counters(
+                    node.name, GossipEvent.DEAD
                 )
+                if node.name in self.hints:
+                    self.spawn(self.replay_hints(node.name))
                 self.flow.notify(FlowEvent.ALIVE_NODE_GOSSIP)
                 added = [
                     s
@@ -682,15 +769,25 @@ class MyShard:
                 pass
         return not another_gossip_sent
 
+    def _reset_gossip_counters(self, node_name: str, kind: str) -> None:
+        """Drop dedup counters of ``kind`` for every boot of a node
+        (gossip sources are '<name>#<boot_id>')."""
+        dead_keys = [
+            key
+            for key in self.gossip_requests
+            if key[1] == kind
+            and key[0].split("#", 1)[0] == node_name
+        ]
+        for key in dead_keys:
+            del self.gossip_requests[key]
+
     async def handle_dead_node(self, node_name: str) -> None:
         if self.nodes.pop(node_name, None) is None:
             return
         # Allow the node's next Alive announcement through the gossip
         # dedup immediately (see the matching reset in
         # handle_gossip_event).
-        self.gossip_requests.pop(
-            (node_name, GossipEvent.ALIVE), None
-        )
+        self._reset_gossip_counters(node_name, GossipEvent.ALIVE)
         removed = [s for s in self.shards if s.node_name == node_name]
         self.shards = [
             s for s in self.shards if s.node_name != node_name

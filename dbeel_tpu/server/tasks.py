@@ -216,6 +216,15 @@ async def handle_gossip_packet(my_shard: MyShard, buf: bytes) -> None:
 
     key = (source, event[0])
     seen = my_shard.gossip_requests.get(key, 0)
+    if seen == 0:
+        # Every key expires eventually (not only ones that reach the
+        # max-seen count): boot-id-salted sources would otherwise
+        # accumulate one entry per boot per kind forever.
+        async def expire_new():
+            await asyncio.sleep(GOSSIP_REQUEST_EXPIRATION_S * 2)
+            my_shard.gossip_requests.pop(key, None)
+
+        my_shard.spawn(expire_new())
     if seen >= my_shard.config.gossip_max_seen_count:
         if seen == my_shard.config.gossip_max_seen_count:
             my_shard.gossip_requests[key] = seen + 1

@@ -89,6 +89,8 @@ class ClusterNode:
     async def crash(self) -> None:
         """Hard crash (test_utils/src/lib.rs:159-170): cancel without
         stop events — no death gossip, sockets just vanish."""
+        for s in self.shards:
+            s.crashed = True
         for t in self.tasks:
             t.cancel()
         await asyncio.gather(*self.tasks, return_exceptions=True)

@@ -201,6 +201,97 @@ def test_replication_with_multiple_shards_per_node(tmp_dir):
     run(main(), timeout=60)
 
 
+def test_hinted_handoff_replays_missed_writes(tmp_dir):
+    """Improvement over the reference (which has no hinted handoff): a
+    write whose replica was down is queued as a hint and replayed when
+    the node rejoins — the replica converges WITHOUT any read."""
+
+    async def main():
+        # Slow detector: hints target the down-but-not-yet-detected
+        # window (a detected-dead node leaves the ring and is healed by
+        # read repair instead).
+        cfgs = _three_nodes(
+            tmp_dir, failure_detection_interval_ms=60000
+        )
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        client = await DbeelClient.from_seed_nodes([nodes[0].db_address])
+        col = await client.create_collection("hh", replication_factor=3)
+        for n in nodes:
+            while "hh" not in n.shards[0].collections:
+                await asyncio.sleep(0.01)
+
+        # Node 3 goes down (silently); ALL-consistency writes whose
+        # fan-out window covers it queue hints on their coordinators.
+        # (Keys whose PRIMARY was node 3 are never attempted there —
+        # read repair covers those; hints cover the attempted ones.)
+        await nodes[2].crash()
+        n_keys = 30
+        for i in range(n_keys):
+            await col.set(
+                f"hk{i:02}", i, consistency=Consistency.ALL
+            )
+
+        def total_hints():
+            return sum(
+                len(q)
+                for n in nodes[:2]
+                for s in n.shards
+                for q in s.hints.values()
+            )
+
+        for _ in range(200):
+            if total_hints() > 0:
+                break
+            await asyncio.sleep(0.02)
+        hinted_count = total_hints()
+        assert hinted_count > 0, "no hints recorded for the dead replica"
+
+        hinted_shards = [
+            s
+            for n in nodes[:2]
+            for s in n.shards
+            if s.hints
+        ]
+        replays = [
+            s.flow.subscribe(FlowEvent.HINTS_REPLAYED)
+            for s in hinted_shards
+        ]
+        nodes[2] = await ClusterNode(cfgs[2]).start()
+        await asyncio.wait(replays, timeout=10)
+
+        import msgpack
+
+        tree = nodes[2].shards[0].collections["hh"].tree
+
+        async def present():
+            count = 0
+            for i in range(n_keys):
+                if (
+                    await tree.get(msgpack.packb(f"hk{i:02}"))
+                    is not None
+                ):
+                    count += 1
+            return count
+
+        for _ in range(300):
+            if await present() >= hinted_count:
+                break
+            await asyncio.sleep(0.02)
+        assert await present() >= hinted_count, (
+            f"only {await present()} of {hinted_count} hinted writes "
+            "reached the rejoined replica"
+        )
+        assert total_hints() == 0, "hints not drained after replay"
+        for n in reversed(nodes):
+            await n.stop()
+
+    run(main(), timeout=60)
+
+
 def test_read_repair_heals_stale_replica(tmp_dir):
     """Improvement over the reference (which has no read repair): a
     replica that missed a write converges after a quorum read observes
