@@ -814,22 +814,28 @@ class MyShard:
     ) -> None:
         assert removed_shards
         actions: List[Tuple[str, List[RangeAndAction]]] = []
+        # Per-collection skips use `continue`, not `return`: the reference
+        # returns out of the whole planning loop here
+        # (/root/reference/src/shards.rs:869-876), which silently aborts
+        # migration for every collection after an rf=1 one in iteration
+        # order — a durability hole with mixed-RF collections. Fixed
+        # deliberately (documented in PARITY.md).
         for name, collection in list(self.collections.items()):
             rf = collection.replication_factor
             if rf <= 1:
-                return
+                continue
             if len(self.nodes) + 1 < rf:
-                return
+                continue
             migrate_to = self.get_last_owning_shard(
                 self.shards, self.hash, rf
             )
             if migrate_to is None:
-                return
+                continue
             if not any(
                 is_between(s.hash, self.hash, migrate_to.hash)
                 for s in removed_shards
             ):
-                return
+                continue
             start = self.shards[-1].hash
             candidates = [
                 s.hash
@@ -883,7 +889,7 @@ class MyShard:
                 if s.name not in added_names
             ]
             if not prev_hashes:
-                return
+                continue
             previous_shard_hash = prev_hashes[0]
 
             # Step 1: send (prev, me] range to the closest added shard
