@@ -45,7 +45,10 @@ class Config:
 
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
-    compaction_backend: str = "auto"  # auto | device | cpu | native
+    # auto | device | distributed | coalesced | device_full | cpu |
+    # heap | native.  auto → distributed on a multi-chip mesh, device on
+    # one accelerator, native on CPU-only hosts.
+    compaction_backend: str = "auto"
     memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
     memtable_kind: str = "sorted"  # sorted | hash (device flush sort)
     processes: bool = False  # one pinned OS process per shard

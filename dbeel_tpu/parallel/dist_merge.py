@@ -124,7 +124,18 @@ def _dist_kernel(stack, mesh: Mesh, capacity: int, n_dev: int):
 
 def build_stack(cols: columnar.MergeColumns, n_dev: int) -> np.ndarray:
     """(N_padded, NUM_COLS) uint32 operand stack, padded so the leading
-    dim divides the mesh."""
+    dim divides the mesh.
+
+    Rows are INTERLEAVED across device blocks (block d gets original
+    rows d::n_dev): inputs are concatenated sorted runs, so a contiguous
+    block layout would give each device a narrow slice of the keyspace
+    and funnel its whole slice into a handful of all_to_all buckets
+    (~m/ceil(n_dev/n_runs) rows each), overflowing the fixed exchange
+    capacity of ~2m/n_dev even with zero skew.  Interleaving makes every
+    local slice a stride-sample of the global key distribution — bucket
+    loads concentrate around m/n_dev and the splitter samples on each
+    device see the whole keyspace.  The idx column carries original row
+    identity, so downstream consumers never see the permutation."""
     n = len(cols)
     m = -(-n // n_dev)  # ceil
     m = max(m, _NUM_SAMPLES)
@@ -141,7 +152,14 @@ def build_stack(cols: columnar.MergeColumns, n_dev: int) -> np.ndarray:
     stack[:n, 6] = (ts_inv & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     stack[:n, 7] = ~cols.src
     stack[:n, 8] = np.arange(n, dtype=np.uint32)
-    return stack
+    # Interleave: device block d = rows d::n_dev of the run-concatenated
+    # order (sentinel padding rows disperse too; they sort last on every
+    # device and are masked out of bucket counts).
+    return np.ascontiguousarray(
+        stack.reshape(m, n_dev, NUM_COLS)
+        .transpose(1, 0, 2)
+        .reshape(p, NUM_COLS)
+    )
 
 
 def distributed_sort_dedup(

@@ -182,6 +182,7 @@ def _eager_jax_init(config: Config) -> None:
         "device",
         "device_full",
         "coalesced",
+        "distributed",
     ):
         return
     try:

@@ -268,14 +268,42 @@ def get_strategy(name: str) -> CompactionStrategy:
         except ImportError:
             return ColumnarMergeStrategy()
         return DeviceFullMergeStrategy()
+    if name == "distributed":
+        # Multi-chip sample sort over the whole mesh (BASELINE config 5).
+        # Falls back to the single-device kernel on a 1-chip host and to
+        # the host path when jax is unavailable — loudly, so an operator
+        # who configured the mesh backend can see it didn't engage.
+        try:
+            import jax
+
+            from ..parallel.dist_merge import DistributedMergeStrategy
+            from ..parallel.mesh import shard_mesh
+
+            devices = jax.devices()
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "compaction_backend=distributed unavailable (%r); "
+                "falling back to the host columnar merge",
+                e,
+            )
+            return ColumnarMergeStrategy()
+        if len(devices) <= 1:
+            return get_strategy("device")
+        return DistributedMergeStrategy(shard_mesh())
     if name == "auto":
         try:
             import jax
 
             platform = jax.default_backend()
+            n_devices = len(jax.devices())
         except Exception:
             platform = "cpu"
+            n_devices = 1
         if platform != "cpu":
+            if n_devices > 1:
+                return get_strategy("distributed")
             return get_strategy("device")
         return get_strategy("native")
     raise ValueError(f"unknown compaction backend {name!r}")
