@@ -34,6 +34,14 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
     # device with full key columns rather than fix up row-by-row on host.
     TIE_FALLBACK_FRACTION = 0.02
 
+    # Merges below this input size stay on the single-shot path: they
+    # are fast anyway, keep the page-mirroring write (small fresh
+    # SSTables warm in cache when a cache is supplied), and keep the
+    # TIE_FALLBACK device re-sort close at hand.  Larger merges go
+    # through the O_DIRECT native pipeline, which bails back here on
+    # tie-heavy keyspaces (pipeline.py's tie-fraction guard).
+    PIPELINE_MIN_BYTES = 64 << 20
+
     def merge(
         self,
         sources,
@@ -43,8 +51,43 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
         keep_tombstones,
         bloom_min_size,
     ):
-        """Pipelined override: per-run device uploads overlap the disk
-        reads (each file read once), then the shared finish path."""
+        """Partitioned native pipeline for big merges (ops/pipeline.py:
+        O_DIRECT reads, per-partition kernel launches, C++ gather +
+        O_DIRECT streaming writes, all stages overlapped); otherwise
+        the single-shot path with per-run upload/read overlap."""
+        total = sum(getattr(s, "data_size", 0) for s in sources)
+        if total >= self.PIPELINE_MIN_BYTES:
+            from .pipeline import pipeline_merge
+
+            result = pipeline_merge(
+                sources,
+                dir_path,
+                output_index,
+                keep_tombstones,
+                bloom_min_size,
+            )
+            if result is not None:
+                return result
+        return self._merge_single_shot(
+            sources,
+            dir_path,
+            output_index,
+            cache,
+            keep_tombstones,
+            bloom_min_size,
+        )
+
+    def _merge_single_shot(
+        self,
+        sources,
+        dir_path,
+        output_index,
+        cache,
+        keep_tombstones,
+        bloom_min_size,
+    ):
+        """Per-run device uploads overlap the disk reads (each file
+        read once), then the shared finish path."""
         from ..storage.compaction import write_output_columnar
         from .bitonic import device_merge_prefix_order_pipelined
 

@@ -323,6 +323,9 @@ async def _client_loop(
         ):
             break
         first = False
+        # Foreground activity marker: while requests keep arriving,
+        # scheduler.bg_slice() holders defer (glommio shares parity).
+        my_shard.scheduler.fg_mark()
 
         keepalive = False
         try:

@@ -19,6 +19,8 @@ from ..utils.murmur import hash_bytes
 
 log = logging.getLogger(__name__)
 
+MIGRATION_BATCH_ENTRIES = 128  # one share-scheduler unit
+
 
 def _between(hash_: int, start: int, end: int) -> bool:
     """Half-open wrap-around range [start, end).
@@ -53,27 +55,42 @@ async def migrate_actions(
 
     ranges = [(ra.start, ra.end) for ra in ranges_and_actions]
 
+    async def process(key, value, ts):
+        h = hash_bytes(key)
+        index = next(
+            i
+            for i, (s, e) in enumerate(ranges)
+            if _between(h, s, e)
+        )
+        ra = ranges_and_actions[index]
+        if ra.action == MigrationAction.DELETE:
+            await tree.delete(key)
+            return
+        msg = ShardEvent.set(collection_name, key, value, ts)
+        if streams[index] is not None:
+            await streams[index].send(msg)
+        elif isinstance(ra.connection, LocalShardConnection):
+            await ra.connection.send_message(my_shard.id, msg)
+
+    # Stream in batches, each one background unit under the share
+    # scheduler: a bulk migration defers to live serving traffic
+    # (glommio bg-queue parity) instead of racing it for the loop.
+    agen = tree.iter_filter(
+        lambda k, v, t: any(
+            _between(hash_bytes(k), s, e) for s, e in ranges
+        )
+    ).__aiter__()
     try:
-        async for key, value, ts in tree.iter_filter(
-            lambda k, v, t: any(
-                _between(hash_bytes(k), s, e) for s, e in ranges
-            )
-        ):
-            h = hash_bytes(key)
-            index = next(
-                i
-                for i, (s, e) in enumerate(ranges)
-                if _between(h, s, e)
-            )
-            ra = ranges_and_actions[index]
-            if ra.action == MigrationAction.DELETE:
-                await tree.delete(key)
-                continue
-            msg = ShardEvent.set(collection_name, key, value, ts)
-            if streams[index] is not None:
-                await streams[index].send(msg)
-            elif isinstance(ra.connection, LocalShardConnection):
-                await ra.connection.send_message(my_shard.id, msg)
+        done = False
+        while not done:
+            async with my_shard.scheduler.bg_slice():
+                for _ in range(MIGRATION_BATCH_ENTRIES):
+                    try:
+                        key, value, ts = await agen.__anext__()
+                    except StopAsyncIteration:
+                        done = True
+                        break
+                    await process(key, value, ts)
     finally:
         for stream in streams:
             if stream is not None:

@@ -128,6 +128,15 @@ class MyShard:
         # keyed by the unreachable node, replayed on its next Alive.
         self.hints: Dict[str, deque] = {}
         self.cache = cache
+        # Shares discipline (glommio task-queue parity): serving marks
+        # foreground activity; compaction/migration/hint-replay units
+        # run under scheduler.bg_slice().
+        from .scheduler import ShareScheduler
+
+        self.scheduler = ShareScheduler(
+            config.foreground_tasks_shares,
+            config.background_tasks_shares,
+        )
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
         self.flow = flow_events.FlowEventNotifier()
@@ -347,6 +356,7 @@ class MyShard:
                 "hits": self.cache.hits,
                 "misses": self.cache.misses,
             },
+            "scheduler": self.scheduler.stats(),
             "collections": collections,
         }
 
@@ -496,26 +506,33 @@ class MyShard:
         )
         replayed = 0
         pending = list(queued)
-        if shard is not None:
-            while pending:
-                request = pending[0]
-                try:
-                    msgs.response_to_result(
-                        await shard.connection.send_request(request),
-                        ShardResponse.SET
-                        if request[1] == ShardRequest.SET
-                        else ShardResponse.DELETE,
-                    )
-                    pending.pop(0)
-                    replayed += 1
-                except DbeelError as e:
-                    log.warning(
-                        "hint replay to %s stopped after %d: %s",
-                        node_name,
-                        replayed,
-                        e,
-                    )
-                    break
+        failed = False
+        # Replay in background units so a large hint drain defers to
+        # live serving under the share scheduler.
+        while pending and not failed and shard is not None:
+            async with self.scheduler.bg_slice():
+                for _ in range(32):
+                    if not pending:
+                        break
+                    request = pending[0]
+                    try:
+                        msgs.response_to_result(
+                            await shard.connection.send_request(request),
+                            ShardResponse.SET
+                            if request[1] == ShardRequest.SET
+                            else ShardResponse.DELETE,
+                        )
+                        pending.pop(0)
+                        replayed += 1
+                    except DbeelError as e:
+                        log.warning(
+                            "hint replay to %s stopped after %d: %s",
+                            node_name,
+                            replayed,
+                            e,
+                        )
+                        failed = True
+                        break
         # Anything untried or failed goes back on the queue (node raced
         # back down, shard missing, etc.) — never dropped.
         for request in pending:

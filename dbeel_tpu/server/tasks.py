@@ -67,6 +67,10 @@ async def _handle_remote_client(my_shard, reader, writer):
                 message = await get_message_from_stream(reader)
             except (asyncio.IncompleteReadError, ConnectionError, OSError):
                 break
+            # Replica-side serving (quorum writes/reads from peers) is
+            # foreground work too: without this mark, background units
+            # on replica nodes would never defer to quorum traffic.
+            my_shard.scheduler.fg_mark()
             try:
                 response = await my_shard.handle_shard_message(message)
                 if response is not None:
@@ -118,9 +122,13 @@ def _leading_zeros64(n: int) -> int:
     return 64 - n.bit_length() if n else 64
 
 
-async def compact_tree(tree, compaction_factor: int) -> None:
+async def compact_tree(
+    tree, compaction_factor: int, scheduler=None
+) -> None:
     """Size-tiered grouping by size order (leading_zeros) with cascade
-    merge of adjacent orders (compaction.rs:35-102)."""
+    merge of adjacent orders (compaction.rs:35-102).  Each merge is one
+    background unit under the share scheduler: while serving is busy,
+    consecutive merges are spaced to the fg/bg share ratio."""
     indices_and_sizes = tree.sstable_indices_and_sizes()
 
     odd = [i for i, _ in indices_and_sizes if i % 2 != 0]
@@ -150,7 +158,15 @@ async def compact_tree(tree, compaction_factor: int) -> None:
         # (compaction.rs:90-92).
         keep_tombstones = i > 0
         try:
-            await tree.compact(indices, index_to_compact, keep_tombstones)
+            if scheduler is not None:
+                async with scheduler.bg_slice():
+                    await tree.compact(
+                        indices, index_to_compact, keep_tombstones
+                    )
+            else:
+                await tree.compact(
+                    indices, index_to_compact, keep_tombstones
+                )
         except Exception as e:
             log.error("failed to compact files: %s", e)
         index_to_compact += 2
@@ -172,7 +188,10 @@ async def run_compaction_loop(my_shard: MyShard) -> None:
 
     # Compact once on startup (crash may have left ungrouped files).
     await asyncio.gather(
-        *[compact_tree(t, compaction_factor) for t in trees]
+        *[
+            compact_tree(t, compaction_factor, my_shard.scheduler)
+            for t in trees
+        ]
     )
 
     while True:
@@ -191,7 +210,9 @@ async def run_compaction_loop(my_shard: MyShard) -> None:
         for i, fut in enumerate(listeners):
             if fut.done():
                 listeners[i] = trees[i].flush_done_event.listen()
-                await compact_tree(trees[i], compaction_factor)
+                await compact_tree(
+                    trees[i], compaction_factor, my_shard.scheduler
+                )
 
 
 # ----------------------------------------------------------------------

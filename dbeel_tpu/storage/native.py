@@ -49,21 +49,37 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH):
+    src = os.path.join(_NATIVE_DIR, "src", "dbeel_native.cpp")
+    stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
+        _LIB_PATH
+    ) < os.path.getmtime(src)
+    if not os.path.exists(_LIB_PATH) or stale:
+        # Rebuild BEFORE the first dlopen: ctypes.CDLL caches by path,
+        # so a stale library loaded once cannot be swapped in-process.
         try:
             subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
+                ["make", "-C", _NATIVE_DIR, "-B"] if stale
+                else ["make", "-C", _NATIVE_DIR],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
         except Exception as e:
             log.info("native build unavailable: %s", e)
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as e:
         log.info("native lib load failed: %s", e)
+        return None
+    if not hasattr(lib, "dbeel_writer_open"):
+        # Still stale (rebuild failed / old binary pinned): degrade to
+        # the pure-Python paths rather than crash on registration.
+        log.warning(
+            "native library at %s predates the pipeline API; "
+            "falling back to host merges", _LIB_PATH
+        )
         return None
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -82,6 +98,31 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_uint32,
         ctypes.POINTER(ctypes.c_uint32),
     ]
+    lib.dbeel_read_file.restype = ctypes.c_int64
+    lib.dbeel_read_file.argtypes = [
+        ctypes.c_char_p,
+        u8p,
+        ctypes.c_uint64,
+    ]
+    lib.dbeel_writer_open.restype = ctypes.c_void_p
+    lib.dbeel_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.dbeel_writer_put.restype = ctypes.c_int64
+    lib.dbeel_writer_put.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint64,
+    ]
+    lib.dbeel_writer_close.restype = ctypes.c_int64
+    lib.dbeel_writer_close.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dbeel_writer_abort.restype = None
+    lib.dbeel_writer_abort.argtypes = [ctypes.c_void_p]
     lib.dbeel_bloom_add_batch.restype = None
     lib.dbeel_merge.restype = ctypes.c_int64
     lib.dbeel_merge.argtypes = [
