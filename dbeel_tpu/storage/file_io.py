@@ -14,6 +14,7 @@ without changing callers.
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import Optional, Tuple
 
@@ -71,12 +72,62 @@ class CachedFileReader:
             page = self._cache.get_copied(self.file_id, address)
             if page is not None:
                 return page
-        raw = os.pread(self._fd, PAGE_SIZE, address)
-        if len(raw) < PAGE_SIZE:
-            raw = raw + b"\x00" * (PAGE_SIZE - len(raw))
+        raw = self._pread_page(address)
         if self._cache is not None:
             self._cache.set(self.file_id, address, raw)
         return raw
+
+    def _pread_page(self, address: int) -> bytes:
+        raw = os.pread(self._fd, PAGE_SIZE, address)
+        if len(raw) < PAGE_SIZE:
+            raw = raw + b"\x00" * (PAGE_SIZE - len(raw))
+        return raw
+
+    def _pread_pages(self, addresses) -> list:
+        return [self._pread_page(a) for a in addresses]
+
+    async def read_at_async(self, pos: int, size: int) -> bytes:
+        """read_at that never blocks the event loop on disk: cached
+        pages are served inline; ALL missing pages of the range are
+        pread in one executor hop (reference parity: the read path is
+        async DMA through io_uring, cached_file_reader.rs:28-88), then
+        inserted into the cache back on the loop — cache mutation stays
+        loop-confined."""
+        if size <= 0:
+            return b""
+        end = min(pos + size, self.size)
+        start = align_down(pos)
+        pages = {}
+        missing = []
+        address = start
+        while address < end:
+            page = (
+                self._cache.get_copied(self.file_id, address)
+                if self._cache is not None
+                else None
+            )
+            if page is None:
+                missing.append(address)
+            else:
+                pages[address] = page
+            address += PAGE_SIZE
+        if missing:
+            raws = await asyncio.get_event_loop().run_in_executor(
+                None, self._pread_pages, missing
+            )
+            for address, raw in zip(missing, raws):
+                if self._cache is not None:
+                    self._cache.set(self.file_id, address, raw)
+                pages[address] = raw
+        out = bytearray()
+        address = start
+        while address < end:
+            page = pages[address]
+            lo = pos - address if address <= pos else 0
+            hi = min(PAGE_SIZE, end - address)
+            out += page[lo:hi]
+            address += PAGE_SIZE
+        return bytes(out)
 
 
 class PageMirroringWriter:

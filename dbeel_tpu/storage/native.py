@@ -50,9 +50,11 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
     _tried = True
     src = os.path.join(_NATIVE_DIR, "src", "dbeel_native.cpp")
-    stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
-        _LIB_PATH
-    ) < os.path.getmtime(src)
+    stale = (
+        os.path.exists(_LIB_PATH)
+        and os.path.exists(src)
+        and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+    )
     if not os.path.exists(_LIB_PATH) or stale:
         # Rebuild BEFORE the first dlopen: ctypes.CDLL caches by path,
         # so a stale library loaded once cannot be swapped in-process.
