@@ -117,11 +117,14 @@ async def run_shard(
     remote_server = await tasks.bind_remote_shard_server(my_shard)
     db_server = await bind_db_server(my_shard)
 
+    from .db_server import reap_idle_db_connections
+
     coros = [
         tasks.run_remote_shard_server(my_shard, remote_server),
         tasks.run_local_shard_server(my_shard),
         tasks.run_compaction_loop(my_shard),
         run_db_server(my_shard, db_server),
+        reap_idle_db_connections(my_shard),
         tasks.wait_for_stop(my_shard),
     ]
     if is_node_managing:
@@ -153,6 +156,10 @@ async def run_shard(
         # server tasks: Server.wait_closed() (py3.12) waits for open
         # connections, so keepalive handler loops must be torn down
         # before the db-server task can finish closing.
+        # Close live client transports first: py3.12's
+        # Server.wait_closed() blocks until every connection is gone,
+        # and protocol connections have no owning task to cancel.
+        my_shard.close_db_connections()
         background = list(my_shard._background_tasks)
         for t in (*task_set, *background):
             t.cancel()

@@ -139,6 +139,9 @@ class MyShard:
         )
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
+        # Live public-API connections (protocol objects) for the
+        # per-shard idle reaper.
+        self.db_connections: set = set()
         self.flow = flow_events.FlowEventNotifier()
         self._background_tasks: set = set()
         # Set by crash-simulating harnesses: suppresses graceful-stop
@@ -1013,6 +1016,16 @@ class MyShard:
             if s.is_local:
                 s.connection.send_stop()
 
+    def close_db_connections(self) -> None:
+        """Close live client transports so Server.wait_closed() (which
+        waits on them in py3.12) can finish during shutdown."""
+        for conn in list(self.db_connections):
+            conn.closing = True
+            if conn.transport is not None:
+                conn.transport.close()
+        self.db_connections.clear()
+
     def close(self) -> None:
+        self.close_db_connections()
         for col in self.collections.values():
             col.tree.close()

@@ -86,6 +86,27 @@ class CachedFileReader:
     def _pread_pages(self, addresses) -> list:
         return [self._pread_page(a) for a in addresses]
 
+    def read_at_cached(self, pos: int, size: int) -> Optional[bytes]:
+        """Cache-only read: the bytes if EVERY page of the range is
+        already cached, else None (no disk access, no awaits) — the
+        warm-path shortcut that keeps a fully-cached probe synchronous."""
+        if size <= 0:
+            return b""
+        if self._cache is None:
+            return None
+        end = min(pos + size, self.size)
+        out = bytearray()
+        address = align_down(pos)
+        while address < end:
+            page = self._cache.get_copied(self.file_id, address)
+            if page is None:
+                return None
+            lo = pos - address if address <= pos else 0
+            hi = min(PAGE_SIZE, end - address)
+            out += page[lo:hi]
+            address += PAGE_SIZE
+        return bytes(out)
+
     async def read_at_async(self, pos: int, size: int) -> bytes:
         """read_at that never blocks the event loop on disk: cached
         pages are served inline; ALL missing pages of the range are

@@ -124,10 +124,24 @@ class SSTable:
                 ) | words[:, 1].astype(np.uint64)
                 self._fast = (prefix, offs, ks, fs)
             else:
-                offs, ks, fs = self.read_index_columns()
                 stride = self.SPARSE_STRIDE
-                s_offs = offs[::stride].astype(np.uint64)
-                s_ks = ks[::stride]
+                # memmap both files: only the touched pages are read
+                # and no whole-index RAM copy is made (~160MB for a
+                # 10M-key table).
+                idx = np.memmap(
+                    self.index_path,
+                    dtype=np.dtype(
+                        [
+                            ("offset", "<u8"),
+                            ("key_size", "<u4"),
+                            ("full_size", "<u4"),
+                        ]
+                    ),
+                    mode="r",
+                )
+                s_offs = np.array(idx["offset"][::stride], np.uint64)
+                s_ks = np.array(idx["key_size"][::stride], np.uint32)
+                del idx
                 data = np.memmap(
                     self.data_path, dtype=np.uint8, mode="r"
                 )
@@ -207,12 +221,55 @@ class SSTable:
                 hi = mid
         return None
 
+    # Sentinel: the cache-only probe couldn't decide (a page missed).
+    _CACHE_MISS = object()
+
+    def _get_cached(self, key: bytes):
+        """Fully-synchronous probe that touches ONLY cached pages:
+        returns (value, ts), None (definitively absent), or
+        _CACHE_MISS when any needed page is cold.  Keeps the warm
+        serving path free of coroutine hops."""
+        lo, hi, arrays = self._lookup_range(key)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if arrays is not None:
+                offs, ks, fs = arrays
+                offset, key_size, full_size = (
+                    int(offs[mid]),
+                    int(ks[mid]),
+                    int(fs[mid]),
+                )
+            else:
+                raw = self._index.read_at_cached(
+                    mid * INDEX_ENTRY_SIZE, INDEX_ENTRY_SIZE
+                )
+                if raw is None:
+                    return self._CACHE_MISS
+                offset, key_size, full_size = INDEX_ENTRY.unpack(raw)
+            mid_key = self._data.read_at_cached(
+                offset + ENTRY_HEADER_SIZE, key_size
+            )
+            if mid_key is None:
+                return self._CACHE_MISS
+            if mid_key == key:
+                record = self._data.read_at_cached(offset, full_size)
+                if record is None:
+                    return self._CACHE_MISS
+                _, value, ts, _ = decode_entry(record)
+                return value, ts
+            if mid_key < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        return None
+
     async def get_async(self, key: bytes) -> Optional[Tuple[bytes, int]]:
         """get() that keeps disk off the event loop: the read-index
-        build runs in an executor (single-flight), and every index/data
-        probe goes through read_at_async (cache hits inline, misses in
-        one executor pread per probe).  The reference's analog is the
-        io_uring DMA read path (cached_file_reader.rs:28-88)."""
+        build runs in an executor (single-flight), warm probes resolve
+        synchronously from cached pages, and cold probes go through
+        read_at_async (misses in one executor pread per probe).  The
+        reference's analog is the io_uring DMA read path
+        (cached_file_reader.rs:28-88)."""
         if not self._fast_tried:
             if self._build_future is None:
                 self._build_future = (
@@ -227,6 +284,9 @@ class SSTable:
                 # poison the table — retry on the next get; the disk
                 # binary-search fallback below works meanwhile.
                 self._build_future = None
+        hit = self._get_cached(key)
+        if hit is not self._CACHE_MISS:
+            return hit
         lo, hi, arrays = self._lookup_range(key)
         while lo < hi:
             mid = (lo + hi) // 2
