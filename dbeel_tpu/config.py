@@ -50,7 +50,8 @@ class Config:
     # one accelerator, native on CPU-only hosts.
     compaction_backend: str = "auto"
     memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
-    memtable_kind: str = "sorted"  # sorted | hash (device flush sort)
+    # sorted | hash (device flush sort) | arena (C++ rbtree arena)
+    memtable_kind: str = "sorted"
     processes: bool = False  # one pinned OS process per shard
 
     def replace(self, **kw) -> "Config":
@@ -131,6 +132,7 @@ def build_parser() -> argparse.ArgumentParser:
             "device",
             "device_full",
             "coalesced",
+            "distributed",
             "cpu",
             "native",
             "heap",
@@ -142,7 +144,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--memtable-kind",
-        choices=("sorted", "hash"),
+        choices=("sorted", "hash", "arena"),
         default=d.memtable_kind,
     )
     p.add_argument(

@@ -98,16 +98,32 @@ class LSMTree:
         self.strategy = strategy or HeapMergeStrategy()
         # "sorted" = SortedDict kept ordered per insert (reference's
         # rbtree contract); "hash" = O(1) dict, ordered once at flush by
-        # the device sort (ops/sort.py) — the north-star flush path.
-        if memtable_kind not in ("sorted", "hash"):
+        # the device sort (ops/sort.py) — the north-star flush path;
+        # "arena" = the C++ arena red-black tree (native/), the direct
+        # rbtree_arena analog (falls back to "sorted" if unbuilt).
+        if memtable_kind not in ("sorted", "hash", "arena"):
             raise ValueError(
-                f"memtable_kind must be 'sorted' or 'hash', "
+                f"memtable_kind must be 'sorted', 'hash' or 'arena', "
                 f"got {memtable_kind!r}"
             )
         self.memtable_kind = memtable_kind
-        self._memtable_cls = (
-            HashMemtable if memtable_kind == "hash" else Memtable
-        )
+        if memtable_kind == "hash":
+            self._memtable_cls = HashMemtable
+        elif memtable_kind == "arena":
+            from .native import load_if_built
+
+            if load_if_built() is not None:
+                from .memtable import ArenaMemtable
+
+                self._memtable_cls = ArenaMemtable
+            else:
+                log.warning(
+                    "memtable_kind=arena: native library not built; "
+                    "using the sorted Python memtable"
+                )
+                self._memtable_cls = Memtable
+        else:
+            self._memtable_cls = Memtable
 
         self._active = self._memtable_cls(capacity)
         self._flushing: Optional[Memtable] = None

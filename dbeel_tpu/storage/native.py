@@ -125,6 +125,37 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.dbeel_writer_abort.restype = None
     lib.dbeel_writer_abort.argtypes = [ctypes.c_void_p]
+    lib.dbeel_memtable_new.restype = ctypes.c_void_p
+    lib.dbeel_memtable_new.argtypes = [ctypes.c_uint32]
+    lib.dbeel_memtable_free.restype = None
+    lib.dbeel_memtable_free.argtypes = [ctypes.c_void_p]
+    lib.dbeel_memtable_len.restype = ctypes.c_uint32
+    lib.dbeel_memtable_len.argtypes = [ctypes.c_void_p]
+    lib.dbeel_memtable_bytes.restype = ctypes.c_uint64
+    lib.dbeel_memtable_bytes.argtypes = [ctypes.c_void_p]
+    lib.dbeel_memtable_set.restype = ctypes.c_int32
+    lib.dbeel_memtable_set.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.dbeel_memtable_get.restype = ctypes.c_int32
+    lib.dbeel_memtable_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dbeel_memtable_dump_size.restype = ctypes.c_uint64
+    lib.dbeel_memtable_dump_size.argtypes = [ctypes.c_void_p]
+    lib.dbeel_memtable_dump.restype = ctypes.c_uint64
+    lib.dbeel_memtable_dump.argtypes = [ctypes.c_void_p, u8p]
     lib.dbeel_bloom_add_batch.restype = None
     lib.dbeel_merge.restype = ctypes.c_int64
     lib.dbeel_merge.argtypes = [

@@ -391,3 +391,289 @@ void dbeel_writer_abort(void* handle) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------
+// Arena red-black memtable.  Role parity with the reference's
+// rbtree_arena crate (/root/reference/rbtree_arena/src/lib.rs:308-649):
+// tree nodes live in one pre-allocated array (indices as pointers,
+// cache-friendly), capacity bounds the node count and drives the LSM
+// flush trigger; key/value bytes append to a growable byte arena.
+// Comparator: plain lexicographic memcmp on keys.  Overwrites keep the
+// newest timestamp (LSM conflict rule) and append the new value
+// (the superseded bytes die with the memtable at flush).
+// ---------------------------------------------------------------------
+
+namespace {
+
+constexpr uint32_t NIL = 0xFFFFFFFFu;
+
+struct MemNode {
+  uint32_t left, right, parent;
+  uint32_t red;  // 1 = red, 0 = black
+  uint64_t key_off;
+  uint32_t key_len;
+  uint64_t val_off;
+  uint32_t val_len;
+  int64_t ts;
+};
+
+struct ArenaMemtable {
+  std::vector<MemNode> nodes;  // reserved to capacity up front
+  std::vector<uint8_t> bytes;  // key/value storage
+  uint32_t root = NIL;
+  uint32_t capacity;
+  uint64_t live_bytes = 0;  // key+value bytes still referenced
+
+  explicit ArenaMemtable(uint32_t cap) : capacity(cap) {
+    nodes.reserve(cap);
+    bytes.reserve((size_t)cap * 64);
+  }
+
+  // Reclaim superseded value bytes once they exceed the live set:
+  // update-heavy workloads (same keys rewritten below capacity) would
+  // otherwise grow the byte arena without ever triggering a flush.
+  void maybe_compact() {
+    if (bytes.size() - live_bytes <= live_bytes + (1u << 20)) return;
+    std::vector<uint8_t> fresh;
+    fresh.reserve(live_bytes);
+    for (MemNode& n : nodes) {
+      const uint64_t ko = fresh.size();
+      fresh.insert(fresh.end(), bytes.begin() + n.key_off,
+                   bytes.begin() + n.key_off + n.key_len);
+      const uint64_t vo = fresh.size();
+      fresh.insert(fresh.end(), bytes.begin() + n.val_off,
+                   bytes.begin() + n.val_off + n.val_len);
+      n.key_off = ko;
+      n.val_off = vo;
+    }
+    bytes.swap(fresh);
+  }
+
+  int cmp_key(uint32_t n, const uint8_t* key, uint32_t klen) const {
+    const MemNode& node = nodes[n];
+    const uint32_t m =
+        node.key_len < klen ? node.key_len : klen;
+    int c = std::memcmp(bytes.data() + node.key_off, key, m);
+    if (c != 0) return c;
+    if (node.key_len == klen) return 0;
+    return node.key_len < klen ? -1 : 1;
+  }
+
+  void rotate_left(uint32_t x) {
+    uint32_t y = nodes[x].right;
+    nodes[x].right = nodes[y].left;
+    if (nodes[y].left != NIL) nodes[nodes[y].left].parent = x;
+    nodes[y].parent = nodes[x].parent;
+    if (nodes[x].parent == NIL)
+      root = y;
+    else if (nodes[nodes[x].parent].left == x)
+      nodes[nodes[x].parent].left = y;
+    else
+      nodes[nodes[x].parent].right = y;
+    nodes[y].left = x;
+    nodes[x].parent = y;
+  }
+
+  void rotate_right(uint32_t x) {
+    uint32_t y = nodes[x].left;
+    nodes[x].left = nodes[y].right;
+    if (nodes[y].right != NIL) nodes[nodes[y].right].parent = x;
+    nodes[y].parent = nodes[x].parent;
+    if (nodes[x].parent == NIL)
+      root = y;
+    else if (nodes[nodes[x].parent].right == x)
+      nodes[nodes[x].parent].right = y;
+    else
+      nodes[nodes[x].parent].left = y;
+    nodes[y].right = x;
+    nodes[x].parent = y;
+  }
+
+  void insert_fixup(uint32_t z) {
+    while (nodes[z].parent != NIL && nodes[nodes[z].parent].red) {
+      uint32_t p = nodes[z].parent;
+      uint32_t g = nodes[p].parent;
+      if (p == nodes[g].left) {
+        uint32_t u = nodes[g].right;
+        if (u != NIL && nodes[u].red) {
+          nodes[p].red = 0;
+          nodes[u].red = 0;
+          nodes[g].red = 1;
+          z = g;
+        } else {
+          if (z == nodes[p].right) {
+            z = p;
+            rotate_left(z);
+            p = nodes[z].parent;
+            g = nodes[p].parent;
+          }
+          nodes[p].red = 0;
+          nodes[g].red = 1;
+          rotate_right(g);
+        }
+      } else {
+        uint32_t u = nodes[g].left;
+        if (u != NIL && nodes[u].red) {
+          nodes[p].red = 0;
+          nodes[u].red = 0;
+          nodes[g].red = 1;
+          z = g;
+        } else {
+          if (z == nodes[p].left) {
+            z = p;
+            rotate_right(z);
+            p = nodes[z].parent;
+            g = nodes[p].parent;
+          }
+          nodes[p].red = 0;
+          nodes[g].red = 1;
+          rotate_left(g);
+        }
+      }
+    }
+    nodes[root].red = 0;
+  }
+
+  uint64_t append_bytes(const uint8_t* data, uint32_t len) {
+    const uint64_t off = bytes.size();
+    bytes.insert(bytes.end(), data, data + len);
+    return off;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dbeel_memtable_new(uint32_t capacity) {
+  return new ArenaMemtable(capacity);
+}
+
+void dbeel_memtable_free(void* h) {
+  delete static_cast<ArenaMemtable*>(h);
+}
+
+uint32_t dbeel_memtable_len(void* h) {
+  return (uint32_t)static_cast<ArenaMemtable*>(h)->nodes.size();
+}
+
+uint64_t dbeel_memtable_bytes(void* h) {
+  return static_cast<ArenaMemtable*>(h)->bytes.size();
+}
+
+// Returns: 0 inserted new, 1 overwrote (old value length in
+// *old_val_len), 2 ignored (older timestamp), -1 capacity reached.
+int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
+                           const uint8_t* value, uint32_t vlen,
+                           int64_t ts, uint32_t* old_val_len) {
+  auto* t = static_cast<ArenaMemtable*>(h);
+  uint32_t parent = NIL;
+  uint32_t cur = t->root;
+  int c = 0;
+  while (cur != NIL) {
+    parent = cur;
+    c = t->cmp_key(cur, key, klen);
+    if (c == 0) {
+      MemNode& n = t->nodes[cur];
+      if (ts < n.ts) return 2;
+      *old_val_len = n.val_len;
+      if (vlen <= n.val_len) {
+        // In-place overwrite (the common fixed-size-update case).
+        std::memcpy(t->bytes.data() + n.val_off, value, vlen);
+        t->live_bytes -= n.val_len - vlen;
+      } else {
+        t->live_bytes += (uint64_t)vlen - n.val_len;
+        n.val_off = t->append_bytes(value, vlen);
+      }
+      n.val_len = vlen;
+      n.ts = ts;
+      t->maybe_compact();
+      return 1;
+    }
+    cur = c < 0 ? t->nodes[cur].right : t->nodes[cur].left;
+  }
+  if (t->nodes.size() >= t->capacity) return -1;
+  MemNode n;
+  n.left = n.right = NIL;
+  n.parent = parent;
+  n.red = 1;
+  n.key_off = t->append_bytes(key, klen);
+  n.key_len = klen;
+  n.val_off = t->append_bytes(value, vlen);
+  n.val_len = vlen;
+  n.ts = ts;
+  t->live_bytes += (uint64_t)klen + vlen;
+  const uint32_t z = (uint32_t)t->nodes.size();
+  t->nodes.push_back(n);
+  if (parent == NIL)
+    t->root = z;
+  else if (c < 0)
+    t->nodes[parent].right = z;
+  else
+    t->nodes[parent].left = z;
+  t->insert_fixup(z);
+  return 0;
+}
+
+// Returns 1 + fills out-params if found, 0 otherwise.  The value
+// pointer aliases the arena: valid until the next set call (callers
+// copy immediately, as the ctypes wrapper does).
+int32_t dbeel_memtable_get(void* h, const uint8_t* key, uint32_t klen,
+                           const uint8_t** val, uint32_t* vlen,
+                           int64_t* ts) {
+  auto* t = static_cast<ArenaMemtable*>(h);
+  uint32_t cur = t->root;
+  while (cur != NIL) {
+    const int c = t->cmp_key(cur, key, klen);
+    if (c == 0) {
+      const MemNode& n = t->nodes[cur];
+      *val = t->bytes.data() + n.val_off;
+      *vlen = n.val_len;
+      *ts = n.ts;
+      return 1;
+    }
+    cur = c < 0 ? t->nodes[cur].right : t->nodes[cur].left;
+  }
+  return 0;
+}
+
+// Size of the dump buffer: per entry 16B header + key + live value.
+uint64_t dbeel_memtable_dump_size(void* h) {
+  auto* t = static_cast<ArenaMemtable*>(h);
+  uint64_t total = 0;
+  for (const MemNode& n : t->nodes)
+    total += 16 + n.key_len + n.val_len;
+  return total;
+}
+
+// In-order dump as [u32 klen][u32 vlen][i64 ts][key][value] records.
+// Returns the entry count.
+uint64_t dbeel_memtable_dump(void* h, uint8_t* out) {
+  auto* t = static_cast<ArenaMemtable*>(h);
+  uint64_t count = 0;
+  // explicit stack in-order walk (indices; arena has no recursion
+  // depth guarantees beyond ~2 log2(capacity))
+  std::vector<uint32_t> stack;
+  uint32_t cur = t->root;
+  while (cur != NIL || !stack.empty()) {
+    while (cur != NIL) {
+      stack.push_back(cur);
+      cur = t->nodes[cur].left;
+    }
+    cur = stack.back();
+    stack.pop_back();
+    const MemNode& n = t->nodes[cur];
+    std::memcpy(out, &n.key_len, 4);
+    std::memcpy(out + 4, &n.val_len, 4);
+    std::memcpy(out + 8, &n.ts, 8);
+    std::memcpy(out + 16, t->bytes.data() + n.key_off, n.key_len);
+    std::memcpy(out + 16 + n.key_len, t->bytes.data() + n.val_off,
+                n.val_len);
+    out += 16 + n.key_len + n.val_len;
+    count++;
+    cur = t->nodes[cur].right;
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -7,10 +7,19 @@ import random
 import pytest
 
 from dbeel_tpu.errors import MemtableCapacityReached
-from dbeel_tpu.storage.memtable import HashMemtable, Memtable
+from dbeel_tpu.storage.memtable import (
+    ArenaMemtable,
+    HashMemtable,
+    Memtable,
+)
+from dbeel_tpu.storage.native import native_available
+
+_KINDS = [Memtable, HashMemtable]
+if native_available():
+    _KINDS.append(ArenaMemtable)
 
 
-@pytest.fixture(params=[Memtable, HashMemtable])
+@pytest.fixture(params=_KINDS)
 def memtable_cls(request):
     return request.param
 
@@ -62,3 +71,70 @@ def test_data_bytes_accounting(memtable_cls):
     assert m.data_bytes == 16 + 3 + 5
     m.set(b"abc", b"1234567", 2)  # value grows by 2
     assert m.data_bytes == 16 + 3 + 7
+
+
+def test_random_ops_match_model(memtable_cls):
+    """Randomized inserts/overwrites/stale-writes against a dict+sort
+    model — the rbtree_arena suite's structural checks, black-box."""
+    rng = random.Random(11)
+    m = memtable_cls(4096)
+    model = {}
+    for _ in range(5000):
+        k = bytes(rng.randrange(4) for _ in range(rng.randrange(1, 6)))
+        v = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 8)))
+        ts = rng.randrange(1000)
+        prev = model.get(k)
+        m.set(k, v, ts)
+        if prev is None or ts >= prev[1]:
+            model[k] = (v, ts)
+    assert len(m) == len(model)
+    assert m.sorted_items() == [
+        (k, model[k]) for k in sorted(model)
+    ]
+    for k in list(model)[:200]:
+        assert m.get(k) == model[k]
+    assert m.get(b"\xff" * 9) is None
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native library unavailable"
+)
+def test_arena_flush_bytes_identical_to_sorted(tmp_dir):
+    """memtable_kind=arena must leave byte-identical SSTables vs the
+    sorted Python memtable (VERDICT round 1 #7 'Done' criterion)."""
+    import asyncio
+    import hashlib
+    import os
+
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def build(kind, sub):
+        d = os.path.join(tmp_dir, sub)
+        os.makedirs(d)
+        tree = LSMTree.open_or_create(
+            d, capacity=64, memtable_kind=kind
+        )
+        rng = random.Random(2)
+        for i in range(500):
+            k = f"key{rng.randrange(300):04}".encode()
+            await tree.set_with_timestamp(k, b"v%d" % i, 1000 + i)
+            if rng.random() < 0.1:
+                await tree.delete_with_timestamp(k, 2000 + i)
+        await tree.flush()
+        digest = hashlib.sha256()
+        for name in sorted(os.listdir(d)):
+            if name.endswith((".data", ".index")):
+                with open(os.path.join(d, name), "rb") as f:
+                    digest.update(name.encode())
+                    digest.update(f.read())
+        tree.close()
+        return digest.hexdigest()
+
+    async def main():
+        h_sorted = await build("sorted", "a")
+        h_arena = await build("arena", "b")
+        assert h_sorted == h_arena
+
+    from conftest import run
+
+    run(main())
