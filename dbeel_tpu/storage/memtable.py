@@ -152,6 +152,8 @@ class ArenaMemtable(MemtableBase):
             raise MemtableCapacityReached(
                 f"memtable at capacity {self.capacity}"
             )
+        if rc == -2:
+            raise MemoryError("arena memtable allocation failed")
         if rc == 0:
             self.data_bytes += 16 + len(key) + len(value)
         elif rc == 1:

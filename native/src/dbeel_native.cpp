@@ -546,7 +546,12 @@ struct ArenaMemtable {
 extern "C" {
 
 void* dbeel_memtable_new(uint32_t capacity) {
-  return new ArenaMemtable(capacity);
+  // No exception may cross the C ABI: allocation failure -> nullptr.
+  try {
+    return new ArenaMemtable(capacity);
+  } catch (...) {
+    return nullptr;
+  }
 }
 
 void dbeel_memtable_free(void* h) {
@@ -562,10 +567,11 @@ uint64_t dbeel_memtable_bytes(void* h) {
 }
 
 // Returns: 0 inserted new, 1 overwrote (old value length in
-// *old_val_len), 2 ignored (older timestamp), -1 capacity reached.
+// *old_val_len), 2 ignored (older timestamp), -1 capacity reached,
+// -2 allocation failure (no exception crosses the C ABI).
 int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
                            const uint8_t* value, uint32_t vlen,
-                           int64_t ts, uint32_t* old_val_len) {
+                           int64_t ts, uint32_t* old_val_len) try {
   auto* t = static_cast<ArenaMemtable*>(h);
   uint32_t parent = NIL;
   uint32_t cur = t->root;
@@ -613,6 +619,8 @@ int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
     t->nodes[parent].left = z;
   t->insert_fixup(z);
   return 0;
+} catch (...) {
+  return -2;
 }
 
 // Returns 1 + fills out-params if found, 0 otherwise.  The value

@@ -96,15 +96,18 @@ def test_replication_quorum_matrix(tmp_dir):
             client = await DbeelClient.from_seed_nodes(
                 [nodes[0].db_address]
             )
+            # Flow-event discipline (no sleep-polling): subscribe to
+            # CollectionCreated on every node BEFORE creating, then
+            # block on the gossip landing.
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
             col = await client.create_collection(
                 "replicated", replication_factor=3
             )
-            # Collection must exist on every node (gossiped).
+            await asyncio.wait_for(asyncio.gather(*created), 10)
             for n in nodes:
-                for attempt in range(100):
-                    if "replicated" in n.shards[0].collections:
-                        break
-                    await asyncio.sleep(0.01)
                 assert "replicated" in n.shards[0].collections
 
             # W=3 / R=1.

@@ -117,16 +117,30 @@ def test_background_compaction_scheduler_collapses_sstables(tmp_dir):
             )
             col = await client.create_collection("c")
             tree = node.shards[0].collections["c"].tree
-            done = node.shards[0].collections["c"].tree.flow.subscribe(
-                FlowEvent.COMPACTION_DONE
-            )
             for i in range(400):
                 await col.set(f"k{i:05}", "x" * 20)
-            await asyncio.wait_for(done, 15)
-            # Scheduler must have collapsed the flood of 32-entry
-            # flushes into fewer, larger tables.
-            indices = [i for i, _ in tree.sstable_indices_and_sizes()]
+            # Scheduler must collapse the flood of 32-entry flushes
+            # into fewer, larger tables.  The share throttle may space
+            # merges out while writes are in flight, so wait on
+            # COMPACTION_DONE until the tier actually collapses
+            # (subscribe before sampling — no missed wakeups).
             flushed = 400 // 32
+            deadline = asyncio.get_event_loop().time() + 60
+            while True:
+                done = tree.flow.subscribe(FlowEvent.COMPACTION_DONE)
+                indices = [
+                    i for i, _ in tree.sstable_indices_and_sizes()
+                ]
+                if len(indices) < flushed:
+                    break
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(done, remaining)
+                except asyncio.TimeoutError:
+                    break
+            indices = [i for i, _ in tree.sstable_indices_and_sizes()]
             assert len(indices) < flushed, (
                 f"no compaction happened: {indices}"
             )
@@ -136,4 +150,4 @@ def test_background_compaction_scheduler_collapses_sstables(tmp_dir):
         finally:
             await node.stop()
 
-    run(main(), timeout=60)
+    run(main(), timeout=120)

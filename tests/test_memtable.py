@@ -138,3 +138,24 @@ def test_arena_flush_bytes_identical_to_sorted(tmp_dir):
     from conftest import run
 
     run(main())
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native library unavailable"
+)
+def test_arena_bytes_bounded_under_updates():
+    """Update-heavy workload below capacity: the native byte arena
+    must reclaim superseded values (dead-byte compaction) instead of
+    growing without bound (dbeel_memtable_bytes observability hook)."""
+    m = ArenaMemtable(8192)
+    for rnd in range(100):
+        for i in range(500):
+            m.set(b"key%04d" % i, b"v" * (20 + rnd % 7), rnd * 1000 + i)
+    arena_bytes = int(m._lib.dbeel_memtable_bytes(m._handle))
+    live = sum(
+        len(k) + len(v) for k, (v, _) in m.sorted_items()
+    )
+    assert arena_bytes < 4 * live + (2 << 20), (
+        f"arena grew unbounded: {arena_bytes} vs live {live}"
+    )
+    assert m.get(b"key0000") == (b"v" * (20 + 99 % 7), 99 * 1000)
