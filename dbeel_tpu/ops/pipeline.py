@@ -157,10 +157,17 @@ def _choose_partitions(runs: List[_Run]):
     max_run = max((r.prefix64.size for r in runs), default=0)
     if max_run == 0:
         return np.zeros(0, dtype=">u8"), None, 8
+    # Prefer >=4 partitions: the pipeline's whole point is overlapping
+    # read/upload/kernel/download/write across partitions, so a
+    # padding-optimal single partition (e.g. 64 small runs whose
+    # max_run is already a near-pow2) would serialize every stage.
     parts = None
-    for cand in range(1, 65):
+    for cand in (*range(4, 65), 1, 2, 3):  # preference order
         p2 = _pow2(-(-max_run // cand))
-        if p2 <= _MAX_P2 and cand * p2 / max_run - 1.0 <= _PAD_WASTE_LIMIT:
+        if (
+            p2 <= _MAX_P2
+            and cand * p2 / max_run - 1.0 <= _PAD_WASTE_LIMIT
+        ):
             parts = cand
             break
     if parts is None:
@@ -255,7 +262,40 @@ def pipeline_merge(
 ) -> Optional[MergeResult]:
     """Run the partitioned pipeline.  Returns None when unavailable
     (no native lib / no jax / pathological prefix skew) — the caller
-    falls back to the single-shot path."""
+    falls back to the single-shot path.
+
+    Set ``DBEEL_PROFILE_DIR`` to capture a JAX profiler trace of the
+    device stages (viewable in TensorBoard/XProf) — the SURVEY §5
+    observability improvement over the reference's logs-only stance."""
+    import os as _os
+
+    profile_dir = _os.environ.get("DBEEL_PROFILE_DIR")
+    if profile_dir:
+        try:
+            import jax
+        except Exception:
+            jax = None  # impl returns None below, caller falls back
+        if jax is not None:
+            with jax.profiler.trace(profile_dir):
+                return _pipeline_merge_impl(
+                    sources,
+                    dir_path,
+                    output_index,
+                    keep_tombstones,
+                    bloom_min_size,
+                )
+    return _pipeline_merge_impl(
+        sources, dir_path, output_index, keep_tombstones, bloom_min_size
+    )
+
+
+def _pipeline_merge_impl(
+    sources: Sequence,
+    dir_path: str,
+    output_index: int,
+    keep_tombstones: bool,
+    bloom_min_size: int,
+) -> Optional[MergeResult]:
     from ..storage import native as native_mod
 
     lib = native_mod.load_if_built()

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from collections import deque
 from typing import Optional
 
@@ -283,6 +284,8 @@ _REAP_PERIOD_S = 30.0
 async def _serve_frame(my_shard: MyShard, request_buf: bytes):
     """One request frame → (response bytes incl. trailing type byte,
     keepalive?)."""
+    started = time.monotonic()
+    op = "invalid"
     keepalive = False
     try:
         try:
@@ -291,6 +294,7 @@ async def _serve_frame(my_shard: MyShard, request_buf: bytes):
             raise BadFieldType(f"document: {e}") from e
         if not isinstance(req, dict):
             raise BadFieldType("document")
+        op = str(req.get("type", "invalid"))
         keepalive = bool(req.get("keepalive"))
         payload = await handle_request(my_shard, req)
         if payload is None:
@@ -308,6 +312,7 @@ async def _serve_frame(my_shard: MyShard, request_buf: bytes):
         buf = msgpack.packb(
             ["Internal", str(e)], use_bin_type=True
         ) + bytes([RESPONSE_ERR])
+    my_shard.metrics.record_request(op, started)
     return buf, keepalive
 
 

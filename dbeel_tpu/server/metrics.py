@@ -1,0 +1,120 @@
+"""Structured per-shard metrics: latency histograms + slow-op log.
+
+SURVEY.md §5 marks observability as the axis to IMPROVE on (the
+reference has logs only; its latency visibility lives entirely in
+blackbox_bench's client-side percentile report).  Here every served
+request is recorded into a log-bucketed latency histogram per op type,
+queryable over the wire via ``get_stats`` — so an operator reads
+p50/p99/p999 per shard from the live system, no external bench needed.
+
+Design: power-of-two microsecond buckets (1µs … ~67s, 27 buckets).
+Recording is two integer ops (bit_length + increment) — nanoseconds of
+overhead on the serving path.  Percentiles are reconstructed
+server-side at query time from bucket counts (upper-bound estimate,
+within 2× worst case, far tighter in the populated range).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+_BUCKETS = 27  # 2^0 .. 2^26 µs (~67 s)
+
+
+class LatencyHistogram:
+    __slots__ = ("counts", "count", "total_us", "max_us")
+
+    def __init__(self) -> None:
+        self.counts = [0] * _BUCKETS
+        self.count = 0
+        self.total_us = 0
+        self.max_us = 0
+
+    def record_us(self, us: int) -> None:
+        b = min(_BUCKETS - 1, max(0, int(us).bit_length() - 1))
+        self.counts[b] += 1
+        self.count += 1
+        self.total_us += us
+        if us > self.max_us:
+            self.max_us = us
+
+    def percentile_us(self, q: float) -> Optional[int]:
+        """Upper-bound estimate of the q-quantile in µs."""
+        if self.count == 0:
+            return None
+        import math
+
+        # Nearest-rank (ceil) convention; epsilon guards float fuzz
+        # like 8 * 0.999 = 7.992000000000001.
+        target = max(1, math.ceil(self.count * q - 1e-9))
+        seen = 0
+        for b, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return 1 << (b + 1)  # bucket upper bound
+        return self.max_us
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_us": (
+                round(self.total_us / self.count, 1) if self.count else None
+            ),
+            "p50_us": self.percentile_us(0.50),
+            "p90_us": self.percentile_us(0.90),
+            "p99_us": self.percentile_us(0.99),
+            "p999_us": self.percentile_us(0.999),
+            "max_us": self.max_us,
+        }
+
+
+class ShardMetrics:
+    """Per-shard metrics hub: request histograms by op type, a slow-op
+    threshold log, and background-stage counters."""
+
+    SLOW_OP_US = 100_000  # ops slower than 100ms get one log line
+    # Histograms are keyed by the CLIENT-supplied request type: cap the
+    # key set so garbage types can't grow shard memory / stats output.
+    KNOWN_OPS = frozenset(
+        {
+            "set",
+            "get",
+            "delete",
+            "create_collection",
+            "drop_collection",
+            "get_collection",
+            "get_cluster_metadata",
+            "get_stats",
+            "invalid",
+        }
+    )
+
+    def __init__(self) -> None:
+        self.requests: Dict[str, LatencyHistogram] = {}
+        self.slow_ops = 0
+
+    def record_request(self, op: str, started: float) -> None:
+        """``started`` from time.monotonic() at frame receipt."""
+        us = int((time.monotonic() - started) * 1e6)
+        if op not in self.KNOWN_OPS:
+            op = "other"
+        hist = self.requests.get(op)
+        if hist is None:
+            hist = self.requests[op] = LatencyHistogram()
+        hist.record_us(us)
+        if us >= self.SLOW_OP_US:
+            self.slow_ops += 1
+            log.warning("slow %s: %.1f ms", op, us / 1e3)
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": {
+                op: hist.snapshot()
+                for op, hist in self.requests.items()
+            },
+            "slow_ops": self.slow_ops,
+        }

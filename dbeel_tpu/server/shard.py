@@ -137,6 +137,9 @@ class MyShard:
             config.foreground_tasks_shares,
             config.background_tasks_shares,
         )
+        from .metrics import ShardMetrics
+
+        self.metrics = ShardMetrics()
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
         # Live public-API connections (protocol objects) for the
@@ -360,6 +363,7 @@ class MyShard:
                 "misses": self.cache.misses,
             },
             "scheduler": self.scheduler.stats(),
+            "metrics": self.metrics.snapshot(),
             "collections": collections,
         }
 

@@ -432,19 +432,29 @@ struct ArenaMemtable {
   // Reclaim superseded value bytes once they exceed the live set:
   // update-heavy workloads (same keys rewritten below capacity) would
   // otherwise grow the byte arena without ever triggering a flush.
+  // Strong exception safety: new offsets are staged in side arrays and
+  // committed only after every copy succeeded — an allocation failure
+  // mid-compaction must leave the memtable exactly as it was (the
+  // triggering set already succeeded; compaction is opportunistic and
+  // its failure is swallowed by the caller).
   void maybe_compact() {
     if (bytes.size() - live_bytes <= live_bytes + (1u << 20)) return;
     std::vector<uint8_t> fresh;
     fresh.reserve(live_bytes);
-    for (MemNode& n : nodes) {
-      const uint64_t ko = fresh.size();
+    std::vector<uint64_t> key_offs(nodes.size());
+    std::vector<uint64_t> val_offs(nodes.size());
+    for (size_t i = 0; i < nodes.size(); i++) {
+      const MemNode& n = nodes[i];
+      key_offs[i] = fresh.size();
       fresh.insert(fresh.end(), bytes.begin() + n.key_off,
                    bytes.begin() + n.key_off + n.key_len);
-      const uint64_t vo = fresh.size();
+      val_offs[i] = fresh.size();
       fresh.insert(fresh.end(), bytes.begin() + n.val_off,
                    bytes.begin() + n.val_off + n.val_len);
-      n.key_off = ko;
-      n.val_off = vo;
+    }
+    for (size_t i = 0; i < nodes.size(); i++) {  // commit (no-throw)
+      nodes[i].key_off = key_offs[i];
+      nodes[i].val_off = val_offs[i];
     }
     bytes.swap(fresh);
   }
@@ -588,12 +598,21 @@ int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
         std::memcpy(t->bytes.data() + n.val_off, value, vlen);
         t->live_bytes -= n.val_len - vlen;
       } else {
-        t->live_bytes += (uint64_t)vlen - n.val_len;
+        // Counter updates only AFTER the throwing append: a bad_alloc
+        // surfacing as rc=-2 must not leave live_bytes overstated
+        // (it drives the dead-byte compaction heuristic).
         n.val_off = t->append_bytes(value, vlen);
+        t->live_bytes += (uint64_t)vlen - n.val_len;
       }
       n.val_len = vlen;
       n.ts = ts;
-      t->maybe_compact();
+      // The write itself is committed at this point: an allocation
+      // failure inside opportunistic compaction must NOT surface as a
+      // failed set.
+      try {
+        t->maybe_compact();
+      } catch (...) {
+      }
       return 1;
     }
     cur = c < 0 ? t->nodes[cur].right : t->nodes[cur].left;
@@ -608,9 +627,9 @@ int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
   n.val_off = t->append_bytes(value, vlen);
   n.val_len = vlen;
   n.ts = ts;
-  t->live_bytes += (uint64_t)klen + vlen;
   const uint32_t z = (uint32_t)t->nodes.size();
-  t->nodes.push_back(n);
+  t->nodes.push_back(n);  // can't realloc-throw: reserved to capacity
+  t->live_bytes += (uint64_t)klen + vlen;
   if (parent == NIL)
     t->root = z;
   else if (c < 0)
