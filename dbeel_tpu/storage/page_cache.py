@@ -34,35 +34,53 @@ def align_up(n: int) -> int:
 
 
 class _CountMinSketch:
-    """4-bit frequency sketch with conservative reset, a la TinyLFU."""
+    """4-bit frequency sketch with conservative reset, a la TinyLFU.
+
+    The table is one flat bytearray (scalar bytearray indexing costs
+    ~50 ns vs ~1 µs for a numpy scalar access): increment/estimate run
+    on EVERY page-cache get and set, so they sit squarely on the
+    serving path's per-probe cost."""
 
     def __init__(self, capacity: int) -> None:
         size = 1
         while size < max(64, capacity):
             size <<= 1
         self._mask = size - 1
-        self._table = np.zeros((4, size), dtype=np.uint8)
+        self._size = size
+        self._table = bytearray(4 * size)
         self._ops = 0
         self._reset_at = 10 * size
 
     _ROW_SEEDS = (0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F)
 
-    def _rows(self, h: int):
-        for row in range(4):
-            mixed = (h ^ self._ROW_SEEDS[row]) * 0x9E3779B1 & 0xFFFFFFFF
-            yield row, (mixed >> 12) & self._mask
+    def _indices(self, h: int):
+        mask = self._mask
+        size = self._size
+        s0, s1, s2, s3 = self._ROW_SEEDS
+        return (
+            ((h ^ s0) * 0x9E3779B1 & 0xFFFFFFFF) >> 12 & mask,
+            size + (((h ^ s1) * 0x9E3779B1 & 0xFFFFFFFF) >> 12 & mask),
+            2 * size
+            + (((h ^ s2) * 0x9E3779B1 & 0xFFFFFFFF) >> 12 & mask),
+            3 * size
+            + (((h ^ s3) * 0x9E3779B1 & 0xFFFFFFFF) >> 12 & mask),
+        )
 
     def increment(self, h: int) -> None:
-        for row, idx in self._rows(h):
-            if self._table[row, idx] < 15:
-                self._table[row, idx] += 1
+        table = self._table
+        for i in self._indices(h):
+            if table[i] < 15:
+                table[i] += 1
         self._ops += 1
         if self._ops >= self._reset_at:
-            self._table >>= 1
+            # Rare: halve all counters in one vectorized pass.
+            arr = np.frombuffer(self._table, dtype=np.uint8)
+            np.right_shift(arr, 1, out=arr)
             self._ops //= 2
 
     def estimate(self, h: int) -> int:
-        return min(int(self._table[row, idx]) for row, idx in self._rows(h))
+        table = self._table
+        return min(table[i] for i in self._indices(h))
 
 
 class PageCache:

@@ -10,6 +10,8 @@ from __future__ import annotations
 import asyncio
 import os
 import threading
+from array import array
+from bisect import bisect_left, bisect_right
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
@@ -119,10 +121,8 @@ class SSTable:
                 words = columnar.prefix_words(
                     data, offs.astype(np.uint64), ks
                 )
-                prefix = (
-                    words[:, 0].astype(np.uint64) << np.uint64(32)
-                ) | words[:, 1].astype(np.uint64)
-                self._fast = (prefix, offs, ks, fs)
+                p1, p2 = self._prefix_pair(words)
+                self._fast = (p1, p2, offs, ks, fs)
             else:
                 stride = self.SPARSE_STRIDE
                 # memmap both files: only the touched pages are read
@@ -146,12 +146,38 @@ class SSTable:
                     self.data_path, dtype=np.uint8, mode="r"
                 )
                 words = columnar.prefix_words(data, s_offs, s_ks)
-                prefix = (
-                    words[:, 0].astype(np.uint64) << np.uint64(32)
-                ) | words[:, 1].astype(np.uint64)
                 del data
-                self._sparse = (prefix, stride)
+                p1, p2 = self._prefix_pair(words)
+                self._sparse = (p1, p2, stride)
             self._fast_tried = True
+
+    @staticmethod
+    def _prefix_pair(words: "np.ndarray"):
+        """Two-level 16-byte prefix as a pair of sorted array('Q')s:
+        bytes 0-8 and bytes 8-16.  Realistic keyspaces cluster under a
+        shared head ("user:...", "key-000..."), which collapses a
+        single 8-byte prefix index into one giant tie range and turns
+        every get into a full-table page-cache binary search; the
+        second level re-narrows inside first-level ties via
+        bisect(lo, hi) at the same O(log) cost."""
+        p1 = (
+            words[:, 0].astype(np.uint64) << np.uint64(32)
+        ) | words[:, 1].astype(np.uint64)
+        p2 = (
+            words[:, 2].astype(np.uint64) << np.uint64(32)
+        ) | words[:, 3].astype(np.uint64)
+        return SSTable._as_q(p1), SSTable._as_q(p2)
+
+    @staticmethod
+    def _as_q(prefix: "np.ndarray") -> array:
+        """stdlib array('Q') of the sorted prefixes: bisect on it costs
+        ~0.8µs/probe vs ~3µs for a numpy searchsorted at point-lookup
+        sizes (scalar-call overhead dominates tiny queries)."""
+        q = array("Q")
+        # native byte order: array('Q') decodes machine-endian, and the
+        # probe values are plain Python ints.
+        q.frombytes(prefix.astype("=u8").tobytes())
+        return q
 
     def warm(self) -> None:
         """Executor hook: build the read index off-loop so first reads
@@ -160,11 +186,19 @@ class SSTable:
 
     def _sparse_range(self, key: bytes) -> Tuple[int, int]:
         """Candidate [lo, hi) entry range for ``key`` from the sparse
-        sampled prefixes."""
-        prefix, stride = self._sparse
-        w = np.uint64(self._key_prefix64(key))
-        lo_s = int(np.searchsorted(prefix, w, side="left"))
-        hi_s = int(np.searchsorted(prefix, w, side="right"))
+        sampled two-level prefixes."""
+        p1, p2, stride = self._sparse
+        w1 = self._key_prefix64(key)
+        lo_s = bisect_left(p1, w1)
+        hi_s = bisect_right(p1, w1)
+        if hi_s - lo_s > 1:
+            w2 = self._key_prefix64b(key)
+            lo_s = bisect_left(p2, w2, lo_s, hi_s)
+            hi_s = bisect_right(p2, w2, lo_s, hi_s)
+        # One sample of slack on the left (the -1) and right (the
+        # hi_s-th sample is the first PAST the match, and entries up
+        # to it may still match): entries between samples are not
+        # represented in p1/p2.
         lo = (lo_s - 1) * stride if lo_s > 0 else 0
         hi = min(self.entry_count, hi_s * stride)
         return lo, hi
@@ -173,14 +207,22 @@ class SSTable:
     def _key_prefix64(key: bytes) -> int:
         return int.from_bytes(key[:8].ljust(8, b"\x00"), "big")
 
+    @staticmethod
+    def _key_prefix64b(key: bytes) -> int:
+        return int.from_bytes(key[8:16].ljust(8, b"\x00"), "big")
+
     def _lookup_range(self, key: bytes):
         """(lo, hi, arrays|None): candidate entry range + in-RAM index
         columns when the dense index is present."""
         if self._fast is not None:
-            prefix, offs, ks, fs = self._fast
-            w = np.uint64(self._key_prefix64(key))
-            lo = int(np.searchsorted(prefix, w, side="left"))
-            hi = int(np.searchsorted(prefix, w, side="right"))
+            p1, p2, offs, ks, fs = self._fast
+            w = self._key_prefix64(key)
+            lo = bisect_left(p1, w)
+            hi = bisect_right(p1, w)
+            if hi - lo > 1:
+                w2 = self._key_prefix64b(key)
+                lo = bisect_left(p2, w2, lo, hi)
+                hi = bisect_right(p2, w2, lo, hi)
             return lo, hi, (offs, ks, fs)
         if self._sparse is not None:
             lo, hi = self._sparse_range(key)

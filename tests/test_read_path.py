@@ -120,10 +120,12 @@ def test_big_table_uses_sparse_not_nothing(tmp_dir, monkeypatch):
     table.warm()
     assert table._fast is None
     assert table._sparse is not None
-    prefix, stride = table._sparse
-    assert prefix.size == -(-500 // stride)
-    # Sampled prefixes must be sorted (searchsorted precondition).
-    assert (np.diff(prefix.astype(np.uint64)) >= 0).all()
+    p1, p2, stride = table._sparse
+    assert len(p1) == len(p2) == -(-500 // stride)
+    # First-level sampled prefixes must be sorted (bisect
+    # precondition); the second level is sorted within level-1 ties.
+    vals = np.frombuffer(p1, dtype=np.uint64)
+    assert (np.diff(vals) >= 0).all()
     k, v, ts = entries[123]
     assert table.get(k) == (v, ts)
     table.close()
