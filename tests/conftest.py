@@ -46,3 +46,32 @@ def run(coro, timeout: float = 10.0):
 @pytest.fixture
 def arun():
     return run
+
+
+def write_sstable_fixture(dir_path, idx, entries):
+    """Shared test fixture writer: a raw sorted sstable (data+index)
+    from (key, value, ts) triples — the on-disk layout in one place."""
+    import numpy as np
+
+    from dbeel_tpu.storage.entry import (
+        DATA_FILE_EXT,
+        INDEX_FILE_EXT,
+        encode_entry,
+        file_name,
+    )
+
+    data = b"".join(encode_entry(k, v, ts) for k, v, ts in entries)
+    index = np.zeros(
+        len(entries),
+        dtype=np.dtype(
+            [("offset", "<u8"), ("key_size", "<u4"), ("full_size", "<u4")]
+        ),
+    )
+    off = 0
+    for i, (k, v, ts) in enumerate(entries):
+        index[i] = (off, len(k), 16 + len(k) + len(v))
+        off += 16 + len(k) + len(v)
+    with open(f"{dir_path}/{file_name(idx, DATA_FILE_EXT)}", "wb") as f:
+        f.write(data)
+    with open(f"{dir_path}/{file_name(idx, INDEX_FILE_EXT)}", "wb") as f:
+        f.write(index.tobytes())

@@ -13,34 +13,11 @@ import random
 import numpy as np
 import pytest
 
-from dbeel_tpu.storage.entry import (
-    DATA_FILE_EXT,
-    INDEX_FILE_EXT,
-    encode_entry,
-    file_name,
-)
 from dbeel_tpu.storage.page_cache import PageCache, PartitionPageCache
 from dbeel_tpu.storage.sstable import SSTable
 
-from conftest import run
+from conftest import run, write_sstable_fixture
 
-
-def _write_table(dir_path, idx, entries):
-    data = b"".join(encode_entry(k, v, ts) for k, v, ts in entries)
-    index = np.zeros(
-        len(entries),
-        dtype=np.dtype(
-            [("offset", "<u8"), ("key_size", "<u4"), ("full_size", "<u4")]
-        ),
-    )
-    off = 0
-    for i, (k, v, ts) in enumerate(entries):
-        index[i] = (off, len(k), 16 + len(k) + len(v))
-        off += 16 + len(k) + len(v)
-    with open(f"{dir_path}/{file_name(idx, DATA_FILE_EXT)}", "wb") as f:
-        f.write(data)
-    with open(f"{dir_path}/{file_name(idx, INDEX_FILE_EXT)}", "wb") as f:
-        f.write(index.tobytes())
 
 
 def _entries(n, seed=1):
@@ -58,7 +35,7 @@ def _entries(n, seed=1):
 @pytest.mark.parametrize("mode", ["dense", "sparse", "disk"])
 def test_get_finds_every_key_and_rejects_absent(tmp_dir, mode, monkeypatch):
     entries = _entries(800)
-    _write_table(tmp_dir, 0, entries)
+    write_sstable_fixture(tmp_dir, 0, entries)
     if mode == "sparse":
         # Force the sparse path: dense caps below the table size.
         monkeypatch.setattr(SSTable, "FAST_INDEX_MAX_ENTRIES", 100)
@@ -88,7 +65,7 @@ def test_get_finds_every_key_and_rejects_absent(tmp_dir, mode, monkeypatch):
 @pytest.mark.parametrize("mode", ["dense", "sparse"])
 def test_get_async_matches_sync(tmp_dir, mode, monkeypatch):
     entries = _entries(600, seed=3)
-    _write_table(tmp_dir, 0, entries)
+    write_sstable_fixture(tmp_dir, 0, entries)
     if mode == "sparse":
         monkeypatch.setattr(SSTable, "FAST_INDEX_MAX_ENTRIES", 100)
         monkeypatch.setattr(SSTable, "SPARSE_STRIDE", 8)
@@ -115,7 +92,7 @@ def test_big_table_uses_sparse_not_nothing(tmp_dir, monkeypatch):
     index.  Now it must build the sparse one (and answer from it)."""
     monkeypatch.setattr(SSTable, "FAST_INDEX_MAX_ENTRIES", 50)
     entries = _entries(500, seed=7)
-    _write_table(tmp_dir, 0, entries)
+    write_sstable_fixture(tmp_dir, 0, entries)
     table = SSTable(tmp_dir, 0, None)
     table.warm()
     assert table._fast is None
