@@ -74,6 +74,7 @@ class DbeelClient:
     ):
         self._seeds = list(seed_addresses)
         self._ring: List[_RingShard] = []
+        self._ring_hashes: List[int] = []
         self._collections: dict = {}
         self._pooled = pooled
         self._pool: dict = {}  # (host, port) -> [(reader, writer)]
@@ -120,6 +121,7 @@ class DbeelClient:
                 )
         ring.sort(key=lambda s: s.hash)
         self._ring = ring
+        self._ring_hashes = [s.hash for s in ring]
         self._collections = {
             name: rf for name, rf in metadata.collections
         }
@@ -197,14 +199,11 @@ class DbeelClient:
         distinct nodes — the replica walk."""
         if not self._ring:
             raise ConnectionError_("empty ring; sync_metadata first")
-        start = next(
-            (
-                i
-                for i, s in enumerate(self._ring)
-                if s.hash >= key_hash
-            ),
-            0,
-        )
+        from bisect import bisect_left
+
+        start = bisect_left(self._ring_hashes, key_hash)
+        if start == len(self._ring):
+            start = 0
         out: List[_RingShard] = []
         seen_nodes: set = set()
         for off in range(len(self._ring)):

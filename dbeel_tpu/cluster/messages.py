@@ -94,6 +94,9 @@ class ShardRequest:
     SET = "set"
     DELETE = "delete"
     GET = "get"
+    RANGE_DIGEST = "range_digest"
+    RANGE_PULL = "range_pull"
+    RANGE_PUSH = "range_push"
 
     @staticmethod
     def ping() -> list:
@@ -127,6 +130,48 @@ class ShardRequest:
     def get(collection: str, key: bytes) -> list:
         return ["request", ShardRequest.GET, collection, key]
 
+    @staticmethod
+    def range_digest(collection: str, start: int, end: int) -> list:
+        """Anti-entropy probe: order-independent digest of (key, ts)
+        pairs whose key hash falls in the half-open wrap range
+        [start, end)."""
+        return [
+            "request",
+            ShardRequest.RANGE_DIGEST,
+            collection,
+            start,
+            end,
+        ]
+
+    @staticmethod
+    def range_pull(
+        collection: str,
+        start: int,
+        end: int,
+        start_after: Optional[bytes],
+        limit: int,
+    ) -> list:
+        """Anti-entropy page fetch: up to ``limit`` (key, value, ts)
+        triples in the range, keys > start_after."""
+        return [
+            "request",
+            ShardRequest.RANGE_PULL,
+            collection,
+            start,
+            end,
+            start_after,
+            limit,
+        ]
+
+    @staticmethod
+    def range_push(collection: str, entries: list) -> list:
+        """Anti-entropy batch apply: the receiver applies each
+        (key, value, ts) ONLY when newer than its own newest for that
+        key — unlike plain Set events, an older pushed entry can never
+        shadow a newer value already flushed to the receiver's
+        sstables."""
+        return ["request", ShardRequest.RANGE_PUSH, collection, entries]
+
 
 class ShardResponse:
     PONG = "pong"
@@ -137,6 +182,9 @@ class ShardResponse:
     SET = "set"
     DELETE = "delete"
     GET = "get"
+    RANGE_DIGEST = "range_digest"
+    RANGE_PULL = "range_pull"
+    RANGE_PUSH = "range_push"
     ERROR = "error"
 
     @staticmethod
@@ -171,6 +219,15 @@ class ShardResponse:
             ShardResponse.GET,
             list(entry) if entry is not None else None,
         ]
+
+    @staticmethod
+    def range_digest(count: int, digest: int) -> list:
+        return ["response", ShardResponse.RANGE_DIGEST, count, digest]
+
+    @staticmethod
+    def range_pull(entries: list) -> list:
+        # entries: [[key, value, ts], ...] sorted by key
+        return ["response", ShardResponse.RANGE_PULL, entries]
 
     @staticmethod
     def error(err: DbeelError) -> list:

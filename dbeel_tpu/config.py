@@ -42,6 +42,9 @@ class Config:
     sstable_bloom_min_size: int = 1 << 20
     foreground_tasks_shares: int = 1000
     background_tasks_shares: int = 250
+    # Anti-entropy digest-compare interval per shard; 0 disables.
+    # (Beyond-reference: the reference has no anti-entropy.)
+    anti_entropy_interval_ms: int = 60_000
 
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
@@ -124,6 +127,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--background-tasks-shares", type=int,
         default=d.background_tasks_shares,
     )
+    p.add_argument(
+        "--anti-entropy-interval",
+        type=int,
+        dest="anti_entropy_interval_ms",
+        default=d.anti_entropy_interval_ms,
+        help="anti-entropy digest-compare interval in ms (0 disables)",
+    )
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
@@ -180,6 +190,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         sstable_bloom_min_size=ns.sstable_bloom_min_size,
         foreground_tasks_shares=ns.foreground_tasks_shares,
         background_tasks_shares=ns.background_tasks_shares,
+        anti_entropy_interval_ms=ns.anti_entropy_interval_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,

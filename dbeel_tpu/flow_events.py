@@ -30,6 +30,8 @@ class FlowEvent(enum.Enum):
     WAL_SYNCED = "WalSynced"
     READ_REPAIR = "ReadRepair"
     HINTS_REPLAYED = "HintsReplayed"
+    ANTI_ENTROPY_DONE = "AntiEntropyDone"
+    ANTI_ENTROPY_SYNCED = "AntiEntropySynced"  # a mismatch was repaired
 
 
 _enabled = False

@@ -37,7 +37,7 @@ from ..storage.compaction import get_strategy
 from ..storage.lsm_tree import LSMTree
 from ..storage.page_cache import PageCache, PartitionPageCache
 from ..utils.event import LocalEvent
-from ..utils.murmur import hash_string
+from ..utils.murmur import hash_bytes, hash_string
 from ..cluster import messages as msgs
 from ..cluster.local_comm import LocalShardConnection
 from ..cluster.messages import (
@@ -712,7 +712,132 @@ class MyShard:
             if col is not None:
                 entry = await col.tree.get_entry(bytes(request[3]))
             return ShardResponse.get(entry)
+        if kind == ShardRequest.RANGE_DIGEST:
+            col = self.collections.get(request[2])
+            count, digest = 0, 0
+            if col is not None:
+                count, digest = await self.compute_range_digest(
+                    col.tree, request[3], request[4]
+                )
+            return ShardResponse.range_digest(count, digest)
+        if kind == ShardRequest.RANGE_PULL:
+            col = self.collections.get(request[2])
+            entries: list = []
+            if col is not None:
+                entries = await self.collect_range_page(
+                    col.tree,
+                    request[3],
+                    request[4],
+                    bytes(request[5]) if request[5] is not None else None,
+                    int(request[6]),
+                )
+            return ShardResponse.range_pull(entries)
+        if kind == ShardRequest.RANGE_PUSH:
+            col = self.collections.get(request[2])
+            if col is None:
+                raise CollectionNotFound(request[2])
+            for key, value, ts in request[3]:
+                await self.apply_if_newer(
+                    col.tree, bytes(key), bytes(value), int(ts)
+                )
+            return ShardResponse.empty(ShardResponse.RANGE_PUSH)
         raise DbeelError(f"unknown shard request {kind!r}")
+
+    # ------------------------------------------------------------------
+    # Anti-entropy primitives (no reference analog — SURVEY §5 lists
+    # anti-entropy as a gap in the reference's replication design,
+    # alongside hinted handoff and read repair, both also added here)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    async def apply_if_newer(
+        tree, key: bytes, value: bytes, ts: int
+    ) -> bool:
+        """Write (key, value, ts) only if strictly newer than the local
+        newest for that key (checks sstables too, not just the
+        memtable).  The anti-entropy apply primitive: a replayed old
+        entry must never shadow a newer value that was already flushed
+        out of the memtable."""
+        local = await tree.get_entry(key)
+        if local is not None and local[1] >= ts:
+            return False
+        await tree.set_with_timestamp(key, value, ts)
+        return True
+
+    @staticmethod
+    def _in_ae_range(h: int, start: int, end: int) -> bool:
+        """Anti-entropy range membership.  ``start``/``end`` are the
+        primary ownership range (prev, self] pre-shifted by +1 into
+        half-open [start, end) form; start == end means the shard's
+        single ring point covers the whole ring."""
+        from .migration import _between
+
+        return start == end or _between(h, start, end)
+
+    @staticmethod
+    async def compute_range_digest(
+        tree, start: int, end: int
+    ) -> Tuple[int, int]:
+        """Order-independent 64-bit digest over (key, newest-ts) pairs
+        in the anti-entropy range.  Tombstones count (their deletions
+        must converge too)."""
+        from ..utils.murmur import murmur3_32
+
+        newest: Dict[bytes, int] = {}
+        async for key, _value, ts in tree.iter_filter(
+            lambda k, v, t: MyShard._in_ae_range(
+                hash_bytes(k), start, end
+            )
+        ):
+            prev = newest.get(key)
+            if prev is None or ts > prev:
+                newest[key] = ts
+        digest = 0
+        for key, ts in newest.items():
+            blob = key + ts.to_bytes(8, "little", signed=True)
+            digest ^= murmur3_32(blob, 0x0A57E4A1) | (
+                murmur3_32(blob, 0x51C6E57A) << 32
+            )
+        return len(newest), digest
+
+    @staticmethod
+    async def collect_range_entries(
+        tree, start: int, end: int
+    ) -> list:
+        """ALL (key, value, newest-ts) triples in the anti-entropy
+        range, ascending by key — materialized once so sync paging
+        doesn't rescan the tree per page."""
+        newest: Dict[bytes, Tuple[bytes, int]] = {}
+        async for key, value, ts in tree.iter_filter(
+            lambda k, v, t: MyShard._in_ae_range(
+                hash_bytes(k), start, end
+            )
+        ):
+            prev = newest.get(key)
+            if prev is None or ts > prev[1]:
+                newest[key] = (value, ts)
+        return [
+            [k, v, ts] for k, (v, ts) in sorted(newest.items())
+        ]
+
+    @staticmethod
+    async def collect_range_page(
+        tree,
+        start: int,
+        end: int,
+        start_after: Optional[bytes],
+        limit: int,
+    ) -> list:
+        """Up to ``limit`` entries with key > start_after (the
+        stateless remote paging entry point)."""
+        entries = await MyShard.collect_range_entries(tree, start, end)
+        if start_after is not None:
+            from bisect import bisect_right
+
+            keys = [e[0] for e in entries]
+            lo = bisect_right(keys, start_after)
+            entries = entries[lo:]
+        return entries[:limit]
 
     # ------------------------------------------------------------------
     # Gossip (shards.rs:791-827, 1131-1200)

@@ -13,6 +13,7 @@ import asyncio
 import logging
 import random
 from ..errors import DbeelError, ShardStopped
+from ..flow_events import FlowEvent
 from ..cluster import messages as msgs
 from ..cluster.local_comm import ShardPacket
 from ..cluster.messages import ShardEvent, ShardResponse
@@ -213,6 +214,157 @@ async def run_compaction_loop(my_shard: MyShard) -> None:
                 await compact_tree(
                     trees[i], compaction_factor, my_shard.scheduler
                 )
+
+
+# ----------------------------------------------------------------------
+# Anti-entropy (beyond-reference: SURVEY §5 lists anti-entropy as a gap
+# in the reference's replication design).  Each shard periodically
+# compares a digest of its PRIMARY range — (ring predecessor, self] —
+# with the rf-1 distinct-node successors that replicate it; on
+# mismatch it pushes its entries (batched RANGE_PUSH, applied on the
+# peer only when strictly newer than the peer's newest — never through
+# raw Set events, which could shadow newer flushed values) and pulls
+# the peer's (same strictly-newer guard locally), so both sides
+# converge on the union.  Every unit runs under the share scheduler.
+#
+# Known caveats (documented, Cassandra has the same fundamentals):
+#  * Granularity is the whole primary range: one diverged key
+#    transfers the range's entries (the strictly-newer guard makes the
+#    applies no-ops, but the bytes still cross).  Sub-range/merkle
+#    digests are the refinement path.
+#  * Bottom-level compaction drops tombstones (reference parity); a
+#    replica that GC'd a delete before every peer saw it can have the
+#    old value resurrected by a later sync — the classic
+#    tombstone-GC-before-repair window (Cassandra's gc_grace).  Keep
+#    the anti-entropy interval well below compaction churn.
+# ----------------------------------------------------------------------
+
+ANTI_ENTROPY_PAGE = 2048
+
+
+async def _sync_range_with_peer(
+    my_shard, name, tree, peer, start, end, count, digest
+):
+    from ..cluster.messages import ShardRequest, ShardResponse
+
+    resp = await peer.connection.send_request(
+        ShardRequest.range_digest(name, start, end)
+    )
+    msgs.response_to_result(resp, ShardResponse.RANGE_DIGEST)
+    p_count, p_digest = resp[2], resp[3]
+    if (count, digest) == (p_count, p_digest):
+        return False
+
+    # Push ours in batched pages from ONE materialized range snapshot;
+    # the peer applies strictly-newer only.
+    mine = await my_shard.collect_range_entries(tree, start, end)
+    pushed = 0
+    for off in range(0, len(mine), ANTI_ENTROPY_PAGE):
+        page = mine[off : off + ANTI_ENTROPY_PAGE]
+        async with my_shard.scheduler.bg_slice():
+            msgs.response_to_result(
+                await peer.connection.send_request(
+                    ShardRequest.range_push(name, page)
+                ),
+                ShardResponse.RANGE_PUSH,
+            )
+        pushed += len(page)
+    # ...and pull theirs, applying only strictly-newer entries.
+    pulled = 0
+    page_after = None
+    while True:
+        resp = await peer.connection.send_request(
+            ShardRequest.range_pull(
+                name, start, end, page_after, ANTI_ENTROPY_PAGE
+            )
+        )
+        entries = msgs.response_to_result(
+            resp, ShardResponse.RANGE_PULL
+        )
+        if not entries:
+            break
+        async with my_shard.scheduler.bg_slice():
+            for key, value, ts in entries:
+                if await my_shard.apply_if_newer(
+                    tree, bytes(key), bytes(value), int(ts)
+                ):
+                    pulled += 1
+        if len(entries) < ANTI_ENTROPY_PAGE:
+            break
+        page_after = bytes(entries[-1][0])
+    if pushed or pulled:
+        log.info(
+            "anti-entropy %s with %s: pushed %d, applied %d pulled",
+            name,
+            peer.name,
+            pushed,
+            pulled,
+        )
+    my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_SYNCED)
+    return True
+
+
+async def run_anti_entropy(my_shard: MyShard) -> None:
+    interval = my_shard.config.anti_entropy_interval_ms / 1000.0
+    if interval <= 0:
+        return
+    while True:
+        await asyncio.sleep(interval)
+        # Primary ownership range is (predecessor, self] — shift both
+        # ends by +1 into the half-open form the range filter takes
+        # (a key hashing exactly onto our ring point IS ours; one on
+        # the predecessor's point is NOT).  start == end after the
+        # shift means we are the only ring point: the whole ring.
+        prev_hash = (
+            my_shard.shards[-1].hash if my_shard.shards else 0
+        )
+        start = (prev_hash + 1) & 0xFFFFFFFF
+        end = (my_shard.hash + 1) & 0xFFFFFFFF
+        for name, col in list(my_shard.collections.items()):
+            rf = col.replication_factor
+            if rf <= 1:
+                continue
+            # rf-1 distinct-node successors replicate my primary range
+            # (the same walk as the replica fan-out).
+            nodes: set = set()
+            peers = []
+            for s in my_shard.shards:
+                if s.node_name == my_shard.config.name:
+                    continue
+                if s.node_name in nodes:
+                    continue
+                nodes.add(s.node_name)
+                peers.append(s)
+                if len(peers) >= rf - 1:
+                    break
+            if not peers:
+                continue
+            # One digest scan per collection per cycle, shared by all
+            # rf-1 peer comparisons.
+            async with my_shard.scheduler.bg_slice():
+                count, digest = await my_shard.compute_range_digest(
+                    col.tree, start, end
+                )
+            for peer in peers:
+                try:
+                    await _sync_range_with_peer(
+                        my_shard,
+                        name,
+                        col.tree,
+                        peer,
+                        start,
+                        end,
+                        count,
+                        digest,
+                    )
+                except (DbeelError, OSError) as e:
+                    log.warning(
+                        "anti-entropy %s with %s failed: %s",
+                        name,
+                        peer.name,
+                        e,
+                    )
+        my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_DONE)
 
 
 # ----------------------------------------------------------------------

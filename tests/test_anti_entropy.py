@@ -1,0 +1,162 @@
+"""Anti-entropy tests — the third beyond-reference replication repair
+mechanism (SURVEY §5 lists hinted handoff, read repair AND anti-entropy
+as gaps in the reference's design; rounds 1-2 added all three).
+
+Replicas that silently diverge (missed fan-out, restored-from-older
+disk) must reconverge via periodic digest compare + push/pull, with no
+client traffic involved.
+"""
+
+import asyncio
+
+from dbeel_tpu.client import DbeelClient, Consistency
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.utils.murmur import hash_bytes
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+def test_diverged_replicas_reconverge(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, anti_entropy_interval_ms=200)
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"]
+        )
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in (node1, node2)
+            ]
+            col = await client.create_collection(
+                "ae", replication_factor=2
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            for i in range(20):
+                await col.set(f"base{i}", i, consistency=Consistency.ALL)
+
+            # Inject divergence BEHIND the replication protocol: write
+            # straight into each node's tree (a missed fan-out / state
+            # restored from older disk looks exactly like this).
+            t1 = node1.shards[0].collections["ae"].tree
+            t2 = node2.shards[0].collections["ae"].tree
+            only1 = b"\xa9only-on-1"  # msgpack-encoded "only-on-1"
+            only2 = b"\xa9only-on-2"
+            await t1.set_with_timestamp(only1, b"\x01", 10_000)
+            await t2.set_with_timestamp(only2, b"\x02", 10_001)
+
+            # Converge: both keys present on BOTH trees, no client ops.
+            async def converged():
+                return (
+                    await t2.get(only1) == b"\x01"
+                    and await t1.get(only2) == b"\x02"
+                )
+
+            for _ in range(60):
+                done1 = node1.flow_event(0, FlowEvent.ANTI_ENTROPY_DONE)
+                done2 = node2.flow_event(0, FlowEvent.ANTI_ENTROPY_DONE)
+                if await converged():
+                    break
+                await asyncio.wait(
+                    [done1, done2],
+                    timeout=5,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            assert await converged(), (
+                "replicas did not reconverge via anti-entropy; "
+                f"hashes: {hash_bytes(only1)}, {hash_bytes(only2)}"
+            )
+        finally:
+            await node1.stop()
+            await node2.stop()
+
+    run(main(), timeout=90)
+
+
+def test_anti_entropy_noop_when_in_sync(tmp_dir):
+    """Digest match → no pushes/pulls (the steady-state cost is one
+    digest round per peer per interval)."""
+
+    async def main():
+        cfg = make_config(tmp_dir, anti_entropy_interval_ms=100)
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"]
+        )
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in (node1, node2)
+            ]
+            col = await client.create_collection(
+                "sync", replication_factor=2
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            for i in range(10):
+                await col.set(f"s{i}", i, consistency=Consistency.ALL)
+            # Two full cycles with no client traffic: a digest
+            # mismatch would fire ANTI_ENTROPY_SYNCED (the repair
+            # path's own milestone) — those subscriptions must stay
+            # unresolved on both nodes.
+            spurious = [
+                n.flow_event(0, FlowEvent.ANTI_ENTROPY_SYNCED)
+                for n in (node1, node2)
+            ]
+            for _ in range(2):
+                await asyncio.wait_for(
+                    node1.flow_event(0, FlowEvent.ANTI_ENTROPY_DONE),
+                    20,
+                )
+            assert not any(f.done() for f in spurious), (
+                "anti-entropy ran a repair while replicas were in sync"
+            )
+            for f in spurious:
+                f.cancel()
+        finally:
+            await node1.stop()
+            await node2.stop()
+
+    run(main(), timeout=60)
+
+
+def test_pull_cannot_shadow_newer_flushed_value(tmp_dir):
+    """Regression (round-2 review): applying a pulled OLD entry through
+    a plain memtable set would shadow a NEWER value already flushed to
+    an sstable (get_entry returns memtable hits unconditionally).
+    apply_if_newer must consult the full tree."""
+
+    async def main():
+        import os
+
+        from dbeel_tpu.server.shard import MyShard
+        from dbeel_tpu.storage.lsm_tree import LSMTree
+
+        d = os.path.join(tmp_dir, "t")
+        os.makedirs(d)
+        tree = LSMTree.open_or_create(d, capacity=16)
+        await tree.set_with_timestamp(b"k", b"new", 200)
+        await tree.flush()  # ts=200 now lives in an sstable only
+
+        applied = await MyShard.apply_if_newer(tree, b"k", b"old", 100)
+        assert not applied
+        assert await tree.get_entry(b"k") == (b"new", 200)
+
+        applied = await MyShard.apply_if_newer(tree, b"k", b"newer", 300)
+        assert applied
+        assert await tree.get_entry(b"k") == (b"newer", 300)
+        tree.close()
+
+    run(main())
