@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+from bisect import bisect_left
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -199,8 +200,6 @@ class DbeelClient:
         distinct nodes — the replica walk."""
         if not self._ring:
             raise ConnectionError_("empty ring; sync_metadata first")
-        from bisect import bisect_left
-
         start = bisect_left(self._ring_hashes, key_hash)
         if start == len(self._ring):
             start = 0

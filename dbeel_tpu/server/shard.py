@@ -716,21 +716,27 @@ class MyShard:
             col = self.collections.get(request[2])
             count, digest = 0, 0
             if col is not None:
-                count, digest = await self.compute_range_digest(
-                    col.tree, request[3], request[4]
-                )
+                # Peer-side anti-entropy scans are background work too:
+                # they must defer to this shard's own serving traffic.
+                async with self.scheduler.bg_slice():
+                    count, digest = await self.compute_range_digest(
+                        col.tree, request[3], request[4]
+                    )
             return ShardResponse.range_digest(count, digest)
         if kind == ShardRequest.RANGE_PULL:
             col = self.collections.get(request[2])
             entries: list = []
             if col is not None:
-                entries = await self.collect_range_page(
-                    col.tree,
-                    request[3],
-                    request[4],
-                    bytes(request[5]) if request[5] is not None else None,
-                    int(request[6]),
-                )
+                async with self.scheduler.bg_slice():
+                    entries = await self.collect_range_page(
+                        col.tree,
+                        request[3],
+                        request[4],
+                        bytes(request[5])
+                        if request[5] is not None
+                        else None,
+                        int(request[6]),
+                    )
             return ShardResponse.range_pull(entries)
         if kind == ShardRequest.RANGE_PUSH:
             col = self.collections.get(request[2])
@@ -760,6 +766,16 @@ class MyShard:
         out of the memtable."""
         local = await tree.get_entry(key)
         if local is not None and local[1] >= ts:
+            return False
+        # Close the probe/write race: a concurrent client write may
+        # have landed during get_entry's awaits (and even been swapped
+        # to the flushing memtable).  Re-probe the memtables with NO
+        # awaits between this check and set_with_timestamp's
+        # synchronous memtable insert.  (Residual window: a
+        # capacity-wait inside set_with_timestamp can still interleave
+        # — the same width the replication fan-out itself has.)
+        newest = tree.newest_memtable_ts(key)
+        if newest is not None and newest >= ts:
             return False
         await tree.set_with_timestamp(key, value, ts)
         return True
@@ -802,17 +818,24 @@ class MyShard:
 
     @staticmethod
     async def collect_range_entries(
-        tree, start: int, end: int
+        tree,
+        start: int,
+        end: int,
+        start_after: Optional[bytes] = None,
     ) -> list:
         """ALL (key, value, newest-ts) triples in the anti-entropy
-        range, ascending by key — materialized once so sync paging
-        doesn't rescan the tree per page."""
+        range with key > start_after, ascending by key.  The push side
+        calls this once and pages from the result; the stateless
+        RANGE_PULL server pays one scan per page (keys <= start_after
+        are filtered during the scan, so later pages dedup less)."""
         newest: Dict[bytes, Tuple[bytes, int]] = {}
         async for key, value, ts in tree.iter_filter(
             lambda k, v, t: MyShard._in_ae_range(
                 hash_bytes(k), start, end
             )
         ):
+            if start_after is not None and key <= start_after:
+                continue
             prev = newest.get(key)
             if prev is None or ts > prev[1]:
                 newest[key] = (value, ts)
@@ -830,13 +853,9 @@ class MyShard:
     ) -> list:
         """Up to ``limit`` entries with key > start_after (the
         stateless remote paging entry point)."""
-        entries = await MyShard.collect_range_entries(tree, start, end)
-        if start_after is not None:
-            from bisect import bisect_right
-
-            keys = [e[0] for e in entries]
-            lo = bisect_right(keys, start_after)
-            entries = entries[lo:]
+        entries = await MyShard.collect_range_entries(
+            tree, start, end, start_after
+        )
         return entries[:limit]
 
     # ------------------------------------------------------------------

@@ -257,7 +257,8 @@ async def _sync_range_with_peer(
 
     # Push ours in batched pages from ONE materialized range snapshot;
     # the peer applies strictly-newer only.
-    mine = await my_shard.collect_range_entries(tree, start, end)
+    async with my_shard.scheduler.bg_slice():
+        mine = await my_shard.collect_range_entries(tree, start, end)
     pushed = 0
     for off in range(0, len(mine), ANTI_ENTROPY_PAGE):
         page = mine[off : off + ANTI_ENTROPY_PAGE]
@@ -347,7 +348,7 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                 )
             for peer in peers:
                 try:
-                    await _sync_range_with_peer(
+                    synced = await _sync_range_with_peer(
                         my_shard,
                         name,
                         col.tree,
@@ -357,6 +358,16 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                         count,
                         digest,
                     )
+                    if synced:
+                        # A pull may have changed our range: later
+                        # peers must compare against the CURRENT
+                        # digest or every one of them re-syncs.
+                        async with my_shard.scheduler.bg_slice():
+                            count, digest = (
+                                await my_shard.compute_range_digest(
+                                    col.tree, start, end
+                                )
+                            )
                 except (DbeelError, OSError) as e:
                     log.warning(
                         "anti-entropy %s with %s failed: %s",

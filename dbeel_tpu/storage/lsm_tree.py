@@ -273,6 +273,20 @@ class LSMTree:
     # Reads (lsm_tree.rs:674-723)
     # ------------------------------------------------------------------
 
+    def newest_memtable_ts(self, key: bytes) -> Optional[int]:
+        """Newest timestamp for ``key`` across the active + flushing
+        memtables, or None — a synchronous probe for callers that must
+        re-check freshness with no awaits before writing."""
+        newest = None
+        hit = self._active.get(key)
+        if hit is not None:
+            newest = hit[1]
+        if self._flushing is not None:
+            hit = self._flushing.get(key)
+            if hit is not None and (newest is None or hit[1] > newest):
+                newest = hit[1]
+        return newest
+
     async def get_entry(self, key: bytes) -> Optional[Tuple[bytes, int]]:
         """Async point read: memtable hits return inline; sstable
         probes go through the executor-backed async read path so a
