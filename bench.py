@@ -204,6 +204,8 @@ def _kernel_only_rate(d, args) -> float:
             (jax.device_put(pref), jax.device_put(counts))
         )
     out_rows = bitonic._pow2(k) * p_chunk
+    if not chunks:
+        return 0.0
     # Warm (compile) pass.
     for pref, counts in chunks:
         o = bitonic.merge_runs_prefix_kernel(pref, counts, out_rows)

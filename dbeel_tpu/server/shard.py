@@ -742,10 +742,11 @@ class MyShard:
             col = self.collections.get(request[2])
             if col is None:
                 raise CollectionNotFound(request[2])
-            for key, value, ts in request[3]:
-                await self.apply_if_newer(
-                    col.tree, bytes(key), bytes(value), int(ts)
-                )
+            async with self.scheduler.bg_slice():
+                for key, value, ts in request[3]:
+                    await self.apply_if_newer(
+                        col.tree, bytes(key), bytes(value), int(ts)
+                    )
             return ShardResponse.empty(ShardResponse.RANGE_PUSH)
         raise DbeelError(f"unknown shard request {kind!r}")
 

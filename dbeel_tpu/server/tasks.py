@@ -71,7 +71,21 @@ async def _handle_remote_client(my_shard, reader, writer):
             # Replica-side serving (quorum writes/reads from peers) is
             # foreground work too: without this mark, background units
             # on replica nodes would never defer to quorum traffic.
-            my_shard.scheduler.fg_mark()
+            # Anti-entropy's own requests must NOT mark: they are
+            # background traffic, and marking would make the peer-side
+            # bg_slice throttle against the very request it serves.
+            if not (
+                isinstance(message, (list, tuple))
+                and len(message) > 1
+                and message[0] == "request"
+                and message[1]
+                in (
+                    msgs.ShardRequest.RANGE_DIGEST,
+                    msgs.ShardRequest.RANGE_PULL,
+                    msgs.ShardRequest.RANGE_PUSH,
+                )
+            ):
+                my_shard.scheduler.fg_mark()
             try:
                 response = await my_shard.handle_shard_message(message)
                 if response is not None:
@@ -302,7 +316,9 @@ async def _sync_range_with_peer(
             pulled,
         )
     my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_SYNCED)
-    return True
+    # Local state changed only if a pull applied — the caller
+    # recomputes the shared digest exactly then.
+    return pulled > 0
 
 
 async def run_anti_entropy(my_shard: MyShard) -> None:
@@ -348,7 +364,7 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                 )
             for peer in peers:
                 try:
-                    synced = await _sync_range_with_peer(
+                    pulled_any = await _sync_range_with_peer(
                         my_shard,
                         name,
                         col.tree,
@@ -358,10 +374,10 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                         count,
                         digest,
                     )
-                    if synced:
-                        # A pull may have changed our range: later
-                        # peers must compare against the CURRENT
-                        # digest or every one of them re-syncs.
+                    if pulled_any:
+                        # A pull changed our range: later peers must
+                        # compare against the CURRENT digest or every
+                        # one of them re-syncs.
                         async with my_shard.scheduler.bg_slice():
                             count, digest = (
                                 await my_shard.compute_range_digest(
