@@ -200,24 +200,34 @@ def _kernel_only_rate(d, args) -> float:
             pref[r, : hi - lo, 0] = cols.key_words[sl, 0]
             pref[r, : hi - lo, 1] = cols.key_words[sl, 1]
             counts[r] = hi - lo
-        chunks.append(
-            (jax.device_put(pref), jax.device_put(counts))
-        )
+        chunks.append((pref, counts))
     out_rows = bitonic._pow2(k) * p_chunk
     if not chunks:
         return 0.0
+    # One fresh device-resident copy per pass (warm + 3 timed):
+    # repeated launches on the very same buffers can be served from
+    # already-ready results by the remote plugin, reading as an
+    # impossible ~0ms pass.
+    staged = [
+        [
+            (jax.device_put(pref), jax.device_put(counts))
+            for pref, counts in chunks
+        ]
+        for _ in range(4)
+    ]
     # Warm (compile) pass.
-    for pref, counts in chunks:
+    for pref, counts in staged[0]:
         o = bitonic.merge_runs_prefix_kernel(pref, counts, out_rows)
     jax.block_until_ready(o)
     times = []
-    for _ in range(3):
+    for i in range(3):
+        batch = staged[i + 1]
         t0 = time.perf_counter()
-        for pref, counts in chunks:
-            o = bitonic.merge_runs_prefix_kernel(
-                pref, counts, out_rows
-            )
-        jax.block_until_ready(o)
+        outs = [
+            bitonic.merge_runs_prefix_kernel(pref, counts, out_rows)
+            for pref, counts in batch
+        ]
+        jax.block_until_ready(outs)
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[1]  # median
     rate = n / dt
@@ -269,11 +279,43 @@ def main():
         )
         log(f"  build took {time.perf_counter() - t0:.1f}s")
 
-        log(f"CPU baseline ({args.baseline}) ...")
-        cpu_rate, cpu_n, cpu_hash, cpu_t = run_strategy(
-            args.baseline, d, indices, 101
-        )
+        # Two CPU baselines, both reported:
+        #  * legacy  — the ROUND-1 baseline definition (C++ merge +
+        #    page-mirroring Python writer), the denominator the >=5x
+        #    north star was calibrated against; kept stable across
+        #    rounds via vs_baseline.
+        #  * best    — the same merge with the O_DIRECT native writer
+        #    (the product's actual CPU fallback since round 2); the
+        #    honest same-host compute comparison, reported as
+        #    vs_best_cpu.
+        from dbeel_tpu.storage import native as native_mod
+
+        log(f"CPU baseline ({args.baseline}, r1 legacy write path) ...")
+        saved_min = native_mod.ODIRECT_MIN_BYTES
+        native_mod.ODIRECT_MIN_BYTES = 1 << 62
+        try:
+            cpu_rate, cpu_n, cpu_hash, cpu_t = run_strategy(
+                args.baseline, d, indices, 101
+            )
+        finally:
+            native_mod.ODIRECT_MIN_BYTES = saved_min
         log(f"  {cpu_rate:,.0f} keys/s ({cpu_t:.2f}s, {cpu_n} out)")
+
+        log(f"CPU baseline ({args.baseline}, O_DIRECT write path) ...")
+        # Force the O_DIRECT branch symmetrically (small --keys runs
+        # would otherwise fall under the threshold and measure the
+        # legacy writer twice).
+        native_mod.ODIRECT_MIN_BYTES = 0
+        try:
+            best_cpu_rate, _bn, best_cpu_hash, best_t = run_strategy(
+                args.baseline, d, indices, 107
+            )
+        finally:
+            native_mod.ODIRECT_MIN_BYTES = saved_min
+        log(
+            f"  {best_cpu_rate:,.0f} keys/s ({best_t:.2f}s); "
+            f"identical: {best_cpu_hash == cpu_hash}"
+        )
 
         # Untimed same-shape warm pass: jit compile + first-dispatch
         # runtime setup happen here.  Compaction shapes repeat in
@@ -310,6 +352,10 @@ def main():
                     "unit": "keys/s",
                     "vs_baseline": round(dev_rate / cpu_rate, 3),
                     "cpu_keys_per_sec": round(cpu_rate),
+                    "best_cpu_keys_per_sec": round(best_cpu_rate),
+                    "vs_best_cpu": round(
+                        dev_rate / best_cpu_rate, 3
+                    ),
                     "kernel_keys_per_sec": (
                         round(kernel_rate) if kernel_rate else None
                     ),

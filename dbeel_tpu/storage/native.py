@@ -35,6 +35,10 @@ from .file_io import PageMirroringWriter
 
 log = logging.getLogger(__name__)
 
+# Outputs at/above this size write through the C++ O_DIRECT streamer
+# instead of the page-mirroring Python writer.
+ODIRECT_MIN_BYTES = 64 << 20
+
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
 )
@@ -75,7 +79,9 @@ def _load() -> Optional[ctypes.CDLL]:
     except OSError as e:
         log.info("native lib load failed: %s", e)
         return None
-    if not hasattr(lib, "dbeel_writer_open"):
+    if not hasattr(lib, "dbeel_writer_open") or not hasattr(
+        lib, "dbeel_write_file"
+    ):
         # Still stale (rebuild failed / old binary pinned): degrade to
         # the pure-Python paths rather than crash on registration.
         log.warning(
@@ -102,6 +108,12 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.dbeel_read_file.restype = ctypes.c_int64
     lib.dbeel_read_file.argtypes = [
+        ctypes.c_char_p,
+        u8p,
+        ctypes.c_uint64,
+    ]
+    lib.dbeel_write_file.restype = ctypes.c_int64
+    lib.dbeel_write_file.argtypes = [
         ctypes.c_char_p,
         u8p,
         ctypes.c_uint64,
@@ -242,20 +254,47 @@ class NativeMergeStrategy(CompactionStrategy):
 
         from .entry import DATA_FILE_EXT, INDEX_FILE_EXT
 
-        data_w = PageMirroringWriter(
-            f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}",
-            (DATA_FILE_EXT, output_index),
-            cache,
+        data_path = (
+            f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}"
         )
-        data_w.write(out_data[:data_size].tobytes())
-        data_w.close()
-        index_w = PageMirroringWriter(
-            f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}",
-            (INDEX_FILE_EXT, output_index),
-            cache,
+        index_path = (
+            f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}"
         )
-        index_w.write(out_index[: n_out * 16].tobytes())
-        index_w.close()
+        # Large outputs: O_DIRECT native writes (no Python buffer
+        # copies, no page-cache mirroring — same policy as the device
+        # pipeline).  Small outputs keep the mirroring writer so fresh
+        # little SSTables stay warm.  (bench.py overrides the module
+        # constant to reproduce the round-1 baseline definition.)
+        if data_size >= ODIRECT_MIN_BYTES:
+            rc1 = lib.dbeel_write_file(
+                data_path.encode(),
+                out_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_uint64(int(data_size)),
+            )
+            rc2 = lib.dbeel_write_file(
+                index_path.encode(),
+                out_index.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)
+                ),
+                ctypes.c_uint64(int(n_out) * 16),
+            )
+            if rc1 != 0 or rc2 != 0:
+                raise OSError("native O_DIRECT write failed")
+        else:
+            data_w = PageMirroringWriter(
+                data_path,
+                (DATA_FILE_EXT, output_index),
+                cache,
+            )
+            data_w.write(out_data[:data_size].tobytes())
+            data_w.close()
+            index_w = PageMirroringWriter(
+                index_path,
+                (INDEX_FILE_EXT, output_index),
+                cache,
+            )
+            index_w.write(out_index[: n_out * 16].tobytes())
+            index_w.close()
 
         wrote_bloom = False
         if data_size >= bloom_min_size and n_out > 0:

@@ -333,6 +333,17 @@ int64_t dbeel_read_file(const char* path, uint8_t* dst, uint64_t size) {
   return (int64_t)done;
 }
 
+// Write one contiguous buffer as a whole file through the O_DIRECT
+// streaming path (aligned staging, ftruncate to logical size,
+// fdatasync).  Returns 0 on success, -1 on error.
+int64_t dbeel_write_file(const char* path, const uint8_t* data,
+                         uint64_t size) {
+  StreamFile f;
+  if (!f.open_for_write(path)) return -1;
+  const bool ok = f.append(data, size);
+  return (f.close_sync() && ok) ? 0 : -1;
+}
+
 void* dbeel_writer_open(const char* data_path, const char* index_path) {
   auto* w = new GatherWriter();
   if (!w->data.open_for_write(data_path) ||
