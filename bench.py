@@ -160,13 +160,13 @@ def _kernel_only_rate(d, args) -> float:
     """Steady-state bitonic merge throughput on device-resident data,
     measured at the PRODUCTION launch shape: the partitioned pipeline
     (ops/pipeline.py) slices the job into per-run chunks of <= 2^17
-    rows and launches one merge kernel per partition — which runs
-    ~20x closer to the HBM roofline than one whole-job launch (XLA
-    handles the short shapes far better)."""
+    rows, rebases prefixes to u32, and vmaps _LAUNCH_BATCH partitions
+    per launch of the packed-run-id kernel."""
     import jax
     import numpy as np
 
     from dbeel_tpu.ops import bitonic
+    from dbeel_tpu.ops.pipeline import _LAUNCH_BATCH
     from dbeel_tpu.storage import columnar
 
     indices = [r * 2 for r in range(args.runs)]
@@ -177,9 +177,12 @@ def _kernel_only_rate(d, args) -> float:
     run_counts = np.bincount(cols.src).tolist()
     n = len(cols)
     k = max(1, len(run_counts))
+    k2 = bitonic._pow2(k)
+    pack_bits = bitonic.rid_pack_bits(k2)
     p_chunk = 1 << 17
     # Per-run slices of p_chunk rows (sorted runs stay sorted when
-    # sliced) — the same (K, 2^17, 2) operand shape the pipeline ships.
+    # sliced), top-4-bytes operand (= the pipeline's rebased u32 at
+    # shift 32 over the uniform keyspace), batched J per launch.
     chunks = []
     bases = np.zeros(k, dtype=np.int64)
     base = 0
@@ -188,44 +191,55 @@ def _kernel_only_rate(d, args) -> float:
         base += cnt
     max_cnt = max(run_counts) if run_counts else 0
     for lo in range(0, max_cnt, p_chunk):
-        pref = np.full(
-            (bitonic._pow2(k), p_chunk, 2), 0xFFFFFFFF, np.uint32
-        )
-        counts = np.zeros(bitonic._pow2(k), dtype=np.uint32)
+        vals = np.full((k2, p_chunk), 0xFFFFFFFF, np.uint32)
+        counts = np.zeros(k2, dtype=np.uint32)
         for r, cnt in enumerate(run_counts):
             hi = min(cnt, lo + p_chunk)
             if lo >= hi:
                 continue
             sl = slice(bases[r] + lo, bases[r] + hi)
-            pref[r, : hi - lo, 0] = cols.key_words[sl, 0]
-            pref[r, : hi - lo, 1] = cols.key_words[sl, 1]
+            vals[r, : hi - lo] = cols.key_words[sl, 0]
             counts[r] = hi - lo
-        chunks.append((pref, counts))
-    out_rows = bitonic._pow2(k) * p_chunk
+        chunks.append((vals, counts))
     if not chunks:
         return 0.0
+    batches = []
+    for j0 in range(0, len(chunks), _LAUNCH_BATCH):
+        grp = chunks[j0 : j0 + _LAUNCH_BATCH]
+        stack = np.full(
+            (_LAUNCH_BATCH, k2, p_chunk), 0xFFFFFFFF, np.uint32
+        )
+        cnts = np.zeros((_LAUNCH_BATCH, k2), np.uint32)
+        for slot, (v, c) in enumerate(grp):
+            stack[slot] = v
+            cnts[slot] = c
+        batches.append((stack, cnts))
     # One fresh device-resident copy per pass (warm + 3 timed):
     # repeated launches on the very same buffers can be served from
     # already-ready results by the remote plugin, reading as an
     # impossible ~0ms pass.
     staged = [
         [
-            (jax.device_put(pref), jax.device_put(counts))
-            for pref, counts in chunks
+            (jax.device_put(stack), jax.device_put(cnts))
+            for stack, cnts in batches
         ]
         for _ in range(4)
     ]
     # Warm (compile) pass.
-    for pref, counts in staged[0]:
-        o = bitonic.merge_runs_prefix_kernel(pref, counts, out_rows)
+    for stack, cnts in staged[0]:
+        o = bitonic.merge_runs_prefix32_packed_batch_kernel(
+            stack, cnts, pack_bits
+        )
     jax.block_until_ready(o)
     times = []
     for i in range(3):
         batch = staged[i + 1]
         t0 = time.perf_counter()
         outs = [
-            bitonic.merge_runs_prefix_kernel(pref, counts, out_rows)
-            for pref, counts in batch
+            bitonic.merge_runs_prefix32_packed_batch_kernel(
+                stack, cnts, pack_bits
+            )
+            for stack, cnts in batch
         ]
         jax.block_until_ready(outs)
         times.append(time.perf_counter() - t0)
@@ -301,17 +315,19 @@ def main():
             native_mod.ODIRECT_MIN_BYTES = saved_min
         log(f"  {cpu_rate:,.0f} keys/s ({cpu_t:.2f}s, {cpu_n} out)")
 
+        # This host's throughput see-saws 2-3x between minutes (shared
+        # disk + tunneled TPU), so single-shot timings are noise.  Both
+        # sides get multiple INTERLEAVED passes and report their best —
+        # the same estimator under the same conditions.
+        def best_cpu_pass(oi):
+            native_mod.ODIRECT_MIN_BYTES = 0
+            try:
+                return run_strategy(args.baseline, d, indices, oi)
+            finally:
+                native_mod.ODIRECT_MIN_BYTES = saved_min
+
         log(f"CPU baseline ({args.baseline}, O_DIRECT write path) ...")
-        # Force the O_DIRECT branch symmetrically (small --keys runs
-        # would otherwise fall under the threshold and measure the
-        # legacy writer twice).
-        native_mod.ODIRECT_MIN_BYTES = 0
-        try:
-            best_cpu_rate, _bn, best_cpu_hash, best_t = run_strategy(
-                args.baseline, d, indices, 107
-            )
-        finally:
-            native_mod.ODIRECT_MIN_BYTES = saved_min
+        best_cpu_rate, _bn, best_cpu_hash, best_t = best_cpu_pass(107)
         log(
             f"  {best_cpu_rate:,.0f} keys/s ({best_t:.2f}s); "
             f"identical: {best_cpu_hash == cpu_hash}"
@@ -325,11 +341,24 @@ def main():
         for ext in ("compact_data", "compact_index"):
             os.unlink(f"{d}/{file_name(105, ext)}.{args.device}")
 
-        log(f"device ({args.device}) ...")
+        log(f"device ({args.device}) pass 1 ...")
         dev_rate, dev_n, dev_hash, dev_t = run_strategy(
             args.device, d, indices, 103
         )
         log(f"  {dev_rate:,.0f} keys/s ({dev_t:.2f}s, {dev_n} out)")
+
+        for extra in range(2):
+            log(f"CPU baseline extra pass {extra + 2} ...")
+            r2, _n2, h2, t2 = best_cpu_pass(107)
+            log(f"  {r2:,.0f} keys/s ({t2:.2f}s)")
+            if r2 > best_cpu_rate:
+                best_cpu_rate, best_cpu_hash, best_t = r2, h2, t2
+            log(f"device extra pass {extra + 2} ...")
+            dr, dn, dh, dt = run_strategy(args.device, d, indices, 103)
+            log(f"  {dr:,.0f} keys/s ({dt:.2f}s)")
+            assert dh == dev_hash, "device output changed between passes"
+            if dr > dev_rate:
+                dev_rate, dev_t = dr, dt
 
         identical = cpu_hash == dev_hash
         log(f"byte-identical output: {identical}")

@@ -158,6 +158,146 @@ def merge_runs_prefix_kernel(
     return _prefix_merge_body(prefixes, counts, out_rows)
 
 
+# ----------------------------------------------------------------------
+# Round-3 transfer-minimal kernels (ops/pipeline.py hot path).
+#
+# Uplink: the pipeline rebases every partition's 8-byte prefixes to the
+# partition minimum and right-shifts so the span fits 32 bits — an
+# order-preserving u32 approximation (collisions become host-fixed tie
+# blocks, exactly like genuinely equal prefixes).  The operand is ONE
+# u32 word per entry instead of two: half the h2d bytes and a cheaper
+# comparator.  Wide partitions where the shift would collapse dense
+# clusters keep the exact 2-word operand (the host checks cheaply).
+#
+# Downlink: within one partition each run's survivors appear in
+# increasing position order (the comparator is a total order and runs
+# are pre-sorted), so run-id alone reconstructs the permutation with
+# per-run counters on the host.  The kernel therefore returns only the
+# run-id sequence, bit-packed `pack_bits` per entry into u32 words —
+# 8x (K<=16) or 4x (K<=256) fewer d2h bytes than the packed u32 index.
+# ----------------------------------------------------------------------
+
+
+def _pack_rids(idx_sorted: jnp.ndarray, logp: int, pack_bits: int):
+    """Sorted packed indices (N,) u32 → bit-packed run-ids, pack_bits
+    per entry, little-end-first within each u32 word."""
+    per = 32 // pack_bits
+    n = idx_sorted.shape[0]
+    pad = (-n) % per
+    if pad:
+        idx_sorted = jnp.concatenate(
+            [idx_sorted, jnp.full((pad,), SENTINEL, jnp.uint32)]
+        )
+    rid = (idx_sorted >> jnp.uint32(logp)) & jnp.uint32(
+        (1 << pack_bits) - 1
+    )
+    group = rid.reshape(-1, per)
+    shifts = jnp.arange(per, dtype=jnp.uint32) * jnp.uint32(pack_bits)
+    # Disjoint bit ranges: sum == bitwise-or.
+    return jnp.sum(
+        group << shifts[None, :], axis=1, dtype=jnp.uint32
+    )
+
+
+def _prefix32_packed_body(
+    vals: jnp.ndarray, counts: jnp.ndarray, pack_bits: int
+):
+    k, p = vals.shape
+    iota = (
+        jnp.arange(k, dtype=jnp.uint32)[:, None] * jnp.uint32(p)
+        + jnp.arange(p, dtype=jnp.uint32)[None, :]
+    )
+    valid = jnp.arange(p, dtype=jnp.uint32)[None, :] < counts[:, None]
+    idx = jnp.where(valid, iota, SENTINEL)
+    x = jnp.stack([vals, idx], axis=2)
+    while x.shape[0] > 1:
+        x = _merge_level(x, ncmp=2)
+    return _pack_rids(x[0, :, 1], p.bit_length() - 1, pack_bits)
+
+
+@functools.partial(jax.jit, static_argnames=("pack_bits",))
+def merge_runs_prefix32_packed_kernel(
+    vals: jnp.ndarray,  # (K, P) u32 rebased+shifted prefixes
+    counts: jnp.ndarray,  # (K,) u32 valid rows per run
+    pack_bits: int,
+):
+    return _prefix32_packed_body(vals, counts, pack_bits)
+
+
+def _prefix64_packed_body(
+    prefixes: jnp.ndarray, counts: jnp.ndarray, pack_bits: int
+):
+    k, p, _ = prefixes.shape
+    iota = (
+        jnp.arange(k, dtype=jnp.uint32)[:, None] * jnp.uint32(p)
+        + jnp.arange(p, dtype=jnp.uint32)[None, :]
+    )
+    valid = jnp.arange(p, dtype=jnp.uint32)[None, :] < counts[:, None]
+    idx = jnp.where(valid, iota, SENTINEL)
+    x = jnp.concatenate([prefixes, idx[:, :, None]], axis=2)
+    while x.shape[0] > 1:
+        x = _merge_level(x, ncmp=3)
+    return _pack_rids(x[0, :, 2], p.bit_length() - 1, pack_bits)
+
+
+@functools.partial(jax.jit, static_argnames=("pack_bits",))
+def merge_runs_prefix64_packed_kernel(
+    prefixes: jnp.ndarray,  # (K, P, 2) u32 big-endian prefix words
+    counts: jnp.ndarray,  # (K,) u32
+    pack_bits: int,
+):
+    return _prefix64_packed_body(prefixes, counts, pack_bits)
+
+
+@functools.partial(jax.jit, static_argnames=("pack_bits",))
+def merge_runs_prefix32_packed_batch_kernel(
+    vals: jnp.ndarray,  # (J, K, P) u32 — J partitions per launch
+    counts: jnp.ndarray,  # (J, K) u32
+    pack_bits: int,
+):
+    """Batched variant: J keyspace partitions merged in ONE device
+    program (vmap over the partition axis).  On tunneled/remote TPUs
+    each launch pays a ~100ms+ round-trip, so batching divides the
+    dominant per-launch overhead by J; empty slots (counts=0) pad the
+    final batch to keep one compiled shape."""
+    return jax.vmap(
+        lambda v, c: _prefix32_packed_body(v, c, pack_bits)
+    )(vals, counts)
+
+
+@functools.partial(jax.jit, static_argnames=("pack_bits",))
+def merge_runs_prefix64_packed_batch_kernel(
+    prefixes: jnp.ndarray,  # (J, K, P, 2) u32
+    counts: jnp.ndarray,  # (J, K) u32
+    pack_bits: int,
+):
+    return jax.vmap(
+        lambda v, c: _prefix64_packed_body(v, c, pack_bits)
+    )(prefixes, counts)
+
+
+def rid_pack_bits(k2: int) -> int:
+    """Smallest packing width in {1,2,4,8,16} holding run-ids < k2."""
+    need = max(1, (k2 - 1).bit_length())
+    for b in (1, 2, 4, 8, 16):
+        if need <= b:
+            return b
+    raise ValueError(f"too many runs for rid packing: {k2}")
+
+
+def unpack_rids(
+    words: np.ndarray, pack_bits: int, n: int
+) -> np.ndarray:
+    """Host-side inverse of _pack_rids → (n,) run-ids as uint32."""
+    per = 32 // pack_bits
+    mask = np.uint32((1 << pack_bits) - 1)
+    shifts = (
+        np.arange(per, dtype=np.uint32) * np.uint32(pack_bits)
+    )
+    rids = (words[:, None] >> shifts[None, :]) & mask
+    return rids.reshape(-1)[:n]
+
+
 @functools.partial(jax.jit, static_argnames=("out_rows",))
 def merge_runs_prefix_batch_kernel(
     prefixes: jnp.ndarray,  # (J, K, P, 2) — J independent merge jobs

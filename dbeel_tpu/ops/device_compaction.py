@@ -35,11 +35,12 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
     TIE_FALLBACK_FRACTION = 0.02
 
     # Merges below this input size stay on the single-shot path: they
-    # are fast anyway, keep the page-mirroring write (small fresh
-    # SSTables warm in cache when a cache is supplied), and keep the
-    # TIE_FALLBACK device re-sort close at hand.  Larger merges go
-    # through the O_DIRECT native pipeline, which bails back here on
-    # tie-heavy keyspaces (pipeline.py's tie-fraction guard).
+    # are fast anyway and keep the page-mirroring write (small fresh
+    # SSTables warm in cache when a cache is supplied).  Larger merges
+    # go through the O_DIRECT native pipeline, which handles tie-heavy
+    # keyspaces internally (vectorized fixup) and declines (None) only
+    # when no native lib/jax or an equal-prefix group exceeds the
+    # kernel rows.
     PIPELINE_MIN_BYTES = 64 << 20
 
     def merge(
@@ -113,9 +114,7 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
                 return DeviceFullMergeStrategy.sort_and_dedup(
                     self, cols
                 )
-        perm = columnar.fixup_prefix_ties(cols, perm, words=2)
-        keep = columnar.dedup_mask_prefix(cols, perm, words=2)
-        return perm, keep
+        return columnar.fixup_and_dedup_prefix(cols, perm, words=2)
 
     def sort_and_dedup(
         self, cols: columnar.MergeColumns

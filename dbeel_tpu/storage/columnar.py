@@ -216,6 +216,148 @@ def _flags_to_runs(flags: np.ndarray) -> List[Tuple[int, int]]:
     return runs
 
 
+def tie_positions_and_blocks(flags: np.ndarray):
+    """Adjacent-pair tie flags (n-1,) → (positions, block_id): the
+    sorted positions participating in any tie block, and a 0-based
+    block index per position.  Blocks are maximal chains of flagged
+    pairs; a False flag between two flagged pairs separates blocks even
+    when the positions are contiguous."""
+    if flags.size == 0 or not flags.any():
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    in_block = np.zeros(flags.size + 1, dtype=bool)
+    in_block[:-1] |= flags
+    in_block[1:] |= flags
+    positions = np.flatnonzero(in_block)
+    starts = np.ones(positions.size, dtype=bool)
+    starts[1:] = ~flags[positions[:-1]]
+    block_id = np.cumsum(starts) - 1
+    return positions, block_id
+
+
+def tie_block_sort(
+    block_id: np.ndarray,  # (m,) int64, ascending
+    key_words: np.ndarray,  # (m, W) native u64 of BE-padded key bytes
+    key_len: np.ndarray,  # (m,)
+    inv_ts: np.ndarray,  # (m,) u64, ~timestamp
+    inv_src: np.ndarray,  # (m,)  ~source (newest-first tiebreak)
+):
+    """One vectorized lexsort ordering every tie block by the exact
+    merge order (full key asc, newest ts, newest src), blocks kept in
+    place via the primary block_id key.  Returns (order, dup): the
+    permutation over the m tie entries and per-sorted-entry duplicate
+    flags (equal full key as predecessor within the same block; the
+    first = newest survives)."""
+    cols = (
+        (inv_src, inv_ts, key_len)
+        + tuple(
+            key_words[:, w]
+            for w in range(key_words.shape[1] - 1, -1, -1)
+        )
+        + (block_id,)
+    )
+    order = np.lexsort(cols)
+    dup = np.zeros(order.size, dtype=bool)
+    if order.size > 1:
+        kb = key_words[order]
+        dup[1:] = (
+            (block_id[order][1:] == block_id[order][:-1])
+            & (key_len[order][1:] == key_len[order][:-1])
+            & np.all(kb[1:] == kb[:-1], axis=1)
+        )
+    return order, dup
+
+
+def padded_key_words(
+    data: np.ndarray,
+    key_start: np.ndarray,
+    key_len: np.ndarray,
+    pad_to: int = 0,
+) -> np.ndarray:
+    """(m, W) native-u64 words of the zero-padded key bytes (big-endian
+    within each word, so numeric order == lexicographic byte order;
+    equal padded words + equal length <=> equal key).  ``pad_to``
+    forces a common byte width across separate calls (multi-buffer
+    callers gathering per source)."""
+    m = key_start.size
+    max_len = int(key_len.max()) if m else 0
+    lpad = max(8, pad_to, ((max_len + 7) // 8) * 8)
+    if m == 0:
+        return np.zeros((0, lpad // 8), dtype=np.uint64)
+    lanes = np.arange(lpad, dtype=np.uint64)
+    pos = key_start.astype(np.uint64)[:, None] + lanes
+    valid = lanes < key_len.astype(np.uint64)[:, None]
+    pos = np.minimum(pos, np.uint64(max(0, data.size - 1)))
+    mat = np.where(valid, data[pos.astype(np.int64)], 0).astype(
+        np.uint8
+    )
+    return (
+        np.ascontiguousarray(mat)
+        .view(np.dtype(">u8"))
+        .astype(np.uint64)
+        .reshape(m, lpad // 8)
+    )
+
+
+def tie_block_widths(
+    block_id: np.ndarray, key_len: np.ndarray
+) -> np.ndarray:
+    """Per-entry padded-key byte width, bounded by the entry's BLOCK
+    max key length (pow2-multiples-of-8 buckets): one long-key outlier
+    widens only its own bucket's key matrix, not every tie entry's."""
+    if block_id.size == 0:
+        return np.zeros(0, np.int64)
+    nblocks = int(block_id[-1]) + 1
+    blk_max = np.zeros(nblocks, dtype=np.int64)
+    np.maximum.at(blk_max, block_id, key_len.astype(np.int64))
+    widths = np.empty(nblocks, np.int64)
+    for b in np.unique(blk_max):
+        c = (int(b) + 7) // 8
+        p = 1
+        while p < max(1, c):
+            p <<= 1
+        widths[blk_max == b] = 8 * p
+    return widths[block_id]
+
+
+def fixup_and_dedup_prefix(
+    cols: MergeColumns, perm: np.ndarray, words: int = KEY_PREFIX_WORDS
+):
+    """Vectorized combination of fixup_prefix_ties + dedup_mask_prefix:
+    one lexsort per key-width bucket over the tie-block entries (full
+    padded key, ~ts, ~src) instead of per-entry Python compares.
+    Returns (perm, keep)."""
+    n = perm.size
+    keep = np.ones(n, dtype=bool)
+    if n <= 1:
+        return perm, keep
+    kw = cols.key_words[perm]
+    flags = np.all(kw[1:, :words] == kw[:-1, :words], axis=1)
+    positions, block_id = tie_positions_and_blocks(flags)
+    if positions.size == 0:
+        return perm, keep
+    sel = perm[positions]
+    ks = cols.key_size[sel]
+    inv_ts = ~cols.timestamp[sel]
+    inv_src = ~cols.src[sel]
+    ent_w = tie_block_widths(block_id, ks)
+    perm = perm.copy()
+    for w in np.unique(ent_w):
+        bm = ent_w == w
+        kwords = padded_key_words(
+            cols.data,
+            cols.start[sel[bm]] + np.uint64(ENTRY_HEADER_SIZE),
+            ks[bm],
+            pad_to=int(w),
+        )
+        order, dup = tie_block_sort(
+            block_id[bm], kwords, ks[bm], inv_ts[bm], inv_src[bm]
+        )
+        sub_pos = positions[bm]
+        perm[sub_pos] = sel[bm][order]
+        keep[sub_pos] = ~dup
+    return perm, keep
+
+
 def fixup_prefix_ties(
     cols: MergeColumns, perm: np.ndarray, words: int = KEY_PREFIX_WORDS
 ) -> np.ndarray:

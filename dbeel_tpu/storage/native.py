@@ -62,14 +62,33 @@ def _load() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH) or stale:
         # Rebuild BEFORE the first dlopen: ctypes.CDLL caches by path,
         # so a stale library loaded once cannot be swapped in-process.
+        # Serialized under an flock: with --processes every shard
+        # process races through here at startup, and the lock makes
+        # the others wait for one build instead of compiling N times
+        # (the Makefile's atomic rename already guarantees nobody can
+        # dlopen a half-written library).
         try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "-B"] if stale
-                else ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
+            import fcntl
+
+            os.makedirs(
+                os.path.join(_NATIVE_DIR, "build"), exist_ok=True
             )
+            lock_path = os.path.join(_NATIVE_DIR, "build", ".lock")
+            with open(lock_path, "w") as lock_f:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+                # Re-check under the lock: another process may have
+                # just finished the same rebuild.
+                stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
+                    _LIB_PATH
+                ) < os.path.getmtime(src)
+                if not os.path.exists(_LIB_PATH) or stale:
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR, "-B"] if stale
+                        else ["make", "-C", _NATIVE_DIR],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
         except Exception as e:
             log.info("native build unavailable: %s", e)
             if not os.path.exists(_LIB_PATH):
@@ -137,6 +156,9 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.dbeel_writer_abort.restype = None
     lib.dbeel_writer_abort.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_writer_sync"):
+        lib.dbeel_writer_sync.restype = None
+        lib.dbeel_writer_sync.argtypes = [ctypes.c_void_p]
     lib.dbeel_memtable_new.restype = ctypes.c_void_p
     lib.dbeel_memtable_new.argtypes = [ctypes.c_uint32]
     lib.dbeel_memtable_free.restype = None

@@ -237,8 +237,21 @@ struct StreamFile {
   bool flush_aligned() {
     const uint64_t whole = fill & ~(KALIGN - 1);
     if (whole == 0) return true;
-    if (::pwrite(fd, buf, whole, file_off) != (ssize_t)whole)
-      return false;
+    // Short pwrites are legal (signal interruption, near-full fs):
+    // continue from the written offset; only ret < 0 (except EINTR)
+    // is fatal.  O_DIRECT keeps alignment because the kernel writes
+    // whole blocks or fails.
+    uint64_t done = 0;
+    while (done < whole) {
+      const ssize_t ret =
+          ::pwrite(fd, buf + done, whole - done, file_off + done);
+      if (ret < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      if (ret == 0) return false;
+      done += (uint64_t)ret;
+    }
     file_off += whole;
     fill -= whole;
     if (fill) std::memmove(buf, buf + whole, fill);
@@ -392,6 +405,17 @@ int64_t dbeel_writer_close(void* handle, uint64_t* data_size) {
   *data_size = w->data.logical;
   delete w;
   return (d && i) ? entries : -1;
+}
+
+// Flush the data file's written bytes to stable storage WITHOUT
+// closing: safe to call concurrently with dbeel_writer_put from
+// another thread (fdatasync and pwrite on the same fd are
+// independent), letting callers pipeline the device-cache flush
+// behind the write stream instead of paying it all at close_sync.
+// Only touches the kernel-visible file, never the writer's buffers.
+void dbeel_writer_sync(void* handle) {
+  auto* w = static_cast<GatherWriter*>(handle);
+  if (w->data.fd >= 0) ::fdatasync(w->data.fd);
 }
 
 void dbeel_writer_abort(void* handle) {

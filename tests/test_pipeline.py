@@ -90,3 +90,182 @@ def test_pipeline_byte_identical_to_heap(
     assert results["heap"] == results["device"], (
         f"seed {seed}: {results['heap']} != {results['device']}"
     )
+
+
+def _golden_vs_heap(tmp_dir, idxs, keep_tomb=False, expect_pipeline=True):
+    """Byte-identity vs the heap oracle + proof the pipeline actually
+    produced the device output (a silent None fallback to the
+    single-shot path would be byte-identical too, hiding a regression)."""
+    from dbeel_tpu.ops import pipeline as pipeline_mod
+
+    ran = []
+    real_impl = pipeline_mod._pipeline_merge_impl
+
+    def spy(*a, **kw):
+        res = real_impl(*a, **kw)
+        ran.append(res is not None)
+        return res
+
+    pipeline_mod._pipeline_merge_impl, saved = spy, real_impl
+    try:
+        results = {}
+        for name, oi in (("heap", 101), ("device", 103)):
+            strat = get_strategy(name)
+            srcs = [SSTable(tmp_dir, i, None) for i in idxs]
+            res = strat.merge(srcs, tmp_dir, oi, None, keep_tomb, 1)
+            for s in srcs:
+                s.close()
+            results[name] = (
+                _sha_triplet(tmp_dir, oi),
+                res.entry_count,
+                res.data_size,
+                res.wrote_bloom,
+            )
+    finally:
+        pipeline_mod._pipeline_merge_impl = saved
+    assert results["heap"] == results["device"]
+    if expect_pipeline:
+        assert ran and ran[-1], "pipeline fell back to single-shot"
+
+
+def _keys_from_u64(vals):
+    return [int(v).to_bytes(8, "big") for v in vals]
+
+
+def test_pipeline_wide_span_u32_collisions(tmp_dir, monkeypatch):
+    """Partition span >= 2^32 forces the order-preserving right shift;
+    keys planted within 2^shift of each other collide in the u32
+    approximation and must be fixed up (and deduped) on the host."""
+    monkeypatch.setattr(DeviceMergeStrategy, "PIPELINE_MIN_BYTES", 0)
+    rng = random.Random(11)
+    base = []
+    for _ in range(600):
+        v = rng.randrange(0, 1 << 63)
+        base.append(v)
+        if rng.random() < 0.04:
+            # sparse neighbours within 2^20 — far below the shift
+            # granularity, so they collide in u32 without tripping
+            # the exact-operand guard (_SHIFT_DUP_LIMIT)
+            base.append(v + rng.randrange(1, 1 << 20))
+    for r in range(3):
+        sub = sorted(set(rng.sample(base, 500)))
+        write_sstable_fixture(
+            tmp_dir,
+            r * 2,
+            [
+                (k, b"v%d" % r, 100 + r)
+                for k in _keys_from_u64(sub)
+            ],
+        )
+    _golden_vs_heap(tmp_dir, [0, 2, 4])
+
+
+def test_pipeline_dense_cluster_exact_operand(tmp_dir, monkeypatch):
+    """A dense sequential cluster plus one far outlier: the shift would
+    collapse the cluster into one value (the _SHIFT_DUP_LIMIT guard
+    keeps the exact 2-word operand), and the output must still match."""
+    monkeypatch.setattr(DeviceMergeStrategy, "PIPELINE_MIN_BYTES", 0)
+    for r in range(2):
+        vals = list(range(r, 4000, 2))  # dense, interleaved runs
+        if r == 0:
+            vals.append(1 << 62)  # outlier stretches the span
+        write_sstable_fixture(
+            tmp_dir,
+            r * 2,
+            [
+                (k, b"x" * 5, 200 + r)
+                for k in _keys_from_u64(sorted(vals))
+            ],
+        )
+    _golden_vs_heap(tmp_dir, [0, 2])
+
+
+def test_pipeline_tie_heavy_shared_prefixes(tmp_dir, monkeypatch):
+    """~30 hot 8-byte prefixes with long keys differing past them, plus
+    cross-run duplicate full keys: nearly every entry lands in a tie
+    block.  Round 2 aborted such runs (_TieFallback) and re-read
+    everything; round 3 must handle them inside the pipeline via the
+    vectorized fixup, byte-identical to the heap oracle."""
+    monkeypatch.setattr(DeviceMergeStrategy, "PIPELINE_MIN_BYTES", 0)
+    rng = random.Random(13)
+    hot = [b"PF%06d" % (i * 7) for i in range(30)]
+    shared = [
+        rng.choice(hot) + rng.randbytes(rng.randint(4, 12))
+        for _ in range(200)
+    ]
+    for r in range(4):
+        keys = {
+            rng.choice(hot) + rng.randbytes(rng.randint(4, 12))
+            for _ in range(250)
+        }
+        keys |= set(rng.sample(shared, 120))  # cross-run duplicates
+        write_sstable_fixture(
+            tmp_dir,
+            r * 2,
+            [(k, b"v%d" % r, 300 + r) for k in sorted(keys)],
+        )
+    _golden_vs_heap(tmp_dir, [0, 2, 4, 6])
+
+
+def test_pipeline_single_prefix_group_falls_back(tmp_dir, monkeypatch):
+    """One equal-prefix group larger than the kernel rows is
+    unsplittable: the pipeline must decline (None) and the single-shot
+    path must still produce the oracle bytes."""
+    monkeypatch.setattr(DeviceMergeStrategy, "PIPELINE_MIN_BYTES", 0)
+    rng = random.Random(19)
+    for r in range(2):
+        keys = sorted(
+            b"ONEPREFX" + rng.randbytes(6) for _ in range(400)
+        )
+        write_sstable_fixture(
+            tmp_dir, r * 2, [(k, b"v", 500 + r) for k in keys]
+        )
+    from dbeel_tpu.ops import pipeline as pipeline_mod
+
+    monkeypatch.setattr(pipeline_mod, "_MAX_P2", 128)
+    _golden_vs_heap(tmp_dir, [0, 2], expect_pipeline=False)
+
+
+def test_pipeline_many_runs_wide_packing(tmp_dir, monkeypatch):
+    """64 runs -> k2=64 -> 8-bit run-id packing (config-4's shape)."""
+    monkeypatch.setattr(DeviceMergeStrategy, "PIPELINE_MIN_BYTES", 0)
+    rng = random.Random(17)
+    for r in range(64):
+        entries = {}
+        for _ in range(40):
+            k = rng.randbytes(rng.randint(8, 16))
+            entries[k] = (rng.randbytes(rng.randint(0, 20)), 400 + r)
+        write_sstable_fixture(
+            tmp_dir,
+            r * 2,
+            [(k, v, ts) for k, (v, ts) in sorted(entries.items())],
+        )
+    _golden_vs_heap(tmp_dir, [r * 2 for r in range(64)])
+
+
+def test_rid_pack_roundtrip():
+    import numpy as np
+
+    from dbeel_tpu.ops import bitonic
+
+    for k2 in (1, 2, 4, 8, 16, 64, 256):
+        bits = bitonic.rid_pack_bits(k2)
+        assert k2 <= (1 << bits) <= 2 ** 16
+        rng = random.Random(k2)
+        n = 101
+        rids = np.array(
+            [rng.randrange(k2) for _ in range(n)], dtype=np.uint32
+        )
+        per = 32 // bits
+        pad = (-n) % per
+        padded = np.concatenate(
+            [rids, np.full(pad, (1 << bits) - 1, np.uint32)]
+        )
+        shifts = np.arange(per, dtype=np.uint32) * np.uint32(bits)
+        words = (
+            (padded.reshape(-1, per) << shifts[None, :])
+            .sum(axis=1)
+            .astype(np.uint32)
+        )
+        out = bitonic.unpack_rids(words, bits, n)
+        assert (out == rids).all()
