@@ -351,6 +351,7 @@ def main():
             log(f"CPU baseline extra pass {extra + 2} ...")
             r2, _n2, h2, t2 = best_cpu_pass(107)
             log(f"  {r2:,.0f} keys/s ({t2:.2f}s)")
+            assert h2 == cpu_hash, "CPU output changed between passes"
             if r2 > best_cpu_rate:
                 best_cpu_rate, best_cpu_hash, best_t = r2, h2, t2
             log(f"device extra pass {extra + 2} ...")

@@ -215,15 +215,6 @@ def _prefix32_packed_body(
     return _pack_rids(x[0, :, 1], p.bit_length() - 1, pack_bits)
 
 
-@functools.partial(jax.jit, static_argnames=("pack_bits",))
-def merge_runs_prefix32_packed_kernel(
-    vals: jnp.ndarray,  # (K, P) u32 rebased+shifted prefixes
-    counts: jnp.ndarray,  # (K,) u32 valid rows per run
-    pack_bits: int,
-):
-    return _prefix32_packed_body(vals, counts, pack_bits)
-
-
 def _prefix64_packed_body(
     prefixes: jnp.ndarray, counts: jnp.ndarray, pack_bits: int
 ):
@@ -238,15 +229,6 @@ def _prefix64_packed_body(
     while x.shape[0] > 1:
         x = _merge_level(x, ncmp=3)
     return _pack_rids(x[0, :, 2], p.bit_length() - 1, pack_bits)
-
-
-@functools.partial(jax.jit, static_argnames=("pack_bits",))
-def merge_runs_prefix64_packed_kernel(
-    prefixes: jnp.ndarray,  # (K, P, 2) u32 big-endian prefix words
-    counts: jnp.ndarray,  # (K,) u32
-    pack_bits: int,
-):
-    return _prefix64_packed_body(prefixes, counts, pack_bits)
 
 
 @functools.partial(jax.jit, static_argnames=("pack_bits",))
@@ -344,7 +326,8 @@ def device_merge_prefix_order(
     cols: columnar.MergeColumns, run_counts: List[int]
 ) -> np.ndarray:
     """Device order of ``cols`` by 8-byte key prefix (ties by staging
-    position — resolve with columnar.fixup_prefix_ties afterwards).
+    position — resolve with columnar.fixup_and_dedup_prefix
+    afterwards).
     Returns perm as int64 entry indices."""
     n = len(cols)
     if n == 0:

@@ -267,10 +267,18 @@ def pipeline_merge(
     output_index: int,
     keep_tombstones: bool,
     bloom_min_size: int,
+    mesh=None,
 ) -> Optional[MergeResult]:
     """Run the partitioned pipeline.  Returns None when unavailable
     (no native lib / no jax / pathological prefix skew) — the caller
     falls back to the single-shot path.
+
+    ``mesh``: a 1-D jax.sharding.Mesh — keyspace partitions are
+    disjoint sorted ranges, so the multi-chip form is pure data
+    parallelism: the launch-batch axis is sharded over the mesh and
+    every device merges its own partitions with NO cross-device
+    exchange (contrast the reference's single-core heap loop,
+    /root/reference/src/tasks/compaction.rs:104-137).
 
     Set ``DBEEL_PROFILE_DIR`` to capture a JAX profiler trace of the
     device stages (viewable in TensorBoard/XProf) — the SURVEY §5
@@ -291,9 +299,15 @@ def pipeline_merge(
                     output_index,
                     keep_tombstones,
                     bloom_min_size,
+                    mesh,
                 )
     return _pipeline_merge_impl(
-        sources, dir_path, output_index, keep_tombstones, bloom_min_size
+        sources,
+        dir_path,
+        output_index,
+        keep_tombstones,
+        bloom_min_size,
+        mesh,
     )
 
 
@@ -400,6 +414,7 @@ def _pipeline_merge_impl(
     output_index: int,
     keep_tombstones: bool,
     bloom_min_size: int,
+    mesh=None,
 ) -> Optional[MergeResult]:
     from ..storage import native as native_mod
 
@@ -458,6 +473,22 @@ def _pipeline_merge_impl(
     k2 = _pow2(max(1, len(runs)))
     pack_bits = rid_pack_bits(k2)
 
+    # Mesh mode: widen the launch batch to a device multiple and shard
+    # the batch axis — each device merges its own keyspace partitions.
+    launch_j = _LAUNCH_BATCH
+    shard32 = shard64 = shard_counts = None
+    if mesh is not None and mesh.devices.size > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        n_dev = int(mesh.devices.size)
+        launch_j = n_dev * max(1, _LAUNCH_BATCH // n_dev)
+        axis = mesh.axis_names[0]
+        shard32 = NamedSharding(mesh, PartitionSpec(axis, None, None))
+        shard64 = NamedSharding(
+            mesh, PartitionSpec(axis, None, None, None)
+        )
+        shard_counts = NamedSharding(mesh, PartitionSpec(axis, None))
+
     counts_all = np.array(
         [r.offsets.size for r in runs], dtype=np.int64
     )
@@ -507,18 +538,19 @@ def _pipeline_merge_impl(
 
     # ---- pipeline threads -------------------------------------------
     # Per-partition permits, sized for two full launch batches in
-    # flight (the upload thread holds up to _LAUNCH_BATCH permits
-    # while assembling a batch, so the pool must exceed one batch or
+    # flight (the upload thread holds up to launch_j permits while
+    # assembling a batch, so the pool must exceed one batch or
     # assembly itself would deadlock).
-    in_flight = threading.Semaphore(2 * _LAUNCH_BATCH)
+    in_flight = threading.Semaphore(2 * launch_j)
     kernel_q: "queue.Queue" = queue.Queue()
     order_q: "queue.Queue" = queue.Queue()
     stop = threading.Event()
 
     def _launch_batch(metas, hosts, mode32):
-        """One vmapped launch over up to _LAUNCH_BATCH same-mode
-        partitions, empty-slot padded to a single compiled shape."""
-        j = _LAUNCH_BATCH
+        """One vmapped launch over up to ``launch_j`` same-mode
+        partitions, empty-slot padded to a single compiled shape; the
+        batch axis shards over the mesh when one is supplied."""
+        j = launch_j
         if mode32:
             stack = np.full((j, k2, p2), SENTINEL, dtype=np.uint32)
         else:
@@ -530,14 +562,20 @@ def _pipeline_merge_impl(
             stack[slot] = host
             counts[slot] = meta[1]
         _ev(f"launch batch parts={[m[0] for m in metas]} mode32={mode32}")
-        dev = jax.device_put(stack)
+        sharding = shard32 if mode32 else shard64
+        if sharding is not None:
+            dev = jax.device_put(stack, sharding)
+            cnt = jax.device_put(counts, shard_counts)
+        else:
+            dev = jax.device_put(stack)
+            cnt = counts
         if mode32:
             out = merge_runs_prefix32_packed_batch_kernel(
-                dev, counts, pack_bits
+                dev, cnt, pack_bits
             )
         else:
             out = merge_runs_prefix64_packed_batch_kernel(
-                dev, counts, pack_bits
+                dev, cnt, pack_bits
             )
         _ev(f"dispatched batch parts={[m[0] for m in metas]}")
         kernel_q.put((metas, out))
@@ -580,7 +618,7 @@ def _pipeline_merge_impl(
                 batch_mode = mode32
                 metas.append((p, counts, los, mode32, minpf, shift))
                 hosts.append(host)
-                if len(metas) == _LAUNCH_BATCH:
+                if len(metas) == launch_j:
                     flush()
             flush()
             kernel_q.put(None)
@@ -685,7 +723,19 @@ def _pipeline_merge_impl(
     try:
         expected = 0
         while True:
-            item = order_q.get()
+            # Timed get: the writer thread can fail and set ``stop``
+            # without ever feeding order_q (it is not part of the
+            # upload->download chain), so an untimed get could park
+            # this thread forever on e.g. a full disk.
+            while True:
+                try:
+                    item = order_q.get(timeout=0.25)
+                    break
+                except queue.Empty:
+                    if writer_state["error"] is not None:
+                        raise writer_state["error"]
+                    if stop.is_set():
+                        raise _PipelineError("pipeline stopped")
             if item is None:
                 break
             if isinstance(item, BaseException):

@@ -41,7 +41,8 @@ class CompactionCoalescer:
         self, cols: columnar.MergeColumns, run_counts: List[int]
     ) -> np.ndarray:
         """Returns the merged permutation for this job (8B-prefix order;
-        ties resolved by the caller via columnar.fixup_prefix_ties)."""
+        ties resolved by the caller via
+        columnar.fixup_and_dedup_prefix)."""
         if len(cols) == 0:
             return np.zeros(0, np.int64)
         loop = asyncio.get_event_loop()
@@ -160,9 +161,31 @@ class CoalescedDeviceMergeStrategy:
         keep_tombstones,
         bloom_min_size,
     ):
+        from ..ops.device_compaction import DeviceMergeStrategy
         from ..storage.compaction import write_output_columnar
 
         loop = asyncio.get_event_loop()
+
+        # Big merges: the partitioned native pipeline (off-loop) beats
+        # any coalesced single-shot launch; the coalescer exists for
+        # many small concurrent per-shard merges.
+        total = sum(getattr(s, "data_size", 0) for s in sources)
+        if total >= DeviceMergeStrategy.PIPELINE_MIN_BYTES:
+            from ..ops.pipeline import pipeline_merge
+
+            result = await loop.run_in_executor(
+                None,
+                lambda: pipeline_merge(
+                    sources,
+                    dir_path,
+                    output_index,
+                    keep_tombstones,
+                    bloom_min_size,
+                ),
+            )
+            if result is not None:
+                return result
+
         cols = await loop.run_in_executor(
             None, columnar.load_columns, sources
         )
@@ -181,8 +204,9 @@ class CoalescedDeviceMergeStrategy:
             perm = columnar.fixup_long_key_ties(cols, perm)
 
         def finish():
-            p = columnar.fixup_prefix_ties(cols, perm, words=2)
-            keep = columnar.dedup_mask_prefix(cols, p, words=2)
+            p, keep = columnar.fixup_and_dedup_prefix(
+                cols, perm, words=2
+            )
             if not keep_tombstones:
                 keep = keep & ~cols.is_tombstone[p]
             order = p[keep]

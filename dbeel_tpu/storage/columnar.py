@@ -322,10 +322,9 @@ def tie_block_widths(
 def fixup_and_dedup_prefix(
     cols: MergeColumns, perm: np.ndarray, words: int = KEY_PREFIX_WORDS
 ):
-    """Vectorized combination of fixup_prefix_ties + dedup_mask_prefix:
-    one lexsort per key-width bucket over the tie-block entries (full
-    padded key, ~ts, ~src) instead of per-entry Python compares.
-    Returns (perm, keep)."""
+    """Vectorized tie fixup + dedup: one lexsort per key-width bucket
+    over the tie-block entries (full padded key, ~ts, ~src) instead of
+    per-entry Python compares.  Returns (perm, keep)."""
     n = perm.size
     keep = np.ones(n, dtype=bool)
     if n <= 1:
@@ -356,62 +355,6 @@ def fixup_and_dedup_prefix(
         perm[sub_pos] = sel[bm][order]
         keep[sub_pos] = ~dup
     return perm, keep
-
-
-def fixup_prefix_ties(
-    cols: MergeColumns, perm: np.ndarray, words: int = KEY_PREFIX_WORDS
-) -> np.ndarray:
-    """Re-sort every block of adjacent entries whose first ``words``
-    key-prefix words tie, by (full key, ~ts, ~src) — the exact merge
-    order.  Host refinement for the device prefix kernel; blocks are
-    empty for well-spread keys (e.g. uniform 16-byte benchmark keys)."""
-    if perm.size <= 1:
-        return perm
-    kw = cols.key_words[perm]
-    tie = np.all(kw[1:, :words] == kw[:-1, :words], axis=1)
-    if not tie.any():
-        return perm
-    perm = perm.copy()
-    for lo, hi in _flags_to_runs(tie):
-        block = perm[lo:hi]
-        order = sorted(
-            range(block.size),
-            key=lambda j: (
-                full_key(cols, int(block[j])),
-                ~cols.timestamp[block[j]],
-                ~cols.src[block[j]],
-            ),
-        )
-        perm[lo:hi] = block[np.array(order)]
-    return perm
-
-
-def dedup_mask_prefix(
-    cols: MergeColumns, perm: np.ndarray, words: int = KEY_PREFIX_WORDS
-) -> np.ndarray:
-    """keep-first-per-key mask where key identity is confirmed with full
-    compares inside prefix-tie blocks (keys ≤ words*4 bytes shortcut via
-    padded-word + length equality)."""
-    n = perm.size
-    keep = np.ones(n, dtype=bool)
-    if n <= 1:
-        return keep
-    kw = cols.key_words[perm]
-    ks = cols.key_size[perm]
-    tie = np.all(kw[1:, :words] == kw[:-1, :words], axis=1)
-    len_eq = ks[1:] == ks[:-1]
-    short = ks <= words * 4
-    # Short keys: padded prefix + equal length <=> equal key.
-    confirmed = tie & len_eq & short[1:] & short[:-1]
-    needs_check = np.flatnonzero(tie & len_eq & ~(short[1:] & short[:-1]))
-    same = confirmed
-    for j in needs_check:
-        if full_key(cols, int(perm[j + 1])) == full_key(
-            cols, int(perm[j])
-        ):
-            same[j] = True
-    keep[1:] = ~same
-    return keep
 
 
 def fixup_long_key_ties(cols: MergeColumns, perm: np.ndarray) -> np.ndarray:
