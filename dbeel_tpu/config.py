@@ -54,7 +54,7 @@ class Config:
     compaction_backend: str = "auto"
     memtable_capacity: int = 0  # 0 = storage.DEFAULT_TREE_CAPACITY
     # sorted | hash (device flush sort) | arena (C++ rbtree arena)
-    memtable_kind: str = "sorted"
+    memtable_kind: str = "auto"
     processes: bool = False  # one pinned OS process per shard
 
     def replace(self, **kw) -> "Config":
@@ -154,7 +154,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--memtable-kind",
-        choices=("sorted", "hash", "arena"),
+        choices=("auto", "sorted", "hash", "arena"),
         default=d.memtable_kind,
     )
     p.add_argument(

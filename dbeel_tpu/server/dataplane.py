@@ -1,0 +1,184 @@
+"""Native serving data plane — the C fast path for db-server frames.
+
+SURVEY §7's stated architecture ("C++ host runtime owning I/O ...
+Python as thin API veneer") applied to the serving path: one C call
+per request frame covers msgpack parse → ownership check → arena
+memtable set → WAL append (plus memtable-hit gets), replacing ~60-90µs
+of interpreted Python per op with a few µs of native code — the role
+the reference's compiled handler plays
+(/root/reference/src/tasks/db_server.rs:395-454).
+
+Python remains the brain: only RF=1 collections with the arena
+memtable and no wal-sync are registered, and ANY non-trivial condition
+(other request types, unowned keys, full memtables, replica traffic,
+malformed frames, sstable reads, tombstones) makes the C side return
+PUNT and the frame re-runs through the unchanged Python handler, so
+semantics and error formatting are identical on both paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# Full wire response for a successful set/delete: u32-LE length +
+# msgpack "OK" + RESPONSE_BYTES trailing byte (db_server.rs:405-428).
+OK_RESPONSE = b"\x04\x00\x00\x00\xa2OK\x02"
+
+_GET_BUF_CAP = 256 << 10
+
+
+class DataPlane:
+    """Per-shard native fast path.  Lifecycle:
+
+    * the shard registers every eligible collection's write state
+      (active/flushing memtable handles + native WAL handle) and
+      re-registers on every flush swap (LSMTree.write_state_listener);
+    * ring changes recompute the replica-0 ownership range;
+    * the db server offers each frame via try_handle() before falling
+      back to the async Python path.
+    """
+
+    def __init__(self, lib) -> None:
+        self._lib = lib
+        self._handle = lib.dbeel_dp_new()
+        if not self._handle:
+            raise MemoryError("dataplane allocation failed")
+        self._trees = {}  # name -> LSMTree (flush spawning)
+        self._get_buf = ctypes.create_string_buffer(_GET_BUF_CAP)
+        self._out_len = ctypes.c_uint32(0)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.dbeel_dp_free(handle)
+            self._handle = None
+
+    # ---- registration ------------------------------------------------
+
+    @staticmethod
+    def tree_eligible(tree) -> bool:
+        """Fast path requires the native arena memtable (its handle IS
+        the C-side memtable) and a native WAL appender; wal-sync trees
+        stay on the Python path (sync coalescing is asyncio-side)."""
+        active = getattr(tree, "_active", None)
+        wal = getattr(tree, "_wal", None)
+        return (
+            getattr(active, "_handle", None) is not None
+            and wal is not None
+            and getattr(wal, "_native", None) is not None
+            and not tree.wal_sync
+        )
+
+    def register_tree(self, name: str, tree) -> None:
+        if not self.tree_eligible(tree):
+            self.unregister(name)
+            return
+        nm = name.encode()
+        flushing = getattr(tree, "_flushing", None)
+        rc = self._lib.dbeel_dp_register(
+            self._handle,
+            nm,
+            len(nm),
+            ctypes.c_void_p(tree._active._handle),
+            ctypes.c_void_p(
+                flushing._handle
+                if getattr(flushing, "_handle", None)
+                else None
+            ),
+            ctypes.c_void_p(tree._wal._native),
+            tree.capacity,
+        )
+        if rc < 0:
+            # Failed (re-)registration must also clear any C-side
+            # entry: a stale slot would keep old memtable/WAL pointers
+            # alive past their owners (use-after-free on the next
+            # fast write) and desynchronize slot indexing.
+            log.warning("dataplane registration failed for %s", name)
+            self.unregister(name)
+            return
+        self._trees[name] = tree
+        if list(self._trees).index(name) != rc:
+            # Slot bookkeeping diverged from the C vector (should be
+            # impossible): disable the flush lookup safely.
+            log.error(
+                "dataplane slot mismatch for %s (rc=%d)", name, rc
+            )
+            self.unregister(name)
+            return
+        tree.write_state_listener = lambda t, n=name: self.register_tree(
+            n, t
+        )
+
+    def unregister(self, name: str) -> None:
+        nm = name.encode()
+        self._lib.dbeel_dp_unregister(self._handle, nm, len(nm))
+        tree = self._trees.pop(name, None)
+        if tree is not None:
+            tree.write_state_listener = None
+
+    def set_ownership(self, mode: int, lo: int = 0, hi: int = 0) -> None:
+        """mode 0 = punt everything, 1 = own the whole ring (single
+        shard), 2 = cyclic range (lo, hi] for replica_index 0."""
+        self._lib.dbeel_dp_set_ownership(self._handle, mode, lo, hi)
+
+    # ---- serving -----------------------------------------------------
+
+    def try_handle(
+        self, frame: bytes
+    ) -> Optional[Tuple[bytes, bool, Optional[object], str]]:
+        """Returns (response_bytes, keepalive, tree_needing_flush, op)
+        when the frame was fully handled natively; None to punt."""
+        flags = self._lib.dbeel_dp_handle(
+            self._handle,
+            frame,
+            len(frame),
+            self._get_buf,
+            _GET_BUF_CAP,
+            ctypes.byref(self._out_len),
+        )
+        if flags < 0:
+            return None
+        keepalive = bool(flags & 1)
+        if flags & 4:  # get served from a memtable
+            return (
+                self._get_buf[: self._out_len.value],
+                keepalive,
+                None,
+                "get",
+            )
+        flush_tree = None
+        if flags & 2:  # memtable reached capacity: spawn the flush
+            col_idx = flags >> 8
+            trees = list(self._trees.values())
+            # Slot order matches registration order (C appends).
+            if 0 <= col_idx < len(trees):
+                flush_tree = trees[col_idx]
+        op = "delete" if flags & 8 else "set"
+        return OK_RESPONSE, keepalive, flush_tree, op
+
+    def stats(self) -> dict:
+        return {
+            "fast_sets": int(
+                self._lib.dbeel_dp_fast_sets(self._handle)
+            ),
+            "fast_gets": int(
+                self._lib.dbeel_dp_fast_gets(self._handle)
+            ),
+        }
+
+
+def create_dataplane() -> Optional[DataPlane]:
+    try:
+        from ..storage import native as native_mod
+
+        lib = native_mod.load_if_built()
+        if lib is None or not hasattr(lib, "dbeel_dp_handle"):
+            return None
+        return DataPlane(lib)
+    except Exception:
+        log.exception("dataplane unavailable")
+        return None

@@ -383,12 +383,42 @@ class _DbProtocol(asyncio.Protocol):
         self.last_active = asyncio.get_event_loop().time()
         self.shard.scheduler.fg_mark()
         parsed = False
+        dp = self.shard.dataplane
         while len(self.buf) >= 2:
             size = self.buf[0] | (self.buf[1] << 8)
             if len(self.buf) < 2 + size:
                 break
-            self.pending.append(bytes(self.buf[2 : 2 + size]))
+            frame = bytes(self.buf[2 : 2 + size])
             del self.buf[: 2 + size]
+            # Native fast path: only when no async frames are queued
+            # (responses must leave in request order per connection).
+            # A handled frame is answered synchronously right here —
+            # no task hop, no interpreter dispatch.
+            if (
+                dp is not None
+                and self.task is None
+                and not self.pending
+                and not self.closing
+                # Honor transport backpressure: while the peer reads
+                # slowly (pause_writing fired) responses must queue
+                # behind _drain's writable.wait(), not pile into the
+                # transport buffer unboundedly.
+                and self.writable.is_set()
+            ):
+                started = time.monotonic()
+                fast = dp.try_handle(frame)
+                if fast is not None:
+                    resp, keepalive, flush_tree, op = fast
+                    self.transport.write(resp)
+                    self.shard.metrics.record_request(op, started)
+                    if flush_tree is not None:
+                        self.shard.spawn(flush_tree.flush())
+                    if not keepalive:
+                        self.closing = True
+                        self.transport.close()
+                        return
+                    continue
+            self.pending.append(frame)
             parsed = True
         if (
             len(self.pending) > self.PENDING_HIGH

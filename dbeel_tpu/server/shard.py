@@ -140,6 +140,13 @@ class MyShard:
         from .metrics import ShardMetrics
 
         self.metrics = ShardMetrics()
+        # Native serving data plane (SURVEY §7: compiled hot path,
+        # Python keeps the cluster/replication brain).  None when the
+        # native library is unavailable — everything then runs the
+        # Python path.
+        from .dataplane import create_dataplane
+
+        self.dataplane = create_dataplane()
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
         # Live public-API connections (protocol objects) for the
@@ -171,6 +178,28 @@ class MyShard:
         )
         self._hash_sorted = sorted(self.shards, key=lambda s: s.hash)
         self._sorted_hashes = [s.hash for s in self._hash_sorted]
+        self._refresh_dataplane_ownership()
+
+    def _refresh_dataplane_ownership(self) -> None:
+        """Push the replica-0 ownership range down to the native fast
+        path.  owns_key(h, 0) == "I am the first shard with hash >= h
+        on the wrapping ring", i.e. the cyclic range
+        (predecessor_hash, my_hash]."""
+        dp = getattr(self, "dataplane", None)
+        if dp is None:
+            return
+        ring = self._sorted_hashes
+        if len(ring) < 2:
+            dp.set_ownership(1)
+            return
+        if len(set(ring)) != len(ring):
+            # Hash collisions on the ring: bisect tie-breaks get
+            # subtle — serve ownership checks from Python only.
+            dp.set_ownership(0)
+            return
+        idx = ring.index(self.hash)
+        prev_hash = ring[idx - 1]  # cyclic: idx 0 -> last entry
+        dp.set_ownership(2, prev_hash, self.hash)
 
     def add_shards_of_nodes(self, nodes: List[NodeMetadata]) -> None:
         for node in nodes:
@@ -364,6 +393,11 @@ class MyShard:
             },
             "scheduler": self.scheduler.stats(),
             "metrics": self.metrics.snapshot(),
+            "dataplane": (
+                self.dataplane.stats()
+                if self.dataplane is not None
+                else None
+            ),
             "collections": collections,
         }
 
@@ -385,6 +419,8 @@ class MyShard:
                 f.flush()
                 os.fsync(f.fileno())
         self.collections[name] = Collection(tree, replication_factor)
+        if self.dataplane is not None and replication_factor == 1:
+            self.dataplane.register_tree(name, tree)
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_CREATED)
 
@@ -396,6 +432,8 @@ class MyShard:
         col = self.collections.pop(name, None)
         if col is None:
             raise CollectionNotFound(name)
+        if self.dataplane is not None:
+            self.dataplane.unregister(name)
         await col.tree.purge()
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_DROPPED)

@@ -101,10 +101,19 @@ class LSMTree:
         # the device sort (ops/sort.py) — the north-star flush path;
         # "arena" = the C++ arena red-black tree (native/), the direct
         # rbtree_arena analog (falls back to "sorted" if unbuilt).
-        if memtable_kind not in ("sorted", "hash", "arena"):
+        if memtable_kind not in ("auto", "sorted", "hash", "arena"):
             raise ValueError(
-                f"memtable_kind must be 'sorted', 'hash' or 'arena', "
-                f"got {memtable_kind!r}"
+                f"memtable_kind must be 'auto', 'sorted', 'hash' or "
+                f"'arena', got {memtable_kind!r}"
+            )
+        if memtable_kind == "auto":
+            # Arena when the native library is present: it is the
+            # rbtree_arena analog AND what the native serving data
+            # plane writes into; otherwise the Python sorted map.
+            from .native import load_if_built
+
+            memtable_kind = (
+                "arena" if load_if_built() is not None else "sorted"
             )
         self.memtable_kind = memtable_kind
         if memtable_kind == "hash":
@@ -139,6 +148,10 @@ class LSMTree:
         self.flush_start_event = LocalEvent()
         self.flush_done_event = LocalEvent()
         self.flow = flow_events.FlowEventNotifier()
+        # Serving-data-plane hook: called with this tree whenever the
+        # write state (active/flushing memtable, WAL) changes, so the
+        # native fast path re-registers fresh handles.
+        self.write_state_listener = None
 
     # ------------------------------------------------------------------
     # Open / recovery (lsm_tree.rs:401-545)
@@ -242,6 +255,14 @@ class LSMTree:
             sync=self.wal_sync,
             sync_delay_us=self.wal_sync_delay_us,
         )
+        self._notify_write_state()
+
+    def _notify_write_state(self) -> None:
+        if self.write_state_listener is not None:
+            try:
+                self.write_state_listener(self)
+            except Exception:
+                log.exception("write_state_listener failed")
 
     def _wal_path(self, index: int) -> str:
         return os.path.join(
@@ -383,6 +404,7 @@ class LSMTree:
                 self._active = self._memtable_cls(self.capacity)
                 self._wal = new_wal
                 self._index = next_index
+                self._notify_write_state()
                 self.flush_start_event.notify()
 
             flush_index, old_wal = self._pending_flush
@@ -408,6 +430,7 @@ class LSMTree:
             )
             self._flushing = None
             self._pending_flush = None
+            self._notify_write_state()
             old_wal.delete()
         finally:
             self._is_flushing = False

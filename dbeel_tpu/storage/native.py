@@ -159,6 +159,62 @@ def _load() -> Optional[ctypes.CDLL]:
     if hasattr(lib, "dbeel_writer_sync"):
         lib.dbeel_writer_sync.restype = None
         lib.dbeel_writer_sync.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_dp_handle"):
+        lib.dbeel_wal_new.restype = ctypes.c_void_p
+        lib.dbeel_wal_new.argtypes = [ctypes.c_int32, ctypes.c_uint64]
+        lib.dbeel_wal_free.restype = None
+        lib.dbeel_wal_free.argtypes = [ctypes.c_void_p]
+        lib.dbeel_wal_offset.restype = ctypes.c_uint64
+        lib.dbeel_wal_offset.argtypes = [ctypes.c_void_p]
+        lib.dbeel_wal_append.restype = ctypes.c_uint64
+        lib.dbeel_wal_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_int64,
+        ]
+        lib.dbeel_dp_new.restype = ctypes.c_void_p
+        lib.dbeel_dp_new.argtypes = []
+        lib.dbeel_dp_free.restype = None
+        lib.dbeel_dp_free.argtypes = [ctypes.c_void_p]
+        lib.dbeel_dp_set_ownership.restype = None
+        lib.dbeel_dp_set_ownership.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.dbeel_dp_register.restype = ctypes.c_int32
+        lib.dbeel_dp_register.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+        ]
+        lib.dbeel_dp_unregister.restype = None
+        lib.dbeel_dp_unregister.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.dbeel_dp_fast_sets.restype = ctypes.c_uint64
+        lib.dbeel_dp_fast_sets.argtypes = [ctypes.c_void_p]
+        lib.dbeel_dp_fast_gets.restype = ctypes.c_uint64
+        lib.dbeel_dp_fast_gets.argtypes = [ctypes.c_void_p]
+        lib.dbeel_dp_handle.restype = ctypes.c_int64
+        lib.dbeel_dp_handle.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
     lib.dbeel_memtable_new.restype = ctypes.c_void_p
     lib.dbeel_memtable_new.argtypes = [ctypes.c_uint32]
     lib.dbeel_memtable_free.restype = None

@@ -49,6 +49,22 @@ class Wal:
         # writes become unreachable.
         self._offset = _valid_end(self._fd)
         os.ftruncate(self._fd, self._offset)
+        # Native appender (encode + crc + padded pwrite in one C call):
+        # it owns the offset while alive, so the serving data plane and
+        # this class can interleave appends on one shared counter.
+        self._native = None
+        self._lib = None
+        try:
+            from . import native as native_mod
+
+            lib = native_mod.load_if_built()
+            if lib is not None and hasattr(lib, "dbeel_wal_new"):
+                handle = lib.dbeel_wal_new(self._fd, self._offset)
+                if handle:
+                    self._native = handle
+                    self._lib = lib
+        except Exception:
+            self._native = None
         self._seq = 0  # appends so far
         self._synced_seq = 0  # appends covered by a completed fdatasync
         self._syncing = False
@@ -57,13 +73,21 @@ class Wal:
         self._closing = False
 
     async def append(self, key: bytes, value: bytes, timestamp: int) -> None:
-        entry = encode_entry(key, value, timestamp)
-        record = _HEADER.pack(
-            _MAGIC, len(entry), zlib.crc32(entry), 0
-        ) + entry
-        record += b"\x00" * (_padded(len(record)) - len(record))
-        os.pwrite(self._fd, record, self._offset)
-        self._offset += len(record)
+        if self._native is not None:
+            new_off = self._lib.dbeel_wal_append(
+                self._native, key, len(key), value, len(value), timestamp
+            )
+            if new_off == 0:
+                raise OSError(f"WAL append failed for {self.path}")
+            self._offset = new_off
+        else:
+            entry = encode_entry(key, value, timestamp)
+            record = _HEADER.pack(
+                _MAGIC, len(entry), zlib.crc32(entry), 0
+            ) + entry
+            record += b"\x00" * (_padded(len(record)) - len(record))
+            os.pwrite(self._fd, record, self._offset)
+            self._offset += len(record)
         self._seq += 1
         await self._maybe_sync()
 
@@ -110,6 +134,9 @@ class Wal:
                 self._sync_event.notify()
 
     def _really_close(self) -> None:
+        if self._native is not None:
+            self._lib.dbeel_wal_free(self._native)
+            self._native = None
         if self._fd >= 0:
             os.close(self._fd)
             self._fd = -1
