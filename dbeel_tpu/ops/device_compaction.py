@@ -66,6 +66,7 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
                 output_index,
                 keep_tombstones,
                 bloom_min_size,
+                throttle=self.throttle,
             )
             if result is not None:
                 return result
@@ -94,12 +95,14 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
 
         perm, pieces = device_merge_prefix_order_pipelined(sources)
         cols = columnar.assemble_columns(pieces)
+        self._tick()
         perm, keep = self._refine(cols, perm)
+        self._tick()
         if not keep_tombstones:
             keep = keep & ~cols.is_tombstone[perm]
         return write_output_columnar(
             cols, perm[keep], dir_path, output_index, cache,
-            bloom_min_size,
+            bloom_min_size, throttle=self.throttle,
         )
 
     def _refine(self, cols, perm):

@@ -268,6 +268,7 @@ def pipeline_merge(
     keep_tombstones: bool,
     bloom_min_size: int,
     mesh=None,
+    throttle=None,
 ) -> Optional[MergeResult]:
     """Run the partitioned pipeline.  Returns None when unavailable
     (no native lib / no jax / pathological prefix skew) — the caller
@@ -300,6 +301,7 @@ def pipeline_merge(
                     keep_tombstones,
                     bloom_min_size,
                     mesh,
+                    throttle,
                 )
     return _pipeline_merge_impl(
         sources,
@@ -308,6 +310,7 @@ def pipeline_merge(
         keep_tombstones,
         bloom_min_size,
         mesh,
+        throttle,
     )
 
 
@@ -415,6 +418,7 @@ def _pipeline_merge_impl(
     keep_tombstones: bool,
     bloom_min_size: int,
     mesh=None,
+    throttle=None,
 ) -> Optional[MergeResult]:
     from ..storage import native as native_mod
 
@@ -844,6 +848,10 @@ def _pipeline_merge_impl(
                             "writer stopped"
                         )
             _ev(f"consume done p={p}")
+            if throttle is not None:
+                # Latency class: one partition is the consume quantum —
+                # pay back CPU to serving between partitions.
+                throttle.tick()
             if collect_bloom:
                 bloom_sel.append(sel)
         write_q.put(None)

@@ -248,6 +248,7 @@ def DistributedMergeStrategy(mesh: Mesh):
                     keep_tombstones,
                     bloom_min_size,
                     mesh=self.mesh,
+                    throttle=self.throttle,
                 )
                 if result is not None:
                     return result

@@ -140,6 +140,9 @@ class CoalescedDeviceMergeStrategy:
     concurrent shard compactions rendezvous in one launch."""
 
     name = "coalesced"
+    # Intra-merge latency-class hook (see CompactionStrategy.throttle;
+    # this class is duck-typed, not a subclass, so it needs its own).
+    throttle = None
 
     def __init__(
         self, coalescer: Optional[CompactionCoalescer] = None
@@ -150,7 +153,9 @@ class CoalescedDeviceMergeStrategy:
     def merge(self, *args, **kwargs):
         from ..ops.device_compaction import DeviceMergeStrategy
 
-        return DeviceMergeStrategy().merge(*args, **kwargs)
+        s = DeviceMergeStrategy()
+        s.throttle = self.throttle
+        return s.merge(*args, **kwargs)
 
     async def merge_async(
         self,
@@ -181,6 +186,7 @@ class CoalescedDeviceMergeStrategy:
                     output_index,
                     keep_tombstones,
                     bloom_min_size,
+                    throttle=self.throttle,
                 ),
             )
             if result is not None:
@@ -212,7 +218,7 @@ class CoalescedDeviceMergeStrategy:
             order = p[keep]
             return write_output_columnar(
                 cols, order, dir_path, output_index, cache,
-                bloom_min_size,
+                bloom_min_size, throttle=self.throttle,
             )
 
         return await loop.run_in_executor(None, finish)

@@ -14,10 +14,18 @@ compaction merge, one migration batch, one hint replay) runs inside
 ``bg_slice()``, which measures the unit's wall time and then, for as
 long as foreground work keeps arriving, idles ``elapsed * fg/bg``
 seconds — converging on the glommio ratio under load and imposing zero
-delay on an idle shard.  Units are coarser than glommio's preemption
-quanta (a merge can't be preempted mid-run), which is exactly the
-granularity the single-threaded reference pays too: its merge yields
-only between heap pops.
+delay on an idle shard.
+
+Units alone would be too coarse — one unit is a whole merge, and the
+reference's merge yields between heap pops — so long merges are ALSO
+sliced internally: every merge strategy carries a ``BgThrottle``
+(thread-safe, usable from the executor thread the merge runs on) and
+ticks it between bounded quanta (pipeline partitions, native heap-merge
+entry blocks, columnar write chunks).  Each tick pays back
+``quantum * fg/bg`` of idle time while serving stays busy, bounding how
+long a compaction can monopolise the CPU against a latency-sensitive
+request to roughly one quantum — the Latency::Matters(20ms) analog
+(/root/reference/src/tasks/db_server.rs:466-471).
 """
 
 from __future__ import annotations
@@ -78,6 +86,11 @@ class ShareScheduler:
             self.bg_throttled_s += slept
             debt -= slept
 
+    def thread_throttle(self) -> "BgThrottle":
+        """A throttle for background WORKER THREADS (merges run off-loop
+        via run_in_executor): tick it between bounded work quanta."""
+        return BgThrottle(self)
+
     def stats(self) -> dict:
         return {
             "foreground_shares": self.fg_shares,
@@ -87,3 +100,40 @@ class ShareScheduler:
             "background_busy_s": round(self.bg_busy_s, 6),
             "background_throttled_s": round(self.bg_throttled_s, 6),
         }
+
+
+class BgThrottle:
+    """Cooperative intra-merge throttle, callable from any thread.
+
+    Each ``tick()`` measures the quantum since the previous tick and
+    sleeps ``quantum * fg/bg`` (in POLL_S steps, re-checking) for as
+    long as foreground traffic keeps the shard busy; an idle shard pays
+    nothing.  ``time.sleep`` releases the GIL, handing the CPU to the
+    event-loop thread — which is the whole point on a one-core host.
+    Quanta are clamped so a long un-ticked stretch (device kernel wait,
+    big IO) can't convert into one giant stall afterwards.
+    """
+
+    MAX_QUANTUM_S = 0.5
+
+    __slots__ = ("_sched", "_last")
+
+    def __init__(self, scheduler: ShareScheduler) -> None:
+        self._sched = scheduler
+        self._last = time.monotonic()
+
+    def reset(self) -> None:
+        self._last = time.monotonic()
+
+    def tick(self) -> None:
+        s = self._sched
+        now = time.monotonic()
+        debt = min(now - self._last, self.MAX_QUANTUM_S) * s._ratio
+        while debt > 0 and s.fg_busy():
+            step = min(s.POLL_S, debt)
+            time.sleep(step)
+            s.bg_throttled_s += step
+            debt -= step
+        self._last = time.monotonic()
+
+    __call__ = tick

@@ -360,6 +360,10 @@ class MyShard:
 
     def _create_lsm_tree(self, name: str) -> LSMTree:
         capacity = self.config.memtable_capacity or DEFAULT_TREE_CAPACITY
+        strategy = get_strategy(self.config.compaction_backend)
+        # Intra-merge latency class: the merge worker thread yields CPU
+        # to serving between bounded quanta (scheduler.BgThrottle).
+        strategy.throttle = self.scheduler.thread_throttle()
         return LSMTree.open_or_create(
             self._collection_dir(name),
             cache=PartitionPageCache(name, self.cache),
@@ -367,7 +371,7 @@ class MyShard:
             wal_sync=self.config.wal_sync,
             wal_sync_delay_us=self.config.wal_sync_delay_us,
             bloom_min_size=self.config.sstable_bloom_min_size,
-            strategy=get_strategy(self.config.compaction_backend),
+            strategy=strategy,
             memtable_kind=self.config.memtable_kind,
         )
 

@@ -53,6 +53,18 @@ class MergeResult:
 class CompactionStrategy(ABC):
     name = "abstract"
 
+    # Optional intra-merge throttle (server.scheduler.BgThrottle): the
+    # shard attaches one per tree so long merges yield CPU to serving
+    # between bounded quanta even though they run on a worker thread.
+    # Strategies tick it between partitions / entry blocks / write
+    # chunks; None (the default, e.g. in tests and bench) is free.
+    throttle = None
+
+    def _tick(self) -> None:
+        t = self.throttle
+        if t is not None:
+            t.tick()
+
     @abstractmethod
     def merge(
         self,
@@ -101,7 +113,11 @@ class HeapMergeStrategy(CompactionStrategy):
                 break
         keys: List[bytes] = []
         last_key: Optional[bytes] = None
+        popped = 0
         while heap:
+            popped += 1
+            if popped % 8192 == 0:
+                self._tick()
             key, _nts, _ni, value, i = heapq.heappop(heap)
             for nkey, nvalue, nts in iters[i]:
                 heapq.heappush(heap, (nkey, ~nts, -i, nvalue, i))
@@ -146,12 +162,15 @@ class ColumnarMergeStrategy(CompactionStrategy):
         bloom_min_size,
     ) -> MergeResult:
         cols = columnar.load_columns(sources)
+        self._tick()
         perm, keep = self.sort_and_dedup(cols)
+        self._tick()
         if not keep_tombstones:
             keep = keep & ~cols.is_tombstone[perm]
         order = perm[keep]
         return write_output_columnar(
-            cols, order, dir_path, output_index, cache, bloom_min_size
+            cols, order, dir_path, output_index, cache, bloom_min_size,
+            throttle=self.throttle,
         )
 
 
@@ -162,6 +181,7 @@ def write_output_columnar(
     output_index: int,
     cache: Optional[PartitionPageCache],
     bloom_min_size: int,
+    throttle=None,
 ) -> MergeResult:
     """Bulk-write the compact_* triplet from a surviving-record order."""
     full_sizes = cols.full_size[order].astype(np.uint64)
@@ -197,6 +217,8 @@ def write_output_columnar(
     chunk = 32 << 20
     for off in range(0, len(view), chunk):
         data_w.write(view[off : off + chunk])
+        if throttle is not None:
+            throttle.tick()
     data_w.close()
     index_w = PageMirroringWriter(
         f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}",

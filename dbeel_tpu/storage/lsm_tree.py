@@ -508,6 +508,11 @@ class LSMTree:
             SSTable(self.dir_path, t.index, None) for t in inputs
         ]
         try:
+            throttle = getattr(self.strategy, "throttle", None)
+            if throttle is not None:
+                # A fresh merge must not inherit debt accumulated since
+                # the previous merge's last tick.
+                throttle.reset()
             merge_async = getattr(self.strategy, "merge_async", None)
             if merge_async is not None:
                 result = await merge_async(

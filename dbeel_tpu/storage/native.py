@@ -47,6 +47,13 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libdbeel_native.so")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+# C-side latency-class hook: the heap merge calls back into Python
+# every TICK_EVERY popped entries so the BgThrottle can yield CPU to
+# serving (the callback re-acquires the GIL; at this stride the cost
+# is noise — ~15 calls per million entries).
+TICK_FN = ctypes.CFUNCTYPE(None)
+_MERGE_TICK_EVERY = 65536
+
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
@@ -258,6 +265,20 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint64),
         u8p,
     ]
+    if hasattr(lib, "dbeel_merge_cb"):
+        lib.dbeel_merge_cb.restype = ctypes.c_int64
+        lib.dbeel_merge_cb.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint32,
+            ctypes.c_int,
+            u8p,
+            ctypes.POINTER(ctypes.c_uint64),
+            u8p,
+            TICK_FN,
+            ctypes.c_uint64,
+        ]
     _lib = lib
     return _lib
 
@@ -318,7 +339,7 @@ class NativeMergeStrategy(CompactionStrategy):
 
         DataArr = ctypes.c_char_p * len(sources)
         CountArr = ctypes.c_uint64 * len(sources)
-        n_out = lib.dbeel_merge(
+        args = (
             DataArr(*datas),
             DataArr(*indexes),
             CountArr(*counts),
@@ -328,7 +349,19 @@ class NativeMergeStrategy(CompactionStrategy):
             ctypes.byref(out_size),
             out_index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
+        throttle = self.throttle
+        if hasattr(lib, "dbeel_merge_cb"):
+            # None maps to a NULL fn pointer — same as dbeel_merge.
+            tick_cb = (
+                TICK_FN(throttle.tick) if throttle is not None else None
+            )
+            n_out = lib.dbeel_merge_cb(
+                *args, tick_cb, _MERGE_TICK_EVERY
+            )
+        else:
+            n_out = lib.dbeel_merge(*args)
         data_size = out_size.value
+        self._tick()
 
         from .entry import DATA_FILE_EXT, INDEX_FILE_EXT
 

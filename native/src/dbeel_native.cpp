@@ -132,10 +132,16 @@ void dbeel_bloom_add_batch(uint8_t* bits, uint64_t num_bits,
 // k-way merge. Returns the number of output entries; fills out_data
 // (records) and out_index (16B entries), sets *out_data_size.
 // The caller sizes out_data/out_index at the sum of the inputs.
-int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
-                    const uint64_t* counts, uint32_t nsrc,
-                    int keep_tombstones, uint8_t* out_data,
-                    uint64_t* out_data_size, uint8_t* out_index) {
+// dbeel_merge_cb additionally invokes tick() every tick_every popped
+// entries — the server's latency-class quantum hook (a ctypes callback
+// that yields CPU to serving while it is busy); tick may be null.
+typedef void (*dbeel_tick_fn)(void);
+
+int64_t dbeel_merge_cb(const uint8_t** datas, const uint8_t** indexes,
+                       const uint64_t* counts, uint32_t nsrc,
+                       int keep_tombstones, uint8_t* out_data,
+                       uint64_t* out_data_size, uint8_t* out_index,
+                       dbeel_tick_fn tick, uint64_t tick_every) {
   std::vector<HeapItem> heap;
   heap.reserve(nsrc);
 
@@ -163,7 +169,9 @@ int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
   uint32_t last_key_len = 0;
   IndexEntry* oindex = reinterpret_cast<IndexEntry*>(out_index);
 
+  uint64_t popped = 0;
   while (!heap.empty()) {
+    if (tick && tick_every && ++popped % tick_every == 0) tick();
     std::pop_heap(heap.begin(), heap.end(), item_greater);
     HeapItem item = heap.back();
     heap.pop_back();
@@ -200,6 +208,14 @@ int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
 
   *out_data_size = out_off;
   return out_count;
+}
+
+int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
+                    const uint64_t* counts, uint32_t nsrc,
+                    int keep_tombstones, uint8_t* out_data,
+                    uint64_t* out_data_size, uint8_t* out_index) {
+  return dbeel_merge_cb(datas, indexes, counts, nsrc, keep_tombstones,
+                        out_data, out_data_size, out_index, nullptr, 0);
 }
 
 }  // extern "C"

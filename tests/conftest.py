@@ -18,13 +18,65 @@ jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import shutil  # noqa: E402
+import signal  # noqa: E402
 import tempfile  # noqa: E402
+from contextlib import contextmanager  # noqa: E402
 
 import pytest  # noqa: E402
 
 from dbeel_tpu import flow_events  # noqa: E402
 
 flow_events.enable()
+
+
+# ----------------------------------------------------------------------
+# Per-test watchdog: a jax/TPU-tunnel init stall must fail THAT test in
+# under two minutes instead of wedging the whole suite / CI for hours
+# (pytest-timeout is not in the image; SIGALRM interrupts blocking
+# syscalls via EINTR, and Python runs the handler before retrying them,
+# PEP 475).  Override with DBEEL_TEST_TIMEOUT_S (0 disables).
+# ----------------------------------------------------------------------
+
+_TEST_TIMEOUT_S = int(os.environ.get("DBEEL_TEST_TIMEOUT_S", "110"))
+
+
+@contextmanager
+def _alarm(phase, item):
+    if _TEST_TIMEOUT_S <= 0 or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def handler(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} {phase} exceeded the {_TEST_TIMEOUT_S}s "
+            f"suite watchdog (wedged TPU tunnel / jax init?)"
+        )
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+    with _alarm("setup", item):
+        return (yield)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    with _alarm("call", item):
+        return (yield)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_teardown(item):
+    with _alarm("teardown", item):
+        return (yield)
 
 
 @pytest.fixture

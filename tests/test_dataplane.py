@@ -12,7 +12,6 @@ import pytest
 
 from dbeel_tpu.storage.native import native_available
 
-from conftest import run
 
 pytestmark = pytest.mark.skipif(
     not native_available(), reason="native library unavailable"
