@@ -131,16 +131,21 @@ class ShardRequest:
         return ["request", ShardRequest.GET, collection, key]
 
     @staticmethod
-    def range_digest(collection: str, start: int, end: int) -> list:
-        """Anti-entropy probe: order-independent digest of (key, ts)
+    def range_digest(
+        collection: str, start: int, end: int, buckets: int = 1
+    ) -> list:
+        """Anti-entropy probe: order-independent digests of (key, ts)
         pairs whose key hash falls in the half-open wrap range
-        [start, end)."""
+        [start, end), split into ``buckets`` equal hash sub-ranges
+        (merkle-bucket style — one diverged key then syncs only its
+        ~range/buckets slice, not the whole range)."""
         return [
             "request",
             ShardRequest.RANGE_DIGEST,
             collection,
             start,
             end,
+            buckets,
         ]
 
     @staticmethod
@@ -150,9 +155,13 @@ class ShardRequest:
         end: int,
         start_after: Optional[bytes],
         limit: int,
+        buckets: Optional[list] = None,
+        nbuckets: int = 0,
     ) -> list:
         """Anti-entropy page fetch: up to ``limit`` (key, value, ts)
-        triples in the range, keys > start_after."""
+        triples in the range, keys > start_after.  With ``buckets``
+        (+ ``nbuckets``), only entries whose hash falls in one of the
+        listed sub-range buckets are returned."""
         return [
             "request",
             ShardRequest.RANGE_PULL,
@@ -161,6 +170,8 @@ class ShardRequest:
             end,
             start_after,
             limit,
+            buckets,
+            nbuckets,
         ]
 
     @staticmethod
@@ -221,8 +232,14 @@ class ShardResponse:
         ]
 
     @staticmethod
-    def range_digest(count: int, digest: int) -> list:
-        return ["response", ShardResponse.RANGE_DIGEST, count, digest]
+    def range_digest(counts: list, digests: list) -> list:
+        # Per-bucket (count, digest) vectors, index = bucket id.
+        return [
+            "response",
+            ShardResponse.RANGE_DIGEST,
+            counts,
+            digests,
+        ]
 
     @staticmethod
     def range_pull(entries: list) -> list:

@@ -45,6 +45,9 @@ class Config:
     # Anti-entropy digest-compare interval per shard; 0 disables.
     # (Beyond-reference: the reference has no anti-entropy.)
     anti_entropy_interval_ms: int = 60_000
+    # Hash sub-range buckets per digest scan (flat merkle layer): one
+    # diverged key syncs ~range/buckets entries, not the whole range.
+    anti_entropy_buckets: int = 64
 
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
@@ -134,6 +137,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=d.anti_entropy_interval_ms,
         help="anti-entropy digest-compare interval in ms (0 disables)",
     )
+    p.add_argument(
+        "--anti-entropy-buckets",
+        type=int,
+        default=d.anti_entropy_buckets,
+        help="hash sub-range buckets per anti-entropy digest scan",
+    )
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
@@ -191,6 +200,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         foreground_tasks_shares=ns.foreground_tasks_shares,
         background_tasks_shares=ns.background_tasks_shares,
         anti_entropy_interval_ms=ns.anti_entropy_interval_ms,
+        anti_entropy_buckets=ns.anti_entropy_buckets,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,

@@ -29,6 +29,7 @@ class FlowEvent(enum.Enum):
     COMPACTION_DONE = "CompactionDone"
     WAL_SYNCED = "WalSynced"
     READ_REPAIR = "ReadRepair"
+    HINT_RECORDED = "HintRecorded"
     HINTS_REPLAYED = "HintsReplayed"
     ANTI_ENTROPY_DONE = "AntiEntropyDone"
     ANTI_ENTROPY_SYNCED = "AntiEntropySynced"  # a mismatch was repaired

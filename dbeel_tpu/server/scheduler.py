@@ -54,6 +54,11 @@ class ShareScheduler:
         self.bg_units = 0
         self.bg_busy_s = 0.0
         self.bg_throttled_s = 0.0
+        # Busy time intra-merge ticks have already charged the share
+        # ratio for — bg_slice subtracts it so a merge that throttled
+        # itself internally is not charged a second time by the outer
+        # unit payback.
+        self.bg_precharged_s = 0.0
 
     # -- foreground side (serving path: one call per request) ----------
     def fg_mark(self) -> None:
@@ -67,15 +72,30 @@ class ShareScheduler:
     @asynccontextmanager
     async def bg_slice(self):
         """Wrap one background unit of work; idles afterwards in
-        proportion to the unit's duration while foreground stays busy."""
+        proportion to the unit's duration while foreground stays busy.
+        Work an attached BgThrottle already paid for mid-unit (its
+        sleeps AND the quanta it charged) is excluded, otherwise a
+        self-throttling merge pays the share ratio twice.  (Concurrent
+        units on other trees can tick the same scheduler inside this
+        window — the subtraction then errs toward less throttling,
+        never more.)"""
         t0 = time.monotonic()
+        thr0 = self.bg_throttled_s
+        pre0 = self.bg_precharged_s
         try:
             yield
         finally:
             elapsed = time.monotonic() - t0
+            covered = (self.bg_throttled_s - thr0) + (
+                self.bg_precharged_s - pre0
+            )
             self.bg_units += 1
+            # Stats keep the full unit duration; only the payback debt
+            # excludes already-covered time.
             self.bg_busy_s += elapsed
-            await self._throttle(elapsed * self._ratio)
+            await self._throttle(
+                max(0.0, elapsed - covered) * self._ratio
+            )
 
     async def _throttle(self, debt: float) -> None:
         while debt > 0 and self.fg_busy():
@@ -128,7 +148,9 @@ class BgThrottle:
     def tick(self) -> None:
         s = self._sched
         now = time.monotonic()
-        debt = min(now - self._last, self.MAX_QUANTUM_S) * s._ratio
+        quantum = min(now - self._last, self.MAX_QUANTUM_S)
+        s.bg_precharged_s += quantum
+        debt = quantum * s._ratio
         while debt > 0 and s.fg_busy():
             step = min(s.POLL_S, debt)
             time.sleep(step)

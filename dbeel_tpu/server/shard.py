@@ -140,6 +140,11 @@ class MyShard:
         from .metrics import ShardMetrics
 
         self.metrics = ShardMetrics()
+        # Anti-entropy transfer counters (observability + the
+        # sub-range proportionality test: one diverged key must move
+        # ~range/buckets entries, not the whole range).
+        self.ae_entries_pushed = 0
+        self.ae_entries_fetched = 0
         # Native serving data plane (SURVEY §7: compiled hot path,
         # Python keeps the cluster/replication brain).  None when the
         # native library is unavailable — everything then runs the
@@ -396,6 +401,10 @@ class MyShard:
                 "misses": self.cache.misses,
             },
             "scheduler": self.scheduler.stats(),
+            "anti_entropy": {
+                "entries_pushed": self.ae_entries_pushed,
+                "entries_fetched": self.ae_entries_fetched,
+            },
             "metrics": self.metrics.snapshot(),
             "dataplane": (
                 self.dataplane.stats()
@@ -545,6 +554,7 @@ class MyShard:
         self.hints.setdefault(
             node_name, deque(maxlen=self.MAX_HINTS_PER_NODE)
         ).append(request)
+        self.flow.notify(FlowEvent.HINT_RECORDED)
 
     async def replay_hints(self, node_name: str) -> None:
         queued = self.hints.pop(node_name, None)
@@ -756,19 +766,26 @@ class MyShard:
             return ShardResponse.get(entry)
         if kind == ShardRequest.RANGE_DIGEST:
             col = self.collections.get(request[2])
-            count, digest = 0, 0
+            nb = int(request[5]) if len(request) > 5 else 1
+            nb = max(1, nb)
+            counts, digests = [0] * nb, [0] * nb
             if col is not None:
                 # Peer-side anti-entropy scans are background work too:
                 # they must defer to this shard's own serving traffic.
                 async with self.scheduler.bg_slice():
-                    count, digest = await self.compute_range_digest(
-                        col.tree, request[3], request[4]
+                    counts, digests = await self.compute_range_digests(
+                        col.tree, request[3], request[4], nb
                     )
-            return ShardResponse.range_digest(count, digest)
+            return ShardResponse.range_digest(counts, digests)
         if kind == ShardRequest.RANGE_PULL:
             col = self.collections.get(request[2])
             entries: list = []
             if col is not None:
+                buckets = None
+                nb = 0
+                if len(request) > 8 and request[7] is not None:
+                    buckets = {int(b) for b in request[7]}
+                    nb = int(request[8])
                 async with self.scheduler.bg_slice():
                     entries = await self.collect_range_page(
                         col.tree,
@@ -778,6 +795,8 @@ class MyShard:
                         if request[5] is not None
                         else None,
                         int(request[6]),
+                        buckets,
+                        nb,
                     )
             return ShardResponse.range_pull(entries)
         if kind == ShardRequest.RANGE_PUSH:
@@ -834,30 +853,50 @@ class MyShard:
         return start == end or _between(h, start, end)
 
     @staticmethod
-    async def compute_range_digest(
-        tree, start: int, end: int
-    ) -> Tuple[int, int]:
-        """Order-independent 64-bit digest over (key, newest-ts) pairs
-        in the anti-entropy range.  Tombstones count (their deletions
-        must converge too)."""
+    def _ae_bucket_of(h: int, start: int, end: int, nbuckets: int) -> int:
+        """Sub-range bucket (0..nbuckets-1) of an in-range hash: the
+        wrap range [start, end) is split into nbuckets equal slices.
+        Both digest sides and the pull filter use THIS function, so
+        bucket membership can never disagree across peers."""
+        width = (end - start) & 0xFFFFFFFF
+        if width == 0:
+            width = 1 << 32  # single ring point: the whole ring
+        d = (h - start) & 0xFFFFFFFF
+        return min(nbuckets - 1, (d * nbuckets) // width)
+
+    @staticmethod
+    async def compute_range_digests(
+        tree, start: int, end: int, nbuckets: int = 1
+    ) -> Tuple[list, list]:
+        """Order-independent 64-bit digests over (key, newest-ts) pairs
+        in the anti-entropy range, one per hash sub-range bucket (a
+        flat merkle layer: ONE scan fills all buckets).  Tombstones
+        count (their deletions must converge too)."""
         from ..utils.murmur import murmur3_32
 
-        newest: Dict[bytes, int] = {}
-        async for key, _value, ts in tree.iter_filter(
-            lambda k, v, t: MyShard._in_ae_range(
-                hash_bytes(k), start, end
-            )
-        ):
+        newest: Dict[bytes, int] = {}  # key -> newest ts
+        # One hash per entry: range membership is checked in the loop
+        # body (the filter lambda would hash a second time) and the
+        # bucket is derived once per unique key at aggregation.
+        async for key, _value, ts in tree.iter_filter(None):
+            h = hash_bytes(key)
+            if not MyShard._in_ae_range(h, start, end):
+                continue
             prev = newest.get(key)
             if prev is None or ts > prev:
                 newest[key] = ts
-        digest = 0
+        counts = [0] * nbuckets
+        digests = [0] * nbuckets
         for key, ts in newest.items():
+            b = MyShard._ae_bucket_of(
+                hash_bytes(key), start, end, nbuckets
+            )
             blob = key + ts.to_bytes(8, "little", signed=True)
-            digest ^= murmur3_32(blob, 0x0A57E4A1) | (
+            counts[b] += 1
+            digests[b] ^= murmur3_32(blob, 0x0A57E4A1) | (
                 murmur3_32(blob, 0x51C6E57A) << 32
             )
-        return len(newest), digest
+        return counts, digests
 
     @staticmethod
     async def collect_range_entries(
@@ -865,19 +904,27 @@ class MyShard:
         start: int,
         end: int,
         start_after: Optional[bytes] = None,
+        buckets: Optional[set] = None,
+        nbuckets: int = 0,
     ) -> list:
         """ALL (key, value, newest-ts) triples in the anti-entropy
-        range with key > start_after, ascending by key.  The push side
-        calls this once and pages from the result; the stateless
-        RANGE_PULL server pays one scan per page (keys <= start_after
-        are filtered during the scan, so later pages dedup less)."""
+        range with key > start_after, ascending by key; with
+        ``buckets``, only entries in those hash sub-range buckets.
+        The push side calls this once and pages from the result; the
+        stateless RANGE_PULL server pays one scan per page (keys <=
+        start_after are filtered during the scan, so later pages dedup
+        less)."""
         newest: Dict[bytes, Tuple[bytes, int]] = {}
-        async for key, value, ts in tree.iter_filter(
-            lambda k, v, t: MyShard._in_ae_range(
-                hash_bytes(k), start, end
-            )
-        ):
+        async for key, value, ts in tree.iter_filter(None):
             if start_after is not None and key <= start_after:
+                continue
+            h = hash_bytes(key)  # once per entry: range AND bucket
+            if not MyShard._in_ae_range(h, start, end):
+                continue
+            if buckets is not None and (
+                MyShard._ae_bucket_of(h, start, end, nbuckets)
+                not in buckets
+            ):
                 continue
             prev = newest.get(key)
             if prev is None or ts > prev[1]:
@@ -893,11 +940,13 @@ class MyShard:
         end: int,
         start_after: Optional[bytes],
         limit: int,
+        buckets: Optional[set] = None,
+        nbuckets: int = 0,
     ) -> list:
         """Up to ``limit`` entries with key > start_after (the
         stateless remote paging entry point)."""
         entries = await MyShard.collect_range_entries(
-            tree, start, end, start_after
+            tree, start, end, start_after, buckets, nbuckets
         )
         return entries[:limit]
 
@@ -941,7 +990,8 @@ class MyShard:
         if kind == GossipEvent.ALIVE:
             node = NodeMetadata.from_wire(event[1])
             if node.name != self.config.name:
-                if node.name not in self.nodes:
+                newly_added = node.name not in self.nodes
+                if newly_added:
                     self.nodes[node.name] = node
                     self.add_shards_of_nodes([node])
                 # State transition resets the opposite epidemic
@@ -952,12 +1002,19 @@ class MyShard:
                 if node.name in self.hints:
                     self.spawn(self.replay_hints(node.name))
                 self.flow.notify(FlowEvent.ALIVE_NODE_GOSSIP)
-                added = [
-                    s
-                    for s in self.shards
-                    if s.node_name == node.name
-                ]
-                self.migrate_data_on_node_addition(added)
+                if newly_added:
+                    # Migrate ONLY on the add transition (shards.rs:
+                    # 1139-1152 — the ring didn't change on a duplicate
+                    # Alive, so re-streaming every owned range per
+                    # gossip re-receipt is pure waste and hides real
+                    # repair mechanisms behind accidental migrations).
+                    self.migrate_data_on_node_addition(
+                        [
+                            s
+                            for s in self.shards
+                            if s.node_name == node.name
+                        ]
+                    )
         elif kind == GossipEvent.DEAD:
             node_name = event[1]
             if node_name == self.config.name:

@@ -257,22 +257,45 @@ ANTI_ENTROPY_PAGE = 2048
 
 
 async def _sync_range_with_peer(
-    my_shard, name, tree, peer, start, end, count, digest
+    my_shard, name, tree, peer, start, end, counts, digests
 ):
+    """Compare per-bucket digests with one peer; push+pull ONLY the
+    diverged hash sub-ranges.  A single diverged key now transfers
+    ~range/nbuckets entries instead of the whole primary range (the
+    round-2 whole-range caveat, resolved with a flat merkle layer)."""
     from ..cluster.messages import ShardRequest, ShardResponse
 
+    nb = len(counts)
     resp = await peer.connection.send_request(
-        ShardRequest.range_digest(name, start, end)
+        ShardRequest.range_digest(name, start, end, nb)
     )
     msgs.response_to_result(resp, ShardResponse.RANGE_DIGEST)
-    p_count, p_digest = resp[2], resp[3]
-    if (count, digest) == (p_count, p_digest):
+    try:
+        p_counts, p_digests = list(resp[2]), list(resp[3])
+    except TypeError:  # scalar (pre-bucket dialect) or junk
+        p_counts = []
+        p_digests = []
+    if len(p_counts) != nb or len(p_digests) != nb:
+        # Defensive: peer answered a weird/old shape — treat every
+        # bucket as diverged and fall back to a whole-range sync
+        # rather than crashing this shard's anti-entropy loop.
+        p_counts = [-1] * nb
+        p_digests = [0] * nb
+    diverged = [
+        b
+        for b in range(nb)
+        if (counts[b], digests[b]) != (p_counts[b], p_digests[b])
+    ]
+    if not diverged:
         return False
+    bucket_set = set(diverged)
 
-    # Push ours in batched pages from ONE materialized range snapshot;
-    # the peer applies strictly-newer only.
+    # Push ours in batched pages from ONE materialized snapshot of the
+    # diverged buckets; the peer applies strictly-newer only.
     async with my_shard.scheduler.bg_slice():
-        mine = await my_shard.collect_range_entries(tree, start, end)
+        mine = await my_shard.collect_range_entries(
+            tree, start, end, None, bucket_set, nb
+        )
     pushed = 0
     for off in range(0, len(mine), ANTI_ENTROPY_PAGE):
         page = mine[off : off + ANTI_ENTROPY_PAGE]
@@ -284,13 +307,22 @@ async def _sync_range_with_peer(
                 ShardResponse.RANGE_PUSH,
             )
         pushed += len(page)
-    # ...and pull theirs, applying only strictly-newer entries.
+        my_shard.ae_entries_pushed += len(page)
+    # ...and pull theirs (same diverged buckets), applying only
+    # strictly-newer entries.
     pulled = 0
+    fetched = 0
     page_after = None
     while True:
         resp = await peer.connection.send_request(
             ShardRequest.range_pull(
-                name, start, end, page_after, ANTI_ENTROPY_PAGE
+                name,
+                start,
+                end,
+                page_after,
+                ANTI_ENTROPY_PAGE,
+                diverged,
+                nb,
             )
         )
         entries = msgs.response_to_result(
@@ -298,6 +330,8 @@ async def _sync_range_with_peer(
         )
         if not entries:
             break
+        fetched += len(entries)
+        my_shard.ae_entries_fetched += len(entries)
         async with my_shard.scheduler.bg_slice():
             for key, value, ts in entries:
                 if await my_shard.apply_if_newer(
@@ -309,10 +343,14 @@ async def _sync_range_with_peer(
         page_after = bytes(entries[-1][0])
     if pushed or pulled:
         log.info(
-            "anti-entropy %s with %s: pushed %d, applied %d pulled",
+            "anti-entropy %s with %s: %d/%d buckets diverged, "
+            "pushed %d, fetched %d, applied %d pulled",
             name,
             peer.name,
+            len(diverged),
+            nb,
             pushed,
+            fetched,
             pulled,
         )
     my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_SYNCED)
@@ -325,6 +363,7 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
     interval = my_shard.config.anti_entropy_interval_ms / 1000.0
     if interval <= 0:
         return
+    nb = max(1, my_shard.config.anti_entropy_buckets)
     while True:
         await asyncio.sleep(interval)
         # Primary ownership range is (predecessor, self] — shift both
@@ -356,11 +395,11 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                     break
             if not peers:
                 continue
-            # One digest scan per collection per cycle, shared by all
-            # rf-1 peer comparisons.
+            # One digest scan per collection per cycle fills ALL
+            # sub-range buckets, shared by the rf-1 peer comparisons.
             async with my_shard.scheduler.bg_slice():
-                count, digest = await my_shard.compute_range_digest(
-                    col.tree, start, end
+                counts, digests = await my_shard.compute_range_digests(
+                    col.tree, start, end, nb
                 )
             for peer in peers:
                 try:
@@ -371,17 +410,17 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                         peer,
                         start,
                         end,
-                        count,
-                        digest,
+                        counts,
+                        digests,
                     )
                     if pulled_any:
                         # A pull changed our range: later peers must
-                        # compare against the CURRENT digest or every
+                        # compare against the CURRENT digests or every
                         # one of them re-syncs.
                         async with my_shard.scheduler.bg_slice():
-                            count, digest = (
-                                await my_shard.compute_range_digest(
-                                    col.tree, start, end
+                            counts, digests = (
+                                await my_shard.compute_range_digests(
+                                    col.tree, start, end, nb
                                 )
                             )
                 except (DbeelError, OSError) as e:

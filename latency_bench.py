@@ -1,0 +1,234 @@
+"""Set latency under a concurrent major compaction (BENCH.md row for
+the intra-merge latency classes; /root/reference's analog is glommio's
+Latency::Matters serving queue, src/tasks/db_server.rs:466-471).
+
+Phase "quiet":      Sets against an idle single-shard node.
+Phase "compacting": the same load while the node major-compacts
+                    --keys synthetic keys at startup (the compaction
+                    scheduler's startup pass picks up the pre-built
+                    even-index sstables immediately).
+
+Prints one JSON line with p50/p99 for both phases and the compaction
+evidence (odd-index output present).  Usage:
+
+    python latency_bench.py [--keys 10000000] [--runs 8] \
+        [--backend native] [--port 12600] [--duration 8]
+"""
+
+import argparse
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+
+import msgpack
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def req(port, obj, timeout=10.0):
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    b = msgpack.packb(obj, use_bin_type=True)
+    s.sendall(struct.pack("<H", len(b)) + b)
+    hdr = b""
+    while len(hdr) < 4:
+        c = s.recv(4 - len(hdr))
+        assert c, "connection closed"
+        hdr += c
+    (n,) = struct.unpack("<I", hdr)
+    body = b""
+    while len(body) < n:
+        c = s.recv(n - len(body))
+        assert c, "connection closed"
+        body += c
+    s.close()
+    return body[-1], body[:-1]
+
+
+def wait_up(port, deadline=120.0):
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        try:
+            t, _ = req(port, {"type": "get_cluster_metadata"})
+            return
+        except OSError:
+            time.sleep(0.3)
+    raise SystemExit("server never came up")
+
+
+def run_load(port, duration, tag):
+    """Connect-per-request Sets (the reference client dialect) for
+    ``duration`` seconds; returns sorted latency list in seconds."""
+    lat = []
+    t_end = time.time() + duration
+    i = 0
+    while time.time() < t_end:
+        ta = time.time()
+        t, b = req(
+            port,
+            {
+                "type": "set",
+                "collection": "c",
+                "key": f"lb{tag}{i:08d}",
+                "value": i,
+            },
+        )
+        assert t == 2, (t, b)
+        lat.append(time.time() - ta)
+        i += 1
+    lat.sort()
+    return lat
+
+
+def pct(lat, p):
+    return lat[min(len(lat) - 1, int(len(lat) * p))]
+
+
+def start_server(d, port, backend, extra=()):
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO
+        + (
+            ":" + os.environ["PYTHONPATH"]
+            if os.environ.get("PYTHONPATH")
+            else ""
+        ),
+    }
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dbeel_tpu.server.run",
+            "--dir",
+            d,
+            "--port",
+            str(port),
+            "--remote-shard-port",
+            str(port + 10000),
+            "--gossip-port",
+            str(port + 20000),
+            "--shards",
+            "1",
+            "--compaction-backend",
+            backend,
+            *extra,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--runs", type=int, default=8)
+    ap.add_argument("--backend", default="native")
+    ap.add_argument("--port", type=int, default=12600)
+    ap.add_argument("--duration", type=float, default=8.0)
+    ap.add_argument(
+        "--server-arg",
+        action="append",
+        default=[],
+        help="extra args passed to the server (repeatable), e.g. "
+        "--server-arg=--background-tasks-shares=1000000 to neutralize "
+        "the merge throttle for comparison",
+    )
+    args = ap.parse_args()
+
+    from bench import build_runs  # noqa: E402 (repo-root import)
+
+    # ---- quiet phase ------------------------------------------------
+    d1 = tempfile.mkdtemp(prefix="latbench_quiet_")
+    p1 = start_server(d1, args.port, args.backend, args.server_arg)
+    try:
+        wait_up(args.port)
+        t, _ = req(args.port, {"type": "create_collection", "name": "c"})
+        assert t == 2, "create failed"
+        quiet = run_load(args.port, args.duration, "q")
+    finally:
+        p1.terminate()
+        p1.wait(timeout=20)
+
+    # ---- compacting phase ------------------------------------------
+    # Pre-build the big even-index runs + collection metadata, then
+    # start the node: its startup compaction pass majors them while we
+    # measure the same Set load.
+    d2 = tempfile.mkdtemp(prefix="latbench_compact_")
+    col_dir = os.path.join(d2, "c-0")
+    os.makedirs(col_dir)
+    with open(os.path.join(d2, "c.metadata"), "wb") as f:
+        f.write(msgpack.packb({"replication_factor": 1}))
+    print(
+        f"building {args.runs} runs x {args.keys // args.runs} keys ...",
+        file=sys.stderr,
+    )
+    build_runs(col_dir, args.keys, args.runs)
+
+    port2 = args.port + 1
+    p2 = start_server(d2, port2, args.backend, args.server_arg)
+    compacted = False
+    try:
+        wait_up(port2)
+        # Give the startup compaction a beat to actually begin.
+        time.sleep(0.5)
+        busy = run_load(port2, args.duration, "b")
+        # Compaction evidence: an odd output index exists (in-flight
+        # compact_* or finished .data).
+        names = os.listdir(col_dir)
+        compacted = any(
+            n.split(".")[0].isdigit() and int(n.split(".")[0]) % 2 == 1
+            for n in names
+        ) or any("compact" in n for n in names)
+        # Wait for the merge to finish so teardown is clean; the odd
+        # output index appearing IS the compaction evidence (it may
+        # land after the measurement window — the merge only writes
+        # its compact_* files at the end).
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            names = os.listdir(col_dir)
+            if any(
+                n.endswith(".data")
+                and int(n.split(".")[0]) % 2 == 1
+                for n in names
+            ) and not any("compact_" in n for n in names):
+                compacted = True
+                break
+            time.sleep(1.0)
+    finally:
+        p2.terminate()
+        p2.wait(timeout=30)
+
+    out = {
+        "metric": "set_p99_under_major_compaction",
+        "unit": "us",
+        "keys": args.keys,
+        "backend": args.backend,
+        "server_args": args.server_arg,
+        "quiet": {
+            "ops": len(quiet),
+            "p50_us": round(pct(quiet, 0.50) * 1e6, 1),
+            "p99_us": round(pct(quiet, 0.99) * 1e6, 1),
+            "max_ms": round(quiet[-1] * 1e3, 2),
+        },
+        "compacting": {
+            "ops": len(busy),
+            "p50_us": round(pct(busy, 0.50) * 1e6, 1),
+            "p99_us": round(pct(busy, 0.99) * 1e6, 1),
+            "max_ms": round(busy[-1] * 1e3, 2),
+        },
+        "compaction_observed": compacted,
+        "p99_ratio": round(
+            pct(busy, 0.99) / max(pct(quiet, 0.99), 1e-9), 2
+        ),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -170,13 +170,20 @@ def test_replication_with_multiple_shards_per_node(tmp_dir):
             client = await DbeelClient.from_seed_nodes(
                 [node1.db_address]
             )
+            # Collection-visible-on-every-shard via flow events (no
+            # sleep-polling): subscribe on all 6 shards BEFORE creating.
+            visible = [
+                s.flow.subscribe(FlowEvent.COLLECTION_CREATED)
+                for n in (node1, node2)
+                for s in n.shards
+            ]
             col = await client.create_collection(
                 "ms", replication_factor=2
             )
+            await asyncio.wait_for(asyncio.gather(*visible), 10)
             for n in (node1, node2):
                 for s in n.shards:
-                    while "ms" not in s.collections:
-                        await asyncio.sleep(0.01)
+                    assert "ms" in s.collections
             for i in range(80):
                 await col.set(
                     f"key{i:03}", i, consistency=Consistency.ALL
@@ -222,16 +229,22 @@ def test_hinted_handoff_replays_missed_writes(tmp_dir):
             nodes.append(await ClusterNode(c).start())
             await alive
         client = await DbeelClient.from_seed_nodes([nodes[0].db_address])
+        visible = [
+            n.flow_event(0, FlowEvent.COLLECTION_CREATED) for n in nodes
+        ]
         col = await client.create_collection("hh", replication_factor=3)
-        for n in nodes:
-            while "hh" not in n.shards[0].collections:
-                await asyncio.sleep(0.01)
+        await asyncio.wait_for(asyncio.gather(*visible), 10)
 
         # Node 3 goes down (silently); ALL-consistency writes whose
         # fan-out window covers it queue hints on their coordinators.
         # (Keys whose PRIMARY was node 3 are never attempted there —
         # read repair covers those; hints cover the attempted ones.)
         await nodes[2].crash()
+        hint_recorded = [
+            s.flow.subscribe(FlowEvent.HINT_RECORDED)
+            for n in nodes[:2]
+            for s in n.shards
+        ]
         n_keys = 30
         for i in range(n_keys):
             await col.set(
@@ -246,10 +259,14 @@ def test_hinted_handoff_replays_missed_writes(tmp_dir):
                 for q in s.hints.values()
             )
 
-        for _ in range(200):
-            if total_hints() > 0:
-                break
-            await asyncio.sleep(0.02)
+        # At least one coordinator records a hint (flow milestone; the
+        # early-ack fan-out may record more shortly after).
+        await asyncio.wait(
+            hint_recorded, timeout=10,
+            return_when=asyncio.FIRST_COMPLETED,
+        )
+        for f in hint_recorded:
+            f.cancel()
         hinted_count = total_hints()
         assert hinted_count > 0, "no hints recorded for the dead replica"
 
@@ -280,10 +297,21 @@ def test_hinted_handoff_replays_missed_writes(tmp_dir):
                     count += 1
             return count
 
+        # Event-driven wait: every replayed hint lands as a shard Set
+        # message on the rejoined node (ITEM_SET_FROM_SHARD_MESSAGE
+        # fires AFTER the tree write).  Subscribe-then-check closes the
+        # notify race; the wait_for is only a liveness fallback.
         for _ in range(300):
+            w = nodes[2].flow_event(
+                0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+            )
             if await present() >= hinted_count:
+                w.cancel()
                 break
-            await asyncio.sleep(0.02)
+            try:
+                await asyncio.wait_for(w, 5)
+            except asyncio.TimeoutError:
+                pass
         assert await present() >= hinted_count, (
             f"only {await present()} of {hinted_count} hinted writes "
             "reached the rejoined replica"
@@ -308,10 +336,11 @@ def test_read_repair_heals_stale_replica(tmp_dir):
             nodes.append(await ClusterNode(c).start())
             await alive
         client = await DbeelClient.from_seed_nodes([nodes[0].db_address])
+        visible = [
+            n.flow_event(0, FlowEvent.COLLECTION_CREATED) for n in nodes
+        ]
         col = await client.create_collection("rr", replication_factor=3)
-        for n in nodes:
-            while "rr" not in n.shards[0].collections:
-                await asyncio.sleep(0.01)
+        await asyncio.wait_for(asyncio.gather(*visible), 10)
 
         await col.set("k", "v1", consistency=Consistency.ALL)
 
@@ -323,16 +352,23 @@ def test_read_repair_heals_stale_replica(tmp_dir):
             nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP),
             nodes[1].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP),
         ]
-        nodes[2] = await ClusterNode(cfgs[2]).start()
+        # start(wait_started=False) creates the shard objects without
+        # letting their tasks run yet, so the disk re-discovery
+        # milestone can be subscribed race-free.
+        node3 = ClusterNode(cfgs[2])
+        await node3.start(wait_started=False)
+        rejoined = [
+            node3.shards[0].flow.subscribe(FlowEvent.START_TASKS),
+            node3.shards[0].flow.subscribe(FlowEvent.COLLECTION_CREATED),
+        ]
+        nodes[2] = node3
         # Survivors must have node 3 back on their rings before the
-        # repairing read fans out.
+        # repairing read fans out (ALIVE_NODE_GOSSIP fires after the
+        # ring edit); node 3 must have re-discovered "rr" from disk.
         await asyncio.gather(*alive_again)
-        for _ in range(200):
-            if "rr" in nodes[2].shards[0].collections and all(
-                len(n.shards[0].nodes) == 2 for n in nodes[:2]
-            ):
-                break
-            await asyncio.sleep(0.02)
+        await asyncio.wait_for(asyncio.gather(*rejoined), 10)
+        assert "rr" in nodes[2].shards[0].collections
+        assert all(len(n.shards[0].nodes) == 2 for n in nodes[:2])
 
         def stale_tree():
             return nodes[2].shards[0].collections["rr"].tree
@@ -343,12 +379,14 @@ def test_read_repair_heals_stale_replica(tmp_dir):
         entry = await stale_tree().get(key)
         assert entry == msgpack.packb("v1"), "precondition: stale"
 
-        # A full-consistency read observes the divergence and repairs.
+        # A full-consistency read observes the divergence and repairs;
+        # the repair write lands on the stale node as a shard Set
+        # message (ITEM_SET_FROM_SHARD_MESSAGE fires after the write).
+        repaired = nodes[2].flow_event(
+            0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+        )
         assert await col.get("k", consistency=Consistency.ALL) == "v2"
-        for _ in range(300):
-            if await stale_tree().get(key) == msgpack.packb("v2"):
-                break
-            await asyncio.sleep(0.02)
+        await asyncio.wait_for(repaired, 10)
         assert await stale_tree().get(key) == msgpack.packb("v2"), (
             "replica not repaired"
         )
@@ -374,10 +412,12 @@ def test_replicated_set_reaches_replica_trees(tmp_dir):
             client = await DbeelClient.from_seed_nodes(
                 [nodes[0].db_address]
             )
+            visible = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
             col = await client.create_collection("r", replication_factor=3)
-            for n in nodes:
-                while "r" not in n.shards[0].collections:
-                    await asyncio.sleep(0.01)
+            await asyncio.wait_for(asyncio.gather(*visible), 10)
             waiters = [
                 n.flow_event(0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
                 for n in nodes
