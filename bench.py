@@ -179,7 +179,12 @@ def _kernel_only_rate(d, args) -> float:
     k = max(1, len(run_counts))
     k2 = bitonic._pow2(k)
     pack_bits = bitonic.rid_pack_bits(k2)
-    p_chunk = 1 << 17
+    # Mirror the pipeline's shape choice: per-run rows are padded to a
+    # power of two no larger than the actual longest run — a wide
+    # merge (many small runs, e.g. config 4's 64-way) must not pad
+    # 31K-row runs to 2^17 each or the vmapped operand set blows HBM.
+    max_run = max(run_counts) if run_counts else 1
+    p_chunk = min(1 << 17, bitonic._pow2(max_run))
     # Per-run slices of p_chunk rows (sorted runs stay sorted when
     # sliced), top-4-bytes operand (= the pipeline's rebased u32 at
     # shift 32 over the uniform keyspace), batched J per launch.
@@ -370,7 +375,11 @@ def main():
         # compute-vs-compute comparison, independent of the host<->device
         # link (this environment tunnels the TPU at ~45 MB/s; PCIe-local
         # hosts move the same buffers ~100x faster).
-        kernel_rate = _kernel_only_rate(d, args)
+        try:
+            kernel_rate = _kernel_only_rate(d, args)
+        except Exception as e:
+            log(f"kernel-only measurement failed ({e!r}); skipping")
+            kernel_rate = 0.0
         if kernel_rate:
             log(f"device kernel-only: {kernel_rate:,.0f} keys/s")
 

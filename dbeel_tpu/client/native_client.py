@@ -1,0 +1,206 @@
+"""ctypes wrapper over the compiled C++ smart client
+(native/src/dbeel_client.cpp) — the compiled analog of
+/root/reference/dbeel_client (lib.rs:85-152, 336-417): metadata
+bootstrap, client-side ring, replica walk with replica_index,
+resync-and-retry on KeyNotOwnedByShard, persistent keepalive
+connections.
+
+This is also the serving-path latency yardstick: one Python→C call per
+operation, everything else (framing, routing, socket IO) compiled.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Optional
+
+import msgpack
+
+from ..errors import DbeelError, KeyNotFound
+from ..storage import native as native_mod
+
+_GET_BUF_CAP = 16 << 20
+
+
+def _bind(lib) -> None:
+    if getattr(lib, "_cli_bound", False):
+        return
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.dbeel_cli_new.restype = ctypes.c_void_p
+    lib.dbeel_cli_new.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.dbeel_cli_free.restype = None
+    lib.dbeel_cli_free.argtypes = [ctypes.c_void_p]
+    lib.dbeel_cli_sync.restype = ctypes.c_int
+    lib.dbeel_cli_sync.argtypes = [ctypes.c_void_p]
+    lib.dbeel_cli_ring_size.restype = ctypes.c_uint64
+    lib.dbeel_cli_ring_size.argtypes = [ctypes.c_void_p]
+    lib.dbeel_cli_last_error.restype = ctypes.c_char_p
+    lib.dbeel_cli_last_error.argtypes = [ctypes.c_void_p]
+    lib.dbeel_cli_create_collection.restype = ctypes.c_int
+    lib.dbeel_cli_create_collection.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+    ]
+    lib.dbeel_cli_set.restype = ctypes.c_int
+    lib.dbeel_cli_set.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        u8p,
+        ctypes.c_uint32,
+        u8p,
+        ctypes.c_uint32,
+        ctypes.c_int,
+        ctypes.c_uint32,
+    ]
+    lib.dbeel_cli_delete.restype = ctypes.c_int
+    lib.dbeel_cli_delete.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        u8p,
+        ctypes.c_uint32,
+        ctypes.c_int,
+        ctypes.c_uint32,
+    ]
+    lib.dbeel_cli_get.restype = ctypes.c_int64
+    lib.dbeel_cli_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        u8p,
+        ctypes.c_uint32,
+        ctypes.c_int,
+        ctypes.c_uint32,
+        u8p,
+        ctypes.c_uint64,
+    ]
+    lib._cli_bound = True
+
+
+def available() -> bool:
+    lib = native_mod.load_if_built()
+    return lib is not None and hasattr(lib, "dbeel_cli_new")
+
+
+class NativeDbeelClient:
+    """Synchronous compiled client.  Blocking — use from scripts,
+    benchmarks, and worker threads (never on a server event loop)."""
+
+    def __init__(self, seed_ip: str, seed_port: int):
+        lib = native_mod._load()
+        if lib is None or not hasattr(lib, "dbeel_cli_new"):
+            raise RuntimeError("native client library unavailable")
+        _bind(lib)
+        self._lib = lib
+        self._h = lib.dbeel_cli_new(
+            seed_ip.encode(), ctypes.c_uint16(seed_port)
+        )
+        if not self._h:
+            raise ConnectionError(
+                f"could not bootstrap from {seed_ip}:{seed_port}"
+            )
+        self._buf = (ctypes.c_uint8 * _GET_BUF_CAP)()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dbeel_cli_free(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+
+    def _err(self) -> str:
+        return self._lib.dbeel_cli_last_error(self._h).decode(
+            "utf-8", "replace"
+        )
+
+    @property
+    def ring_size(self) -> int:
+        return int(self._lib.dbeel_cli_ring_size(self._h))
+
+    def sync_metadata(self) -> None:
+        if self._lib.dbeel_cli_sync(self._h) != 0:
+            raise DbeelError(self._err())
+
+    def create_collection(
+        self, name: str, replication_factor: int = 1
+    ) -> None:
+        rc = self._lib.dbeel_cli_create_collection(
+            self._h, name.encode(), replication_factor
+        )
+        if rc != 0:
+            raise DbeelError(self._err())
+
+    @staticmethod
+    def _enc(obj: Any) -> bytes:
+        return msgpack.packb(obj, use_bin_type=True)
+
+    def set(
+        self,
+        collection: str,
+        key: Any,
+        value: Any,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> None:
+        k = self._enc(key)
+        v = self._enc(value)
+        rc = self._lib.dbeel_cli_set(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            (ctypes.c_uint8 * len(v)).from_buffer_copy(v),
+            len(v),
+            consistency,
+            rf,
+        )
+        if rc != 0:
+            raise DbeelError(self._err())
+
+    def get(
+        self,
+        collection: str,
+        key: Any,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> Optional[Any]:
+        k = self._enc(key)
+        n = self._lib.dbeel_cli_get(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            consistency,
+            rf,
+            self._buf,
+            _GET_BUF_CAP,
+        )
+        if n == -1:
+            raise KeyNotFound(repr(key))
+        if n < 0:
+            raise DbeelError(self._err())
+        return msgpack.unpackb(bytes(self._buf[: int(n)]), raw=False)
+
+    def delete(
+        self,
+        collection: str,
+        key: Any,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> None:
+        k = self._enc(key)
+        rc = self._lib.dbeel_cli_delete(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            consistency,
+            rf,
+        )
+        if rc != 0:
+            raise DbeelError(self._err())

@@ -766,8 +766,11 @@ class MyShard:
             return ShardResponse.get(entry)
         if kind == ShardRequest.RANGE_DIGEST:
             col = self.collections.get(request[2])
+            # Clamp both sides: nb sizes two local allocations, so an
+            # unbounded peer-supplied count would be an OOM lever on
+            # the network-facing port.
             nb = int(request[5]) if len(request) > 5 else 1
-            nb = max(1, nb)
+            nb = max(1, min(nb, 65536))
             counts, digests = [0] * nb, [0] * nb
             if col is not None:
                 # Peer-side anti-entropy scans are background work too:
@@ -874,23 +877,21 @@ class MyShard:
         count (their deletions must converge too)."""
         from ..utils.murmur import murmur3_32
 
-        newest: Dict[bytes, int] = {}  # key -> newest ts
+        newest: Dict[bytes, Tuple[int, int]] = {}  # key -> (ts, hash)
         # One hash per entry: range membership is checked in the loop
         # body (the filter lambda would hash a second time) and the
-        # bucket is derived once per unique key at aggregation.
+        # hash is carried into aggregation for the bucket derivation.
         async for key, _value, ts in tree.iter_filter(None):
             h = hash_bytes(key)
             if not MyShard._in_ae_range(h, start, end):
                 continue
             prev = newest.get(key)
-            if prev is None or ts > prev:
-                newest[key] = ts
+            if prev is None or ts > prev[0]:
+                newest[key] = (ts, h)
         counts = [0] * nbuckets
         digests = [0] * nbuckets
-        for key, ts in newest.items():
-            b = MyShard._ae_bucket_of(
-                hash_bytes(key), start, end, nbuckets
-            )
+        for key, (ts, h) in newest.items():
+            b = MyShard._ae_bucket_of(h, start, end, nbuckets)
             blob = key + ts.to_bytes(8, "little", signed=True)
             counts[b] += 1
             digests[b] ^= murmur3_32(blob, 0x0A57E4A1) | (

@@ -60,11 +60,21 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    src = os.path.join(_NATIVE_DIR, "src", "dbeel_native.cpp")
+    def _src_mtime() -> float:
+        """Newest .cpp under native/src drives staleness."""
+        src_dir = os.path.join(_NATIVE_DIR, "src")
+        try:
+            return max(
+                os.path.getmtime(os.path.join(src_dir, f))
+                for f in os.listdir(src_dir)
+                if f.endswith(".cpp")
+            )
+        except (OSError, ValueError):
+            return 0.0
+
     stale = (
         os.path.exists(_LIB_PATH)
-        and os.path.exists(src)
-        and os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+        and os.path.getmtime(_LIB_PATH) < _src_mtime()
     )
     if not os.path.exists(_LIB_PATH) or stale:
         # Rebuild BEFORE the first dlopen: ctypes.CDLL caches by path,
@@ -87,7 +97,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 # just finished the same rebuild.
                 stale = os.path.exists(_LIB_PATH) and os.path.getmtime(
                     _LIB_PATH
-                ) < os.path.getmtime(src)
+                ) < _src_mtime()
                 if not os.path.exists(_LIB_PATH) or stale:
                     subprocess.run(
                         ["make", "-C", _NATIVE_DIR, "-B"] if stale
@@ -351,9 +361,11 @@ class NativeMergeStrategy(CompactionStrategy):
         )
         throttle = self.throttle
         if hasattr(lib, "dbeel_merge_cb"):
-            # None maps to a NULL fn pointer — same as dbeel_merge.
+            # TICK_FN() is a NULL fn pointer — same as dbeel_merge.
             tick_cb = (
-                TICK_FN(throttle.tick) if throttle is not None else None
+                TICK_FN(throttle.tick)
+                if throttle is not None
+                else TICK_FN()
             )
             n_out = lib.dbeel_merge_cb(
                 *args, tick_cb, _MERGE_TICK_EVERY

@@ -1,0 +1,569 @@
+// Compiled smart client — the C++ analog of the reference's
+// dbeel_client crate (/root/reference/dbeel_client/src/lib.rs:85-152,
+// 336-417): seed bootstrap, cluster-metadata sync into a client-side
+// consistent-hash ring, key-hash routing with the distinct-node
+// replica walk + replica_index injection, and resync-and-retry on
+// KeyNotOwnedByShard.  Connections are persistent per target (the
+// keepalive protocol extension); callers supply keys/values as raw
+// msgpack blobs which are embedded verbatim into the request frame.
+//
+// Exposed as a C ABI in the same shared library as the rest of the
+// native runtime; dbeel_tpu.client.native_client wraps it via ctypes
+// and tests/test_native_client.py drives it against a live server.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+extern "C" uint32_t dbeel_murmur3_32(const uint8_t* data, uint64_t len,
+                                     uint32_t seed);
+
+namespace {
+
+// ------------------------- msgpack encode ----------------------------
+
+struct MpBuf {
+  std::vector<uint8_t> b;
+  void u8(uint8_t v) { b.push_back(v); }
+  void raw(const void* p, size_t n) {
+    const uint8_t* q = static_cast<const uint8_t*>(p);
+    b.insert(b.end(), q, q + n);
+  }
+  void be16(uint16_t v) {
+    u8(v >> 8);
+    u8(v & 0xff);
+  }
+  void be32(uint32_t v) {
+    u8(v >> 24);
+    u8((v >> 16) & 0xff);
+    u8((v >> 8) & 0xff);
+    u8(v & 0xff);
+  }
+  void map_header(uint32_t n) {
+    if (n <= 15) {
+      u8(0x80 | n);
+    } else {
+      u8(0xde);
+      be16(n);
+    }
+  }
+  void str(const std::string& s) {
+    if (s.size() <= 31) {
+      u8(0xa0 | (uint8_t)s.size());
+    } else if (s.size() <= 0xff) {
+      u8(0xd9);
+      u8((uint8_t)s.size());
+    } else {
+      u8(0xda);
+      be16((uint16_t)s.size());
+    }
+    raw(s.data(), s.size());
+  }
+  void uint(uint64_t v) {
+    if (v <= 0x7f) {
+      u8((uint8_t)v);
+    } else if (v <= 0xff) {
+      u8(0xcc);
+      u8((uint8_t)v);
+    } else if (v <= 0xffff) {
+      u8(0xcd);
+      be16((uint16_t)v);
+    } else if (v <= 0xffffffffull) {
+      u8(0xce);
+      be32((uint32_t)v);
+    } else {
+      u8(0xcf);
+      for (int i = 7; i >= 0; i--) u8((v >> (8 * i)) & 0xff);
+    }
+  }
+  void boolean(bool v) { u8(v ? 0xc3 : 0xc2); }
+};
+
+// ------------------------- msgpack decode ----------------------------
+// Minimal reader for the metadata response shape:
+//   [[ [name, ip, remote_port, [ids...], gossip_port, db_port], ...],
+//    [[name, rf], ...]]
+
+struct MpRd {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  bool need(size_t n) {
+    if ((size_t)(end - p) < n) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  uint64_t be(int n) {
+    uint64_t v = 0;
+    for (int i = 0; i < n; i++) v = (v << 8) | p[i];
+    p += n;
+    return v;
+  }
+  int64_t integer() {
+    if (!need(1)) return 0;
+    uint8_t b = *p++;
+    if (b <= 0x7f) return b;
+    if (b >= 0xe0) return (int8_t)b;
+    switch (b) {
+      case 0xcc: return need(1) ? (int64_t)be(1) : 0;
+      case 0xcd: return need(2) ? (int64_t)be(2) : 0;
+      case 0xce: return need(4) ? (int64_t)be(4) : 0;
+      case 0xcf: return need(8) ? (int64_t)be(8) : 0;
+      case 0xd0: return need(1) ? (int8_t)be(1) : 0;
+      case 0xd1: return need(2) ? (int16_t)be(2) : 0;
+      case 0xd2: return need(4) ? (int32_t)be(4) : 0;
+      case 0xd3: return need(8) ? (int64_t)be(8) : 0;
+      default: fail = true; return 0;
+    }
+  }
+  uint32_t array_header() {
+    if (!need(1)) return 0;
+    uint8_t b = *p++;
+    if ((b & 0xf0) == 0x90) return b & 0x0f;
+    if (b == 0xdc) return need(2) ? (uint32_t)be(2) : 0;
+    if (b == 0xdd) return need(4) ? (uint32_t)be(4) : 0;
+    fail = true;
+    return 0;
+  }
+  std::string str() {
+    if (!need(1)) return "";
+    uint8_t b = *p++;
+    uint64_t n;
+    if ((b & 0xe0) == 0xa0) {
+      n = b & 0x1f;
+    } else if (b == 0xd9) {
+      if (!need(1)) return "";
+      n = be(1);
+    } else if (b == 0xda) {
+      if (!need(2)) return "";
+      n = be(2);
+    } else if (b == 0xdb) {
+      if (!need(4)) return "";
+      n = be(4);
+    } else {
+      fail = true;
+      return "";
+    }
+    if (!need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+// ------------------------------ client -------------------------------
+
+struct RingShard {
+  uint32_t hash;
+  std::string node_name;
+  std::string ip;
+  uint16_t db_port;
+};
+
+struct Client {
+  std::string seed_ip;
+  uint16_t seed_port;
+  std::vector<RingShard> ring;  // sorted by hash
+  std::map<std::pair<std::string, uint16_t>, int> conns;
+  std::string last_error;
+
+  ~Client() {
+    for (auto& kv : conns) {
+      if (kv.second >= 0) ::close(kv.second);
+    }
+  }
+};
+
+int connect_to(Client* c, const std::string& ip, uint16_t port) {
+  auto key = std::make_pair(ip, port);
+  auto it = c->conns.find(key);
+  if (it != c->conns.end() && it->second >= 0) return it->second;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    c->last_error = "socket: " + std::string(strerror(errno));
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct timeval tv {5, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip.c_str(), &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof addr) != 0) {
+    c->last_error = "connect " + ip + ": " + strerror(errno);
+    ::close(fd);
+    return -1;
+  }
+  c->conns[key] = fd;
+  return fd;
+}
+
+void drop_conn(Client* c, const std::string& ip, uint16_t port) {
+  auto key = std::make_pair(ip, port);
+  auto it = c->conns.find(key);
+  if (it != c->conns.end()) {
+    if (it->second >= 0) ::close(it->second);
+    c->conns.erase(it);
+  }
+}
+
+bool write_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+bool read_all(int fd, uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// One round trip: u16-LE length-prefixed request; u32-LE
+// length-prefixed response whose length INCLUDES the trailing type
+// byte (0=Err, 1=Ok payload, 2=plain OK).  Returns false on transport
+// failure (the caller reconnects once).
+bool round_trip(Client* c, const std::string& ip, uint16_t port,
+                const MpBuf& req, std::vector<uint8_t>* body,
+                uint8_t* rtype) {
+  if (req.b.size() > 0xFFFF) {
+    // The request header is u16-LE: an oversized frame would truncate
+    // the length and desync the whole connection.  Mirror the Python
+    // client's loud struct.pack failure with a clear error instead.
+    c->last_error = "request frame too large (" +
+                    std::to_string(req.b.size()) + " > 65535 bytes)";
+    return false;
+  }
+  for (int attempt = 0; attempt < 2; attempt++) {
+    int fd = connect_to(c, ip, port);
+    if (fd < 0) return false;
+    uint8_t hdr[2] = {(uint8_t)(req.b.size() & 0xff),
+                      (uint8_t)(req.b.size() >> 8)};
+    uint8_t len4[4];
+    if (!write_all(fd, hdr, 2) ||
+        !write_all(fd, req.b.data(), req.b.size()) ||
+        !read_all(fd, len4, 4)) {
+      drop_conn(c, ip, port);  // stale keepalive conn: retry fresh
+      continue;
+    }
+    uint32_t n = (uint32_t)len4[0] | ((uint32_t)len4[1] << 8) |
+                 ((uint32_t)len4[2] << 16) | ((uint32_t)len4[3] << 24);
+    if (n == 0 || n > (64u << 20)) {
+      drop_conn(c, ip, port);
+      c->last_error = "bad response length";
+      return false;
+    }
+    body->resize(n);
+    if (!read_all(fd, body->data(), n)) {
+      drop_conn(c, ip, port);
+      continue;
+    }
+    *rtype = body->back();
+    body->pop_back();
+    return true;
+  }
+  c->last_error = "transport failure to " + ip;
+  return false;
+}
+
+// Parse an Err body ([kind, message] msgpack array of strings).
+std::string error_kind(const std::vector<uint8_t>& body,
+                       std::string* message) {
+  MpRd r{body.data(), body.data() + body.size()};
+  uint32_t n = r.array_header();
+  if (r.fail || n < 1) return "";
+  std::string kind = r.str();
+  if (message && n >= 2) *message = r.str();
+  return kind;
+}
+
+void common_fields(MpBuf* m, const char* type,
+                   const std::string& collection, bool keepalive) {
+  m->str("type");
+  m->str(type);
+  if (!collection.empty()) {
+    m->str("collection");
+    m->str(collection);
+  }
+  if (keepalive) {
+    m->str("keepalive");
+    m->boolean(true);
+  }
+}
+
+int sync_metadata(Client* c) {
+  MpBuf m;
+  m.map_header(2);
+  common_fields(&m, "get_cluster_metadata", "", true);
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  // Bootstrap from the seed; after the first sync any ring member
+  // works, but the seed stays the canonical fallback.
+  if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype) ||
+      rtype == 0) {
+    if (rtype == 0) c->last_error = "metadata request failed";
+    return -1;
+  }
+  MpRd r{body.data(), body.data() + body.size()};
+  uint32_t outer = r.array_header();
+  if (r.fail || outer < 2) {
+    c->last_error = "bad metadata shape";
+    return -1;
+  }
+  std::vector<RingShard> ring;
+  uint32_t n_nodes = r.array_header();
+  for (uint32_t i = 0; i < n_nodes && !r.fail; i++) {
+    uint32_t f = r.array_header();  // node tuple
+    if (r.fail || f < 6) break;
+    std::string name = r.str();
+    std::string ip = r.str();
+    (void)r.integer();  // remote_shard_base_port
+    uint32_t n_ids = r.array_header();
+    std::vector<int64_t> ids(n_ids);
+    for (uint32_t j = 0; j < n_ids; j++) ids[j] = r.integer();
+    (void)r.integer();  // gossip_port
+    int64_t db_port = r.integer();
+    for (uint32_t extra = 6; extra < f; extra++) (void)r.integer();
+    for (int64_t sid : ids) {
+      std::string label = name + "-" + std::to_string(sid);
+      RingShard s;
+      s.hash = dbeel_murmur3_32(
+          reinterpret_cast<const uint8_t*>(label.data()),
+          label.size(), 0);
+      s.node_name = name;
+      s.ip = ip;
+      s.db_port = (uint16_t)(db_port + sid);
+      ring.push_back(std::move(s));
+    }
+  }
+  if (r.fail || ring.empty()) {
+    c->last_error = "metadata parse failed";
+    return -1;
+  }
+  std::sort(ring.begin(), ring.end(),
+            [](const RingShard& a, const RingShard& b) {
+              return a.hash < b.hash;
+            });
+  c->ring = std::move(ring);
+  return 0;
+}
+
+// The replica walk (lib.rs:336-417): first ring shard at/after the
+// hash, then forward skipping same-node shards.
+std::vector<const RingShard*> shards_for_key(const Client* c,
+                                             uint32_t key_hash,
+                                             uint32_t rf) {
+  std::vector<const RingShard*> out;
+  if (c->ring.empty()) return out;
+  size_t lo = 0, hi = c->ring.size();
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (c->ring[mid].hash < key_hash) {
+      lo = mid + 1;
+    } else {
+      hi = mid;
+    }
+  }
+  size_t start = lo == c->ring.size() ? 0 : lo;
+  std::vector<std::string> seen;
+  for (size_t off = 0; off < c->ring.size() && out.size() < rf; off++) {
+    const RingShard& s = c->ring[(start + off) % c->ring.size()];
+    bool dup = false;
+    for (const auto& n : seen) {
+      if (n == s.node_name) {
+        dup = true;
+        break;
+      }
+    }
+    if (dup) continue;
+    seen.push_back(s.node_name);
+    out.push_back(&s);
+  }
+  return out;
+}
+
+// Build and send one keyed request, walking replicas and resyncing on
+// KeyNotOwnedByShard.  Returns 0 ok (body filled for gets), -1 not
+// found, -2 error (last_error set).
+int keyed_request(Client* c, const char* type,
+                  const std::string& collection, const uint8_t* key,
+                  uint32_t klen, const uint8_t* value, uint32_t vlen,
+                  int consistency, uint32_t rf,
+                  std::vector<uint8_t>* out_body) {
+  uint32_t key_hash = dbeel_murmur3_32(key, klen, 0);
+  bool is_set = std::strcmp(type, "set") == 0;
+  for (int attempt = 0; attempt < 2; attempt++) {
+    auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
+    bool not_owned = false;
+    for (size_t ri = 0; ri < replicas.size(); ri++) {
+      MpBuf m;
+      // type, collection, keepalive, key, hash, replica_index
+      // (+ value on set, + consistency when requested).
+      uint32_t fields = 6 + (is_set ? 1 : 0) +
+                        (consistency > 0 ? 1 : 0);
+      m.map_header(fields);
+      common_fields(&m, type, collection, true);
+      m.str("key");
+      m.raw(key, klen);  // raw msgpack blob straight into the map
+      if (is_set) {
+        m.str("value");
+        m.raw(value, vlen);
+      }
+      if (consistency > 0) {
+        m.str("consistency");
+        m.uint((uint64_t)consistency);
+      }
+      m.str("hash");
+      m.uint(key_hash);
+      m.str("replica_index");
+      m.uint((uint64_t)ri);
+      std::vector<uint8_t> body;
+      uint8_t rtype = 0;
+      if (!round_trip(c, replicas[ri]->ip, replicas[ri]->db_port, m,
+                      &body, &rtype)) {
+        continue;  // next replica
+      }
+      if (rtype != 0) {
+        if (out_body) *out_body = std::move(body);
+        return 0;
+      }
+      std::string msg;
+      std::string kind = error_kind(body, &msg);
+      if (kind == "KeyNotOwnedByShard") {
+        not_owned = true;
+        break;  // resync and retry (lib.rs:392-409)
+      }
+      if (kind == "KeyNotFound") return -1;
+      c->last_error = kind + ": " + msg;
+      return -2;
+    }
+    if (not_owned && attempt == 0) {
+      if (sync_metadata(c) != 0) return -2;
+      continue;
+    }
+    if (not_owned) {
+      c->last_error = "KeyNotOwnedByShard after resync";
+      return -2;
+    }
+    if (c->last_error.empty()) c->last_error = "no replica reachable";
+    return -2;
+  }
+  return -2;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dbeel_cli_new(const char* seed_ip, uint16_t seed_port) {
+  Client* c = new Client();
+  c->seed_ip = seed_ip;
+  c->seed_port = seed_port;
+  if (sync_metadata(c) != 0) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void dbeel_cli_free(void* h) { delete static_cast<Client*>(h); }
+
+int dbeel_cli_sync(void* h) {
+  return sync_metadata(static_cast<Client*>(h));
+}
+
+uint64_t dbeel_cli_ring_size(void* h) {
+  return static_cast<Client*>(h)->ring.size();
+}
+
+const char* dbeel_cli_last_error(void* h) {
+  return static_cast<Client*>(h)->last_error.c_str();
+}
+
+int dbeel_cli_create_collection(void* h, const char* name,
+                                uint32_t rf) {
+  Client* c = static_cast<Client*>(h);
+  MpBuf m;
+  m.map_header(4);
+  common_fields(&m, "create_collection", "", true);
+  m.str("name");
+  m.str(name);
+  m.str("replication_factor");
+  m.uint(rf);
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype)) {
+    return -2;
+  }
+  if (rtype == 0) {
+    std::string msg;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+    return -2;
+  }
+  return 0;
+}
+
+// key/value: raw msgpack-encoded blobs.  rf: the collection's
+// replication factor (drives the replica walk length).
+int dbeel_cli_set(void* h, const char* collection, const uint8_t* key,
+                  uint32_t klen, const uint8_t* value, uint32_t vlen,
+                  int consistency, uint32_t rf) {
+  return keyed_request(static_cast<Client*>(h), "set", collection, key,
+                       klen, value, vlen, consistency, rf, nullptr);
+}
+
+int dbeel_cli_delete(void* h, const char* collection,
+                     const uint8_t* key, uint32_t klen, int consistency,
+                     uint32_t rf) {
+  return keyed_request(static_cast<Client*>(h), "delete", collection,
+                       key, klen, nullptr, 0, consistency, rf, nullptr);
+}
+
+// Returns the value length (raw msgpack bytes copied into out, up to
+// cap), -1 when not found, -2 on error, -3 when cap is too small.
+int64_t dbeel_cli_get(void* h, const char* collection,
+                      const uint8_t* key, uint32_t klen,
+                      int consistency, uint32_t rf, uint8_t* out,
+                      uint64_t cap) {
+  std::vector<uint8_t> body;
+  int rc = keyed_request(static_cast<Client*>(h), "get", collection,
+                         key, klen, nullptr, 0, consistency, rf,
+                         &body);
+  if (rc != 0) return rc;
+  if (body.size() > cap) return -3;
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
+}  // extern "C"

@@ -144,3 +144,46 @@ def test_native_merge_cb_ticks_and_matches(tmp_dir):
     n1, d1, i1, n_ticks = run_merge(True)
     assert (n0, d0, i0) == (n1, d1, i1)
     assert n_ticks == n_total // 4096
+
+
+def test_native_strategy_merge_with_and_without_throttle(tmp_dir):
+    """Regression: the no-throttle path must pass a NULL fn pointer to
+    dbeel_merge_cb (a bare None for a CFUNCTYPE argtype raises
+    ArgumentError — this crashed bench.py's CPU baseline)."""
+    import pytest
+
+    from dbeel_tpu.server.scheduler import ShareScheduler
+    from dbeel_tpu.storage import native
+    from dbeel_tpu.storage.sstable import SSTable
+
+    if not native.native_available():
+        pytest.skip("native lib unavailable")
+
+    from conftest import write_sstable_fixture
+
+    entries_a = [(b"k%04d" % i, b"va%d" % i, 5) for i in range(0, 200, 2)]
+    entries_b = [(b"k%04d" % i, b"vb%d" % i, 6) for i in range(1, 200, 2)]
+    write_sstable_fixture(tmp_dir, 0, entries_a)
+    write_sstable_fixture(tmp_dir, 2, entries_b)
+
+    def merge(out_index, throttle):
+        s = native.NativeMergeStrategy()
+        s.throttle = throttle
+        sources = [SSTable(tmp_dir, 0, None), SSTable(tmp_dir, 2, None)]
+        try:
+            return s.merge(sources, tmp_dir, out_index, None, True, 1 << 30)
+        finally:
+            for t in sources:
+                t.close()
+
+    r1 = merge(1, None)  # no throttle: NULL callback path
+    r2 = merge(3, ShareScheduler().thread_throttle())
+    assert r1.entry_count == r2.entry_count == 200
+    from dbeel_tpu.storage.entry import (
+        COMPACT_DATA_FILE_EXT,
+        file_name,
+    )
+
+    d1 = open(f"{tmp_dir}/{file_name(1, COMPACT_DATA_FILE_EXT)}", "rb").read()
+    d3 = open(f"{tmp_dir}/{file_name(3, COMPACT_DATA_FILE_EXT)}", "rb").read()
+    assert d1 == d3 and len(d1) > 0
