@@ -182,6 +182,10 @@ class NativeDbeelClient:
         )
         if n == -1:
             raise KeyNotFound(repr(key))
+        if n == -3:
+            raise DbeelError(
+                f"value too large for client buffer: {self._err()}"
+            )
         if n < 0:
             raise DbeelError(self._err())
         return msgpack.unpackb(bytes(self._buf[: int(n)]), raw=False)

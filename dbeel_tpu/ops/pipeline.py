@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -77,8 +78,14 @@ _SHIFT_DUP_LIMIT = 0.10
 # round-trip per launch, so same-mode partitions are vmapped together.
 _LAUNCH_BATCH = 4
 # Background fdatasync stride: flush the output's device write cache
-# every this many written bytes, concurrently with the write stream.
-_SYNC_STRIDE = 192 << 20
+# every this many written bytes concurrently with the write stream.
+# DISABLED by default (0): on this virtio disk a concurrent fdatasync
+# SERIALIZES against in-flight O_DIRECT pwrites and stalls the gather
+# writer ~0.5s per flush (measured: bg-sync-on 6.0s vs off 4.85s on
+# the 10M merge), while the single close-time flush costs <1s.  Set
+# DBEEL_SYNC_STRIDE to a byte count on devices whose close-time cache
+# flush is the bigger tail.
+_SYNC_STRIDE = int(os.environ.get("DBEEL_SYNC_STRIDE", 0))
 
 
 def _unlink_quiet(*paths: str) -> None:
@@ -514,12 +521,16 @@ def _pipeline_merge_impl(
         if runs
         else np.zeros(0, np.uint32)
     )
+    # Native-endian u64 prefixes: one bulk byteswap here replaces the
+    # per-partition BE->native astype in the consume loop AND feeds
+    # the native decoder directly.
     pf_cat = (
-        np.concatenate([r.prefix64 for r in runs])
+        np.concatenate([r.prefix64 for r in runs]).astype(np.uint64)
         if runs
-        else np.zeros(0, ">u8")
+        else np.zeros(0, np.uint64)
     )
     tomb_cat = fs_cat == ks_cat + np.uint32(ENTRY_HEADER_SIZE)
+    have_decode = hasattr(lib, "dbeel_pipe_decode")
 
     data_path = f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}"
     index_path = f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}"
@@ -720,6 +731,8 @@ def _pipeline_merge_impl(
     t_write = threading.Thread(target=writer, daemon=True)
     t_write.start()
     t_sync = None
+    if _SYNC_STRIDE <= 0:
+        have_sync = False  # disabled: one flush at close only
     if have_sync:
         t_sync = threading.Thread(target=syncer, daemon=True)
         t_sync.start()
@@ -753,39 +766,96 @@ def _pipeline_merge_impl(
             n_p = int(counts.sum())
             if n_p == 0:
                 continue
-            rids = unpack_rids(packed, pack_bits, n_p).astype(
-                np.int64
-            )
-            # Rebuild positions: the comparator is a total order and
-            # runs are pre-sorted, so each run's entries appear in
-            # increasing position order — a per-run counter inverts
-            # it.  One bincount (decode check) + one stable argsort
-            # (grouped cumcount), independent of the run count.
-            counts_dec = np.bincount(rids, minlength=len(runs))
-            if counts_dec.size > len(runs) or not (
-                counts_dec == counts[: len(runs)]
-            ).all():
-                raise _PipelineError("packed run-id decode mismatch")
-            grouped = np.argsort(rids, kind="stable")
-            group_lo = np.concatenate(
-                [[0], np.cumsum(counts_dec)[:-1]]
-            )
-            pos = np.empty(n_p, dtype=np.int64)
-            pos[grouped] = np.arange(n_p, dtype=np.int64) - np.repeat(
-                group_lo, counts_dec
-            )
-            gidx = run_base[rids] + los[rids] + pos
+            if have_decode:
+                # One C pass: unpack rids, per-run counters ->
+                # permutation, device-key tie flags.  Replaces the
+                # numpy unpack/bincount/argsort/cumcount chain — on a
+                # 1-core host this decode was ~40% of the pipeline's
+                # host CPU.
+                gidx = np.empty(n_p, dtype=np.int64)
+                rids32 = np.empty(n_p, dtype=np.uint32)
+                tieb = np.empty(n_p, dtype=np.uint8)
+                packed_c = np.ascontiguousarray(packed)
+                cnts_c = np.ascontiguousarray(
+                    counts[: len(runs)], dtype=np.uint32
+                )
+                los_c = np.ascontiguousarray(los, dtype=np.int64)
+                rc = lib.dbeel_pipe_decode(
+                    packed_c.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint32)
+                    ),
+                    ctypes.c_uint64(n_p),
+                    ctypes.c_uint32(pack_bits),
+                    ctypes.c_uint32(len(runs)),
+                    cnts_c.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint32)
+                    ),
+                    los_c.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)
+                    ),
+                    run_base.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)
+                    ),
+                    pf_cat.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint64)
+                    ),
+                    ctypes.c_uint64(minpf),
+                    ctypes.c_uint32(shift),
+                    1 if mode32 else 0,
+                    gidx.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)
+                    ),
+                    rids32.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint32)
+                    ),
+                    tieb.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint8)
+                    ),
+                )
+                if rc != 0:
+                    raise _PipelineError(
+                        "packed run-id decode mismatch"
+                    )
+                flags = tieb[1:].view(np.bool_)
+            else:
+                rids = unpack_rids(packed, pack_bits, n_p).astype(
+                    np.int64
+                )
+                # Rebuild positions: the comparator is a total order
+                # and runs are pre-sorted, so each run's entries
+                # appear in increasing position order — a per-run
+                # counter inverts it.  One bincount (decode check) +
+                # one stable argsort (grouped cumcount), independent
+                # of the run count.
+                counts_dec = np.bincount(rids, minlength=len(runs))
+                if counts_dec.size > len(runs) or not (
+                    counts_dec == counts[: len(runs)]
+                ).all():
+                    raise _PipelineError(
+                        "packed run-id decode mismatch"
+                    )
+                grouped = np.argsort(rids, kind="stable")
+                group_lo = np.concatenate(
+                    [[0], np.cumsum(counts_dec)[:-1]]
+                )
+                pos = np.empty(n_p, dtype=np.int64)
+                pos[grouped] = np.arange(
+                    n_p, dtype=np.int64
+                ) - np.repeat(group_lo, counts_dec)
+                gidx = run_base[rids] + los[rids] + pos
+                rids32 = rids.astype(np.uint32)
 
             # Tie blocks: adjacent entries equal under the DEVICE sort
             # key (shifted u32 or exact 8B prefix) are re-ordered by
             # (full key, newest ts, newest src) — one vectorized
             # lexsort — and duplicate keys are marked for dedup.
-            pf = pf_cat[gidx].astype(np.uint64)
-            if mode32:
-                dv = (pf - np.uint64(minpf)) >> np.uint64(shift)
-                flags = dv[1:] == dv[:-1]
-            else:
-                flags = pf[1:] == pf[:-1]
+            if not have_decode:
+                pf = pf_cat[gidx]
+                if mode32:
+                    dv = (pf - np.uint64(minpf)) >> np.uint64(shift)
+                    flags = dv[1:] == dv[:-1]
+                else:
+                    flags = pf[1:] == pf[:-1]
             keep = np.ones(n_p, dtype=bool)
             positions, block_id = columnar.tie_positions_and_blocks(
                 flags
@@ -808,16 +878,26 @@ def _pipeline_merge_impl(
                         block_id[bm], kwords, ks_t[bm], inv_ts, inv_src
                     )
                     gidx[positions[bm]] = sel_t[bm][order]
+                    # The reorder moved entries across runs: refresh
+                    # the run-id column at exactly those positions.
+                    rids32[positions[bm]] = (
+                        np.searchsorted(
+                            run_base, gidx[positions[bm]], side="right"
+                        )
+                        - 1
+                    ).astype(np.uint32)
                     keep[positions[bm]] = ~dup
 
             if not keep_tombstones:
                 keep &= ~tomb_cat[gidx]
-            sel = gidx[keep] if not keep.all() else gidx
+            if not keep.all():
+                sel = gidx[keep]
+                src_run = np.ascontiguousarray(rids32[keep])
+            else:
+                sel = gidx
+                src_run = np.ascontiguousarray(rids32)
             if sel.size == 0:
                 continue
-            src_run = (
-                np.searchsorted(run_base, sel, side="right") - 1
-            ).astype(np.uint32)
             src_off = np.ascontiguousarray(off_cat[sel])
             ks_sel = np.ascontiguousarray(ks_cat[sel])
             fs_sel = np.ascontiguousarray(fs_cat[sel])

@@ -330,9 +330,14 @@ int sync_metadata(Client* c) {
   uint8_t rtype = 0;
   // Bootstrap from the seed; after the first sync any ring member
   // works, but the seed stays the canonical fallback.
-  if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype) ||
-      rtype == 0) {
-    if (rtype == 0) c->last_error = "metadata request failed";
+  if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype)) {
+    return -1;  // last_error already carries the transport cause
+  }
+  if (rtype == 0) {
+    std::string msg;
+    c->last_error =
+        "metadata request failed: " + error_kind(body, &msg) + ": " +
+        msg;
     return -1;
   }
   MpRd r{body.data(), body.data() + body.size()};
@@ -423,6 +428,11 @@ int keyed_request(Client* c, const char* type,
                   std::vector<uint8_t>* out_body) {
   uint32_t key_hash = dbeel_murmur3_32(key, klen, 0);
   bool is_set = std::strcmp(type, "set") == 0;
+  // Like the Python client and the reference walk
+  // (lib.rs:368-383): server errors record the last outcome and
+  // ADVANCE to the next replica; only KeyNotOwnedByShard breaks out
+  // (stale ring -> resync once and retry).
+  int last_rc = -2;
   for (int attempt = 0; attempt < 2; attempt++) {
     auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
     bool not_owned = false;
@@ -464,9 +474,13 @@ int keyed_request(Client* c, const char* type,
         not_owned = true;
         break;  // resync and retry (lib.rs:392-409)
       }
-      if (kind == "KeyNotFound") return -1;
-      c->last_error = kind + ": " + msg;
-      return -2;
+      if (kind == "KeyNotFound") {
+        last_rc = -1;
+      } else {
+        last_rc = -2;
+        c->last_error = kind + ": " + msg;
+      }
+      // walk on: the next replica may have the key / be healthy
     }
     if (not_owned && attempt == 0) {
       if (sync_metadata(c) != 0) return -2;
@@ -476,8 +490,10 @@ int keyed_request(Client* c, const char* type,
       c->last_error = "KeyNotOwnedByShard after resync";
       return -2;
     }
-    if (c->last_error.empty()) c->last_error = "no replica reachable";
-    return -2;
+    if (last_rc == -2 && c->last_error.empty()) {
+      c->last_error = "no replica reachable";
+    }
+    return last_rc;
   }
   return -2;
 }
@@ -556,12 +572,17 @@ int64_t dbeel_cli_get(void* h, const char* collection,
                       const uint8_t* key, uint32_t klen,
                       int consistency, uint32_t rf, uint8_t* out,
                       uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
   std::vector<uint8_t> body;
-  int rc = keyed_request(static_cast<Client*>(h), "get", collection,
-                         key, klen, nullptr, 0, consistency, rf,
-                         &body);
+  int rc = keyed_request(c, "get", collection, key, klen, nullptr, 0,
+                         consistency, rf, &body);
   if (rc != 0) return rc;
-  if (body.size() > cap) return -3;
+  if (body.size() > cap) {
+    c->last_error = "value too large for caller buffer (" +
+                    std::to_string(body.size()) + " > " +
+                    std::to_string(cap) + " bytes)";
+    return -3;
+  }
   std::memcpy(out, body.data(), body.size());
   return (int64_t)body.size();
 }

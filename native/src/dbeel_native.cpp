@@ -443,6 +443,55 @@ void dbeel_writer_abort(void* handle) {
   delete w;
 }
 
+// One-pass decode of the kernel's bit-packed run-id stream (the
+// pipeline's per-partition download).  Replaces the numpy chain
+// unpack -> bincount -> stable argsort -> cumcount -> searchsorted:
+// within a partition each run's survivors appear in increasing
+// position order (the comparator is a total order over pre-sorted
+// runs), so a per-run counter rebuilds the permutation in O(n).
+// Also emits the adjacent-equal flags under the DEVICE sort key
+// (rebased/shifted u32 or exact 8B prefix) that seed the host tie
+// fixup.  Layout must match bitonic.unpack_rids: each u32 word holds
+// 32/pack_bits rids, LSB-first.  Returns 0, or -1 on a decode
+// mismatch (rid out of range / per-run counts disagree).
+int dbeel_pipe_decode(const uint32_t* packed, uint64_t n_p,
+                      uint32_t pack_bits, uint32_t k,
+                      const uint32_t* counts, const int64_t* los,
+                      const int64_t* run_base, const uint64_t* pf_cat,
+                      uint64_t minpf, uint32_t shift, int mode32,
+                      int64_t* gidx_out, uint32_t* rid_out,
+                      uint8_t* tie_out) {
+  const uint32_t per = 32u / pack_bits;
+  const uint32_t mask = (pack_bits >= 32)
+                            ? 0xFFFFFFFFu
+                            : ((1u << pack_bits) - 1u);
+  std::vector<uint64_t> counters(k, 0);
+  uint64_t prev_key = 0;
+  for (uint64_t i = 0; i < n_p; i++) {
+    const uint32_t word = packed[i / per];
+    const uint32_t rid =
+        (word >> ((i % per) * pack_bits)) & mask;
+    if (rid >= k) return -1;
+    // Validate BEFORE indexing pf_cat: a garbled stream that
+    // over-represents a valid rid must fail cleanly, not read out of
+    // bounds (the final per-run equality check would come too late).
+    if (counters[rid] >= counts[rid]) return -1;
+    const uint64_t pos = counters[rid]++;
+    const int64_t g = run_base[rid] + los[rid] + (int64_t)pos;
+    gidx_out[i] = g;
+    rid_out[i] = rid;
+    const uint64_t pf = pf_cat[g];
+    const uint64_t keydev =
+        mode32 ? ((pf - minpf) >> shift) : pf;
+    tie_out[i] = (i > 0 && keydev == prev_key) ? 1 : 0;
+    prev_key = keydev;
+  }
+  for (uint32_t r = 0; r < k; r++) {
+    if (counters[r] != counts[r]) return -1;
+  }
+  return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------

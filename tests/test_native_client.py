@@ -18,7 +18,35 @@ pytestmark = pytest.mark.skipif(
     not native_client.available(), reason="native client not built"
 )
 
-PORT = 14600
+def _free_port_block() -> int:
+    """A db port such that db/db+1 (2 shards), remote (+10000/+1) and
+    gossip (+20000) are all bindable.  Chosen from [20000, 28000) —
+    above the harness's 11000+64n blocks, and the derived ports stay
+    under 65536 (an ephemeral-range port would push gossip past it)."""
+    import random
+    import socket as _socket
+
+    rng = random.Random()
+    for _ in range(128):
+        port = rng.randrange(20000, 28000, 2)
+        probes = (port, port + 1, port + 10000, port + 10001,
+                  port + 20000)
+        ok = True
+        for p in probes:
+            s = _socket.socket()
+            try:
+                s.bind(("127.0.0.1", p))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return port
+    raise RuntimeError("no free port block")
+
+
+PORT = _free_port_block()
 
 
 def _wait_port(port, deadline=60.0):
