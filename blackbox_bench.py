@@ -110,6 +110,90 @@ async def main_async(args):
     )
 
 
+def main_native(args):
+    """Compiled-client mode: N OS threads, each with its own
+    NativeDbeelClient (blocking C round trips; the GIL releases during
+    socket syscalls, so threads overlap like the reference's
+    executor-pinned clients)."""
+    import threading
+
+    from dbeel_tpu.client.native_client import NativeDbeelClient
+    from dbeel_tpu.errors import DbeelError
+
+    boot = NativeDbeelClient(args.host, args.port)
+    rf = args.replication_factor or 1
+    try:
+        boot.create_collection(args.collection, rf)
+    except DbeelError as e:
+        if "CollectionAlreadyExists" not in str(e):
+            raise
+    consistency = {"default": 0, "one": 1, "all": rf}.get(
+        args.consistency
+    )
+    if consistency is None:
+        raise SystemExit(
+            "--native-client supports default/one/all consistency"
+        )
+    time.sleep(0.3)  # collection fan-out to sibling shards
+
+    keys = [f"key-{i:08}" for i in range(args.clients * args.requests)]
+    rng = random.Random(args.seed)
+    rng.shuffle(keys)
+    value = {"blob": "x" * args.value_size}
+
+    def phase(op):
+        lats = [[] for _ in range(args.clients)]
+        errors = []
+        chunk = (len(keys) + args.clients - 1) // args.clients
+
+        def worker(wi):
+            try:
+                cli = NativeDbeelClient(args.host, args.port)
+            except Exception as e:
+                errors.append(e)
+                return
+            try:
+                for k in keys[wi * chunk : (wi + 1) * chunk]:
+                    t0 = time.perf_counter()
+                    if op == "set":
+                        cli.set(
+                            args.collection, k, value, consistency, rf
+                        )
+                    else:
+                        cli.get(args.collection, k, consistency, rf)
+                    lats[wi].append(time.perf_counter() - t0)
+            except Exception as e:
+                errors.append(e)
+            finally:
+                cli.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(args.clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = time.perf_counter() - t0
+        if errors:
+            # A failed run must not print inflated full-count
+            # throughput (the async path aborts visibly too).
+            raise errors[0]
+        return total, [x for w in lats for x in w]
+
+    for op in ("set", "get"):
+        if op == "get":
+            rng.shuffle(keys)
+        total, lat = phase(op)
+        print(
+            f"{op}: total {total:.3f}s "
+            f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
+        )
+    boot.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -128,8 +212,17 @@ def main():
         choices=("default", "quorum", "all", "one"),
         default="default",
     )
+    ap.add_argument(
+        "--native-client",
+        action="store_true",
+        help="drive the load through the compiled C++ client "
+        "(native/src/dbeel_client.cpp) on OS threads",
+    )
     args = ap.parse_args()
-    asyncio.run(main_async(args))
+    if args.native_client:
+        main_native(args)
+    else:
+        asyncio.run(main_async(args))
 
 
 if __name__ == "__main__":

@@ -85,7 +85,15 @@ _LAUNCH_BATCH = 4
 # the 10M merge), while the single close-time flush costs <1s.  Set
 # DBEEL_SYNC_STRIDE to a byte count on devices whose close-time cache
 # flush is the bigger tail.
-_SYNC_STRIDE = int(os.environ.get("DBEEL_SYNC_STRIDE", 0))
+try:
+    _SYNC_STRIDE = int(os.environ.get("DBEEL_SYNC_STRIDE", 0))
+except ValueError:
+    logging.getLogger(__name__).warning(
+        "DBEEL_SYNC_STRIDE=%r is not an integer byte count; "
+        "background sync stays disabled",
+        os.environ.get("DBEEL_SYNC_STRIDE"),
+    )
+    _SYNC_STRIDE = 0
 
 
 def _unlink_quiet(*paths: str) -> None:

@@ -462,6 +462,10 @@ int keyed_request(Client* c, const char* type,
       uint8_t rtype = 0;
       if (!round_trip(c, replicas[ri]->ip, replicas[ri]->db_port, m,
                       &body, &rtype)) {
+        // Transport failure must overwrite an earlier replica's
+        // KeyNotFound: a partially-down cluster is an error, not a
+        // missing key (last_error already carries the cause).
+        last_rc = -2;
         continue;  // next replica
       }
       if (rtype != 0) {
