@@ -19,7 +19,11 @@ import msgpack
 from ..errors import DbeelError, KeyNotFound
 from ..storage import native as native_mod
 
-_GET_BUF_CAP = 16 << 20
+# The get buffer starts small and grows on demand (the C side reports
+# the needed size); eager 16MB-per-client buffers measurably crowd the
+# page cache when dozens of bench clients colocate with the server.
+_GET_BUF_INITIAL = 256 << 10
+_GET_BUF_MAX = 64 << 20
 
 
 def _bind(lib) -> None:
@@ -98,7 +102,7 @@ class NativeDbeelClient:
             raise ConnectionError(
                 f"could not bootstrap from {seed_ip}:{seed_port}"
             )
-        self._buf = (ctypes.c_uint8 * _GET_BUF_CAP)()
+        self._buf = None  # allocated lazily by the first get
 
     def close(self) -> None:
         if self._h:
@@ -170,22 +174,31 @@ class NativeDbeelClient:
         rf: int = 1,
     ) -> Optional[Any]:
         k = self._enc(key)
-        n = self._lib.dbeel_cli_get(
-            self._h,
-            collection.encode(),
-            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
-            len(k),
-            consistency,
-            rf,
-            self._buf,
-            _GET_BUF_CAP,
-        )
+        kb = (ctypes.c_uint8 * len(k)).from_buffer_copy(k)
+        if self._buf is None:
+            self._buf = (ctypes.c_uint8 * _GET_BUF_INITIAL)()
+        for _ in range(2):
+            n = self._lib.dbeel_cli_get(
+                self._h,
+                collection.encode(),
+                kb,
+                len(k),
+                consistency,
+                rf,
+                self._buf,
+                len(self._buf),
+            )
+            if n <= -10:
+                # Buffer too small: the C side reports the needed
+                # size; grow and retry once.
+                needed = -int(n) - 10
+                if needed > _GET_BUF_MAX:
+                    raise DbeelError(self._err())
+                self._buf = (ctypes.c_uint8 * needed)()
+                continue
+            break
         if n == -1:
             raise KeyNotFound(repr(key))
-        if n == -3:
-            raise DbeelError(
-                f"value too large for client buffer: {self._err()}"
-            )
         if n < 0:
             raise DbeelError(self._err())
         return msgpack.unpackb(bytes(self._buf[: int(n)]), raw=False)

@@ -77,6 +77,24 @@ class SSTableList:
             self.drained.notify()
 
 
+class ScanSnapshot:
+    """Point-in-time scan view (see LSMTree.scan_snapshot)."""
+
+    def __init__(self, memtable_items, sstables: SSTableList) -> None:
+        self.memtable_items = memtable_items
+        self._sstables = sstables
+        self._released = False
+
+    @property
+    def tables(self):
+        return self._sstables.tables
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._sstables.release()
+
+
 class LSMTree:
     def __init__(
         self,
@@ -482,6 +500,9 @@ class LSMTree:
             (t.index, t.data_size) for t in self._sstables.tables
         ]
 
+    def sstable_entry_count(self) -> int:
+        return sum(t.entry_count for t in self._sstables.tables)
+
     async def compact(
         self,
         indices: Sequence[int],
@@ -655,6 +676,24 @@ class LSMTree:
 
     def iter(self) -> AsyncIterator[Tuple[bytes, bytes, int]]:
         return self.iter_filter(None)
+
+    def scan_snapshot(self) -> "ScanSnapshot":
+        """Synchronous point-in-time view for OFF-LOOP bulk scans
+        (vectorized anti-entropy digests): memtable items materialized
+        now, sstable list acquired so compaction cannot delete the
+        files under the scan.  Caller MUST release()."""
+        items: List[Tuple[bytes, bytes, int]] = []
+        if self._flushing is not None:
+            items.extend(
+                (k, v, ts)
+                for k, (v, ts) in self._flushing.sorted_items()
+            )
+        items.extend(
+            (k, v, ts) for k, (v, ts) in self._active.sorted_items()
+        )
+        snapshot = self._sstables
+        snapshot.acquire()
+        return ScanSnapshot(items, snapshot)
 
     # ------------------------------------------------------------------
 

@@ -436,6 +436,9 @@ int keyed_request(Client* c, const char* type,
   for (int attempt = 0; attempt < 2; attempt++) {
     auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
     bool not_owned = false;
+    // Per attempt: a post-resync walk that cleanly answers is not
+    // tainted by pre-resync failures against the stale ring.
+    bool transport_failed = false;
     for (size_t ri = 0; ri < replicas.size(); ri++) {
       MpBuf m;
       // type, collection, keepalive, key, hash, replica_index
@@ -462,9 +465,11 @@ int keyed_request(Client* c, const char* type,
       uint8_t rtype = 0;
       if (!round_trip(c, replicas[ri]->ip, replicas[ri]->db_port, m,
                       &body, &rtype)) {
-        // Transport failure must overwrite an earlier replica's
-        // KeyNotFound: a partially-down cluster is an error, not a
-        // missing key (last_error already carries the cause).
+        // A partially-down cluster is an error, not a missing key —
+        // and the flag is sticky so walk ORDER can't matter: a later
+        // replica's KeyNotFound must not downgrade it either
+        // (last_error already carries the transport cause).
+        transport_failed = true;
         last_rc = -2;
         continue;  // next replica
       }
@@ -492,6 +497,11 @@ int keyed_request(Client* c, const char* type,
     }
     if (not_owned) {
       c->last_error = "KeyNotOwnedByShard after resync";
+      return -2;
+    }
+    if (transport_failed) {
+      // Some replica was unreachable and none succeeded: the key's
+      // state is UNKNOWN, never "not found".
       return -2;
     }
     if (last_rc == -2 && c->last_error.empty()) {
@@ -585,7 +595,9 @@ int64_t dbeel_cli_get(void* h, const char* collection,
     c->last_error = "value too large for caller buffer (" +
                     std::to_string(body.size()) + " > " +
                     std::to_string(cap) + " bytes)";
-    return -3;
+    // <= -10 encodes the needed size (-rc - 10) so the caller can
+    // grow its buffer and retry; -1/-2 stay not-found/error.
+    return -((int64_t)body.size()) - 10;
   }
   std::memcpy(out, body.data(), body.size());
   return (int64_t)body.size();

@@ -22,6 +22,7 @@
 
 #include <cerrno>
 #include <cstdlib>
+#include <thread>
 #include <ctime>
 #include <fcntl.h>
 #include <string>
@@ -417,8 +418,13 @@ int64_t dbeel_writer_put(void* handle, const uint8_t* const* run_ptrs,
 // success (data_size set to the data file's logical size), -1 on error.
 int64_t dbeel_writer_close(void* handle, uint64_t* data_size) {
   auto* w = static_cast<GatherWriter*>(handle);
+  // The two fdatasyncs run in parallel: the close flush is the
+  // pipeline's tail (~1s of a 10M merge) and the device can overlap
+  // the data and index cache flushes.
+  bool i = false;
+  std::thread index_close([&] { i = w->index.close_sync(); });
   const bool d = w->data.close_sync();
-  const bool i = w->index.close_sync();
+  index_close.join();
   const int64_t entries = w->entries;
   *data_size = w->data.logical;
   delete w;

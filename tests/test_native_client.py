@@ -166,3 +166,28 @@ def test_native_client_latency_yardstick(server):
             cli.set("lat", "warm", i)
         per_op = (time.perf_counter() - t0) / n
         assert per_op < 0.05, f"set round trip {per_op*1e6:.0f}us"
+
+
+def test_native_client_large_value_grows_buffer(server):
+    """A value larger than the current get buffer must round-trip via
+    the grow-and-retry protocol (C reports the needed size).  The u16
+    request frame caps doc-API values at ~64KB — under the default
+    initial buffer — so the path is exercised by shrinking the buffer
+    first (values beyond it can still enter trees via the inter-shard
+    planes, whose frames are u32)."""
+    import ctypes
+
+    with native_client.NativeDbeelClient("127.0.0.1", PORT) as cli:
+        cli.create_collection("big", replication_factor=1)
+        time.sleep(0.3)
+        big = "x" * 4096
+        cli.set("big", "k", big)
+        cli._buf = (ctypes.c_uint8 * 16)()  # force the -10 grow path
+        assert cli.get("big", "k") == big
+        assert len(cli._buf) >= 4096  # grown to the reported size
+
+        # And an oversized SET is rejected loudly by the frame bound.
+        from dbeel_tpu.errors import DbeelError
+
+        with pytest.raises(DbeelError, match="frame too large"):
+            cli.set("big", "k2", "x" * 70000)

@@ -1,0 +1,166 @@
+"""Vectorized anti-entropy digests (storage/range_digest.py) must be
+bit-identical to the per-entry scalar path across random trees, wrap
+ranges, bucket counts, duplicate keys, and tombstones — and the bucket
+/ membership vectorizations must match the scalar functions
+exhaustively."""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pytest
+
+from dbeel_tpu.server.shard import MyShard
+from dbeel_tpu.storage import range_digest as rd
+from dbeel_tpu.storage.lsm_tree import LSMTree
+from dbeel_tpu.storage.native import native_available
+from dbeel_tpu.utils.murmur import hash_bytes, murmur3_32
+
+from conftest import run
+
+
+def scalar_digests(entries, start, end, nb):
+    """Reference implementation: the per-entry algorithm over a
+    materialized (key, newest-ts) view."""
+    newest = {}
+    for k, ts in entries:
+        h = hash_bytes(k)
+        if not MyShard._in_ae_range(h, start, end):
+            continue
+        if k not in newest or ts > newest[k]:
+            newest[k] = ts
+    counts = [0] * nb
+    digests = [0] * nb
+    for k, ts in newest.items():
+        b = MyShard._ae_bucket_of(hash_bytes(k), start, end, nb)
+        blob = k + ts.to_bytes(8, "little", signed=True)
+        counts[b] += 1
+        digests[b] ^= murmur3_32(blob, 0x0A57E4A1) | (
+            murmur3_32(blob, 0x51C6E57A) << 32
+        )
+    return counts, digests
+
+
+def test_bucket_and_membership_vectorizations_match_scalar():
+    rng = random.Random(11)
+    hs = np.array(
+        [rng.randrange(0, 1 << 32) for _ in range(2000)]
+        + [0, 1, (1 << 32) - 1],
+        dtype=np.uint32,
+    )
+    cases = [
+        (0, 0, 1),
+        (5, 5, 64),  # whole ring
+        (100, 2_000_000_000, 64),
+        (4_000_000_000, 1_000_000_000, 16),  # wrap
+        ((1 << 32) - 1, 3, 7),
+    ]
+    for start, end, nb in cases:
+        mask = rd.range_members_mask(hs, start, end)
+        buckets = rd.bucket_of(hs, start, end, nb)
+        for h, m, b in zip(hs.tolist(), mask.tolist(), buckets.tolist()):
+            assert m == MyShard._in_ae_range(h, start, end), (
+                h, start, end,
+            )
+            if m:
+                assert b == MyShard._ae_bucket_of(h, start, end, nb), (
+                    h, start, end, nb,
+                )
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native lib unavailable"
+)
+def test_vectorized_digest_matches_scalar_on_real_tree(tmp_dir):
+    async def main():
+        rng = random.Random(7)
+        d = os.path.join(tmp_dir, "t")
+        os.makedirs(d)
+        tree = LSMTree.open_or_create(d, capacity=64)
+        entries = []
+        # Multiple flushed generations + duplicates + tombstones +
+        # variable-length keys + in-memtable leftovers.
+        for gen in range(3):
+            for i in range(150):
+                k = f"key-{rng.randrange(120):03d}".encode()
+                if rng.random() < 0.2:
+                    k += b"-long-suffix" * rng.randrange(1, 4)
+                ts = 1000 * gen + i
+                v = b"" if rng.random() < 0.15 else b"v%d" % i
+                await tree.set_with_timestamp(k, v, ts)
+                entries.append((k, ts))
+            await tree.flush()
+        for i in range(40):  # stays in the memtable
+            k = f"mem-{i:02d}".encode()
+            await tree.set_with_timestamp(k, b"m", 90_000 + i)
+            entries.append((k, 90_000 + i))
+
+        for start, end, nb in (
+            (0, 0, 64),
+            (123, 123, 8),  # whole ring
+            (100, 3_000_000_000, 64),
+            (3_500_000_000, 200_000_000, 32),  # wrap
+        ):
+            snap = tree.scan_snapshot()
+            try:
+                got = rd.vectorized_range_digests(
+                    snap.memtable_items, snap.tables, start, end, nb
+                )
+            finally:
+                snap.release()
+            assert got is not None
+            want = scalar_digests(entries, start, end, nb)
+            assert got == want, (start, end, nb)
+
+            # And through the shard entry point (size gate bypassed by
+            # patching the threshold).
+            old = rd.MIN_VECTORIZED_ENTRIES
+            rd.MIN_VECTORIZED_ENTRIES = 1
+            try:
+                via_shard = await MyShard.compute_range_digests(
+                    tree, start, end, nb
+                )
+            finally:
+                rd.MIN_VECTORIZED_ENTRIES = old
+            assert via_shard == want
+        tree.close()
+
+    run(main(), timeout=60)
+
+
+@pytest.mark.skipif(
+    not native_available(), reason="native lib unavailable"
+)
+def test_vectorized_digest_hash_collision_groups(tmp_dir):
+    """Different keys in one 32-bit hash group must not merge: feed
+    many keys so same-hash groups (forced via duplicate keys across
+    sstables) resolve by exact key bytes."""
+
+    async def main():
+        d = os.path.join(tmp_dir, "t")
+        os.makedirs(d)
+        tree = LSMTree.open_or_create(d, capacity=32)
+        entries = []
+        # The same key set written twice across two sstables: every
+        # hash becomes a multi-entry group.
+        for gen in range(2):
+            for i in range(100):
+                k = b"dup-%02d" % i
+                ts = gen * 100 + i
+                await tree.set_with_timestamp(k, b"x", ts)
+                entries.append((k, ts))
+            await tree.flush()
+        snap = tree.scan_snapshot()
+        try:
+            got = rd.vectorized_range_digests(
+                snap.memtable_items, snap.tables, 0, 0, 16
+            )
+        finally:
+            snap.release()
+        want = scalar_digests(entries, 0, 0, 16)
+        assert got == want
+        assert sum(got[0]) == 100  # one survivor per unique key
+        tree.close()
+
+    run(main())
