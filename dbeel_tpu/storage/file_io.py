@@ -86,6 +86,54 @@ class CachedFileReader:
     def _pread_pages(self, addresses) -> list:
         return [self._pread_page(a) for a in addresses]
 
+    async def _read_pages_async(self, addresses) -> list:
+        """Whole pages for every address: io_uring submissions when
+        available (each a zero-thread async read), executor preads
+        otherwise; partial trailing pages are zero-padded either way."""
+        from . import uring
+
+        ur = uring.get_for_loop()
+        if ur is not None:
+            futs = []  # (address, future)
+            fallback = []
+            for a in addresses:
+                f = ur.queue_pread(self._fd, PAGE_SIZE, a)
+                if f is None:  # ring at capacity: executor for these
+                    fallback.append(a)
+                else:
+                    futs.append((a, f))
+            if futs and not ur.flush():
+                # Kernel rejected the batch: those futures will never
+                # complete — cancel them and take the executor path.
+                for _a, f in futs:
+                    f.cancel()
+                fallback.extend(a for a, _f in futs)
+                futs = []
+            by_addr = {}
+            if futs:
+                done = await asyncio.gather(*[f for _a, f in futs])
+                for (a, _f), r in zip(futs, done):
+                    by_addr[a] = r
+            if fallback:
+                for a, r in zip(
+                    fallback,
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, self._pread_pages, fallback
+                    ),
+                ):
+                    by_addr[a] = r
+            return [
+                (
+                    r + b"\x00" * (PAGE_SIZE - len(r))
+                    if len(r) < PAGE_SIZE
+                    else r
+                )
+                for r in (by_addr[a] for a in addresses)
+            ]
+        return await asyncio.get_event_loop().run_in_executor(
+            None, self._pread_pages, addresses
+        )
+
     def read_at_cached(self, pos: int, size: int) -> Optional[bytes]:
         """Cache-only read: the bytes if EVERY page of the range is
         already cached, else None (no disk access, no awaits) — the
@@ -109,10 +157,12 @@ class CachedFileReader:
 
     async def read_at_async(self, pos: int, size: int) -> bytes:
         """read_at that never blocks the event loop on disk: cached
-        pages are served inline; ALL missing pages of the range are
-        pread in one executor hop (reference parity: the read path is
-        async DMA through io_uring, cached_file_reader.rs:28-88), then
-        inserted into the cache back on the loop — cache mutation stays
+        pages are served inline; missing pages are SUBMITTED to the
+        loop's io_uring reader (storage/uring.py — true async reads
+        with no thread hop, the reference's DmaFile-over-io_uring
+        shape, cached_file_reader.rs:28-88) or, when io_uring is
+        unavailable, pread in one executor hop.  Cache insertion
+        happens back on the loop — cache mutation stays
         loop-confined."""
         if size <= 0:
             return b""
@@ -133,9 +183,7 @@ class CachedFileReader:
                 pages[address] = page
             address += PAGE_SIZE
         if missing:
-            raws = await asyncio.get_event_loop().run_in_executor(
-                None, self._pread_pages, missing
-            )
+            raws = await self._read_pages_async(missing)
             for address, raw in zip(missing, raws):
                 if self._cache is not None:
                     self._cache.set(self.file_id, address, raw)

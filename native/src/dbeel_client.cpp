@@ -581,7 +581,9 @@ int dbeel_cli_delete(void* h, const char* collection,
 }
 
 // Returns the value length (raw msgpack bytes copied into out, up to
-// cap), -1 when not found, -2 on error, -3 when cap is too small.
+// cap), -1 when not found, -2 on error; when cap is too small the
+// return is <= -10 and encodes the needed size as -(rc) - 10 (grow
+// the buffer and retry).
 int64_t dbeel_cli_get(void* h, const char* collection,
                       const uint8_t* key, uint32_t klen,
                       int consistency, uint32_t rf, uint8_t* out,

@@ -1352,3 +1352,214 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------
+// Raw io_uring async reader — the serving read path's DMA engine.
+// Role parity with glommio's DmaFile::read_at_aligned over io_uring
+// (/root/reference/src/storage_engine/cached_file_reader.rs:28-88):
+// page reads are SUBMITTED from the event-loop thread without
+// blocking, completions arrive via an eventfd the loop polls, and no
+// worker threads or executor hops are involved.  No liburing in the
+// image — the rings are mapped and driven with raw syscalls.
+// Single-threaded contract: submit and reap only from the loop thread.
+// ---------------------------------------------------------------------
+
+#include <linux/io_uring.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+
+namespace {
+
+struct UringReader {
+  int ring_fd = -1;
+  int efd = -1;
+  unsigned sq_entries = 0;
+  unsigned cq_entries = 0;
+  // SQ ring pointers
+  void* sq_ring = nullptr;
+  size_t sq_ring_sz = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  io_uring_sqe* sqes = nullptr;
+  size_t sqes_sz = 0;
+  // CQ ring pointers
+  void* cq_ring = nullptr;
+  size_t cq_ring_sz = 0;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  bool single_mmap = false;
+  unsigned in_flight = 0;
+  unsigned queued = 0;
+};
+
+inline int sys_uring_setup(unsigned entries, io_uring_params* p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+inline int sys_uring_enter(int fd, unsigned to_submit,
+                           unsigned min_complete, unsigned flags) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit,
+                      min_complete, flags, nullptr, 0);
+}
+inline int sys_uring_register(int fd, unsigned op, void* arg,
+                              unsigned nr) {
+  return (int)syscall(__NR_io_uring_register, fd, op, arg, nr);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dbeel_uring_create(unsigned entries) {
+  io_uring_params p;
+  std::memset(&p, 0, sizeof(p));
+  int fd = sys_uring_setup(entries, &p);
+  if (fd < 0) return nullptr;
+  auto* u = new UringReader();
+  u->ring_fd = fd;
+  u->sq_entries = p.sq_entries;
+  u->cq_entries = p.cq_entries;
+  u->single_mmap = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+
+  u->sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+  u->cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+  if (u->single_mmap && u->cq_ring_sz > u->sq_ring_sz)
+    u->sq_ring_sz = u->cq_ring_sz;
+
+  u->sq_ring = ::mmap(nullptr, u->sq_ring_sz, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+  if (u->sq_ring == MAP_FAILED) goto fail;
+  u->cq_ring =
+      u->single_mmap
+          ? u->sq_ring
+          : ::mmap(nullptr, u->cq_ring_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+  if (u->cq_ring == MAP_FAILED) goto fail;
+  u->sqes_sz = p.sq_entries * sizeof(io_uring_sqe);
+  u->sqes = static_cast<io_uring_sqe*>(
+      ::mmap(nullptr, u->sqes_sz, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES));
+  if (u->sqes == MAP_FAILED) goto fail;
+
+  {
+    uint8_t* sq = static_cast<uint8_t*>(u->sq_ring);
+    u->sq_head = reinterpret_cast<unsigned*>(sq + p.sq_off.head);
+    u->sq_tail = reinterpret_cast<unsigned*>(sq + p.sq_off.tail);
+    u->sq_mask = reinterpret_cast<unsigned*>(sq + p.sq_off.ring_mask);
+    u->sq_array = reinterpret_cast<unsigned*>(sq + p.sq_off.array);
+    uint8_t* cq = static_cast<uint8_t*>(u->cq_ring);
+    u->cq_head = reinterpret_cast<unsigned*>(cq + p.cq_off.head);
+    u->cq_tail = reinterpret_cast<unsigned*>(cq + p.cq_off.tail);
+    u->cq_mask = reinterpret_cast<unsigned*>(cq + p.cq_off.ring_mask);
+    u->cqes = reinterpret_cast<io_uring_cqe*>(cq + p.cq_off.cqes);
+  }
+
+  u->efd = ::eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (u->efd < 0) goto fail;
+  if (sys_uring_register(fd, IORING_REGISTER_EVENTFD, &u->efd, 1) < 0)
+    goto fail;
+  return u;
+
+fail:
+  if (u->sqes && u->sqes != MAP_FAILED) ::munmap(u->sqes, u->sqes_sz);
+  if (!u->single_mmap && u->cq_ring && u->cq_ring != MAP_FAILED)
+    ::munmap(u->cq_ring, u->cq_ring_sz);
+  if (u->sq_ring && u->sq_ring != MAP_FAILED)
+    ::munmap(u->sq_ring, u->sq_ring_sz);
+  if (u->efd >= 0) ::close(u->efd);
+  ::close(fd);
+  delete u;
+  return nullptr;
+}
+
+void dbeel_uring_destroy(void* h) {
+  auto* u = static_cast<UringReader*>(h);
+  if (!u) return;
+  if (u->sqes) ::munmap(u->sqes, u->sqes_sz);
+  if (!u->single_mmap && u->cq_ring) ::munmap(u->cq_ring, u->cq_ring_sz);
+  if (u->sq_ring) ::munmap(u->sq_ring, u->sq_ring_sz);
+  if (u->efd >= 0) ::close(u->efd);
+  if (u->ring_fd >= 0) ::close(u->ring_fd);
+  delete u;
+}
+
+int dbeel_uring_eventfd(void* h) {
+  return static_cast<UringReader*>(h)->efd;
+}
+
+// Queue one positional read WITHOUT submitting (call
+// dbeel_uring_flush once per batch).  Returns 0, or -1 when the SQ is
+// full or the completion queue could overflow — in-flight + queued is
+// capped at cq_entries, because overflowed completions would only be
+// flushed by a GETEVENTS enter that the non-blocking reaper never
+// issues (callers fall back to the executor path instead of hanging).
+int dbeel_uring_queue_read(void* h, int fd, void* buf, uint32_t len,
+                           uint64_t off, uint64_t tag) {
+  auto* u = static_cast<UringReader*>(h);
+  if (u->in_flight + u->queued >= u->cq_entries) return -1;
+  const unsigned head =
+      __atomic_load_n(u->sq_head, __ATOMIC_ACQUIRE);
+  const unsigned tail = *u->sq_tail;
+  if (tail - head >= u->sq_entries) return -1;  // SQ full
+  const unsigned idx = tail & *u->sq_mask;
+  io_uring_sqe* sqe = &u->sqes[idx];
+  std::memset(sqe, 0, sizeof(*sqe));
+  sqe->opcode = IORING_OP_READ;
+  sqe->fd = fd;
+  sqe->addr = (uint64_t)(uintptr_t)buf;
+  sqe->len = len;
+  sqe->off = off;
+  sqe->user_data = tag;
+  u->sq_array[idx] = idx;
+  __atomic_store_n(u->sq_tail, tail + 1, __ATOMIC_RELEASE);
+  u->queued++;
+  return 0;
+}
+
+// Submit everything queued in ONE syscall (a 16-page cache miss pays
+// one io_uring_enter, not 16).  Returns the number submitted or -1.
+int dbeel_uring_flush(void* h) {
+  auto* u = static_cast<UringReader*>(h);
+  if (u->queued == 0) return 0;
+  const int ret = sys_uring_enter(u->ring_fd, u->queued, 0, 0);
+  if (ret < 0) return -1;
+  u->in_flight += u->queued;
+  u->queued = 0;
+  return ret;
+}
+
+// Convenience: queue + flush one read (tests / single-read callers).
+int dbeel_uring_submit_read(void* h, int fd, void* buf, uint32_t len,
+                            uint64_t off, uint64_t tag) {
+  if (dbeel_uring_queue_read(h, fd, buf, len, off, tag) != 0)
+    return -1;
+  return dbeel_uring_flush(h) < 0 ? -1 : 0;
+}
+
+// Drain available completions (non-blocking).  Returns the count;
+// tags[i]/results[i] carry user_data and the read result (bytes or
+// -errno).
+int dbeel_uring_reap(void* h, uint64_t* tags, int32_t* results,
+                     int max) {
+  auto* u = static_cast<UringReader*>(h);
+  int n = 0;
+  unsigned head = *u->cq_head;
+  const unsigned tail =
+      __atomic_load_n(u->cq_tail, __ATOMIC_ACQUIRE);
+  while (head != tail && n < max) {
+    const io_uring_cqe* cqe = &u->cqes[head & *u->cq_mask];
+    tags[n] = cqe->user_data;
+    results[n] = cqe->res;
+    n++;
+    head++;
+  }
+  __atomic_store_n(u->cq_head, head, __ATOMIC_RELEASE);
+  if (n > 0 && u->in_flight >= (unsigned)n) u->in_flight -= n;
+  return n;
+}
+
+}  // extern "C"
