@@ -119,6 +119,7 @@ class ShareScheduler:
             "background_units": self.bg_units,
             "background_busy_s": round(self.bg_busy_s, 6),
             "background_throttled_s": round(self.bg_throttled_s, 6),
+            "background_precharged_s": round(self.bg_precharged_s, 6),
         }
 
 
