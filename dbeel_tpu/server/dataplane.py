@@ -20,9 +20,30 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import os
 from typing import Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+
+class _TableDesc(ctypes.Structure):
+    """Mirrors the C FastTable descriptor (dbeel_native.cpp).  The
+    bloom/prefix fields are raw buffer addresses; the DataPlane keeps
+    the owning Python objects alive in _table_refs until the next
+    registration for the collection."""
+
+    _fields_ = [
+        ("data_fd", ctypes.c_int32),
+        ("index_fd", ctypes.c_int32),
+        ("entry_count", ctypes.c_uint64),
+        ("bloom_bits", ctypes.c_uint64),
+        ("bloom_nbits", ctypes.c_uint64),
+        ("bloom_k", ctypes.c_uint32),
+        ("stride", ctypes.c_uint32),
+        ("p1", ctypes.c_uint64),
+        ("p2", ctypes.c_uint64),
+        ("n_samples", ctypes.c_uint64),
+    ]
 
 # Full wire response for a successful set/delete: u32-LE length +
 # msgpack "OK" + RESPONSE_BYTES trailing byte (db_server.rs:405-428).
@@ -48,8 +69,16 @@ class DataPlane:
         if not self._handle:
             raise MemoryError("dataplane allocation failed")
         self._trees = {}  # name -> LSMTree (flush spawning)
+        self._table_refs = {}  # name -> borrowed-buffer keepalives
+        self._table_fps = {}  # name -> registry fingerprint (skip no-ops)
         self._get_buf = ctypes.create_string_buffer(_GET_BUF_CAP)
         self._out_len = ctypes.c_uint32(0)
+        # DBEEL_DP_NO_TABLES=1 disables the native sstable-get path
+        # (A/B benching; gets punt to Python on memtable miss).
+        # "0"/"" keep it enabled.
+        self._has_tables = hasattr(
+            lib, "dbeel_dp_set_tables"
+        ) and os.environ.get("DBEEL_DP_NO_TABLES", "0") in ("", "0")
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -61,17 +90,26 @@ class DataPlane:
 
     @staticmethod
     def tree_eligible(tree) -> bool:
-        """Fast path requires the native arena memtable (its handle IS
-        the C-side memtable) and a native WAL appender; wal-sync trees
-        stay on the Python path (sync coalescing is asyncio-side)."""
+        """Registration requires the native arena memtable (its handle
+        IS the C-side memtable).  Write fast-pathing additionally
+        requires a native WAL appender and no wal-sync (sync
+        coalescing is asyncio-side) — see _write_wal_handle; trees
+        that fail only the write conditions still register for native
+        GETS (memtable probe + sstable search) with a null WAL, which
+        makes the C write path punt."""
         active = getattr(tree, "_active", None)
+        return getattr(active, "_handle", None) is not None
+
+    @staticmethod
+    def _write_wal_handle(tree):
         wal = getattr(tree, "_wal", None)
-        return (
-            getattr(active, "_handle", None) is not None
-            and wal is not None
-            and getattr(wal, "_native", None) is not None
-            and not tree.wal_sync
-        )
+        if (
+            wal is None
+            or getattr(wal, "_native", None) is None
+            or tree.wal_sync
+        ):
+            return None
+        return wal._native
 
     def register_tree(self, name: str, tree) -> None:
         if not self.tree_eligible(tree):
@@ -89,7 +127,7 @@ class DataPlane:
                 if getattr(flushing, "_handle", None)
                 else None
             ),
-            ctypes.c_void_p(tree._wal._native),
+            ctypes.c_void_p(self._write_wal_handle(tree)),
             tree.capacity,
         )
         if rc < 0:
@@ -112,11 +150,86 @@ class DataPlane:
         tree.write_state_listener = lambda t, n=name: self.register_tree(
             n, t
         )
+        self._register_tables(name, tree)
+
+    def _register_tables(self, name: str, tree) -> None:
+        """Mirror the tree's sstable list (newest first) into the C
+        registry so gets that miss the memtables resolve natively.
+        Runs on the shard loop thread (write_state_listener fires on
+        flush commit, compaction swap, and read-index warm
+        completion); on ANY irregularity the registry is invalidated
+        so the C side punts instead of mis-reporting absence."""
+        if not self._has_tables:
+            return
+        nm = name.encode()
+        lib = self._lib
+        try:
+            tables = list(reversed(tree._sstables.tables))
+            # Most write-state notifications (memtable swaps, warm
+            # completions of already-registered tables) don't change
+            # the registry inputs: skip the dup/close syscall churn
+            # when the (table, index-built) fingerprint is unchanged.
+            fp = tuple(
+                (id(t), t._fast is not None, t._sparse is not None)
+                for t in tables
+            )
+            if self._table_fps.get(name) == fp:
+                return
+            descs = (_TableDesc * max(1, len(tables)))()
+            refs = []
+            for i, t in enumerate(tables):
+                d = descs[i]
+                fd_d = t._data._fd
+                fd_i = t._index._fd
+                if fd_d < 0 or fd_i < 0:
+                    raise ValueError(f"closed fds on sstable {t.index}")
+                d.data_fd = fd_d
+                d.index_fd = fd_i
+                d.entry_count = t.entry_count
+                bloom = t.bloom
+                if bloom is not None:
+                    d.bloom_bits = bloom.bits.ctypes.data
+                    d.bloom_nbits = bloom.num_bits
+                    d.bloom_k = bloom.num_hashes
+                fast, sparse = t._fast, t._sparse
+                p1 = p2 = None
+                if fast is not None:
+                    p1, p2 = fast[0], fast[1]
+                    d.stride = 1
+                elif sparse is not None:
+                    p1, p2, d.stride = sparse
+                if p1 is not None and len(p1):
+                    d.p1 = p1.buffer_info()[0]
+                    d.p2 = p2.buffer_info()[0]
+                    d.n_samples = len(p1)
+                else:
+                    d.stride = 0
+                refs.append((t, bloom, fast, sparse))
+            rc = lib.dbeel_dp_set_tables(
+                self._handle, nm, len(nm), descs, len(tables)
+            )
+            if rc == 0:
+                self._table_refs[name] = refs
+                self._table_fps[name] = fp
+            else:
+                # C kept (but invalidated) the old registry — keep the
+                # old refs so its fd-close sweep stays safe.
+                self._table_fps.pop(name, None)
+        except Exception:
+            log.exception("dataplane table registration for %s", name)
+            self._table_fps.pop(name, None)
+            lib.dbeel_dp_set_tables(self._handle, nm, len(nm), None, -1)
 
     def unregister(self, name: str) -> None:
         nm = name.encode()
         self._lib.dbeel_dp_unregister(self._handle, nm, len(nm))
         tree = self._trees.pop(name, None)
+        self._table_refs.pop(name, None)
+        # Drop the fingerprint too: a re-created collection with the
+        # same name starts with a FRESH (tables_valid=false) C entry,
+        # and a stale matching fingerprint would skip the set_tables
+        # call that validates it.
+        self._table_fps.pop(name, None)
         if tree is not None:
             tree.write_state_listener = None
 
@@ -161,7 +274,7 @@ class DataPlane:
         return OK_RESPONSE, keepalive, flush_tree, op
 
     def stats(self) -> dict:
-        return {
+        out = {
             "fast_sets": int(
                 self._lib.dbeel_dp_fast_sets(self._handle)
             ),
@@ -169,6 +282,11 @@ class DataPlane:
                 self._lib.dbeel_dp_fast_gets(self._handle)
             ),
         }
+        if self._has_tables:
+            out["fast_table_gets"] = int(
+                self._lib.dbeel_dp_fast_table_gets(self._handle)
+            )
+        return out
 
 
 def create_dataplane() -> Optional[DataPlane]:

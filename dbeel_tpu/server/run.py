@@ -194,6 +194,16 @@ def _eager_jax_init(config: Config) -> None:
         "distributed",
     ):
         return
+    from ..utils.jax_gate import probe_jax_alive
+
+    # Subprocess probe first: a dead TPU tunnel wedges backend init in
+    # an uninterruptible recvfrom (no exception to catch), and it must
+    # wedge a throwaway child, not the serving process.  Healthy cold
+    # starts pay one extra backend init in the child (~seconds);
+    # operators who know the backend is up can preset
+    # DBEEL_JAX_PROBED=ok to skip it.
+    if not probe_jax_alive():
+        return
     try:
         import jax
 

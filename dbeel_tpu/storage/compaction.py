@@ -258,6 +258,24 @@ def _write_bloom(dir_path: str, output_index: int, bloom: BloomFilter):
         os.fsync(f.fileno())
 
 
+def _jax_marked_dead(backend: str) -> bool:
+    """True when the server's startup probe (utils/jax_gate) found the
+    jax backend wedged/dead — device strategies must then degrade to
+    host merges instead of hanging the compaction worker."""
+    from ..utils.jax_gate import jax_marked_dead
+
+    if not jax_marked_dead():
+        return False
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "compaction_backend=%s: jax backend marked dead by the "
+        "startup probe; using the host merge path",
+        backend,
+    )
+    return True
+
+
 def get_strategy(name: str) -> CompactionStrategy:
     """Resolve a strategy by config name (config.compaction_backend)."""
     if name == "heap":
@@ -273,18 +291,24 @@ def get_strategy(name: str) -> CompactionStrategy:
             return NativeMergeStrategy()
         return ColumnarMergeStrategy()
     if name == "device":
+        if _jax_marked_dead("device"):
+            return ColumnarMergeStrategy()
         try:
             from ..ops.device_compaction import DeviceMergeStrategy
         except ImportError:
             return ColumnarMergeStrategy()
         return DeviceMergeStrategy()
     if name == "coalesced":
+        if _jax_marked_dead("coalesced"):
+            return ColumnarMergeStrategy()
         try:
             from ..server.coalescer import CoalescedDeviceMergeStrategy
         except ImportError:
             return ColumnarMergeStrategy()
         return CoalescedDeviceMergeStrategy()
     if name == "device_full":
+        if _jax_marked_dead("device_full"):
+            return ColumnarMergeStrategy()
         try:
             from ..ops.device_compaction import DeviceFullMergeStrategy
         except ImportError:
@@ -295,6 +319,8 @@ def get_strategy(name: str) -> CompactionStrategy:
         # Falls back to the single-device kernel on a 1-chip host and to
         # the host path when jax is unavailable — loudly, so an operator
         # who configured the mesh backend can see it didn't engage.
+        if _jax_marked_dead("distributed"):
+            return ColumnarMergeStrategy()
         try:
             import jax
 
@@ -316,6 +342,8 @@ def get_strategy(name: str) -> CompactionStrategy:
         return DistributedMergeStrategy(shard_mesh())
     if name == "auto":
         try:
+            if _jax_marked_dead("auto"):
+                raise RuntimeError("jax marked dead by startup probe")
             import jax
 
             platform = jax.default_backend()

@@ -439,9 +439,14 @@ class LSMTree:
             )
             table = SSTable(self.dir_path, flush_index, self.cache)
             # Pre-warm the in-memory read index off-loop so the first
-            # point lookup doesn't pay the bulk read.
-            asyncio.get_event_loop().run_in_executor(
+            # point lookup doesn't pay the bulk read; when it lands,
+            # re-notify so the native data plane picks up the built
+            # prefix arrays (callback runs on the loop thread).
+            warm_fut = asyncio.get_event_loop().run_in_executor(
                 None, table.warm
+            )
+            warm_fut.add_done_callback(
+                lambda _f: self._notify_write_state()
             )
             self._sstables = SSTableList(
                 self._sstables.tables + [table]
@@ -615,11 +620,22 @@ class LSMTree:
             t for t in self._sstables.tables if t.index not in index_set
         ]
         output_table = SSTable(self.dir_path, output_index, self.cache)
-        asyncio.get_event_loop().run_in_executor(
+        warm_fut = asyncio.get_event_loop().run_in_executor(
             None, output_table.warm
         )
+        warm_fut.add_done_callback(
+            lambda _f: self._notify_write_state()
+        )
         survivors.append(output_table)
+        # SSTableList sorts by index: the even/odd scheme ranks the
+        # output (max(inputs)+1) below any table flushed DURING this
+        # compaction, so reversed() keeps probing newest data first.
         self._sstables = SSTableList(survivors)
+        # The native data plane must swap to the new table list before
+        # the inputs are closed/unlinked below (its dup'd fds make the
+        # old tables safe mid-probe, but it should pick up the merged
+        # table's bloom/prefix index promptly).
+        self._notify_write_state()
 
         # Reader drain before deleting inputs (1141-1145).
         while old_list.readers > 0:

@@ -223,6 +223,17 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dbeel_dp_fast_sets.argtypes = [ctypes.c_void_p]
         lib.dbeel_dp_fast_gets.restype = ctypes.c_uint64
         lib.dbeel_dp_fast_gets.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "dbeel_dp_set_tables"):
+            lib.dbeel_dp_set_tables.restype = ctypes.c_int32
+            lib.dbeel_dp_set_tables.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_void_p,  # FastTable descriptor array
+                ctypes.c_int32,
+            ]
+            lib.dbeel_dp_fast_table_gets.restype = ctypes.c_uint64
+            lib.dbeel_dp_fast_table_gets.argtypes = [ctypes.c_void_p]
         lib.dbeel_dp_handle.restype = ctypes.c_int64
         lib.dbeel_dp_handle.argtypes = [
             ctypes.c_void_p,

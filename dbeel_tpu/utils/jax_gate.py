@@ -1,0 +1,109 @@
+"""Liveness gate for the jax device backend.
+
+The tunneled TPU plugin can wedge hard — blocked in a plain
+``recvfrom`` during backend init — in a way no except-clause can
+catch, and it does so even under ``JAX_PLATFORMS=cpu`` because plugin
+discovery still phones the tunnel.  Observed in production: a dead
+tunnel turned ``jax.devices()`` into an unbounded hang, so the whole
+node (which only needs jax for background compaction) never came up.
+
+The gate probes backend init in a THROWAWAY SUBPROCESS with a
+timeout: a wedged child is killed, the parent never blocks, and the
+verdict is cached in ``DBEEL_JAX_PROBED`` so per-core shard processes
+(``--processes``) inherit it instead of re-probing.  On failure the
+server still serves — device compaction backends degrade loudly to
+the native host merge (storage/compaction.py get_strategy), matching
+the reference's always-available single-threaded merge
+(/root/reference/src/storage_engine/lsm_tree.rs:950-1156).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_verdict: Optional[bool] = None
+
+
+def probe_jax_alive(timeout_s: Optional[float] = None) -> bool:
+    """Probe jax backend init in a subprocess (once per process tree).
+    Returns False when init wedges past the timeout or fails."""
+    global _verdict
+    if _verdict is not None:
+        return _verdict
+    cached = os.environ.get("DBEEL_JAX_PROBED")
+    if cached in ("ok", "fail"):
+        _verdict = cached == "ok"
+        return _verdict
+    # Already initialized in this process (tests, embedders): devices()
+    # cannot wedge anymore, so skip the subprocess (which would pay a
+    # redundant multi-second backend init).
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                _verdict = True
+                os.environ["DBEEL_JAX_PROBED"] = "ok"
+                return True
+        except Exception:
+            pass
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("DBEEL_JAX_INIT_TIMEOUT_S", "45")
+        )
+    try:
+        # Popen + wait(timeout), NOT subprocess.run: run()'s timeout
+        # path calls kill() then an UNBOUNDED wait(), which blocks
+        # forever if the child is wedged in an uninterruptible
+        # (D-state) syscall — the exact condition being probed.  Here
+        # the child is killed and, if it still won't reap, abandoned
+        # (it is kill-pending; init will reap it eventually).
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+            _verdict = rc == 0
+            if rc != 0:
+                log.warning(
+                    "jax backend init failed (probe exit %d); device "
+                    "compaction disabled for this run",
+                    rc,
+                )
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: abandon, never block startup
+            log.warning(
+                "jax backend init wedged for %.0fs (dead TPU "
+                "tunnel?); device compaction disabled for this run",
+                timeout_s,
+            )
+            _verdict = False
+    except Exception as e:
+        log.warning(
+            "jax backend init failed (%s); device compaction disabled "
+            "for this run",
+            e,
+        )
+        _verdict = False
+    os.environ["DBEEL_JAX_PROBED"] = "ok" if _verdict else "fail"
+    return _verdict
+
+
+def jax_marked_dead() -> bool:
+    """True only when a prior probe (this process or a parent) marked
+    the backend unusable.  Never probes — safe for library contexts."""
+    if _verdict is not None:
+        return not _verdict
+    return os.environ.get("DBEEL_JAX_PROBED") == "fail"

@@ -26,6 +26,7 @@
 #include <ctime>
 #include <fcntl.h>
 #include <string>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -1004,6 +1005,85 @@ static bool mp_read_str(MpCur& c, const uint8_t** s, uint32_t* n) {
   return true;
 }
 
+// True when the msgpack object at [s, s+n) is encoded exactly as
+// msgpack-python (use_bin_type=True) would re-encode it.  The server
+// stores keys RE-ENCODED by the Python path (db_server.py extract_key
+// -> _encode_field), while the C fast path stores/compares the
+// client's raw slice — so a valid-but-non-minimal client encoding
+// (e.g. 5 as 0xce 00 00 00 05) must PUNT on both the write and read
+// paths, or the two paths would disagree on key identity (the C read
+// path can now return an authoritative KeyNotFound, which would turn
+// that disagreement into a false absence).  Conservative: containers,
+// ext types and float32 punt.
+static bool mp_key_canonical(const uint8_t* s, uint32_t n) {
+  if (n == 0) return false;
+  const uint8_t b = s[0];
+  if (b <= 0x7f || b >= 0xe0) return n == 1;         // fixint
+  if (b >= 0xa0 && b <= 0xbf) return n == 1u + (b & 0x1f);  // fixstr
+  switch (b) {
+    case 0xc0: case 0xc2: case 0xc3: return n == 1;  // nil/bool
+    case 0xcb: return n == 9;                        // float64
+    case 0xcc:  // uint8: only for values that don't fit a fixint
+      return n == 2 && s[1] > 0x7f;
+    case 0xcd:  // uint16: value must need >8 bits
+      return n == 3 && !(s[1] == 0);
+    case 0xce:  // uint32: value must need >16 bits
+      return n == 5 && !(s[1] == 0 && s[2] == 0);
+    case 0xcf:  // uint64: value must need >32 bits
+      return n == 9 && !(s[1] == 0 && s[2] == 0 && s[3] == 0 && s[4] == 0);
+    case 0xd0:  // int8: only -128..-33 (fixint above, uint if >= 0)
+      return n == 2 && s[1] >= 0x80 && s[1] < 0xe0;
+    case 0xd1: {  // int16: must not fit int8
+      if (n != 3) return false;
+      const int16_t v = (int16_t)(((uint16_t)s[1] << 8) | s[2]);
+      return v < -128;  // non-negatives canonicalize as uints
+    }
+    case 0xd2: {  // int32: must not fit int16
+      if (n != 5) return false;
+      const int32_t v =
+          (int32_t)(((uint32_t)s[1] << 24) | ((uint32_t)s[2] << 16) |
+                    ((uint32_t)s[3] << 8) | s[4]);
+      return v < -32768;
+    }
+    case 0xd3: {  // int64: must not fit int32
+      if (n != 9) return false;
+      uint64_t u = 0;
+      for (int i = 1; i <= 8; i++) u = (u << 8) | s[i];
+      return (int64_t)u < -2147483648ll;
+    }
+    case 0xd9:  // str8: len 32..255 (shorter is fixstr)
+      return n >= 2 && n == 2u + s[1] && s[1] >= 32;
+    case 0xda: {  // str16: len >= 256
+      if (n < 3) return false;
+      const uint32_t len = ((uint32_t)s[1] << 8) | s[2];
+      return n == 3u + len && len >= 256;
+    }
+    case 0xdb: {  // str32: len >= 65536
+      if (n < 5) return false;
+      const uint64_t len = ((uint64_t)s[1] << 24) |
+                           ((uint64_t)s[2] << 16) |
+                           ((uint64_t)s[3] << 8) | s[4];
+      return n == 5u + len && len >= 65536;
+    }
+    case 0xc4:  // bin8 (use_bin_type=True packs bytes as bin)
+      return n >= 2 && n == 2u + s[1];
+    case 0xc5: {  // bin16: len >= 256
+      if (n < 3) return false;
+      const uint32_t len = ((uint32_t)s[1] << 8) | s[2];
+      return n == 3u + len && len >= 256;
+    }
+    case 0xc6: {  // bin32: len >= 65536
+      if (n < 5) return false;
+      const uint64_t len = ((uint64_t)s[1] << 24) |
+                           ((uint64_t)s[2] << 16) |
+                           ((uint64_t)s[3] << 8) | s[4];
+      return n == 5u + len && len >= 65536;
+    }
+    default:
+      return false;  // containers/ext/float32: Python decides
+  }
+}
+
 // Read a non-negative integer value.
 static bool mp_read_uint(MpCur& c, uint64_t* out) {
   if (!mp_need(c, 1)) return false;
@@ -1027,12 +1107,41 @@ static bool mp_read_uint(MpCur& c, uint64_t* out) {
   return true;
 }
 
+// One registered SSTable, newest-first search order.  The fds are
+// dup()'d (owned by the C side), so a compaction unlinking the files
+// cannot invalidate an in-progress probe — the reference's
+// reader-drain property for free (lsm_tree.rs:1141-1145).  The bloom
+// bits and two-level prefix arrays are BORROWED from Python (numpy /
+// array('Q') buffers); the Python DataPlane keeps the owning objects
+// alive until the next dbeel_dp_set_tables for this collection, and
+// all calls happen on the shard loop thread.
+struct FastTable {
+  int32_t data_fd = -1;
+  int32_t index_fd = -1;
+  uint64_t entry_count = 0;
+  uint64_t bloom_bits = 0;  // address of the bit array, 0 = no bloom
+  uint64_t bloom_nbits = 0;
+  uint32_t bloom_k = 0;
+  // stride 0 = no in-RAM prefix index (whole-table binary search);
+  // 1 = dense two-level prefixes (one sample per entry); >1 = sparse
+  // (every stride-th entry sampled) — mirrors SSTable._lookup_range.
+  uint32_t stride = 0;
+  uint64_t p1 = 0;  // sorted u64 big-endian key bytes 0..8
+  uint64_t p2 = 0;  // sorted-within-p1-ties u64 key bytes 8..16
+  uint64_t n_samples = 0;
+};
+
 struct FastCollection {
   std::string name;
   void* active;    // arena memtable (dbeel_memtable_*)
   void* flushing;  // arena memtable being flushed, or null
-  NativeWal* wal;
+  NativeWal* wal;  // null => write-path punts (e.g. wal-sync trees)
   uint32_t capacity;
+  std::vector<FastTable> tables;  // newest first
+  // Gets may only conclude "absent" when the table registry is in
+  // sync with the Python sstable list; false until the first
+  // successful dbeel_dp_set_tables (and when Python invalidates it).
+  bool tables_valid = false;
 };
 
 struct DataPlane {
@@ -1041,8 +1150,222 @@ struct DataPlane {
   // 1 = own all hashes (single-shard ring), 2 = cyclic range (lo, hi].
   int32_t own_mode = 0;
   uint32_t own_lo = 0, own_hi = 0;
-  uint64_t fast_sets = 0, fast_gets = 0;
+  uint64_t fast_sets = 0, fast_gets = 0, fast_table_gets = 0;
+  std::vector<uint8_t> keybuf;  // probe scratch (grown on demand)
 };
+
+static void dp_close_tables(FastCollection& col) {
+  for (auto& t : col.tables) {
+    if (t.data_fd >= 0) ::close(t.data_fd);
+    if (t.index_fd >= 0) ::close(t.index_fd);
+  }
+  col.tables.clear();
+  col.tables_valid = false;
+}
+
+// Non-blocking positional read: succeeds only when the page cache can
+// serve the whole range (RWF_NOWAIT); anything else — cold page,
+// short read, unsupported fs — makes the caller punt to the Python
+// async read path (io_uring), so the shard loop never blocks on disk.
+static bool pread_nw(int fd, void* buf, size_t n, uint64_t off) {
+  struct iovec iov{buf, n};
+  const ssize_t r = ::preadv2(fd, &iov, 1, (off_t)off, RWF_NOWAIT);
+  return r == (ssize_t)n;
+}
+
+// Double-hashed bloom check — bit-for-bit the formula in
+// storage/bloom.py (Kirsch–Mitzenmacher over two murmur3_32 seeds).
+static const uint32_t kBloomSeed1 = 0x9747B28C;
+static const uint32_t kBloomSeed2 = 0x85EBCA6B;
+
+static bool bloom_maybe(const FastTable& t, const uint8_t* key,
+                        uint32_t kn) {
+  if (t.bloom_bits == 0 || t.bloom_nbits == 0) return true;
+  const uint8_t* bits = (const uint8_t*)(uintptr_t)t.bloom_bits;
+  const uint64_t h1 = murmur3_32(key, kn, kBloomSeed1);
+  const uint64_t h2 = murmur3_32(key, kn, kBloomSeed2) | 1ull;
+  for (uint32_t i = 0; i < t.bloom_k; i++) {
+    const uint64_t bit = (h1 + (uint64_t)i * h2) % t.bloom_nbits;
+    if (!((bits[bit >> 3] >> (bit & 7)) & 1)) return false;
+  }
+  return true;
+}
+
+// Big-endian 8-byte key prefix, zero padded (SSTable._key_prefix64).
+static uint64_t key_prefix64(const uint8_t* key, uint32_t kn,
+                             uint32_t from) {
+  uint64_t w = 0;
+  for (uint32_t i = 0; i < 8; i++) {
+    const uint32_t j = from + i;
+    w = (w << 8) | (j < kn ? key[j] : 0);
+  }
+  return w;
+}
+
+// Candidate [lo, hi) range from the in-RAM two-level prefixes —
+// mirrors SSTable._lookup_range / _sparse_range.
+static void prefix_range(const FastTable& t, const uint8_t* key,
+                         uint32_t kn, uint64_t* lo_out,
+                         uint64_t* hi_out) {
+  if (t.stride == 0 || t.p1 == 0 || t.n_samples == 0) {
+    *lo_out = 0;
+    *hi_out = t.entry_count;
+    return;
+  }
+  const uint64_t* p1 = (const uint64_t*)(uintptr_t)t.p1;
+  const uint64_t* p2 = (const uint64_t*)(uintptr_t)t.p2;
+  const uint64_t w1 = key_prefix64(key, kn, 0);
+  uint64_t lo_s = std::lower_bound(p1, p1 + t.n_samples, w1) - p1;
+  uint64_t hi_s = std::upper_bound(p1, p1 + t.n_samples, w1) - p1;
+  if (hi_s - lo_s > 1 && p2 != nullptr) {
+    const uint64_t w2 = key_prefix64(key, kn, 8);
+    const uint64_t* base = p2;
+    uint64_t nlo = std::lower_bound(base + lo_s, base + hi_s, w2) - base;
+    uint64_t nhi = std::upper_bound(base + lo_s, base + hi_s, w2) - base;
+    lo_s = nlo;
+    hi_s = nhi;
+  }
+  if (t.stride == 1) {
+    *lo_out = lo_s;
+    *hi_out = hi_s;
+  } else {
+    // One sample of slack each side: entries between samples are not
+    // represented (SSTable._sparse_range).
+    *lo_out = lo_s > 0 ? (lo_s - 1) * (uint64_t)t.stride : 0;
+    const uint64_t hi = hi_s * (uint64_t)t.stride;
+    *hi_out = hi < t.entry_count ? hi : t.entry_count;
+  }
+}
+
+static const uint32_t kDpKeyMax = 64u << 10;  // bigger keys punt
+
+// Binary-search one table for `key` via NOWAIT preads.
+// Returns 1 found (value written to out+4, *vlen/*ts set), 0 absent,
+// -1 punt (cold page / oversized / short read).
+static int table_find(DataPlane* dp, const FastTable& t,
+                      const uint8_t* key, uint32_t kn, uint8_t* out,
+                      uint32_t out_cap, uint32_t* vlen_out) {
+  uint64_t lo, hi;
+  prefix_range(t, key, kn, &lo, &hi);
+  if (dp->keybuf.size() < kDpKeyMax) dp->keybuf.resize(kDpKeyMax);
+  uint8_t* keybuf = dp->keybuf.data();
+  uint8_t rec[16];
+  while (lo < hi) {
+    const uint64_t mid = lo + (hi - lo) / 2;
+    if (!pread_nw(t.index_fd, rec, 16, mid * 16)) return -1;
+    uint64_t off;
+    uint32_t ksz;
+    std::memcpy(&off, rec, 8);
+    std::memcpy(&ksz, rec + 8, 4);
+    if (ksz > kDpKeyMax) return -1;
+    if (ksz != 0 && !pread_nw(t.data_fd, keybuf, ksz, off + 16))
+      return -1;
+    int cmp = std::memcmp(keybuf, key, ksz < kn ? ksz : kn);
+    if (cmp == 0) cmp = ksz < kn ? -1 : (ksz > kn ? 1 : 0);
+    if (cmp == 0) {
+      uint8_t hdr[16];
+      if (!pread_nw(t.data_fd, hdr, 16, off)) return -1;
+      uint32_t klen, vlen;
+      std::memcpy(&klen, hdr, 4);
+      std::memcpy(&vlen, hdr + 4, 4);
+      if (klen != ksz) return -1;  // corrupt index: let Python judge
+      if ((uint64_t)4 + vlen + 1 > out_cap) return -1;
+      if (vlen != 0 &&
+          !pread_nw(t.data_fd, out + 4, vlen, off + 16 + klen))
+        return -1;
+      *vlen_out = vlen;
+      return 1;
+    }
+    if (cmp < 0)
+      lo = mid + 1;
+    else
+      hi = mid;
+  }
+  return 0;
+}
+
+// Python bytes.__repr__ mirror (Objects/bytesobject.c): b'...' with
+// the quote flipped to " when the bytes contain ' but no ", \xNN for
+// non-printables, and \t \n \r \\ escapes.  KeyNotFound messages are
+// repr(key), so byte-exact parity here keeps the native error
+// response identical to the Python handler's (golden-tested).
+static size_t bytes_repr(const uint8_t* s, uint32_t n, uint8_t* out) {
+  char quote = '\'';
+  if (memchr(s, '\'', n) != nullptr && memchr(s, '"', n) == nullptr)
+    quote = '"';
+  size_t o = 0;
+  out[o++] = 'b';
+  out[o++] = (uint8_t)quote;
+  static const char hexd[] = "0123456789abcdef";
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t c = s[i];
+    if (c == (uint8_t)quote || c == '\\') {
+      out[o++] = '\\';
+      out[o++] = c;
+    } else if (c == '\t') {
+      out[o++] = '\\';
+      out[o++] = 't';
+    } else if (c == '\n') {
+      out[o++] = '\\';
+      out[o++] = 'n';
+    } else if (c == '\r') {
+      out[o++] = '\\';
+      out[o++] = 'r';
+    } else if (c < 0x20 || c >= 0x7f) {
+      out[o++] = '\\';
+      out[o++] = 'x';
+      out[o++] = hexd[c >> 4];
+      out[o++] = hexd[c & 0xf];
+    } else {
+      out[o++] = c;
+    }
+  }
+  out[o++] = (uint8_t)quote;
+  return o;
+}
+
+// msgpack str header exactly as msgpack-python packs it.
+static size_t mp_put_strhdr(uint8_t* out, size_t len) {
+  if (len < 32) {
+    out[0] = (uint8_t)(0xa0 | len);
+    return 1;
+  }
+  if (len < 256) {
+    out[0] = 0xd9;
+    out[1] = (uint8_t)len;
+    return 2;
+  }
+  out[0] = 0xda;
+  out[1] = (uint8_t)(len >> 8);
+  out[2] = (uint8_t)len;
+  return 3;
+}
+
+// Full KeyNotFound wire response for `key`: u32-LE length +
+// msgpack ["KeyNotFound", repr(key)] + RESPONSE_ERR(0) trailing byte
+// — byte-identical to _serve_frame's DbeelError formatting.
+static bool keynotfound_response(const uint8_t* key, uint32_t kn,
+                                 uint8_t* out, uint32_t out_cap,
+                                 uint32_t* out_len) {
+  if (kn > 4096) return false;  // giant keys: let Python format
+  const size_t max_msg = (size_t)kn * 4 + 3;
+  if ((uint64_t)4 + 1 + 12 + 3 + max_msg + 1 > out_cap) return false;
+  size_t o = 4;
+  out[o++] = 0x92;  // fixarray(2)
+  out[o++] = 0xab;  // fixstr(11)
+  std::memcpy(out + o, "KeyNotFound", 11);
+  o += 11;
+  uint8_t msg[3 + 4 * 4096];
+  const size_t mlen = bytes_repr(key, kn, msg);
+  o += mp_put_strhdr(out + o, mlen);
+  std::memcpy(out + o, msg, mlen);
+  o += mlen;
+  out[o++] = 0;  // RESPONSE_ERR
+  const uint32_t body = (uint32_t)(o - 4);
+  std::memcpy(out, &body, 4);
+  *out_len = (uint32_t)o;
+  return true;
+}
 
 static bool slice_eq(const uint8_t* s, uint32_t n, const char* lit) {
   const size_t ln = std::strlen(lit);
@@ -1127,7 +1450,12 @@ void* dbeel_dp_new(void) {
   }
 }
 
-void dbeel_dp_free(void* h) { delete static_cast<DataPlane*>(h); }
+void dbeel_dp_free(void* h) {
+  auto* dp = static_cast<DataPlane*>(h);
+  if (dp != nullptr)
+    for (auto& col : dp->cols) dp_close_tables(col);
+  delete dp;
+}
 
 void dbeel_dp_set_ownership(void* h, int32_t mode, uint32_t lo,
                             uint32_t hi) {
@@ -1152,8 +1480,13 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
       return (int32_t)i;
     }
   }
-  dp->cols.push_back(FastCollection{
-      n, active, flushing, static_cast<NativeWal*>(wal), capacity});
+  FastCollection col;
+  col.name = n;
+  col.active = active;
+  col.flushing = flushing;
+  col.wal = static_cast<NativeWal*>(wal);
+  col.capacity = capacity;
+  dp->cols.push_back(std::move(col));
   return (int32_t)dp->cols.size() - 1;
 } catch (...) {
   return -1;
@@ -1164,10 +1497,58 @@ void dbeel_dp_unregister(void* h, const uint8_t* name, uint32_t nlen) {
   const std::string n((const char*)name, nlen);
   for (size_t i = 0; i < dp->cols.size(); i++) {
     if (dp->cols[i].name == n) {
+      dp_close_tables(dp->cols[i]);
       dp->cols.erase(dp->cols.begin() + i);
       return;
     }
   }
+}
+
+// Replace a collection's sstable registry (descs newest-first, the
+// search order).  dup()s every fd so the C side owns its handles; the
+// caller keeps the bloom/prefix buffers alive until the next call.
+// n < 0 invalidates the registry (gets punt on memtable miss).
+// Returns 0 on success, -1 on failure (old registry kept, but marked
+// invalid so stale tables are never trusted for absence).
+int32_t dbeel_dp_set_tables(void* h, const uint8_t* name, uint32_t nlen,
+                            const FastTable* descs, int32_t n) try {
+  auto* dp = static_cast<DataPlane*>(h);
+  const std::string nm((const char*)name, nlen);
+  FastCollection* col = nullptr;
+  for (auto& c : dp->cols)
+    if (c.name == nm) {
+      col = &c;
+      break;
+    }
+  if (col == nullptr) return -1;
+  if (n < 0) {
+    col->tables_valid = false;
+    return 0;
+  }
+  std::vector<FastTable> fresh;
+  fresh.reserve((size_t)n);
+  bool ok = true;
+  for (int32_t i = 0; i < n && ok; i++) {
+    FastTable t = descs[i];
+    t.data_fd = ::fcntl(descs[i].data_fd, F_DUPFD_CLOEXEC, 0);
+    t.index_fd = ::fcntl(descs[i].index_fd, F_DUPFD_CLOEXEC, 0);
+    if (t.data_fd < 0 || t.index_fd < 0) ok = false;
+    fresh.push_back(t);  // pushed even on failure so fds get closed
+  }
+  if (!ok) {
+    for (auto& t : fresh) {
+      if (t.data_fd >= 0) ::close(t.data_fd);
+      if (t.index_fd >= 0) ::close(t.index_fd);
+    }
+    col->tables_valid = false;
+    return -1;
+  }
+  dp_close_tables(*col);
+  col->tables = std::move(fresh);
+  col->tables_valid = true;
+  return 0;
+} catch (...) {
+  return -1;
 }
 
 uint64_t dbeel_dp_fast_sets(void* h) {
@@ -1175,6 +1556,9 @@ uint64_t dbeel_dp_fast_sets(void* h) {
 }
 uint64_t dbeel_dp_fast_gets(void* h) {
   return static_cast<DataPlane*>(h)->fast_gets;
+}
+uint64_t dbeel_dp_fast_table_gets(void* h) {
+  return static_cast<DataPlane*>(h)->fast_table_gets;
 }
 
 // Handle one request frame entirely natively if possible.
@@ -1277,6 +1661,12 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   if (c.p != c.end) return -1;  // trailing bytes: let Python judge
   if (type_s == nullptr || coll_s == nullptr || key_raw == nullptr)
     return -1;
+  // Key identity parity: the Python path stores keys RE-ENCODED by
+  // msgpack-python, the C path the raw wire slice.  Any key whose
+  // encoding isn't already canonical must punt (write AND read), or
+  // the paths would disagree on identity — worst case a false native
+  // KeyNotFound for a key the Python path stored canonically.
+  if (!mp_key_canonical(key_raw, key_n)) return -1;
   const bool is_set = slice_eq(type_s, type_n, "set");
   const bool is_del = slice_eq(type_s, type_n, "delete");
   const bool is_get = slice_eq(type_s, type_n, "get");
@@ -1307,6 +1697,8 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   }
 
   if (is_get) {
+    const int64_t get_flags =
+        ((int64_t)col_idx << 8) | (keepalive ? 1 : 0) | 4;
     const uint8_t* v = nullptr;
     uint32_t vn = 0;
     int64_t ts = 0;
@@ -1315,21 +1707,52 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
     if (!found && col->flushing != nullptr)
       found = dbeel_memtable_get(col->flushing, key_raw, key_n, &v, &vn,
                                  &ts);
-    // Miss => sstable search; tombstone => KeyNotFound formatting:
-    // both belong to Python.
-    if (!found || vn == 0) return -1;
-    const uint32_t resp_len = vn + 1;  // value + type byte
-    if (out_cap < 4 + resp_len) return -1;
-    std::memcpy(out, &resp_len, 4);
-    std::memcpy(out + 4, v, vn);
-    out[4 + vn] = 1;  // RESPONSE_OK
-    *out_len = 4 + resp_len;
-    dp->fast_gets++;
-    return ((int64_t)col_idx << 8) | (keepalive ? 1 : 0) | 4;
+    if (found && vn != 0) {
+      const uint32_t resp_len = vn + 1;  // value + type byte
+      if (out_cap < 4 + resp_len) return -1;
+      std::memcpy(out, &resp_len, 4);
+      std::memcpy(out + 4, v, vn);
+      out[4 + vn] = 1;  // RESPONSE_OK
+      *out_len = 4 + resp_len;
+      dp->fast_gets++;
+      return get_flags;
+    }
+    if (found) {  // memtable tombstone: live value is "not found"
+      if (!keynotfound_response(key_raw, key_n, out, out_cap, out_len))
+        return -1;
+      dp->fast_gets++;
+      return get_flags;
+    }
+    // Memtable miss => sstable search, newest table first; the first
+    // match wins (lsm_tree.py get_entry / lsm_tree.rs:674-723).  Any
+    // cold page punts to the Python async read path.
+    if (!col->tables_valid) return -1;
+    for (const auto& t : col->tables) {
+      if (t.entry_count == 0 || !bloom_maybe(t, key_raw, key_n))
+        continue;
+      uint32_t vlen = 0;
+      const int r =
+          table_find(dp, t, key_raw, key_n, out, out_cap, &vlen);
+      if (r < 0) return -1;
+      if (r == 0) continue;
+      if (vlen == 0) break;  // tombstone shadows older tables
+      const uint32_t resp_len = vlen + 1;
+      std::memcpy(out, &resp_len, 4);
+      out[4 + vlen] = 1;  // RESPONSE_OK
+      *out_len = 4 + resp_len;
+      dp->fast_table_gets++;
+      return get_flags;
+    }
+    // Absent everywhere (or tombstoned): KeyNotFound, natively.
+    if (!keynotfound_response(key_raw, key_n, out, out_cap, out_len))
+      return -1;
+    dp->fast_table_gets++;
+    return get_flags;
   }
 
   // Write path: server-assigned timestamp (CLOCK_REALTIME ns, the
   // same clock as Python's time.time_ns).
+  if (col->wal == nullptr) return -1;  // gets-only registration
   struct timespec tsp;
   clock_gettime(CLOCK_REALTIME, &tsp);
   const int64_t ts = (int64_t)tsp.tv_sec * 1000000000ll + tsp.tv_nsec;

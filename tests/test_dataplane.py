@@ -5,6 +5,7 @@ flushes.  Runs the real server over real sockets (SURVEY §4: no mocks).
 """
 
 import asyncio
+import os
 import struct
 
 import msgpack
@@ -49,6 +50,29 @@ def _fast_counts(node):
     return s["fast_sets"], s["fast_gets"]
 
 
+def _rwf_nowait_supported() -> bool:
+    """The native sstable-get counters only move where
+    preadv2(RWF_NOWAIT) works (kernel >= 4.14 + supporting fs);
+    elsewhere the path punts by design and serving stays correct."""
+    import tempfile
+
+    if not hasattr(os, "RWF_NOWAIT"):
+        return False
+    with tempfile.NamedTemporaryFile() as f:
+        f.write(b"x" * 4096)
+        f.flush()
+        fd = os.open(f.name, os.O_RDONLY)
+        try:
+            return os.preadv(fd, [bytearray(16)], 0, os.RWF_NOWAIT) == 16
+        except OSError:
+            return False
+        finally:
+            os.close(fd)
+
+
+_NOWAIT = _rwf_nowait_supported()
+
+
 def test_fast_set_get_roundtrip(tmp_dir, arun):
     async def body():
         node = await _start_node(tmp_dir)
@@ -85,8 +109,9 @@ def test_fast_set_get_roundtrip(tmp_dir, arun):
             s2, g2 = _fast_counts(node)
             assert g2 == g1 + 1, "get did not take the native fast path"
 
-            # Delete natively, then the miss punts to Python which
-            # formats the canonical KeyNotFound error.
+            # Delete natively; the subsequent miss is ALSO served
+            # natively (memtable tombstone -> native KeyNotFound that
+            # is byte-identical to Python's formatting).
             payload, t = await _request(
                 port,
                 {"type": "delete", "collection": "fast", "key": "k1"},
@@ -328,6 +353,326 @@ def test_unowned_key_punts_to_python_error(tmp_dir, arun):
             assert (
                 msgpack.unpackb(payload)[0] == "KeyNotOwnedByShard"
             )
+        finally:
+            await node.stop()
+
+    arun(body())
+
+
+def _table_gets(node):
+    dp = node.shards[0].dataplane
+    return dp.stats().get("fast_table_gets", 0)
+
+
+@pytest.mark.skipif(
+    not _NOWAIT, reason="no RWF_NOWAIT: native table gets punt by design"
+)
+def test_sstable_gets_served_natively(tmp_dir, arun):
+    """Gets that miss the memtables must resolve from the C-side
+    sstable registry (bloom gate + NOWAIT-pread binary search) with
+    wire bytes identical to the Python read path — present keys,
+    absent keys, and tombstones, across multiple shadowing tables."""
+
+    async def body():
+        # compaction_factor=99: a background compaction rewriting the
+        # tables mid-test would leave cold (O_DIRECT) pages that punt
+        # natively-served gets and deflate the counter assertion.
+        node = await _start_node(
+            tmp_dir, memtable_capacity=16, compaction_factor=99
+        )
+        try:
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "t",
+                    "replication_factor": 1,
+                },
+            )
+            tree = node.shards[0].collections["t"].tree
+            values = {}
+            # Several flush generations: older values shadowed by
+            # newer tables, one key deleted post-flush.
+            for gen in range(3):
+                for i in range(16):
+                    k = f"key-{i:04d}"
+                    v = {"gen": gen, "i": i}
+                    values[k] = v
+                    payload, t = await _request(
+                        port,
+                        {
+                            "type": "set",
+                            "collection": "t",
+                            "key": k,
+                            "value": v,
+                        },
+                    )
+                    assert t == 2, payload
+                await tree.flush()
+            payload, t = await _request(
+                port,
+                {"type": "delete", "collection": "t", "key": "key-0007"},
+            )
+            assert t == 2
+            await tree.flush()
+            assert tree.memtable_entries == 0
+            assert len(tree._sstables.tables) >= 3
+
+            tg0 = _table_gets(node)
+            for i in range(16):
+                k = f"key-{i:04d}"
+                payload, t = await _request(
+                    port, {"type": "get", "collection": "t", "key": k}
+                )
+                if i == 7:
+                    assert t == 0
+                    expected = (
+                        msgpack.packb(
+                            [
+                                "KeyNotFound",
+                                repr(
+                                    msgpack.packb(k, use_bin_type=True)
+                                ),
+                            ],
+                            use_bin_type=True,
+                        )
+                    )
+                    assert payload == expected
+                else:
+                    assert t == 1
+                    assert msgpack.unpackb(payload) == values[k]
+            # Absent key: served natively with Python's exact error.
+            payload, t = await _request(
+                port,
+                {"type": "get", "collection": "t", "key": "nope"},
+            )
+            assert t == 0
+            assert payload == msgpack.packb(
+                [
+                    "KeyNotFound",
+                    repr(msgpack.packb("nope", use_bin_type=True)),
+                ],
+                use_bin_type=True,
+            )
+            tg1 = _table_gets(node)
+            assert tg1 - tg0 >= 15, (
+                f"sstable gets barely engaged natively "
+                f"({tg1 - tg0} of 17)"
+            )
+        finally:
+            await node.stop()
+
+    arun(body())
+
+
+@pytest.mark.skipif(
+    not _NOWAIT, reason="no RWF_NOWAIT: native table gets punt by design"
+)
+def test_native_keynotfound_repr_parity(tmp_dir, arun):
+    """The C bytes-repr mirror must match Python's repr() for nasty
+    keys (quotes, backslashes, control bytes, non-ASCII) — asserted by
+    byte-comparing the native error response against the Python
+    formatter's output."""
+
+    async def body():
+        node = await _start_node(tmp_dir, memtable_capacity=16)
+        try:
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "r",
+                    "replication_factor": 1,
+                },
+            )
+            tree = node.shards[0].collections["r"].tree
+            # One flushed table so absence is a table-registry verdict.
+            await _request(
+                port,
+                {
+                    "type": "set",
+                    "collection": "r",
+                    "key": "anchor",
+                    "value": 0,
+                },
+            )
+            await tree.flush()
+            nasty = [
+                "it's",
+                'quo"te',
+                "both'\"q",
+                "back\\slash",
+                "tab\there",
+                "nl\nhere",
+                "cr\rhere",
+                "nul\x00byte",
+                "unicode-é漢",
+                bytes(range(0, 64)),
+                bytes(range(64, 256)),
+                b"'",
+                b'"',
+                b"'\"",
+            ]
+            tg0 = _table_gets(node)
+            for k in nasty:
+                payload, t = await _request(
+                    port, {"type": "get", "collection": "r", "key": k}
+                )
+                assert t == 0
+                expected = msgpack.packb(
+                    [
+                        "KeyNotFound",
+                        repr(msgpack.packb(k, use_bin_type=True)),
+                    ],
+                    use_bin_type=True,
+                )
+                assert payload == expected, k
+            assert _table_gets(node) - tg0 == len(nasty)
+        finally:
+            await node.stop()
+
+    arun(body())
+
+
+def test_gets_correct_after_native_compaction(tmp_dir, arun):
+    """After a compaction rewrites tables (possibly O_DIRECT, so pages
+    may be cold and the native path may punt), every get must still
+    return the right value — native and Python paths agree."""
+
+    async def body():
+        # compaction_factor=99: keep the background scheduler out of
+        # the way so the manual compact() below can't race it.
+        node = await _start_node(
+            tmp_dir, memtable_capacity=16, compaction_factor=99
+        )
+        try:
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "cc",
+                    "replication_factor": 1,
+                },
+            )
+            tree = node.shards[0].collections["cc"].tree
+            values = {}
+            for gen in range(4):
+                for i in range(16):
+                    k = f"key-{i:04d}"
+                    values[k] = {"gen": gen, "i": i}
+                    await _request(
+                        port,
+                        {
+                            "type": "set",
+                            "collection": "cc",
+                            "key": k,
+                            "value": values[k],
+                        },
+                    )
+                await tree.flush()
+            indices = [i for i, _ in tree.sstable_indices_and_sizes()]
+            await tree.compact(indices, max(indices) + 1, False)
+            assert len(tree._sstables.tables) == 1
+            for k, v in values.items():
+                payload, t = await _request(
+                    port, {"type": "get", "collection": "cc", "key": k}
+                )
+                assert t == 1 and msgpack.unpackb(payload) == v, k
+            # Absent after compaction: still correct.
+            payload, t = await _request(
+                port, {"type": "get", "collection": "cc", "key": "zz"}
+            )
+            assert t == 0
+            assert msgpack.unpackb(payload)[0] == "KeyNotFound"
+        finally:
+            await node.stop()
+
+    arun(body())
+
+
+
+def test_non_minimal_key_encoding_punts(tmp_dir, arun):
+    """A valid-but-non-minimal msgpack key encoding (5 as uint32) must
+    PUNT on both C paths: the Python handler re-canonicalizes the key,
+    so the stored identity is the minimal form, and a raw-slice native
+    compare would disagree (worst case a false native KeyNotFound).
+    Regression for the canonicality gate (mp_key_canonical)."""
+
+    async def body():
+        import struct as _struct
+
+        node = await _start_node(tmp_dir)
+        try:
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "nm",
+                    "replication_factor": 1,
+                },
+            )
+
+            def frame(key_bytes, op, extra=b""):
+                body = (
+                    b"\x83"
+                    + msgpack.packb("type")
+                    + msgpack.packb(op)
+                    + msgpack.packb("collection")
+                    + msgpack.packb("nm")
+                    + msgpack.packb("key")
+                    + key_bytes
+                ) if not extra else (
+                    b"\x84"
+                    + msgpack.packb("type")
+                    + msgpack.packb(op)
+                    + msgpack.packb("collection")
+                    + msgpack.packb("nm")
+                    + msgpack.packb("key")
+                    + key_bytes
+                    + extra
+                )
+                return body
+
+            async def send_raw(payload):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                try:
+                    writer.write(
+                        _struct.pack("<H", len(payload)) + payload
+                    )
+                    await writer.drain()
+                    hdr = await reader.readexactly(4)
+                    (size,) = _struct.unpack("<I", hdr)
+                    buf = await reader.readexactly(size)
+                    return buf[:-1], buf[-1]
+                finally:
+                    writer.close()
+
+            nonminimal_5 = b"\xce\x00\x00\x00\x05"  # uint32(5)
+            value = msgpack.packb("value") + msgpack.packb(41)
+            s0, g0 = _fast_counts(node)
+            # Set with the non-minimal key: punts, Python stores key 5
+            # canonically (0x05).
+            payload, t = await send_raw(
+                frame(nonminimal_5, "set", value)
+            )
+            assert t == 2, payload
+            # Canonical get finds it (fast path, same identity).
+            payload, t = await _request(
+                port, {"type": "get", "collection": "nm", "key": 5}
+            )
+            assert t == 1 and msgpack.unpackb(payload) == 41
+            # Non-minimal get must NOT return a native false absence:
+            # it punts and Python re-canonicalizes to the same key.
+            payload, t = await send_raw(frame(nonminimal_5, "get"))
+            assert t == 1 and msgpack.unpackb(payload) == 41
+            s1, _g1 = _fast_counts(node)
+            assert s1 == s0, "non-minimal key set took the fast path"
         finally:
             await node.stop()
 

@@ -300,3 +300,60 @@ def test_compact_action_journal_replay(tmp_dir):
         tree2.close()
 
     run(main())
+
+
+def test_flush_during_compaction_stays_newest(tmp_dir):
+    """A table flushed WHILE a compaction is merging must outrank the
+    compaction's output (which only holds pre-compaction data): the
+    even/odd index scheme encodes recency, and the sstable list must
+    stay index-sorted after the swap (SSTableList sorts on
+    construction).  If the list were append-ordered, reversed() would
+    probe the compacted (older) table first, resurrecting values
+    overwritten mid-compaction and un-deleting tombstones — this test
+    pins the invariant end to end with a gated merge."""
+    import asyncio
+
+    async def main():
+        gate = asyncio.Event()
+        inner = HeapMergeStrategy()
+
+        class GatedStrategy:
+            async def merge_async(
+                self, inputs, dir_path, output_index, cache,
+                keep_tombstones, bloom_min_size,
+            ):
+                await gate.wait()
+                return await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    inner.merge,
+                    inputs,
+                    dir_path,
+                    output_index,
+                    cache,
+                    keep_tombstones,
+                    bloom_min_size,
+                )
+
+        tree = make_tree(tmp_dir, strategy=GatedStrategy())
+        for i in range(CAP):
+            await tree.set(f"k{i:03d}".encode(), b"old")
+        await tree.flush()  # table 0
+        for i in range(CAP):
+            await tree.set(f"x{i:03d}".encode(), b"pad")
+        await tree.flush()  # table 2
+        task = asyncio.ensure_future(tree.compact([0, 2], 3, False))
+        await asyncio.sleep(0)  # compaction parked on the gate
+        # Overwrite + delete keys, flushed to table 4 mid-compaction.
+        await tree.set(b"k000", b"new")
+        await tree.delete(b"k001")
+        await tree.flush()
+        gate.set()
+        await task
+        indices = [t.index for t in tree._sstables.tables]
+        assert indices == sorted(indices), indices
+        assert await tree.get(b"k000") == b"new"
+        assert await tree.get(b"k001") is None
+        assert await tree.get(b"k002") == b"old"
+        tree.close()
+
+    run(main())
