@@ -431,12 +431,25 @@ class LSMTree:
             # Sort (a no-op for the sorted memtable, a device sort for
             # the hash memtable) AND write off-loop: the flushing
             # memtable is no longer mutated, so the worker may read it.
-            await asyncio.get_event_loop().run_in_executor(
-                None,
-                lambda: self._write_sstable_from_items(
-                    flush_index, flushing.sorted_items()
-                ),
-            )
+            # Arena memtables write the whole triplet in one GIL-free
+            # native call (byte-identical, golden-tested) — the Python
+            # per-entry writer held the GIL for tens of ms per flush,
+            # which surfaced as the serving Set p999 tail.
+            if getattr(flushing, "has_native_flush", False):
+                await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    flushing.flush_to_sstable,
+                    self.dir_path,
+                    flush_index,
+                    self.bloom_min_size,
+                )
+            else:
+                await asyncio.get_event_loop().run_in_executor(
+                    None,
+                    lambda: self._write_sstable_from_items(
+                        flush_index, flushing.sorted_items()
+                    ),
+                )
             table = SSTable(self.dir_path, flush_index, self.cache)
             # Pre-warm the in-memory read index off-loop so the first
             # point lookup doesn't pay the bulk read; when it lands,

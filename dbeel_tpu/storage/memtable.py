@@ -208,6 +208,38 @@ class ArenaMemtable(MemtableBase):
     def items(self) -> Iterator[Item]:
         return iter(self.sorted_items())
 
+    @property
+    def has_native_flush(self) -> bool:
+        """Single capability predicate for the flush dispatch (the
+        LSMTree call site keys on this, not on library internals)."""
+        return hasattr(self._lib, "dbeel_memtable_flush_write")
+
+    def flush_to_sstable(
+        self, dir_path: str, index: int, bloom_min_size: int
+    ) -> int:
+        """Write this memtable to the SSTable triplet in ONE native
+        call (data + index + bloom, byte-identical to the Python
+        EntryWriter path, golden-tested).  The ctypes call releases
+        the GIL for the whole walk+write, so a flush no longer stalls
+        the serving loop — the config-1 Set p999 fix.  Returns the
+        entry count; raises on I/O failure (partial outputs are
+        unlinked natively)."""
+        if not self.has_native_flush:
+            raise RuntimeError("native flush writer unavailable")
+        rc = int(
+            self._lib.dbeel_memtable_flush_write(
+                self._handle,
+                dir_path.encode(),
+                index,
+                bloom_min_size,
+            )
+        )
+        if rc < 0:
+            raise OSError(
+                f"native memtable flush failed for index {index}"
+            )
+        return rc
+
 
 class HashMemtable(MemtableBase):
     def _new_map(self):

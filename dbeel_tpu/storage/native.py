@@ -274,6 +274,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.dbeel_memtable_dump_size.argtypes = [ctypes.c_void_p]
     lib.dbeel_memtable_dump.restype = ctypes.c_uint64
     lib.dbeel_memtable_dump.argtypes = [ctypes.c_void_p, u8p]
+    if hasattr(lib, "dbeel_memtable_flush_write"):
+        lib.dbeel_memtable_flush_write.restype = ctypes.c_int64
+        lib.dbeel_memtable_flush_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
     lib.dbeel_bloom_add_batch.restype = None
     lib.dbeel_merge.restype = ctypes.c_int64
     lib.dbeel_merge.argtypes = [

@@ -21,6 +21,7 @@
 #include <algorithm>
 
 #include <cerrno>
+#include <cmath>
 #include <cstdlib>
 #include <thread>
 #include <ctime>
@@ -810,6 +811,198 @@ uint64_t dbeel_memtable_dump(void* h, uint8_t* out) {
     cur = t->nodes[cur].right;
   }
   return count;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Buffered append-only file writer for the flush path: plain buffered
+// writes (the flush writer mirrors no cache pages), fsync at close,
+// unlink on abort — matching PageMirroringWriter(cache=None) output
+// byte for byte (exact logical size; the Python writer's page padding
+// is truncated away at close).
+struct FlushFile {
+  int fd = -1;
+  std::string path;
+  std::vector<uint8_t> buf;
+
+  ~FlushFile() {
+    if (fd >= 0) ::close(fd);  // exception unwind: no fd leak
+  }
+  bool open(const std::string& p) {
+    path = p;
+    fd = ::open(p.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    buf.reserve(4u << 20);
+    return fd >= 0;
+  }
+  bool drain() {
+    size_t done = 0;
+    while (done < buf.size()) {
+      const ssize_t r = ::write(fd, buf.data() + done, buf.size() - done);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      if (r == 0) return false;
+      done += (size_t)r;
+    }
+    buf.clear();
+    return true;
+  }
+  bool append(const void* p, size_t n) {
+    const uint8_t* s = (const uint8_t*)p;
+    buf.insert(buf.end(), s, s + n);
+    return buf.size() < (4u << 20) || drain();
+  }
+  bool close_sync() {
+    if (!drain()) return false;
+    if (::fsync(fd) != 0) return false;
+    const int rc = ::close(fd);
+    fd = -1;
+    return rc == 0;
+  }
+  void abort() {
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+    ::unlink(path.c_str());
+  }
+};
+
+std::string sstable_path(const char* dir, uint64_t index,
+                         const char* ext) {
+  char name[64];
+  std::snprintf(name, sizeof(name), "%020llu.%s",
+                (unsigned long long)index, ext);
+  std::string p(dir);
+  p += "/";
+  p += name;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Flush the arena memtable straight to an SSTable triplet — the whole
+// flush write path in one GIL-free call.  Role parity with the
+// reference's flush_memtable_to_disk (lsm_tree.rs:925-946); replaces
+// the per-entry Python EntryWriter loop whose GIL hold stalled the
+// serving loop for tens of ms per flush (the config-1 Set p999 tail).
+// Byte-identical to _write_sstable_from_items: data records are the
+// in-order dump ([u32 klen][u32 vlen][i64 ts][key][value]), index
+// records <QII offset,key_size,full_size>, bloom written only when
+// data_size >= bloom_min_size with the same m/k formula as
+// BloomFilter.with_capacity (round-half-even via nearbyint) and the
+// same double-hash bit layout.  Returns entry count, or -1 (partial
+// outputs unlinked).
+int64_t dbeel_memtable_flush_write(void* h, const char* dir,
+                                   uint64_t index,
+                                   uint64_t bloom_min_size) {
+  auto* t = static_cast<ArenaMemtable*>(h);
+  FlushFile data, idx;
+  try {
+    if (!data.open(sstable_path(dir, index, "data"))) return -1;
+    if (!idx.open(sstable_path(dir, index, "index"))) {
+      data.abort();
+      return -1;
+    }
+
+    // First pass sizing for the bloom decision.
+    uint64_t data_size = 0;
+    for (const MemNode& n : t->nodes)
+      data_size += 16 + n.key_len + n.val_len;
+
+    const bool want_bloom = data_size >= bloom_min_size;
+    uint64_t entries = 0;
+
+    // In-order walk (explicit stack, as dbeel_memtable_dump).
+    std::vector<uint32_t> stack;
+    bool ok = true;
+    uint32_t cur = t->root;
+    uint64_t offset = 0;
+    std::vector<std::pair<uint64_t, uint32_t>> key_spans;  // off,len
+    while ((cur != NIL || !stack.empty()) && ok) {
+      while (cur != NIL) {
+        stack.push_back(cur);
+        cur = t->nodes[cur].left;
+      }
+      cur = stack.back();
+      stack.pop_back();
+      const MemNode& n = t->nodes[cur];
+      uint8_t hdr[16];
+      std::memcpy(hdr, &n.key_len, 4);
+      std::memcpy(hdr + 4, &n.val_len, 4);
+      std::memcpy(hdr + 8, &n.ts, 8);
+      const uint32_t full = 16 + n.key_len + n.val_len;
+      ok = data.append(hdr, 16) &&
+           data.append(t->bytes.data() + n.key_off, n.key_len) &&
+           data.append(t->bytes.data() + n.val_off, n.val_len);
+      uint8_t irec[16];
+      std::memcpy(irec, &offset, 8);
+      std::memcpy(irec + 8, &n.key_len, 4);
+      std::memcpy(irec + 12, &full, 4);
+      ok = ok && idx.append(irec, 16);
+      if (want_bloom) key_spans.emplace_back(n.key_off, n.key_len);
+      offset += full;
+      entries++;
+      cur = t->nodes[cur].right;
+    }
+    ok = ok && data.close_sync() && idx.close_sync();
+    if (!ok) {
+      data.abort();
+      idx.abort();
+      return -1;
+    }
+
+    if (want_bloom && entries > 0) {
+      // BloomFilter.with_capacity(n, fp=0.01):
+      //   m = int(-n ln fp / (ln 2)^2) + 1; k = max(1, round(m/n ln 2))
+      // then num_bits = max(64, m), bits = ceil(num_bits/8) bytes.
+      const double n_items = (double)entries;
+      const double ln2 = 0.6931471805599453;
+      const double m_f = -n_items * std::log(0.01) / (ln2 * ln2);
+      const uint64_t m = (uint64_t)m_f + 1;  // int() truncation + 1
+      const double k_f = (double)m / n_items * ln2;
+      uint32_t k = (uint32_t)std::nearbyint(k_f);  // round-half-even
+      if (k < 1) k = 1;
+      const uint64_t num_bits = m < 64 ? 64 : m;
+      const uint32_t num_hashes = k;
+      std::vector<uint8_t> bloom_bits((num_bits + 7) / 8, 0);
+      for (const auto& span : key_spans) {
+        const uint8_t* key = t->bytes.data() + span.first;
+        const uint64_t h1 = murmur3_32(key, span.second, 0x9747B28C);
+        const uint64_t h2 =
+            murmur3_32(key, span.second, 0x85EBCA6B) | 1ull;
+        for (uint32_t j = 0; j < num_hashes; j++) {
+          const uint64_t bit = (h1 + (uint64_t)j * h2) % num_bits;
+          bloom_bits[bit >> 3] |= (uint8_t)(1u << (bit & 7));
+        }
+      }
+      FlushFile bf;
+      bool bok = bf.open(sstable_path(dir, index, "bloom"));
+      uint8_t bh[16];
+      std::memcpy(bh, &num_bits, 8);
+      std::memcpy(bh + 8, &num_hashes, 4);
+      std::memset(bh + 12, 0, 4);
+      bok = bok && bf.append(bh, 16) &&
+            bf.append(bloom_bits.data(), bloom_bits.size()) &&
+            bf.close_sync();
+      if (!bok) {
+        // Honor the unlink-on-failure contract for the whole triplet:
+        // the (closed) data/index outputs go too.
+        bf.abort();
+        ::unlink(data.path.c_str());
+        ::unlink(idx.path.c_str());
+        return -1;
+      }
+    }
+    return (int64_t)entries;
+  } catch (...) {
+    data.abort();  // ~FlushFile closed nothing yet: fds still held
+    idx.abort();
+    return -1;
+  }
 }
 
 }  // extern "C"

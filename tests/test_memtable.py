@@ -159,3 +159,57 @@ def test_arena_bytes_bounded_under_updates():
         f"arena grew unbounded: {arena_bytes} vs live {live}"
     )
     assert m.get(b"key0000") == (b"v" * (20 + 99 % 7), 99 * 1000)
+
+
+def test_native_flush_byte_identical(tmp_dir):
+    """dbeel_memtable_flush_write must produce the exact triplet the
+    Python EntryWriter path writes — below AND above the bloom
+    threshold (the bloom's m/k sizing uses Python round()'s
+    round-half-even, mirrored natively with nearbyint)."""
+    import hashlib
+    import os
+
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+    from dbeel_tpu.storage.memtable import ArenaMemtable, Memtable
+    from dbeel_tpu.storage.native import load_if_built
+
+    lib = load_if_built()
+    if lib is None or not hasattr(lib, "dbeel_memtable_flush_write"):
+        pytest.skip("native flush writer unavailable")
+
+    def sha(path):
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+
+    for case, n, vsize, bloom_min in (
+        ("no-bloom", 200, 50, 1 << 30),
+        ("bloom", 3000, 400, 1 << 20),
+        ("bloom-small-n", 64, 40, 1),  # tiny n exercises m/k rounding
+    ):
+        arena = ArenaMemtable(max(n + 1, 8))
+        py = Memtable(max(n + 1, 8))
+        for i in range(n):
+            k = f"{case}-key-{i:06d}".encode()
+            v = (f"v{i:04d}" * (vsize // 5)).encode()
+            ts = 1_700_000_000_000_000_000 + i
+            arena.set(k, v, ts)
+            py.set(k, v, ts)
+
+        nat_dir = os.path.join(tmp_dir, f"nat-{case}")
+        py_dir = os.path.join(tmp_dir, f"py-{case}")
+        os.makedirs(nat_dir)
+        os.makedirs(py_dir)
+        wrote = arena.flush_to_sstable(nat_dir, 0, bloom_min)
+        assert wrote == n
+        tree = LSMTree.__new__(LSMTree)
+        tree.dir_path = py_dir
+        tree.bloom_min_size = bloom_min
+        tree._write_sstable_from_items(0, py.sorted_items())
+
+        nat_files = sorted(os.listdir(nat_dir))
+        py_files = sorted(os.listdir(py_dir))
+        assert nat_files == py_files, (case, nat_files, py_files)
+        for fn in nat_files:
+            assert sha(os.path.join(nat_dir, fn)) == sha(
+                os.path.join(py_dir, fn)
+            ), (case, fn)
