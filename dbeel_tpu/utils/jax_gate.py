@@ -30,16 +30,21 @@ log = logging.getLogger(__name__)
 _verdict: Optional[bool] = None
 
 
-def probe_jax_alive(timeout_s: Optional[float] = None) -> bool:
+def probe_jax_alive(
+    timeout_s: Optional[float] = None, force: bool = False
+) -> bool:
     """Probe jax backend init in a subprocess (once per process tree).
-    Returns False when init wedges past the timeout or fails."""
+    Returns False when init wedges past the timeout or fails.
+    ``force=True`` ignores a cached verdict and re-probes — for
+    callers that retry while waiting on a flapping tunnel."""
     global _verdict
-    if _verdict is not None:
-        return _verdict
-    cached = os.environ.get("DBEEL_JAX_PROBED")
-    if cached in ("ok", "fail"):
-        _verdict = cached == "ok"
-        return _verdict
+    if not force:
+        if _verdict is not None:
+            return _verdict
+        cached = os.environ.get("DBEEL_JAX_PROBED")
+        if cached in ("ok", "fail"):
+            _verdict = cached == "ok"
+            return _verdict
     # Already initialized in this process (tests, embedders): devices()
     # cannot wedge anymore, so skip the subprocess (which would pay a
     # redundant multi-second backend init).
