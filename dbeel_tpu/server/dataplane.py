@@ -79,6 +79,14 @@ class DataPlane:
         self._has_tables = hasattr(
             lib, "dbeel_dp_set_tables"
         ) and os.environ.get("DBEEL_DP_NO_TABLES", "0") in ("", "0")
+        # DBEEL_DP_NO_SHARD_PLANE=1 disables the native replica-plane
+        # handler (A/B benching); "0"/"" keep it enabled.
+        self._has_shard_plane = hasattr(
+            lib, "dbeel_dp_handle_shard"
+        ) and os.environ.get("DBEEL_DP_NO_SHARD_PLANE", "0") in (
+            "",
+            "0",
+        )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -111,7 +119,13 @@ class DataPlane:
             return None
         return wal._native
 
-    def register_tree(self, name: str, tree) -> None:
+    def register_tree(
+        self, name: str, tree, client_plane: bool = True
+    ) -> None:
+        """client_plane=False (RF>1 collections) registers for the
+        replica plane only: peer set/delete/get messages are served
+        natively, but client-facing frames punt to Python, which owns
+        the replication/consistency fan-out."""
         if not self.tree_eligible(tree):
             self.unregister(name)
             return
@@ -129,6 +143,7 @@ class DataPlane:
             ),
             ctypes.c_void_p(self._write_wal_handle(tree)),
             tree.capacity,
+            1 if client_plane else 0,
         )
         if rc < 0:
             # Failed (re-)registration must also clear any C-side
@@ -147,8 +162,10 @@ class DataPlane:
             )
             self.unregister(name)
             return
-        tree.write_state_listener = lambda t, n=name: self.register_tree(
-            n, t
+        tree.write_state_listener = (
+            lambda t, n=name, cp=client_plane: self.register_tree(
+                n, t, cp
+            )
         )
         self._register_tables(name, tree)
 
@@ -263,15 +280,54 @@ class DataPlane:
                 None,
                 "get",
             )
-        flush_tree = None
-        if flags & 2:  # memtable reached capacity: spawn the flush
-            col_idx = flags >> 8
-            trees = list(self._trees.values())
-            # Slot order matches registration order (C appends).
-            if 0 <= col_idx < len(trees):
-                flush_tree = trees[col_idx]
         op = "delete" if flags & 8 else "set"
-        return OK_RESPONSE, keepalive, flush_tree, op
+        return (
+            OK_RESPONSE,
+            keepalive,
+            self._flush_tree_from_flags(flags),
+            op,
+        )
+
+    def _flush_tree_from_flags(self, flags: int):
+        """Decode bit1 (memtable-now-full) + the slot index in bits 8..
+        into the tree whose flush the caller must spawn.  Slot order
+        matches registration order (the C vector appends; the mismatch
+        guard in register_tree keeps dict and vector aligned)."""
+        if not flags & 2:
+            return None
+        col_idx = flags >> 8
+        trees = list(self._trees.values())
+        if 0 <= col_idx < len(trees):
+            return trees[col_idx]
+        return None
+
+    def try_handle_shard(
+        self, frame: bytes
+    ) -> Optional[Tuple[Optional[bytes], Optional[object], bool]]:
+        """Replica-plane fast path for one remote-shard-protocol
+        message (raw msgpack list bytes, no length prefix).  Returns
+        (response_frame_or_None, tree_needing_flush, notify_set) when
+        handled natively — the response already carries its 4-byte-LE
+        length prefix; notify_set means the caller fires
+        ITEM_SET_FROM_SHARD_MESSAGE (set writes only, matching the
+        Python handler) — or None to punt to handle_shard_message."""
+        if not self._has_shard_plane:
+            return None
+        flags = self._lib.dbeel_dp_handle_shard(
+            self._handle,
+            frame,
+            len(frame),
+            self._get_buf,
+            _GET_BUF_CAP,
+            ctypes.byref(self._out_len),
+        )
+        if flags < 0:
+            return None
+        resp = None
+        if flags & 4:
+            resp = self._get_buf[: self._out_len.value]
+        notify_set = bool(flags & 8) and not bool(flags & 0x20)
+        return resp, self._flush_tree_from_flags(flags), notify_set
 
     def stats(self) -> dict:
         out = {
@@ -285,6 +341,10 @@ class DataPlane:
         if self._has_tables:
             out["fast_table_gets"] = int(
                 self._lib.dbeel_dp_fast_table_gets(self._handle)
+            )
+        if self._has_shard_plane:
+            out["fast_replica_ops"] = int(
+                self._lib.dbeel_dp_fast_replica_ops(self._handle)
             )
         return out
 

@@ -15,7 +15,7 @@ import asyncio
 import logging
 import struct
 import time
-from collections import deque
+
 from typing import Optional
 
 import msgpack
@@ -34,6 +34,7 @@ from ..cluster.messages import ShardRequest, ShardResponse
 from ..storage.entry import TOMBSTONE
 from ..utils.murmur import hash_bytes
 from ..utils.timestamps import now_nanos
+from . import framed
 from .shard import MyShard
 
 log = logging.getLogger(__name__)
@@ -316,7 +317,7 @@ async def _serve_frame(my_shard: MyShard, request_buf: bytes):
     return buf, keepalive
 
 
-class _DbProtocol(asyncio.Protocol):
+class _DbProtocol(framed.FramedServerProtocol):
     """Raw-protocol serving path (latency pass, VERDICT round 1 #4):
     frame parsing happens in data_received with zero per-request
     timeout/stream machinery — the per-request `asyncio.wait_for` +
@@ -325,142 +326,74 @@ class _DbProtocol(asyncio.Protocol):
     arrival order; idle keepalive connections are reaped by one
     per-shard timer instead of a timeout per request.  Wire format
     unchanged: u16-LE request frames; u32-LE response length +
-    payload + trailing type byte (db_server.rs:395-428)."""
+    payload + trailing type byte (db_server.rs:395-428).  Framing and
+    backpressure live in FramedServerProtocol, shared with the peer
+    plane."""
 
-    # Backpressure water marks on the parsed-request backlog: past the
-    # high mark the transport stops reading (the stream version's
-    # implicit 64KB read limit); reading resumes below the low mark.
-    PENDING_HIGH = 64
-    PENDING_LOW = 16
+    HEADER = 2
+    MAX_FRAME = None  # u16 length is its own bound
 
-    __slots__ = (
-        "shard",
-        "transport",
-        "buf",
-        "pending",
-        "task",
-        "last_active",
-        "closing",
-        "paused_reading",
-        "writable",
-    )
+    __slots__ = ("last_active",)
 
     def __init__(self, my_shard: MyShard) -> None:
-        self.shard = my_shard
-        self.transport = None
-        self.buf = bytearray()
-        self.pending = deque()
-        self.task: Optional[asyncio.Task] = None
+        super().__init__(my_shard)
         self.last_active = 0.0
-        self.closing = False
-        self.paused_reading = False
-        self.writable = asyncio.Event()
-        self.writable.set()
 
-    def connection_made(self, transport) -> None:
-        self.transport = transport
+    def _registry(self) -> set:
+        return self.shard.db_connections
+
+    def _on_connect(self) -> None:
         self.last_active = asyncio.get_event_loop().time()
-        self.shard.db_connections.add(self)
 
-    def connection_lost(self, exc) -> None:
+    def _on_disconnect(self) -> None:
+        # Client connections: nothing received is owed once the peer
+        # hangs up — stop serving and drop the backlog.
         self.closing = True
-        self.shard.db_connections.discard(self)
-        self.writable.set()  # unblock a _drain awaiting writability
         if self.task is not None:
             self.task.cancel()
 
-    # Transport write-buffer backpressure: while the peer reads slowly
-    # the loop pauses us; _drain stops serving until resumed, so
-    # responses never pile up in an unbounded kernel buffer.
-    def pause_writing(self) -> None:
-        self.writable.clear()
-
-    def resume_writing(self) -> None:
-        self.writable.set()
-
-    def data_received(self, data: bytes) -> None:
-        self.buf += data
+    def _on_data(self) -> None:
         self.last_active = asyncio.get_event_loop().time()
         self.shard.scheduler.fg_mark()
-        parsed = False
-        dp = self.shard.dataplane
-        while len(self.buf) >= 2:
-            size = self.buf[0] | (self.buf[1] << 8)
-            if len(self.buf) < 2 + size:
-                break
-            frame = bytes(self.buf[2 : 2 + size])
-            del self.buf[: 2 + size]
-            # Native fast path: only when no async frames are queued
-            # (responses must leave in request order per connection).
-            # A handled frame is answered synchronously right here —
-            # no task hop, no interpreter dispatch.
-            if (
-                dp is not None
-                and self.task is None
-                and not self.pending
-                and not self.closing
-                # Honor transport backpressure: while the peer reads
-                # slowly (pause_writing fired) responses must queue
-                # behind _drain's writable.wait(), not pile into the
-                # transport buffer unboundedly.
-                and self.writable.is_set()
-            ):
-                started = time.monotonic()
-                fast = dp.try_handle(frame)
-                if fast is not None:
-                    resp, keepalive, flush_tree, op = fast
-                    self.transport.write(resp)
-                    self.shard.metrics.record_request(op, started)
-                    if flush_tree is not None:
-                        self.shard.spawn(flush_tree.flush())
-                    if not keepalive:
-                        self.closing = True
-                        self.transport.close()
-                        return
-                    continue
-            self.pending.append(frame)
-            parsed = True
-        if (
-            len(self.pending) > self.PENDING_HIGH
-            and not self.paused_reading
-        ):
-            self.paused_reading = True
-            self.transport.pause_reading()
-        if parsed and self.task is None:
-            self.task = self.shard.spawn(self._drain())
 
-    async def _drain(self) -> None:
-        try:
-            while self.pending and not self.closing:
-                frame = self.pending.popleft()
-                if (
-                    self.paused_reading
-                    and len(self.pending) < self.PENDING_LOW
-                ):
-                    self.paused_reading = False
-                    self.transport.resume_reading()
-                buf, keepalive = await _serve_frame(self.shard, frame)
-                if self.closing:
-                    return
-                await self.writable.wait()
-                if self.closing:
-                    return
-                self.transport.write(
-                    struct.pack("<I", len(buf)) + buf
-                )
-                if not keepalive:
-                    # Reference behavior: one request per connection
-                    # unless the client opted into keepalive — any
-                    # already-buffered extra frames are dropped, like
-                    # the stream version dropped unread bytes.
-                    self.closing = True
-                    self.transport.close()
-                    return
-        finally:
-            self.task = None
-            # Frames may have arrived while we were finishing.
-            if self.pending and not self.closing:
-                self.task = self.shard.spawn(self._drain())
+    def _try_fast(self, frame: bytes) -> int:
+        # A handled frame is answered synchronously right here — no
+        # task hop, no interpreter dispatch.
+        dp = self.shard.dataplane
+        if dp is None:
+            return framed.FAST_MISS
+        started = time.monotonic()
+        fast = dp.try_handle(frame)
+        if fast is None:
+            return framed.FAST_MISS
+        resp, keepalive, flush_tree, op = fast
+        self.transport.write(resp)
+        self.shard.metrics.record_request(op, started)
+        if flush_tree is not None:
+            self.shard.spawn(flush_tree.flush())
+        if not keepalive:
+            self.closing = True
+            self.transport.close()
+            return framed.FAST_CLOSE
+        return framed.FAST_HANDLED
+
+    async def _serve_one(self, frame: bytes) -> bool:
+        buf, keepalive = await _serve_frame(self.shard, frame)
+        if self.closing:
+            return False
+        await self.writable.wait()
+        if self.closing:
+            return False
+        self.transport.write(struct.pack("<I", len(buf)) + buf)
+        if not keepalive:
+            # Reference behavior: one request per connection unless
+            # the client opted into keepalive — any already-buffered
+            # extra frames are dropped, like the stream version
+            # dropped unread bytes.
+            self.closing = True
+            self.transport.close()
+            return False
+        return True
 
 
 async def reap_idle_db_connections(my_shard: MyShard) -> None:

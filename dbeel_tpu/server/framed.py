@@ -1,0 +1,188 @@
+"""Shared raw-protocol server machinery for the two framed planes.
+
+Both serving surfaces — the client-facing db server (u16-LE frames,
+db_server.rs:395-428) and the peer-facing remote shard server (u32-LE
+frames, remote_shard_server.rs:23-49) — need the same skeleton: parse
+length-prefixed frames in ``data_received``, answer eligible frames
+synchronously through the native data plane, queue the rest for an
+in-order async drain, and apply read/write backpressure water marks.
+This base holds that skeleton ONCE so a fix to the framing or
+backpressure logic cannot land in only one plane; subclasses supply
+the frame width, the fast-path handler, the per-frame serve step, and
+the connection-lifecycle policy (client connections cancel their drain
+on disconnect; peer connections keep applying already-received frames
+after a fire-and-forget sender's FIN).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+
+# _try_fast verdicts.
+FAST_MISS = 0  # not handled: queue the frame for _drain
+FAST_HANDLED = 1  # answered synchronously: next frame
+FAST_CLOSE = 2  # answered + connection closed: stop parsing
+
+
+class FramedServerProtocol(asyncio.Protocol):
+    """Length-prefixed request/response server over a raw transport.
+
+    Subclass contract:
+    - ``HEADER``: frame-length prefix width in bytes (little-endian).
+    - ``MAX_FRAME``: reject frames above this (None = the header
+      width itself is the bound).
+    - ``_registry()``: the shard set tracking live connections (for
+      shutdown and py3.12 ``Server.wait_closed()``).
+    - ``_on_connect()`` / ``_on_disconnect()``: lifecycle policy.
+    - ``_on_data()``: per-read bookkeeping (activity stamps, fg_mark).
+    - ``_try_fast(frame)``: native fast path; one of the FAST_*
+      verdicts.  Only consulted when in-order delivery is safe (no
+      queued frames, transport writable).
+    - ``_serve_one(frame)``: async slow path; return False to stop
+      draining this connection.
+    """
+
+    PENDING_HIGH = 64
+    PENDING_LOW = 16
+    HEADER = 4
+    MAX_FRAME: int | None = None
+
+    __slots__ = (
+        "shard",
+        "transport",
+        "buf",
+        "pending",
+        "task",
+        "closing",
+        "paused_reading",
+        "writable",
+    )
+
+    def __init__(self, my_shard) -> None:
+        self.shard = my_shard
+        self.transport = None
+        self.buf = bytearray()
+        self.pending: deque = deque()
+        self.task = None
+        self.closing = False
+        self.paused_reading = False
+        self.writable = asyncio.Event()
+        self.writable.set()
+
+    # -- lifecycle --------------------------------------------------
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        self._registry().add(self)
+        self._on_connect()
+
+    def connection_lost(self, exc) -> None:
+        self._registry().discard(self)
+        self.writable.set()  # unblock a _drain awaiting writability
+        self._on_disconnect()
+
+    # Transport write-buffer backpressure: while the peer reads slowly
+    # the loop pauses us; _drain stops serving until resumed, so
+    # responses never pile up in an unbounded kernel buffer.
+    def pause_writing(self) -> None:
+        self.writable.clear()
+
+    def resume_writing(self) -> None:
+        self.writable.set()
+
+    def _registry(self) -> set:
+        raise NotImplementedError
+
+    def _on_connect(self) -> None:
+        pass
+
+    def _on_disconnect(self) -> None:
+        pass
+
+    def _on_data(self) -> None:
+        pass
+
+    def _try_fast(self, frame: bytes) -> int:
+        return FAST_MISS
+
+    async def _serve_one(self, frame: bytes) -> bool:
+        raise NotImplementedError
+
+    # -- framing ----------------------------------------------------
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        self._on_data()
+        parsed = False
+        hdr = self.HEADER
+        while len(self.buf) >= hdr:
+            size = int.from_bytes(self.buf[:hdr], "little")
+            if self.MAX_FRAME is not None and size > self.MAX_FRAME:
+                # Protocol error: stop reading, but frames already
+                # received MUST still be applied (fire-and-forget
+                # senders close right after their last write; the
+                # oversized header may simply be stream garbage after
+                # a peer bug).  The drain below applies the backlog;
+                # response writes are skipped once the transport
+                # closes.
+                self.buf.clear()
+                self.transport.close()
+                break
+            if len(self.buf) < hdr + size:
+                break
+            frame = bytes(self.buf[hdr : hdr + size])
+            del self.buf[: hdr + size]
+            # Native fast path: only when no async frames are queued
+            # (responses must leave in arrival order per connection)
+            # and the transport is writable — while the peer reads
+            # slowly (pause_writing fired) responses must queue behind
+            # _drain's writable.wait(), not pile into the transport
+            # buffer unboundedly.
+            if (
+                self.task is None
+                and not self.pending
+                and not self.closing
+                and self.writable.is_set()
+            ):
+                verdict = self._try_fast(frame)
+                if verdict == FAST_CLOSE:
+                    return
+                if verdict:
+                    continue
+            self.pending.append(frame)
+            parsed = True
+        if (
+            len(self.pending) > self.PENDING_HIGH
+            and not self.paused_reading
+        ):
+            self.paused_reading = True
+            self.transport.pause_reading()
+        if parsed and self.task is None:
+            self.task = self.shard.spawn(self._drain())
+
+    async def _drain(self) -> None:
+        try:
+            while self.pending and not self.closing:
+                frame = self.pending.popleft()
+                if (
+                    self.paused_reading
+                    and len(self.pending) < self.PENDING_LOW
+                    and not self.transport.is_closing()
+                ):
+                    self.paused_reading = False
+                    self.transport.resume_reading()
+                if not await self._serve_one(frame):
+                    return
+        except asyncio.CancelledError:
+            # Shard shutdown (or client disconnect) cancelled us:
+            # suppress the finally-respawn, or the orphan drain would
+            # outlive the cancellation snapshot and keep writing to
+            # trees the shard is about to close.
+            self.closing = True
+            raise
+        finally:
+            self.task = None
+            # Frames may have arrived while we were finishing.
+            if self.pending and not self.closing:
+                self.task = self.shard.spawn(self._drain())

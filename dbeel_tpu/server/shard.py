@@ -157,6 +157,7 @@ class MyShard:
         # Live public-API connections (protocol objects) for the
         # per-shard idle reaper.
         self.db_connections: set = set()
+        self.remote_connections: set = set()  # peer-plane protocols
         self.flow = flow_events.FlowEventNotifier()
         self._background_tasks: set = set()
         # Set by crash-simulating harnesses: suppresses graceful-stop
@@ -432,8 +433,21 @@ class MyShard:
                 f.flush()
                 os.fsync(f.fileno())
         self.collections[name] = Collection(tree, replication_factor)
-        if self.dataplane is not None and replication_factor == 1:
-            self.dataplane.register_tree(name, tree)
+        if self.dataplane is not None:
+            # RF=1: full client-plane fast path.  RF>1: replica-plane
+            # only (peer set/delete/get with coordinator-assigned
+            # timestamps); the client plane punts so Python keeps the
+            # replication/consistency brain.  RF>1 registration is
+            # gated on the shard-plane ABI being present: a stale
+            # pinned .so (old 7-arg register, no client_ok gate) would
+            # otherwise fast-serve replicated client writes with NO
+            # quorum fan-out.
+            if replication_factor == 1:
+                self.dataplane.register_tree(name, tree)
+            elif self.dataplane._has_shard_plane:
+                self.dataplane.register_tree(
+                    name, tree, client_plane=False
+                )
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_CREATED)
 
@@ -1291,13 +1305,17 @@ class MyShard:
                 s.connection.send_stop()
 
     def close_db_connections(self) -> None:
-        """Close live client transports so Server.wait_closed() (which
-        waits on them in py3.12) can finish during shutdown."""
-        for conn in list(self.db_connections):
+        """Close live client AND peer transports so Server.wait_closed()
+        (which waits on them in py3.12) can finish during shutdown."""
+        for conn in (
+            *list(self.db_connections),
+            *list(self.remote_connections),
+        ):
             conn.closing = True
             if conn.transport is not None:
                 conn.transport.close()
         self.db_connections.clear()
+        self.remote_connections.clear()
 
     def close(self) -> None:
         self.close_db_connections()

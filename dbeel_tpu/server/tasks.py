@@ -12,16 +12,22 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+
 from ..errors import DbeelError, ShardStopped
 from ..flow_events import FlowEvent
 from ..cluster import messages as msgs
 from ..cluster.local_comm import ShardPacket
-from ..cluster.messages import ShardEvent, ShardResponse
-from ..cluster.remote_comm import (
-    RemoteShardConnection,
-    get_message_from_stream,
-    send_message_to_stream,
+from ..cluster.messages import (
+    ShardEvent,
+    ShardResponse,
+    pack_message,
+    unpack_message,
 )
+from ..cluster.remote_comm import (
+    MAX_MESSAGE,
+    RemoteShardConnection,
+)
+from . import framed
 from .shard import MyShard
 
 log = logging.getLogger(__name__)
@@ -61,55 +67,120 @@ async def run_local_shard_server(my_shard: MyShard) -> None:
 # ----------------------------------------------------------------------
 
 
-async def _handle_remote_client(my_shard, reader, writer):
-    try:
-        while True:
-            try:
-                message = await get_message_from_stream(reader)
-            except (asyncio.IncompleteReadError, ConnectionError, OSError):
-                break
-            # Replica-side serving (quorum writes/reads from peers) is
-            # foreground work too: without this mark, background units
-            # on replica nodes would never defer to quorum traffic.
-            # Anti-entropy's own requests must NOT mark: they are
-            # background traffic, and marking would make the peer-side
-            # bg_slice throttle against the very request it serves.
-            if not (
-                isinstance(message, (list, tuple))
-                and len(message) > 1
-                and message[0] == "request"
-                and message[1]
-                in (
-                    msgs.ShardRequest.RANGE_DIGEST,
-                    msgs.ShardRequest.RANGE_PULL,
-                    msgs.ShardRequest.RANGE_PUSH,
-                )
-            ):
-                my_shard.scheduler.fg_mark()
-            try:
-                response = await my_shard.handle_shard_message(message)
-                if response is not None:
-                    await send_message_to_stream(writer, response)
-            except DbeelError as e:
-                await send_message_to_stream(
-                    writer, msgs.ShardResponse.error(e)
-                )
-            except Exception as e:
-                log.exception("remote shard message failed")
-                await send_message_to_stream(
-                    writer,
-                    ["response", ShardResponse.ERROR, "Internal", str(e)],
-                )
-    finally:
-        writer.close()
+class _RemoteShardProtocol(framed.FramedServerProtocol):
+    """Raw-protocol remote shard server (the db server's _DbProtocol
+    treatment applied to the peer plane): 4-byte-LE-length msgpack
+    frames parsed in data_received, replica-plane set/delete/get
+    answered synchronously by the native data plane
+    (dataplane.try_handle_shard), everything else drained in arrival
+    order through the unchanged handle_shard_message path.  Wire
+    format and error behavior identical to the stream version
+    (remote_shard_server.rs:23-49 parity: persistent multi-message
+    connections)."""
+
+    HEADER = 4
+    MAX_FRAME = MAX_MESSAGE
+
+    __slots__ = ()
+
+    def _registry(self) -> set:
+        # Tracked for shutdown: py3.12 Server.wait_closed() waits on
+        # open protocol connections, and peer streams are persistent.
+        return self.shard.remote_connections
+
+    def _on_disconnect(self) -> None:
+        # Fire-and-forget senders (send_event, migration streams)
+        # write their last frames and close immediately: frames
+        # already received MUST still be applied, exactly like the
+        # stream server kept serving readexactly's buffer after EOF.
+        # So the drain is NOT cancelled here — it finishes
+        # self.pending (skipping response writes once the transport
+        # is closing) and exits.  Shard shutdown cancels it via
+        # _background_tasks; the base drain suppresses its respawn on
+        # cancellation.
+        pass
+
+    def _try_fast(self, frame: bytes) -> int:
+        dp = self.shard.dataplane
+        if dp is None:
+            return framed.FAST_MISS
+        fast = dp.try_handle_shard(frame)
+        if fast is None:
+            return framed.FAST_MISS
+        # Replica-side serving is foreground work (set/delete/get
+        # only on this path; the anti-entropy exemption applies to
+        # RANGE_* messages, which always punt).
+        self.shard.scheduler.fg_mark()
+        resp, flush_tree, notify_set = fast
+        if resp is not None:
+            self.transport.write(resp)
+        if flush_tree is not None:
+            self.shard.spawn(flush_tree.flush())
+        if notify_set:
+            self.shard.flow.notify(
+                FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+            )
+        return framed.FAST_HANDLED
+
+    async def _serve_one(self, frame: bytes) -> bool:
+        my_shard = self.shard
+        try:
+            message = unpack_message(frame)
+        except Exception:
+            # Malformed msgpack: stop talking to this peer, but the
+            # remaining length-delimited frames were received intact
+            # — keep applying them (writes skipped, transport
+            # closing).
+            self.transport.close()
+            return True
+        # Replica-side serving (quorum writes/reads from peers) is
+        # foreground work too.  Anti-entropy's own requests must NOT
+        # mark: they are background traffic, and marking would make
+        # the peer-side bg_slice throttle against the very request it
+        # serves.
+        if not (
+            isinstance(message, (list, tuple))
+            and len(message) > 1
+            and message[0] == "request"
+            and message[1]
+            in (
+                msgs.ShardRequest.RANGE_DIGEST,
+                msgs.ShardRequest.RANGE_PULL,
+                msgs.ShardRequest.RANGE_PUSH,
+            )
+        ):
+            my_shard.scheduler.fg_mark()
+        try:
+            response = await my_shard.handle_shard_message(message)
+        except DbeelError as e:
+            response = msgs.ShardResponse.error(e)
+        except Exception as e:
+            log.exception("remote shard message failed")
+            response = [
+                "response",
+                ShardResponse.ERROR,
+                "Internal",
+                str(e),
+            ]
+        if (
+            response is not None
+            and not self.closing
+            and not self.transport.is_closing()
+        ):
+            await self.writable.wait()
+            if self.closing or self.transport.is_closing():
+                return True  # keep applying buffered frames
+            payload = pack_message(response)
+            self.transport.write(
+                len(payload).to_bytes(4, "little") + payload
+            )
+        return True
 
 
 async def bind_remote_shard_server(my_shard: MyShard) -> asyncio.Server:
     port = my_shard.config.remote_port(my_shard.id)
-    server = await asyncio.start_server(
-        lambda r, w: my_shard.spawn(
-            _handle_remote_client(my_shard, r, w)
-        ),
+    server = await asyncio.get_event_loop().create_server(
+        lambda: _RemoteShardProtocol(my_shard),
         my_shard.config.ip,
         port,
     )

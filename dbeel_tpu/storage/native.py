@@ -212,7 +212,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_void_p,
             ctypes.c_uint32,
+            ctypes.c_int32,  # client_plane (0 = replica-plane only)
         ]
+        if hasattr(lib, "dbeel_dp_handle_shard"):
+            lib.dbeel_dp_handle_shard.restype = ctypes.c_int64
+            lib.dbeel_dp_handle_shard.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.dbeel_dp_fast_replica_ops.restype = ctypes.c_uint64
+            lib.dbeel_dp_fast_replica_ops.argtypes = [ctypes.c_void_p]
         lib.dbeel_dp_unregister.restype = None
         lib.dbeel_dp_unregister.argtypes = [
             ctypes.c_void_p,

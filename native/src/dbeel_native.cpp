@@ -1335,6 +1335,12 @@ struct FastCollection {
   // sync with the Python sstable list; false until the first
   // successful dbeel_dp_set_tables (and when Python invalidates it).
   bool tables_valid = false;
+  // RF=1 collections only: the CLIENT-plane fast path may serve them
+  // (replication/consistency fan-out is Python's).  RF>1 collections
+  // register with client_ok=false so only the REPLICA plane
+  // (dbeel_dp_handle_shard — explicit-timestamp peer traffic) touches
+  // them natively.
+  bool client_ok = true;
 };
 
 struct DataPlane {
@@ -1344,7 +1350,9 @@ struct DataPlane {
   int32_t own_mode = 0;
   uint32_t own_lo = 0, own_hi = 0;
   uint64_t fast_sets = 0, fast_gets = 0, fast_table_gets = 0;
+  uint64_t fast_replica_ops = 0;
   std::vector<uint8_t> keybuf;  // probe scratch (grown on demand)
+  std::vector<uint8_t> valbuf;  // table_find value scratch
 };
 
 static void dp_close_tables(FastCollection& col) {
@@ -1432,12 +1440,18 @@ static void prefix_range(const FastTable& t, const uint8_t* key,
 
 static const uint32_t kDpKeyMax = 64u << 10;  // bigger keys punt
 
+static const uint32_t kDpValMax = 255u << 10;  // bigger values punt
+
 // Binary-search one table for `key` via NOWAIT preads.
-// Returns 1 found (value written to out+4, *vlen/*ts set), 0 absent,
-// -1 punt (cold page / oversized / short read).
+// Returns 1 found (value pread into dst, *val_out = dst, *vlen/*ts
+// set), 0 absent, -1 punt (cold page / oversized / short read).
+// The caller picks dst so the client plane can read straight into
+// the response buffer (no staging copy); the replica plane stages in
+// dp->valbuf because its msgpack bin header is variable-width.
 static int table_find(DataPlane* dp, const FastTable& t,
-                      const uint8_t* key, uint32_t kn, uint8_t* out,
-                      uint32_t out_cap, uint32_t* vlen_out) {
+                      const uint8_t* key, uint32_t kn, uint8_t* dst,
+                      uint32_t dst_cap, const uint8_t** val_out,
+                      uint32_t* vlen_out, int64_t* ts_out) {
   uint64_t lo, hi;
   prefix_range(t, key, kn, &lo, &hi);
   if (dp->keybuf.size() < kDpKeyMax) dp->keybuf.resize(kDpKeyMax);
@@ -1459,20 +1473,52 @@ static int table_find(DataPlane* dp, const FastTable& t,
       uint8_t hdr[16];
       if (!pread_nw(t.data_fd, hdr, 16, off)) return -1;
       uint32_t klen, vlen;
+      int64_t ts;
       std::memcpy(&klen, hdr, 4);
       std::memcpy(&vlen, hdr + 4, 4);
+      std::memcpy(&ts, hdr + 8, 8);
       if (klen != ksz) return -1;  // corrupt index: let Python judge
-      if ((uint64_t)4 + vlen + 1 > out_cap) return -1;
+      if (vlen > dst_cap) return -1;
       if (vlen != 0 &&
-          !pread_nw(t.data_fd, out + 4, vlen, off + 16 + klen))
+          !pread_nw(t.data_fd, dst, vlen, off + 16 + klen))
         return -1;
+      *val_out = dst;
       *vlen_out = vlen;
+      *ts_out = ts;
       return 1;
     }
     if (cmp < 0)
       lo = mid + 1;
     else
       hi = mid;
+  }
+  return 0;
+}
+
+// Unified point lookup across memtables then registered sstables.
+// Returns 1 found (tombstone = *vlen==0), 0 authoritative absent,
+// -1 punt (cold page / no valid registry / oversized).
+// skip_memtables: the caller already probed them (the client plane
+// distinguishes memtable-served from table-served for its counters).
+static int col_find(DataPlane* dp, FastCollection* col,
+                    const uint8_t* key, uint32_t kn, uint8_t* dst,
+                    uint32_t dst_cap, const uint8_t** val_out,
+                    uint32_t* vlen_out, int64_t* ts_out,
+                    bool skip_memtables = false) {
+  if (!skip_memtables) {
+    int32_t found = dbeel_memtable_get(col->active, key, kn, val_out,
+                                       vlen_out, ts_out);
+    if (!found && col->flushing != nullptr)
+      found = dbeel_memtable_get(col->flushing, key, kn, val_out,
+                                 vlen_out, ts_out);
+    if (found) return 1;
+  }
+  if (!col->tables_valid) return -1;
+  for (const auto& t : col->tables) {
+    if (t.entry_count == 0 || !bloom_maybe(t, key, kn)) continue;
+    const int r = table_find(dp, t, key, kn, dst, dst_cap, val_out,
+                             vlen_out, ts_out);
+    if (r != 0) return r;  // found (incl. tombstone) or punt
   }
   return 0;
 }
@@ -1658,10 +1704,13 @@ void dbeel_dp_set_ownership(void* h, int32_t mode, uint32_t lo,
   dp->own_hi = hi;
 }
 
-// Register/replace a collection's write state.  Returns the slot index.
+// Register/replace a collection's write state.  Returns the slot
+// index.  client_plane != 0 allows the CLIENT-plane fast path
+// (RF=1); 0 restricts the collection to the replica plane.
 int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
                           void* active, void* flushing, void* wal,
-                          uint32_t capacity) try {
+                          uint32_t capacity,
+                          int32_t client_plane) try {
   auto* dp = static_cast<DataPlane*>(h);
   const std::string n((const char*)name, nlen);
   for (size_t i = 0; i < dp->cols.size(); i++) {
@@ -1670,6 +1719,7 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
       dp->cols[i].flushing = flushing;
       dp->cols[i].wal = static_cast<NativeWal*>(wal);
       dp->cols[i].capacity = capacity;
+      dp->cols[i].client_ok = client_plane != 0;
       return (int32_t)i;
     }
   }
@@ -1679,6 +1729,7 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
   col.flushing = flushing;
   col.wal = static_cast<NativeWal*>(wal);
   col.capacity = capacity;
+  col.client_ok = client_plane != 0;
   dp->cols.push_back(std::move(col));
   return (int32_t)dp->cols.size() - 1;
 } catch (...) {
@@ -1752,6 +1803,9 @@ uint64_t dbeel_dp_fast_gets(void* h) {
 }
 uint64_t dbeel_dp_fast_table_gets(void* h) {
   return static_cast<DataPlane*>(h)->fast_table_gets;
+}
+uint64_t dbeel_dp_fast_replica_ops(void* h) {
+  return static_cast<DataPlane*>(h)->fast_replica_ops;
 }
 
 // Handle one request frame entirely natively if possible.
@@ -1878,6 +1932,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
     }
   }
   if (col == nullptr) return -1;
+  if (!col->client_ok) return -1;  // RF>1: replication brain is Python
 
   const uint32_t key_hash =
       have_hash ? (uint32_t)hash_v : murmur3_32(key_raw, key_n, 0);
@@ -1895,51 +1950,43 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
     const uint8_t* v = nullptr;
     uint32_t vn = 0;
     int64_t ts = 0;
-    int32_t found =
-        dbeel_memtable_get(col->active, key_raw, key_n, &v, &vn, &ts);
-    if (!found && col->flushing != nullptr)
-      found = dbeel_memtable_get(col->flushing, key_raw, key_n, &v, &vn,
-                                 &ts);
+    // Memtables first, then sstables newest-first; first match wins
+    // (lsm_tree.py get_entry / lsm_tree.rs:674-723).  Cold pages punt
+    // to the Python async read path.
+    const bool from_memtable =
+        dbeel_memtable_get(col->active, key_raw, key_n, &v, &vn,
+                           &ts) ||
+        (col->flushing != nullptr &&
+         dbeel_memtable_get(col->flushing, key_raw, key_n, &v, &vn,
+                            &ts));
+    int found = 1;
+    if (!from_memtable) {
+      // Table values pread DIRECTLY into the response slot (out+4):
+      // one copy total.  Reserve 5 bytes for the length prefix + the
+      // trailing type byte.
+      if (out_cap < 5) return -1;
+      found = col_find(dp, col, key_raw, key_n, out + 4, out_cap - 5,
+                       &v, &vn, &ts,
+                       /*skip_memtables=*/true);
+      if (found < 0) return -1;
+    }
     if (found && vn != 0) {
       const uint32_t resp_len = vn + 1;  // value + type byte
-      if (out_cap < 4 + resp_len) return -1;
+      if ((uint64_t)out_cap < (uint64_t)4 + resp_len) return -1;
       std::memcpy(out, &resp_len, 4);
-      std::memcpy(out + 4, v, vn);
+      if (v != out + 4)  // memtable hit: value still in the memtable
+        std::memcpy(out + 4, v, vn);
       out[4 + vn] = 1;  // RESPONSE_OK
       *out_len = 4 + resp_len;
-      dp->fast_gets++;
-      return get_flags;
-    }
-    if (found) {  // memtable tombstone: live value is "not found"
+    } else {
+      // Tombstone or authoritative absence: KeyNotFound, natively.
       if (!keynotfound_response(key_raw, key_n, out, out_cap, out_len))
         return -1;
+    }
+    if (from_memtable)
       dp->fast_gets++;
-      return get_flags;
-    }
-    // Memtable miss => sstable search, newest table first; the first
-    // match wins (lsm_tree.py get_entry / lsm_tree.rs:674-723).  Any
-    // cold page punts to the Python async read path.
-    if (!col->tables_valid) return -1;
-    for (const auto& t : col->tables) {
-      if (t.entry_count == 0 || !bloom_maybe(t, key_raw, key_n))
-        continue;
-      uint32_t vlen = 0;
-      const int r =
-          table_find(dp, t, key_raw, key_n, out, out_cap, &vlen);
-      if (r < 0) return -1;
-      if (r == 0) continue;
-      if (vlen == 0) break;  // tombstone shadows older tables
-      const uint32_t resp_len = vlen + 1;
-      std::memcpy(out, &resp_len, 4);
-      out[4 + vlen] = 1;  // RESPONSE_OK
-      *out_len = 4 + resp_len;
+    else
       dp->fast_table_gets++;
-      return get_flags;
-    }
-    // Absent everywhere (or tombstoned): KeyNotFound, natively.
-    if (!keynotfound_response(key_raw, key_n, out, out_cap, out_len))
-      return -1;
-    dp->fast_table_gets++;
     return get_flags;
   }
 
@@ -1962,6 +2009,304 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   int64_t flags = ((int64_t)col_idx << 8) | (keepalive ? 1 : 0);
   if (is_del) flags |= 8;
   if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  return flags;
+} catch (...) {
+  return -1;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Canonical msgpack emitters (exactly msgpack-python's minimal forms).
+size_t mp_put_int64(uint8_t* o, int64_t v) {
+  if (v >= 0) {
+    const uint64_t u = (uint64_t)v;
+    if (u <= 0x7f) {
+      o[0] = (uint8_t)u;
+      return 1;
+    }
+    if (u <= 0xff) {
+      o[0] = 0xcc;
+      o[1] = (uint8_t)u;
+      return 2;
+    }
+    if (u <= 0xffff) {
+      o[0] = 0xcd;
+      o[1] = (uint8_t)(u >> 8);
+      o[2] = (uint8_t)u;
+      return 3;
+    }
+    if (u <= 0xffffffffull) {
+      o[0] = 0xce;
+      for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(u >> (24 - 8 * i));
+      return 5;
+    }
+    o[0] = 0xcf;
+    for (int i = 0; i < 8; i++) o[1 + i] = (uint8_t)(u >> (56 - 8 * i));
+    return 9;
+  }
+  if (v >= -32) {
+    o[0] = (uint8_t)v;
+    return 1;
+  }
+  if (v >= -128) {
+    o[0] = 0xd0;
+    o[1] = (uint8_t)v;
+    return 2;
+  }
+  if (v >= -32768) {
+    o[0] = 0xd1;
+    o[1] = (uint8_t)((uint16_t)v >> 8);
+    o[2] = (uint8_t)v;
+    return 3;
+  }
+  if (v >= -2147483648ll) {
+    o[0] = 0xd2;
+    const uint32_t u = (uint32_t)v;
+    for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(u >> (24 - 8 * i));
+    return 5;
+  }
+  o[0] = 0xd3;
+  const uint64_t u = (uint64_t)v;
+  for (int i = 0; i < 8; i++) o[1 + i] = (uint8_t)(u >> (56 - 8 * i));
+  return 9;
+}
+
+size_t mp_put_binhdr(uint8_t* o, uint32_t n) {
+  if (n <= 0xff) {
+    o[0] = 0xc4;
+    o[1] = (uint8_t)n;
+    return 2;
+  }
+  if (n <= 0xffff) {
+    o[0] = 0xc5;
+    o[1] = (uint8_t)(n >> 8);
+    o[2] = (uint8_t)n;
+    return 3;
+  }
+  o[0] = 0xc6;
+  for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(n >> (24 - 8 * i));
+  return 5;
+}
+
+// Read a bin8/16/32 value; returns payload slice.
+bool mp_read_bin(MpCur& c, const uint8_t** s, uint32_t* n) {
+  if (!mp_need(c, 1)) return false;
+  const uint8_t b = *c.p++;
+  size_t len;
+  if (b == 0xc4) {
+    if (!mp_need(c, 1)) return false;
+    len = *c.p++;
+  } else if (b == 0xc5) {
+    if (!mp_need(c, 2)) return false;
+    len = ((size_t)c.p[0] << 8) | c.p[1];
+    c.p += 2;
+  } else if (b == 0xc6) {
+    if (!mp_need(c, 4)) return false;
+    len = ((size_t)c.p[0] << 24) | ((size_t)c.p[1] << 16) |
+          ((size_t)c.p[2] << 8) | c.p[3];
+    c.p += 4;
+  } else {
+    return false;
+  }
+  if (!mp_need(c, len)) return false;
+  *s = c.p;
+  *n = (uint32_t)len;
+  c.p += len;
+  return true;
+}
+
+// Read a signed-or-unsigned msgpack int into int64 (replica
+// timestamps are server-assigned nanos, i.e. uint in practice; the
+// signed forms are accepted for parity with Python's unpack).
+bool mp_read_int64(MpCur& c, int64_t* out) {
+  if (!mp_need(c, 1)) return false;
+  const uint8_t b = *c.p;
+  if (b >= 0xe0) {  // fixneg
+    *out = (int8_t)b;
+    c.p++;
+    return true;
+  }
+  if (b == 0xd0 || b == 0xd1 || b == 0xd2 || b == 0xd3) {
+    c.p++;
+    const int n = b == 0xd0 ? 1 : b == 0xd1 ? 2 : b == 0xd2 ? 4 : 8;
+    if (!mp_need(c, (size_t)n)) return false;
+    uint64_t u = 0;
+    for (int i = 0; i < n; i++) u = (u << 8) | *c.p++;
+    // sign-extend
+    const int shift = 64 - 8 * n;
+    *out = (int64_t)(u << shift) >> shift;
+    return true;
+  }
+  uint64_t u;
+  if (!mp_read_uint(c, &u)) return false;
+  if (u > 0x7fffffffffffffffull) return false;
+  *out = (int64_t)u;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Replica-plane fast path: handle one remote-shard-protocol message
+// (4-byte-LE-length framed msgpack list, cluster/messages.py) entirely
+// natively — the peer traffic behind RF>1 quorum ops and migration
+// streams.  Covered: ["request","set",coll,key,value,ts],
+// ["request","delete",coll,key,ts], ["request","get",coll,key], and
+// ["event","set",coll,key,value,ts].  Writes apply the GIVEN
+// timestamp (server-assigned by the coordinating shard,
+// shards.rs:695-773 parity); gets return the entry INCLUDING
+// tombstones with its timestamp (max-ts conflict resolution happens
+// at the coordinator).  Anything else — unknown kinds, unregistered
+// collections, full memtables, cold pages, wal-sync trees — returns
+// -1 and the frame re-runs through the Python handler unchanged.
+// Returns flags: bit1 memtable-now-full (Python spawns the flush),
+// bit2 response present in out (4B-LE length + msgpack payload),
+// bit3 this was a write, bit5 the write was a delete (set writes get
+// the ITEM_SET_FROM_SHARD_MESSAGE flow notification from Python;
+// deletes don't, matching handle_shard_request), bits 8.. collection
+// slot.
+int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
+                              uint32_t len, uint8_t* out,
+                              uint32_t out_cap,
+                              uint32_t* out_len) try {
+  auto* dp = static_cast<DataPlane*>(h);
+  *out_len = 0;
+  MpCur c{frame, frame + len};
+  if (!mp_need(c, 1)) return -1;
+  const uint8_t ah = *c.p;
+  if (ah < 0x90 || ah > 0x9f) return -1;  // fixarray only
+  const uint32_t nelem = ah & 0x0f;
+  c.p++;
+  const uint8_t *tag_s, *kind_s;
+  uint32_t tag_n, kind_n;
+  if (!mp_read_str(c, &tag_s, &tag_n)) return -1;
+  if (!mp_read_str(c, &kind_s, &kind_n)) return -1;
+  const bool is_req = slice_eq(tag_s, tag_n, "request");
+  const bool is_event = slice_eq(tag_s, tag_n, "event");
+  if (!is_req && !is_event) return -1;
+  const bool k_set = slice_eq(kind_s, kind_n, "set");
+  const bool k_del = is_req && slice_eq(kind_s, kind_n, "delete");
+  const bool k_get = is_req && slice_eq(kind_s, kind_n, "get");
+  if (is_event && !k_set) return -1;
+  if (!(k_set || k_del || k_get)) return -1;
+  const uint32_t want =
+      k_set ? 6u : k_del ? 5u : 4u;
+  if (nelem != want) return -1;
+
+  const uint8_t* coll_s;
+  uint32_t coll_n;
+  if (!mp_read_str(c, &coll_s, &coll_n)) return -1;
+  const uint8_t *key_s, *val_s = nullptr;
+  uint32_t key_n, val_n = 0;
+  if (!mp_read_bin(c, &key_s, &key_n)) return -1;
+  if (k_set && !mp_read_bin(c, &val_s, &val_n)) return -1;
+  int64_t ts = 0;
+  if ((k_set || k_del) && !mp_read_int64(c, &ts)) return -1;
+  if (c.p != c.end) return -1;
+
+  FastCollection* col = nullptr;
+  int32_t col_idx = -1;
+  for (size_t i = 0; i < dp->cols.size(); i++) {
+    if (dp->cols[i].name.size() == coll_n &&
+        std::memcmp(dp->cols[i].name.data(), coll_s, coll_n) == 0) {
+      col = &dp->cols[i];
+      col_idx = (int32_t)i;
+      break;
+    }
+  }
+  if (col == nullptr) return -1;
+
+  if (k_get) {
+    const uint8_t* v = nullptr;
+    uint32_t vn = 0;
+    int64_t ets = 0;
+    // Stage table values in valbuf: the msgpack bin header ahead of
+    // the value is variable-width, so the final offset isn't known
+    // until the length is.
+    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
+    const int found =
+        col_find(dp, col, key_s, key_n, dp->valbuf.data(), kDpValMax,
+                 &v, &vn, &ets);
+    if (found < 0) return -1;
+    // ["response","get", [value, ts] | nil]
+    uint8_t hdr[32];
+    size_t o = 0;
+    hdr[o++] = 0x93;
+    hdr[o++] = 0xa8;
+    std::memcpy(hdr + o, "response", 8);
+    o += 8;
+    hdr[o++] = 0xa3;
+    std::memcpy(hdr + o, "get", 3);
+    o += 3;
+    size_t total;
+    if (found) {
+      hdr[o++] = 0x92;
+      o += mp_put_binhdr(hdr + o, vn);
+      // value bytes + ts follow after hdr
+      uint8_t tsbuf[9];
+      const size_t tslen = mp_put_int64(tsbuf, ets);
+      total = o + vn + tslen;
+      if ((uint64_t)4 + total > out_cap) return -1;
+      std::memcpy(out + 4, hdr, o);
+      if (vn) std::memcpy(out + 4 + o, v, vn);
+      std::memcpy(out + 4 + o + vn, tsbuf, tslen);
+    } else {
+      hdr[o++] = 0xc0;  // nil: authoritative absence
+      total = o;
+      if ((uint64_t)4 + total > out_cap) return -1;
+      std::memcpy(out + 4, hdr, o);
+    }
+    const uint32_t t32 = (uint32_t)total;
+    std::memcpy(out, &t32, 4);
+    *out_len = 4 + t32;
+    dp->fast_replica_ops++;
+    return ((int64_t)col_idx << 8) | 4;
+  }
+
+  // Writes: the coordinator assigned ts; apply verbatim.
+  if (col->wal == nullptr) return -1;
+  // The ack is up to 4 + 21 bytes: punt BEFORE applying (a post-write
+  // punt would re-run the frame through Python and apply it twice).
+  if (is_req && out_cap < 32) return -1;
+  uint32_t old_len = 0;
+  const int32_t rc = dbeel_memtable_set(
+      col->active, key_s, key_n, k_set ? val_s : nullptr,
+      k_set ? val_n : 0, ts, &old_len);
+  if (rc < 0) return -1;  // capacity: Python waits for the flush
+  if (dbeel_wal_append(col->wal, key_s, key_n,
+                       k_set ? val_s : nullptr, k_set ? val_n : 0,
+                       ts) == 0)
+    return -1;
+  int64_t flags = ((int64_t)col_idx << 8) | 8;
+  if (k_del) flags |= 0x20;  // delete: no SET flow notification
+  if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (is_req) {
+    // ["response","set"] / ["response","delete"] (out_cap >= 32
+    // checked above, before the write applied)
+    uint8_t* o = out + 4;
+    size_t n = 0;
+    o[n++] = 0x92;
+    o[n++] = 0xa8;
+    std::memcpy(o + n, "response", 8);
+    n += 8;
+    if (k_set) {
+      o[n++] = 0xa3;
+      std::memcpy(o + n, "set", 3);
+      n += 3;
+    } else {
+      o[n++] = 0xa6;
+      std::memcpy(o + n, "delete", 6);
+      n += 6;
+    }
+    const uint32_t n32 = (uint32_t)n;
+    std::memcpy(out, &n32, 4);
+    *out_len = 4 + n32;
+    flags |= 4;
+  }
+  dp->fast_replica_ops++;
   return flags;
 } catch (...) {
   return -1;

@@ -4,6 +4,8 @@ migration}.rs.  No sleeps — synchronization via flow events."""
 
 import asyncio
 
+import msgpack
+
 import pytest
 
 from dbeel_tpu.client import DbeelClient, Consistency
@@ -438,3 +440,206 @@ def test_replicated_set_reaches_replica_trees(tmp_dir):
                 await n.stop()
 
     run(main(), timeout=60)
+
+
+def test_replica_plane_served_natively(tmp_dir):
+    """RF=3 quorum traffic must ride the C replica-plane fast path on
+    the peer shards (dataplane.try_handle_shard): counters advance,
+    and every replica ends up holding byte-identical data — the same
+    end state the Python path produces."""
+
+    async def main():
+        from dbeel_tpu.storage.native import native_available
+
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
+            col = await client.create_collection(
+                "nat", replication_factor=3
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+
+            def replica_ops():
+                total = 0
+                for n in nodes:
+                    dp = n.shards[0].dataplane
+                    if dp is not None:
+                        total += dp.stats().get("fast_replica_ops", 0)
+                return total
+
+            r0 = replica_ops()
+            for i in range(20):
+                await col.set(
+                    f"k{i}", {"i": i}, consistency=Consistency.ALL
+                )
+            for i in range(20):
+                assert await col.get(
+                    f"k{i}", consistency=Consistency.ALL
+                ) == {"i": i}
+            await col.delete("k0", consistency=Consistency.ALL)
+            r1 = replica_ops()
+            if native_available():
+                # 20 sets + 20 gets + 1 delete, each fanned to 2
+                # replicas => >= 60 native replica ops (flush timing
+                # may route a handful through the Python path).
+                assert r1 - r0 >= 50, f"replica plane barely engaged ({r1 - r0})"
+            # Every replica holds identical live data.
+            for i in range(1, 20):
+                k = msgpack.packb(f"k{i}", use_bin_type=True)
+                vals = set()
+                for n in nodes:
+                    tree = n.shards[0].collections["nat"].tree
+                    hit = await tree.get_entry(k)
+                    assert hit is not None, (i, n.config.name)
+                    vals.add(bytes(hit[0]))
+                assert len(vals) == 1, (i, vals)
+            for n in nodes:
+                tree = n.shards[0].collections["nat"].tree
+                assert await tree.get(
+                    msgpack.packb("k0", use_bin_type=True)
+                ) is None
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_buffered_events_applied_after_peer_close(tmp_dir):
+    """Fire-and-forget senders (send_event, migration streams) write
+    their last frames and close the socket immediately.  Frames
+    already received by the server MUST still be applied after the
+    FIN — the drain finishes the pending backlog instead of being
+    cancelled (regression: connection_lost used to cancel it, losing
+    the tail of every migration/replication event stream)."""
+
+    async def main():
+        from dbeel_tpu.cluster.messages import pack_message
+
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            await client.create_collection("ev", replication_factor=1)
+            shard = node.shards[0]
+            sets = [
+                shard.flow.subscribe(
+                    FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+                )
+                for _ in range(8)
+            ]
+            reader, writer = await asyncio.open_connection(
+                cfg.ip, cfg.remote_shard_port
+            )
+            # A punted request first so the following events queue
+            # into the drain backlog (the native path would answer
+            # frames synchronously and hide the regression).
+            frames = [pack_message(["request", "ping"])]
+            for i in range(8):
+                frames.append(
+                    pack_message(
+                        [
+                            "event",
+                            "set",
+                            "ev",
+                            msgpack.packb(f"e{i}", use_bin_type=True),
+                            msgpack.packb(i, use_bin_type=True),
+                            1_000_000 + i,
+                        ]
+                    )
+                )
+            blob = b"".join(
+                len(f).to_bytes(4, "little") + f for f in frames
+            )
+            writer.write(blob)
+            await writer.drain()
+            writer.close()  # FIN races the drain
+            await asyncio.wait_for(asyncio.gather(*sets), 10)
+            tree = shard.collections["ev"].tree
+            for i in range(8):
+                v = await tree.get(
+                    msgpack.packb(f"e{i}", use_bin_type=True)
+                )
+                assert v == msgpack.packb(i, use_bin_type=True), i
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_frames_before_protocol_error_still_applied(tmp_dir):
+    """A peer that sends valid frames followed by stream garbage (an
+    oversized length header) gets disconnected — but the valid frames
+    it already delivered MUST be applied, exactly like the tail-event
+    guarantee after a clean FIN (regression: the oversized-header
+    branch used to drop the whole parsed backlog)."""
+
+    async def main():
+        from dbeel_tpu.cluster.messages import pack_message
+        from dbeel_tpu.cluster.remote_comm import MAX_MESSAGE
+
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            await client.create_collection("pe", replication_factor=1)
+            shard = node.shards[0]
+            sets = [
+                shard.flow.subscribe(
+                    FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+                )
+                for _ in range(4)
+            ]
+            reader, writer = await asyncio.open_connection(
+                cfg.ip, cfg.remote_shard_port
+            )
+            frames = [pack_message(["request", "ping"])]
+            for i in range(4):
+                frames.append(
+                    pack_message(
+                        [
+                            "event",
+                            "set",
+                            "pe",
+                            msgpack.packb(f"g{i}", use_bin_type=True),
+                            msgpack.packb(i, use_bin_type=True),
+                            2_000_000 + i,
+                        ]
+                    )
+                )
+            blob = b"".join(
+                len(f).to_bytes(4, "little") + f for f in frames
+            )
+            # Garbage tail: a length header far beyond MAX_MESSAGE.
+            blob += (MAX_MESSAGE + 1).to_bytes(4, "little") + b"zz"
+            writer.write(blob)
+            await writer.drain()
+            await asyncio.wait_for(asyncio.gather(*sets), 10)
+            tree = shard.collections["pe"].tree
+            for i in range(4):
+                v = await tree.get(
+                    msgpack.packb(f"g{i}", use_bin_type=True)
+                )
+                assert v == msgpack.packb(i, use_bin_type=True), i
+            # The server dropped the connection on the garbage.
+            assert await asyncio.wait_for(reader.read(), 10) is not None
+            writer.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
