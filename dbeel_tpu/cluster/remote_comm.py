@@ -36,12 +36,17 @@ async def send_message_to_stream(
     await writer.drain()
 
 
-async def get_message_from_stream(reader: asyncio.StreamReader) -> list:
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    """One u32-LE length-prefixed frame payload, MAX_MESSAGE-bounded."""
     header = await reader.readexactly(_LEN.size)
     (size,) = _LEN.unpack(header)
     if size > MAX_MESSAGE:
         raise ProtocolError(f"frame too large: {size}")
-    return unpack_message(await reader.readexactly(size))
+    return await reader.readexactly(size)
+
+
+async def get_message_from_stream(reader: asyncio.StreamReader) -> list:
+    return unpack_message(await read_frame(reader))
 
 
 class RemoteShardConnection:
@@ -122,17 +127,15 @@ class RemoteShardConnection:
             get_message_from_stream(reader), self.read_timeout
         )
 
-    async def send_message(self, message: list) -> list:
-        """Send one message, read one reply — over a pooled persistent
-        stream when enabled, else connect-per-request
-        (remote_shard_connection.rs:50-72)."""
+    async def _rpc(self, op):
+        """Run ``op(reader, writer) -> result`` with the pooled
+        persistent-stream semantics when enabled, else
+        connect-per-request (remote_shard_connection.rs:50-72)."""
         if self.pooled:
             while self._pool:
                 reader, writer = self._pool.pop()
                 try:
-                    response = await self._round_trip(
-                        reader, writer, message
-                    )
+                    response = await op(reader, writer)
                 except asyncio.TimeoutError as e:
                     # Must precede OSError: on py3.11+ asyncio
                     # .TimeoutError IS TimeoutError ⊂ OSError.  A slow
@@ -158,9 +161,7 @@ class RemoteShardConnection:
         reader, writer = await self._connect()
         try:
             try:
-                response = await self._round_trip(
-                    reader, writer, message
-                )
+                response = await op(reader, writer)
             except asyncio.TimeoutError as e:
                 raise Timeout(f"rpc to {self.address}") from e
             except (OSError, asyncio.IncompleteReadError) as e:
@@ -175,6 +176,31 @@ class RemoteShardConnection:
         else:
             writer.close()
         return response
+
+    async def send_message(self, message: list) -> list:
+        """Send one message, read one reply."""
+        return await self._rpc(
+            lambda r, w: self._round_trip(r, w, message)
+        )
+
+    async def _round_trip_packed(
+        self, reader, writer, framed: bytes
+    ) -> bytes:
+        writer.write(framed)
+        await asyncio.wait_for(writer.drain(), self.write_timeout)
+        return await asyncio.wait_for(
+            read_frame(reader), self.read_timeout
+        )
+
+    async def send_packed(self, framed: bytes) -> bytes:
+        """Send one PRE-PACKED frame (already carrying its 4B-LE
+        length prefix — e.g. the native coordinator's peer frame) and
+        return the raw response payload bytes (length prefix
+        stripped, NOT unpacked).  Callers byte-compare against the
+        expected constant ack and only unpack on mismatch."""
+        return await self._rpc(
+            lambda r, w: self._round_trip_packed(r, w, framed)
+        )
 
     async def send_request(self, request: list) -> list:
         """Send a ShardRequest, return the ShardResponse payload list."""

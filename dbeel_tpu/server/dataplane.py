@@ -69,6 +69,10 @@ class DataPlane:
         if not self._handle:
             raise MemoryError("dataplane allocation failed")
         self._trees = {}  # name -> LSMTree (flush spawning)
+        # Slot-indexed names mirroring the C collection vector (both
+        # append on register and erase on unregister): O(1) slot ->
+        # name on the per-request paths, no list materialization.
+        self._slots: list = []
         self._table_refs = {}  # name -> borrowed-buffer keepalives
         self._table_fps = {}  # name -> registry fingerprint (skip no-ops)
         self._get_buf = ctypes.create_string_buffer(_GET_BUF_CAP)
@@ -87,6 +91,11 @@ class DataPlane:
             "",
             "0",
         )
+        # DBEEL_DP_NO_COORD=1 disables the native coordinator assist
+        # for RF>1 client writes (A/B benching).
+        self._has_coord = hasattr(
+            lib, "dbeel_dp_handle_coord"
+        ) and os.environ.get("DBEEL_DP_NO_COORD", "0") in ("", "0")
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -154,7 +163,9 @@ class DataPlane:
             self.unregister(name)
             return
         self._trees[name] = tree
-        if list(self._trees).index(name) != rc:
+        if name not in self._slots:
+            self._slots.append(name)
+        if self._slots.index(name) != rc:
             # Slot bookkeeping diverged from the C vector (should be
             # impossible): disable the flush lookup safely.
             log.error(
@@ -240,6 +251,8 @@ class DataPlane:
     def unregister(self, name: str) -> None:
         nm = name.encode()
         self._lib.dbeel_dp_unregister(self._handle, nm, len(nm))
+        if name in self._slots:
+            self._slots.remove(name)
         tree = self._trees.pop(name, None)
         self._table_refs.pop(name, None)
         # Drop the fingerprint too: a re-created collection with the
@@ -289,17 +302,77 @@ class DataPlane:
         )
 
     def _flush_tree_from_flags(self, flags: int):
-        """Decode bit1 (memtable-now-full) + the slot index in bits 8..
-        into the tree whose flush the caller must spawn.  Slot order
-        matches registration order (the C vector appends; the mismatch
-        guard in register_tree keeps dict and vector aligned)."""
+        """Decode bit1 (memtable-now-full) + the slot index in bits
+        8..23 into the tree whose flush the caller must spawn.  Slot
+        order matches registration order (the C vector appends; the
+        mismatch guard in register_tree keeps dict and vector
+        aligned)."""
         if not flags & 2:
             return None
-        col_idx = flags >> 8
-        trees = list(self._trees.values())
-        if 0 <= col_idx < len(trees):
-            return trees[col_idx]
+        col_idx = (flags >> 8) & 0xFFFF
+        if 0 <= col_idx < len(self._slots):
+            return self._trees.get(self._slots[col_idx])
         return None
+
+    def try_handle_coord(
+        self, frame: bytes
+    ) -> Optional[tuple]:
+        """Coordinator fast path for one RF>1 client op: the C side
+        parses the request map, performs the local half (writes:
+        memtable+WAL with a server-assigned timestamp; gets:
+        memtable+sstable lookup), and returns the fully packed peer
+        frame (4B-LE length + msgpack ShardRequest) to fan out
+        verbatim.  Returns None to punt (nothing applied), or
+        (op, peer_frame, keepalive, flush_tree, consistency,
+        timeout_ms, collection_name, local_entry) — op is
+        "set"/"delete"/"get"; consistency is None when the request
+        didn't carry a usable int; timeout_ms is 0 for absent/falsy
+        (caller applies the default); local_entry is None except for
+        gets, where it is ((value_bytes, ts)) for a hit (tombstone =
+        empty value) or ("miss",) for authoritative absence."""
+        if not self._has_coord:
+            return None
+        flags = self._lib.dbeel_dp_handle_coord(
+            self._handle,
+            frame,
+            len(frame),
+            self._get_buf,
+            _GET_BUF_CAP,
+            ctypes.byref(self._out_len),
+        )
+        if flags < 0:
+            return None
+        out = self._get_buf[: self._out_len.value]
+        peer_len = 4 + int.from_bytes(out[:4], "little")
+        peer_frame = out[:peer_len]
+        local_entry = None
+        if flags & 8:
+            op = "get"
+            trailer = out[peer_len:]
+            if trailer[0]:
+                vlen = int.from_bytes(trailer[1:5], "little")
+                ts = int.from_bytes(
+                    trailer[5:13], "little", signed=True
+                )
+                local_entry = (trailer[13 : 13 + vlen], ts)
+            else:
+                local_entry = ("miss",)
+        else:
+            op = "delete" if flags & 4 else "set"
+        col_idx = (flags >> 8) & 0xFFFF
+        cons_p1 = (flags >> 24) & 0xFF
+        return (
+            op,
+            peer_frame,
+            bool(flags & 1),
+            self._flush_tree_from_flags(flags),
+            cons_p1 - 1 if cons_p1 else None,
+            (flags >> 32) & 0x3FFFFFFF,
+            self._slots[col_idx]
+            if 0 <= col_idx < len(self._slots)
+            else None,
+            local_entry,
+        )
 
     def try_handle_shard(
         self, frame: bytes
@@ -345,6 +418,13 @@ class DataPlane:
         if self._has_shard_plane:
             out["fast_replica_ops"] = int(
                 self._lib.dbeel_dp_fast_replica_ops(self._handle)
+            )
+        if self._has_coord:
+            out["fast_coord_writes"] = int(
+                self._lib.dbeel_dp_fast_coord_writes(self._handle)
+            )
+            out["fast_coord_gets"] = int(
+                self._lib.dbeel_dp_fast_coord_gets(self._handle)
             )
         return out
 

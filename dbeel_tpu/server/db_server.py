@@ -30,7 +30,11 @@ from ..errors import (
     UnsupportedField,
 )
 from ..cluster import messages as msgs
-from ..cluster.messages import ShardRequest, ShardResponse
+from ..cluster.messages import (
+    ShardRequest,
+    ShardResponse,
+    pack_message,
+)
 from ..storage.entry import TOMBSTONE
 from ..utils.murmur import hash_bytes
 from ..utils.timestamps import now_nanos
@@ -282,6 +286,131 @@ KEEPALIVE_IDLE_TIMEOUT_S = 300.0  # reap idle keepalive connections
 _REAP_PERIOD_S = 30.0
 
 
+# Expected replica acks for the packed-fan-out byte compare (the
+# native shard plane and the Python handler both produce exactly
+# these canonical frames).
+_ACK_SET = pack_message(["response", ShardResponse.SET])
+_ACK_DELETE = pack_message(["response", ShardResponse.DELETE])
+
+
+async def _serve_coord(my_shard: MyShard, coord: tuple):
+    """Finish one RF>1 client op the native coordinator assist
+    already started: the local half is done and ``coord`` carries the
+    packed peer frame — fan it out, await the quorum acks (merging
+    get results by max timestamp), and answer the client.  Mirrors
+    handle_request's set/delete/get branches (timeout => Timeout
+    error; results beyond the ack count drain in the background with
+    hinted handoff; stale get replicas trigger read repair)."""
+    started = time.monotonic()
+    (
+        op,
+        peer_frame,
+        keepalive,
+        flush_tree,
+        consistency,
+        timeout_ms,
+        col_name,
+        local_entry,
+    ) = coord
+    if flush_tree is not None:
+        my_shard.spawn(flush_tree.flush())
+    try:
+        col = my_shard.collections.get(col_name)
+        if col is None:  # unreachable: registration keeps slots in sync
+            raise MissingField(f"collection slot for {col_name!r}")
+        rf = col.replication_factor
+        consistency = (
+            rf if consistency is None else min(consistency, rf)
+        )
+        if op == "get":
+            buf = await _finish_coord_get(
+                my_shard,
+                col_name,
+                col,
+                peer_frame,
+                local_entry,
+                consistency,
+                timeout_ms or DEFAULT_GET_TIMEOUT_MS,
+            )
+        else:
+            is_delete = op == "delete"
+            try:
+                await asyncio.wait_for(
+                    my_shard.send_packed_to_replicas(
+                        peer_frame,
+                        consistency - 1,
+                        rf - 1,
+                        _ACK_DELETE if is_delete else _ACK_SET,
+                        ShardResponse.DELETE
+                        if is_delete
+                        else ShardResponse.SET,
+                    ),
+                    (timeout_ms or DEFAULT_SET_TIMEOUT_MS) / 1000,
+                )
+            except asyncio.TimeoutError as e:
+                raise Timeout(op) from e
+            buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
+    except Exception as e:  # defensive: never kill the connection task
+        buf = _error_response(e)
+    my_shard.metrics.record_request(op, started)
+    return buf, keepalive
+
+
+async def _finish_coord_get(
+    my_shard: MyShard,
+    col_name: str,
+    col,
+    peer_frame: bytes,
+    local_entry,
+    consistency: int,
+    timeout_ms: int,
+) -> bytes:
+    """Quorum-merge for a coordinator-assisted get: fan the packed
+    peer frame out, combine replica results with the native local
+    lookup by max server timestamp (db_server.rs:353-363), spawn read
+    repair for stale replicas, and build the client response."""
+    remote = my_shard.send_packed_to_replicas(
+        peer_frame,
+        consistency - 1,
+        col.replication_factor - 1,
+        b"",  # no constant ack for gets: always unpack
+        ShardResponse.GET,
+    )
+    try:
+        values = await asyncio.wait_for(remote, timeout_ms / 1000)
+    except asyncio.TimeoutError as e:
+        raise Timeout("get") from e
+    entries = [
+        (bytes(v[0]), v[1]) for v in values if v is not None
+    ]
+    stale_acks = sum(1 for v in values if v is None)
+    if local_entry is not None and local_entry[0] != "miss":
+        entries.append((bytes(local_entry[0]), local_entry[1]))
+    else:
+        stale_acks += 1
+    key = None
+    if entries:
+        win_value, win_ts = max(entries, key=lambda e: e[1])
+        if stale_acks or any(ts != win_ts for _v, ts in entries):
+            key = msgs.unpack_message(peer_frame[4:])[3]
+            my_shard.spawn(
+                _read_repair(
+                    my_shard,
+                    col_name,
+                    col,
+                    key,
+                    win_value,
+                    win_ts,
+                    col.replication_factor - 1,
+                )
+            )
+        if win_value != TOMBSTONE:
+            return win_value + bytes([RESPONSE_OK])
+    if key is None:
+        key = msgs.unpack_message(peer_frame[4:])[3]
+    raise KeyNotFound(repr(key))
+
+
 async def _serve_frame(my_shard: MyShard, request_buf: bytes):
     """One request frame → (response bytes incl. trailing type byte,
     keepalive?)."""
@@ -302,19 +431,26 @@ async def _serve_frame(my_shard: MyShard, request_buf: bytes):
             buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
         else:
             buf = payload + bytes([RESPONSE_OK])
-    except DbeelError as e:
-        if not isinstance(e, KeyNotFound):
-            log.error("error handling request: %r", e)
-        buf = msgpack.packb(e.to_wire(), use_bin_type=True) + bytes(
-            [RESPONSE_ERR]
-        )
     except Exception as e:  # defensive: never kill the connection task
-        log.exception("unexpected error handling request")
-        buf = msgpack.packb(
-            ["Internal", str(e)], use_bin_type=True
-        ) + bytes([RESPONSE_ERR])
+        buf = _error_response(e)
     my_shard.metrics.record_request(op, started)
     return buf, keepalive
+
+
+def _error_response(e: Exception) -> bytes:
+    """The error wire envelope, shared by the slow path and the
+    coordinator fast path so the two can never diverge.  Must be
+    called from an except block (log.exception)."""
+    if isinstance(e, DbeelError):
+        if not isinstance(e, KeyNotFound):
+            log.error("error handling request: %r", e)
+        return msgpack.packb(e.to_wire(), use_bin_type=True) + bytes(
+            [RESPONSE_ERR]
+        )
+    log.exception("unexpected error handling request")
+    return msgpack.packb(
+        ["Internal", str(e)], use_bin_type=True
+    ) + bytes([RESPONSE_ERR])
 
 
 class _DbProtocol(framed.FramedServerProtocol):
@@ -378,7 +514,17 @@ class _DbProtocol(framed.FramedServerProtocol):
         return framed.FAST_HANDLED
 
     async def _serve_one(self, frame: bytes) -> bool:
-        buf, keepalive = await _serve_frame(self.shard, frame)
+        # Native coordinator assist for RF>1 writes: the C side
+        # parses + applies the local write and hands back the packed
+        # peer frame; only the fan-out/quorum brain stays here.
+        dp = self.shard.dataplane
+        coord = (
+            dp.try_handle_coord(frame) if dp is not None else None
+        )
+        if coord is not None:
+            buf, keepalive = await _serve_coord(self.shard, coord)
+        else:
+            buf, keepalive = await _serve_frame(self.shard, frame)
         if self.closing:
             return False
         await self.writable.wait()

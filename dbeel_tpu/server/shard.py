@@ -627,6 +627,54 @@ class MyShard:
         shards on the ring; return after ``number_of_acks`` successes,
         drain the rest in the background.  Failed mutations become
         hints for the unreachable node."""
+        return await self._fan_out_to_replicas(
+            lambda c: c.send_request(request),
+            lambda resp: msgs.response_to_result(
+                resp, expected_kind
+            ),
+            lambda: request,
+            number_of_acks,
+            number_of_nodes,
+        )
+
+    async def send_packed_to_replicas(
+        self,
+        framed: bytes,
+        number_of_acks: int,
+        number_of_nodes: int,
+        expected_ack: bytes,
+        expected_kind: str,
+    ) -> List:
+        """send_request_to_replicas for a PRE-PACKED peer frame (the
+        native coordinator's output): the frame bytes go out verbatim
+        on each replica stream, and each raw response payload is
+        byte-compared against ``expected_ack`` — msgpack unpacking
+        happens only on mismatch (error responses) or when a failed
+        replica's hint needs the request as a list."""
+
+        def interpret(payload: bytes):
+            if payload == expected_ack:
+                return None
+            return msgs.response_to_result(
+                msgs.unpack_message(payload), expected_kind
+            )
+
+        return await self._fan_out_to_replicas(
+            lambda c: c.send_packed(framed),
+            interpret,
+            lambda: msgs.unpack_message(framed[4:]),
+            number_of_acks,
+            number_of_nodes,
+        )
+
+    async def _fan_out_to_replicas(
+        self,
+        send_fn,
+        interpret_fn,
+        hint_request_fn,
+        number_of_acks: int,
+        number_of_nodes: int,
+    ) -> List:
         nodes: set = set()
         connections: List[tuple] = []
         for s in self.shards:
@@ -645,7 +693,7 @@ class MyShard:
 
         async def fan_out():
             fut_node = {
-                asyncio.ensure_future(c.send_request(request)): name
+                asyncio.ensure_future(send_fn(c)): name
                 for name, c in connections
             }
             pending = set(fut_node)
@@ -661,11 +709,8 @@ class MyShard:
                     )
                     for fut in done:
                         try:
-                            response = fut.result()
                             results.append(
-                                msgs.response_to_result(
-                                    response, expected_kind
-                                )
+                                interpret_fn(fut.result())
                             )
                             acks += 1
                         except (Timeout, ConnectionError_) as e:
@@ -674,7 +719,7 @@ class MyShard:
                                 "unreachable replica: %s", e
                             )
                             self._record_hint(
-                                fut_node[fut], request
+                                fut_node[fut], hint_request_fn()
                             )
                         except DbeelError as e:
                             # Application-level error from a LIVE
@@ -692,7 +737,7 @@ class MyShard:
                     await fut
                 except (Timeout, ConnectionError_) as e:
                     log.error("replica request in background: %s", e)
-                    self._record_hint(fut_node[fut], request)
+                    self._record_hint(fut_node[fut], hint_request_fn())
                 except Exception as e:
                     log.error("replica request in background: %s", e)
 

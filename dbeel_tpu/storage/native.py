@@ -226,6 +226,22 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.dbeel_dp_fast_replica_ops.restype = ctypes.c_uint64
             lib.dbeel_dp_fast_replica_ops.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "dbeel_dp_handle_coord"):
+            lib.dbeel_dp_handle_coord.restype = ctypes.c_int64
+            lib.dbeel_dp_handle_coord.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.dbeel_dp_fast_coord_writes.restype = ctypes.c_uint64
+            lib.dbeel_dp_fast_coord_writes.argtypes = [
+                ctypes.c_void_p
+            ]
+            lib.dbeel_dp_fast_coord_gets.restype = ctypes.c_uint64
+            lib.dbeel_dp_fast_coord_gets.argtypes = [ctypes.c_void_p]
         lib.dbeel_dp_unregister.restype = None
         lib.dbeel_dp_unregister.argtypes = [
             ctypes.c_void_p,

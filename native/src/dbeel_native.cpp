@@ -1350,7 +1350,8 @@ struct DataPlane {
   int32_t own_mode = 0;
   uint32_t own_lo = 0, own_hi = 0;
   uint64_t fast_sets = 0, fast_gets = 0, fast_table_gets = 0;
-  uint64_t fast_replica_ops = 0;
+  uint64_t fast_replica_ops = 0, fast_coord_writes = 0;
+  uint64_t fast_coord_gets = 0;
   std::vector<uint8_t> keybuf;  // probe scratch (grown on demand)
   std::vector<uint8_t> valbuf;  // table_find value scratch
 };
@@ -1807,6 +1808,140 @@ uint64_t dbeel_dp_fast_table_gets(void* h) {
 uint64_t dbeel_dp_fast_replica_ops(void* h) {
   return static_cast<DataPlane*>(h)->fast_replica_ops;
 }
+uint64_t dbeel_dp_fast_coord_writes(void* h) {
+  return static_cast<DataPlane*>(h)->fast_coord_writes;
+}
+uint64_t dbeel_dp_fast_coord_gets(void* h) {
+  return static_cast<DataPlane*>(h)->fast_coord_gets;
+}
+
+// One parsed client-API request frame (db_server.py request map),
+// shared by the RF=1 fast path (dbeel_dp_handle) and the RF>1
+// coordinator assist (dbeel_dp_handle_coord).
+struct ClientFrame {
+  const uint8_t *type_s = nullptr, *coll_s = nullptr;
+  uint32_t type_n = 0, coll_n = 0;
+  const uint8_t *key_raw = nullptr, *val_raw = nullptr;
+  uint32_t key_n = 0, val_n = 0;
+  uint64_t hash_v = 0;
+  bool have_hash = false, keepalive = false;
+  uint64_t replica_index = 0;
+  // Coordinator extras.  Python semantics: consistency is used only
+  // if an int (else rf); timeout falls to the default when falsy.
+  bool have_consistency = false;
+  uint64_t consistency = 0;
+  uint64_t timeout_ms = 0;  // 0 = absent/falsy => caller default
+};
+
+// Parse the msgpack request map.  false => punt to Python (unknown
+// encodings, non-canonical forms — Python then judges semantics).
+static bool dp_parse_client_frame(const uint8_t* frame, uint32_t len,
+                                  ClientFrame* f) {
+  MpCur c{frame, frame + len};
+  if (!mp_need(c, 1)) return false;
+  uint64_t nfields;
+  {
+    const uint8_t b = *c.p++;
+    if (b >= 0x80 && b <= 0x8f) {
+      nfields = b & 0x0f;
+    } else if (b == 0xde) {
+      if (!mp_need(c, 2)) return false;
+      nfields = ((uint64_t)c.p[0] << 8) | c.p[1];
+      c.p += 2;
+    } else if (b == 0xdf) {
+      if (!mp_need(c, 4)) return false;
+      nfields = ((uint64_t)c.p[0] << 24) | ((uint64_t)c.p[1] << 16) |
+                ((uint64_t)c.p[2] << 8) | c.p[3];
+      c.p += 4;
+    } else {
+      return false;
+    }
+  }
+  for (uint64_t i = 0; i < nfields; i++) {
+    const uint8_t* ks;
+    uint32_t kn;
+    if (!mp_read_str(c, &ks, &kn)) return false;
+    const uint8_t* vstart = c.p;
+    if (slice_eq(ks, kn, "type")) {
+      if (!mp_read_str(c, &f->type_s, &f->type_n)) return false;
+    } else if (slice_eq(ks, kn, "collection")) {
+      if (!mp_read_str(c, &f->coll_s, &f->coll_n)) return false;
+    } else if (slice_eq(ks, kn, "key")) {
+      if (!mp_skip(c, 0)) return false;
+      f->key_raw = vstart;
+      f->key_n = (uint32_t)(c.p - vstart);
+    } else if (slice_eq(ks, kn, "value")) {
+      if (!mp_skip(c, 0)) return false;
+      f->val_raw = vstart;
+      f->val_n = (uint32_t)(c.p - vstart);
+    } else if (slice_eq(ks, kn, "hash")) {
+      // Python uses ANY int (incl. bools and huge values) verbatim;
+      // only canonical u32-range uints match that semantics here —
+      // everything else punts so both paths agree.  nil counts as
+      // absent (Python recomputes the murmur hash then).
+      if (!mp_need(c, 1)) return false;
+      if (*c.p == 0xc0) {
+        c.p++;
+      } else if (mp_read_uint(c, &f->hash_v) &&
+                 f->hash_v <= 0xFFFFFFFFull) {
+        f->have_hash = true;
+      } else {
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "replica_index")) {
+      // nil => 0 like Python's `get(...) or 0`; non-uint values
+      // (bools, negatives) punt — Python's truthiness rules decide.
+      if (!mp_need(c, 1)) return false;
+      if (*c.p == 0xc0) {
+        c.p++;
+        f->replica_index = 0;
+      } else if (!mp_read_uint(c, &f->replica_index)) {
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "keepalive")) {
+      if (!mp_need(c, 1)) return false;
+      const uint8_t b = *c.p;
+      if (b == 0xc3) {
+        f->keepalive = true;
+        c.p++;
+      } else if (b == 0xc2 || b == 0xc0) {
+        c.p++;
+      } else {
+        // Truthiness of non-bools: punt, Python decides.
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "consistency")) {
+      // Python: used only when isinstance(int); nil counts as
+      // absent.  Canonical uints small enough to be a real quorum
+      // count pass through; bools/negatives/huge punt.
+      if (!mp_need(c, 1)) return false;
+      if (*c.p == 0xc0) {
+        c.p++;
+      } else if (mp_read_uint(c, &f->consistency) &&
+                 f->consistency <= 250) {
+        f->have_consistency = true;
+      } else {
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "timeout")) {
+      // Python: `get("timeout") or DEFAULT` — falsy selects the
+      // default.  nil/false/0 => 0 (caller default); canonical
+      // sane uints pass; anything else punts.
+      if (!mp_need(c, 1)) return false;
+      if (*c.p == 0xc0 || *c.p == 0xc2) {
+        c.p++;
+      } else if (!mp_read_uint(c, &f->timeout_ms) ||
+                 f->timeout_ms > 1000000000ull) {
+        return false;
+      }
+    } else {
+      if (!mp_skip(c, 0)) return false;
+    }
+  }
+  if (c.p != c.end) return false;  // trailing bytes: Python judges
+  return f->type_s != nullptr && f->coll_s != nullptr &&
+         f->key_raw != nullptr;
+}
 
 // Handle one request frame entirely natively if possible.
 // Returns -1 to punt to the Python handler; otherwise a flags word:
@@ -1821,93 +1956,15 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
                         uint32_t* out_len) try {
   auto* dp = static_cast<DataPlane*>(h);
   if (dp->own_mode == 0) return -1;
-  MpCur c{frame, frame + len};
-  if (!mp_need(c, 1)) return -1;
-  uint64_t nfields;
-  {
-    const uint8_t b = *c.p++;
-    if (b >= 0x80 && b <= 0x8f) {
-      nfields = b & 0x0f;
-    } else if (b == 0xde) {
-      if (!mp_need(c, 2)) return -1;
-      nfields = ((uint64_t)c.p[0] << 8) | c.p[1];
-      c.p += 2;
-    } else if (b == 0xdf) {
-      if (!mp_need(c, 4)) return -1;
-      nfields = ((uint64_t)c.p[0] << 24) | ((uint64_t)c.p[1] << 16) |
-                ((uint64_t)c.p[2] << 8) | c.p[3];
-      c.p += 4;
-    } else {
-      return -1;
-    }
-  }
-  const uint8_t *type_s = nullptr, *coll_s = nullptr;
-  uint32_t type_n = 0, coll_n = 0;
-  const uint8_t *key_raw = nullptr, *val_raw = nullptr;
-  uint32_t key_n = 0, val_n = 0;
-  uint64_t hash_v = 0;
-  bool have_hash = false, keepalive = false;
-  uint64_t replica_index = 0;
-  for (uint64_t i = 0; i < nfields; i++) {
-    const uint8_t* ks;
-    uint32_t kn;
-    if (!mp_read_str(c, &ks, &kn)) return -1;
-    const uint8_t* vstart = c.p;
-    if (slice_eq(ks, kn, "type")) {
-      if (!mp_read_str(c, &type_s, &type_n)) return -1;
-    } else if (slice_eq(ks, kn, "collection")) {
-      if (!mp_read_str(c, &coll_s, &coll_n)) return -1;
-    } else if (slice_eq(ks, kn, "key")) {
-      if (!mp_skip(c, 0)) return -1;
-      key_raw = vstart;
-      key_n = (uint32_t)(c.p - vstart);
-    } else if (slice_eq(ks, kn, "value")) {
-      if (!mp_skip(c, 0)) return -1;
-      val_raw = vstart;
-      val_n = (uint32_t)(c.p - vstart);
-    } else if (slice_eq(ks, kn, "hash")) {
-      // Python uses ANY int (incl. bools and huge values) verbatim;
-      // only canonical u32-range uints match that semantics here —
-      // everything else punts so both paths agree.  nil counts as
-      // absent (Python recomputes the murmur hash then).
-      if (!mp_need(c, 1)) return -1;
-      if (*c.p == 0xc0) {
-        c.p++;
-      } else if (mp_read_uint(c, &hash_v) &&
-                 hash_v <= 0xFFFFFFFFull) {
-        have_hash = true;
-      } else {
-        return -1;
-      }
-    } else if (slice_eq(ks, kn, "replica_index")) {
-      // nil => 0 like Python's `get(...) or 0`; non-uint values
-      // (bools, negatives) punt — Python's truthiness rules decide.
-      if (!mp_need(c, 1)) return -1;
-      if (*c.p == 0xc0) {
-        c.p++;
-        replica_index = 0;
-      } else if (!mp_read_uint(c, &replica_index)) {
-        return -1;
-      }
-    } else if (slice_eq(ks, kn, "keepalive")) {
-      if (!mp_need(c, 1)) return -1;
-      const uint8_t b = *c.p;
-      if (b == 0xc3) {
-        keepalive = true;
-        c.p++;
-      } else if (b == 0xc2 || b == 0xc0) {
-        c.p++;
-      } else {
-        // Truthiness of non-bools: punt, Python decides.
-        return -1;
-      }
-    } else {
-      if (!mp_skip(c, 0)) return -1;
-    }
-  }
-  if (c.p != c.end) return -1;  // trailing bytes: let Python judge
-  if (type_s == nullptr || coll_s == nullptr || key_raw == nullptr)
-    return -1;
+  ClientFrame f;
+  if (!dp_parse_client_frame(frame, len, &f)) return -1;
+  const uint8_t *type_s = f.type_s, *coll_s = f.coll_s;
+  const uint32_t type_n = f.type_n, coll_n = f.coll_n;
+  const uint8_t *key_raw = f.key_raw, *val_raw = f.val_raw;
+  const uint32_t key_n = f.key_n, val_n = f.val_n;
+  const uint64_t hash_v = f.hash_v;
+  const bool have_hash = f.have_hash, keepalive = f.keepalive;
+  const uint64_t replica_index = f.replica_index;
   // Key identity parity: the Python path stores keys RE-ENCODED by
   // msgpack-python, the C path the raw wire slice.  Any key whose
   // encoding isn't already canonical must punt (write AND read), or
@@ -2071,6 +2128,27 @@ size_t mp_put_int64(uint8_t* o, int64_t v) {
   const uint64_t u = (uint64_t)v;
   for (int i = 0; i < 8; i++) o[1 + i] = (uint8_t)(u >> (56 - 8 * i));
   return 9;
+}
+
+size_t mp_put_strhdr(uint8_t* o, uint32_t n) {
+  if (n <= 31) {
+    o[0] = (uint8_t)(0xa0 | n);
+    return 1;
+  }
+  if (n <= 0xff) {
+    o[0] = 0xd9;
+    o[1] = (uint8_t)n;
+    return 2;
+  }
+  if (n <= 0xffff) {
+    o[0] = 0xda;
+    o[1] = (uint8_t)(n >> 8);
+    o[2] = (uint8_t)n;
+    return 3;
+  }
+  o[0] = 0xdb;
+  for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(n >> (24 - 8 * i));
+  return 5;
 }
 
 size_t mp_put_binhdr(uint8_t* o, uint32_t n) {
@@ -2307,6 +2385,176 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     flags |= 4;
   }
   dp->fast_replica_ops++;
+  return flags;
+} catch (...) {
+  return -1;
+}
+
+// Coordinator assist for RF>1 client ops (set/delete/get on a
+// replica-plane-only collection): parse the client request map,
+// perform the LOCAL half (writes: memtable + WAL with a
+// server-assigned CLOCK_REALTIME-ns timestamp — the coordinator is
+// replica 0; gets: memtable + sstable lookup), and emit into `out`
+// the fully packed peer frame (4B-LE length + msgpack
+// ["request","set",coll,key,value,ts] / ["request","delete",coll,
+// key,ts] / ["request","get",coll,key]) ready to write verbatim to
+// each replica stream.  For gets the peer frame is followed by the
+// local lookup result: u8 found, u32 vlen, i64 ts, value bytes.
+// Python keeps the replication brain: it picks the replica
+// connections, awaits the quorum acks, merges get results by max
+// timestamp, and answers the client (shards.rs:500-539,
+// db_server.rs:353-363 parity).  Returns -1 to punt (nothing
+// applied); otherwise flags:
+//   bit0 keepalive, bit1 memtable-now-full (spawn the flush),
+//   bit2 delete, bit3 get, bits 8..23 collection slot,
+//   bits 24..31 consistency+1 from the request (0 = absent),
+//   bits 32..61 timeout_ms from the request (0 = absent/falsy).
+int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
+                              uint32_t len, uint8_t* out,
+                              uint32_t out_cap,
+                              uint32_t* out_len) try {
+  auto* dp = static_cast<DataPlane*>(h);
+  *out_len = 0;
+  if (dp->own_mode == 0) return -1;
+  ClientFrame f;
+  if (!dp_parse_client_frame(frame, len, &f)) return -1;
+  if (!mp_key_canonical(f.key_raw, f.key_n)) return -1;
+  const bool is_set = slice_eq(f.type_s, f.type_n, "set");
+  const bool is_del = slice_eq(f.type_s, f.type_n, "delete");
+  const bool is_get = slice_eq(f.type_s, f.type_n, "get");
+  if (!is_set && !is_del && !is_get) return -1;
+  if (is_set && f.val_raw == nullptr) return -1;
+  if (f.replica_index != 0) return -1;
+
+  FastCollection* col = nullptr;
+  int32_t col_idx = -1;
+  for (size_t i = 0; i < dp->cols.size(); i++) {
+    if (dp->cols[i].name.size() == f.coll_n &&
+        std::memcmp(dp->cols[i].name.data(), f.coll_s, f.coll_n) ==
+            0) {
+      col = &dp->cols[i];
+      col_idx = (int32_t)i;
+      break;
+    }
+  }
+  if (col == nullptr) return -1;
+  if (col->client_ok) return -1;  // RF=1: plain fast path territory
+  if (!is_get && col->wal == nullptr) return -1;
+
+  const uint32_t key_hash = f.have_hash
+                                ? (uint32_t)f.hash_v
+                                : murmur3_32(f.key_raw, f.key_n, 0);
+  if (dp->own_mode == 2) {
+    const bool owned =
+        dp->own_lo < dp->own_hi
+            ? (key_hash > dp->own_lo && key_hash <= dp->own_hi)
+            : (key_hash > dp->own_lo || key_hash <= dp->own_hi);
+    if (!owned) return -1;
+  }
+
+  const int64_t base_flags =
+      (f.keepalive ? 1 : 0) | (((int64_t)col_idx & 0xFFFF) << 8) |
+      ((int64_t)(f.have_consistency ? f.consistency + 1 : 0) << 24) |
+      ((int64_t)f.timeout_ms << 32);
+
+  if (is_get) {
+    const uint8_t* v = nullptr;
+    uint32_t vn = 0;
+    int64_t ets = 0;
+    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
+    const int found = col_find(dp, col, f.key_raw, f.key_n,
+                               dp->valbuf.data(), kDpValMax, &v, &vn,
+                               &ets);
+    if (found < 0) return -1;  // cold page: Python async read path
+    // Worst-case fixed overhead: 1 (array) + 8 ("request") + 7
+    // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9 (int64) = 40.
+    const uint64_t need =
+        4ull + 40 + f.coll_n + f.key_n + 13ull + vn;
+    if (need > out_cap) return -1;
+    uint8_t* o = out + 4;
+    size_t n = 0;
+    o[n++] = 0x94;
+    o[n++] = 0xa7;
+    std::memcpy(o + n, "request", 7);
+    n += 7;
+    o[n++] = 0xa3;
+    std::memcpy(o + n, "get", 3);
+    n += 3;
+    n += mp_put_strhdr(o + n, f.coll_n);
+    std::memcpy(o + n, f.coll_s, f.coll_n);
+    n += f.coll_n;
+    n += mp_put_binhdr(o + n, f.key_n);
+    std::memcpy(o + n, f.key_raw, f.key_n);
+    n += f.key_n;
+    const uint32_t n32 = (uint32_t)n;
+    std::memcpy(out, &n32, 4);
+    uint8_t* t = out + 4 + n;
+    t[0] = found ? 1 : 0;
+    std::memcpy(t + 1, &vn, 4);
+    std::memcpy(t + 5, &ets, 8);
+    if (found && vn != 0) std::memcpy(t + 13, v, vn);
+    *out_len = 4 + n32 + 13 + (found ? vn : 0);
+    dp->fast_coord_gets++;
+    return base_flags | 8;
+  }
+
+  // Peer-frame capacity check BEFORE the write (a post-write punt
+  // would re-run the frame through Python and double-apply).  Fixed
+  // overhead budgeted at the worst case (see the get branch): the
+  // delete kind ("delete", 7) + 5-byte str/bin headers peak at 35.
+  const uint64_t need = 4ull + 40 + f.coll_n + f.key_n +
+                        (is_set ? (uint64_t)f.val_n + 5 : 0);
+  if (need > out_cap) return -1;
+
+  struct timespec tsp;
+  clock_gettime(CLOCK_REALTIME, &tsp);
+  const int64_t ts =
+      (int64_t)tsp.tv_sec * 1000000000ll + tsp.tv_nsec;
+  uint32_t old_len = 0;
+  if (dbeel_memtable_set(col->active, f.key_raw, f.key_n,
+                         is_set ? f.val_raw : nullptr,
+                         is_set ? f.val_n : 0, ts, &old_len) < 0)
+    return -1;  // capacity/alloc: Python waits for the flush
+  if (dbeel_wal_append(col->wal, f.key_raw, f.key_n,
+                       is_set ? f.val_raw : nullptr,
+                       is_set ? f.val_n : 0, ts) == 0)
+    return -1;  // wal IO error: Python path surfaces it properly
+
+  uint8_t* o = out + 4;
+  size_t n = 0;
+  o[n++] = is_set ? 0x96 : 0x95;
+  o[n++] = 0xa7;
+  std::memcpy(o + n, "request", 7);
+  n += 7;
+  if (is_set) {
+    o[n++] = 0xa3;
+    std::memcpy(o + n, "set", 3);
+    n += 3;
+  } else {
+    o[n++] = 0xa6;
+    std::memcpy(o + n, "delete", 6);
+    n += 6;
+  }
+  n += mp_put_strhdr(o + n, f.coll_n);
+  std::memcpy(o + n, f.coll_s, f.coll_n);
+  n += f.coll_n;
+  n += mp_put_binhdr(o + n, f.key_n);
+  std::memcpy(o + n, f.key_raw, f.key_n);
+  n += f.key_n;
+  if (is_set) {
+    n += mp_put_binhdr(o + n, f.val_n);
+    std::memcpy(o + n, f.val_raw, f.val_n);
+    n += f.val_n;
+  }
+  n += mp_put_int64(o + n, ts);
+  const uint32_t n32 = (uint32_t)n;
+  std::memcpy(out, &n32, 4);
+  *out_len = 4 + n32;
+  dp->fast_coord_writes++;
+
+  int64_t flags = base_flags;
+  if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (is_del) flags |= 4;
   return flags;
 } catch (...) {
   return -1;

@@ -478,7 +478,18 @@ def test_replica_plane_served_natively(tmp_dir):
                         total += dp.stats().get("fast_replica_ops", 0)
                 return total
 
+            def coord_writes():
+                total = 0
+                for n in nodes:
+                    dp = n.shards[0].dataplane
+                    if dp is not None:
+                        total += dp.stats().get(
+                            "fast_coord_writes", 0
+                        )
+                return total
+
             r0 = replica_ops()
+            c0 = coord_writes()
             for i in range(20):
                 await col.set(
                     f"k{i}", {"i": i}, consistency=Consistency.ALL
@@ -490,6 +501,24 @@ def test_replica_plane_served_natively(tmp_dir):
             await col.delete("k0", consistency=Consistency.ALL)
             r1 = replica_ops()
             if native_available():
+                # Every quorum WRITE rides the coordinator assist on
+                # whichever node owns the key (21 writes total; the
+                # odd one may punt around a flush).
+                assert coord_writes() - c0 >= 18, (
+                    f"coordinator assist barely engaged "
+                    f"({coord_writes() - c0})"
+                )
+                coord_gets = sum(
+                    n.shards[0].dataplane.stats().get(
+                        "fast_coord_gets", 0
+                    )
+                    for n in nodes
+                    if n.shards[0].dataplane is not None
+                )
+                assert coord_gets >= 18, (
+                    f"coordinator get assist barely engaged "
+                    f"({coord_gets})"
+                )
                 # 20 sets + 20 gets + 1 delete, each fanned to 2
                 # replicas => >= 60 native replica ops (flush timing
                 # may route a handful through the Python path).

@@ -201,8 +201,9 @@ def test_rf_gt_1_and_unknown_types_punt(tmp_dir, arun):
                 },
             )
             s0, g0 = _fast_counts(node)
-            # RF=3 collection is not registered: Python path serves it
-            # (single node => local write + background fan-out drain).
+            # RF=3 collections never touch the RF=1 CLIENT fast path
+            # (fast_sets/fast_gets stay put) — they are served by the
+            # coordinator assist + replica plane instead.
             payload, t = await _request(
                 port,
                 {
@@ -674,6 +675,96 @@ def test_non_minimal_key_encoding_punts(tmp_dir, arun):
             s1, _g1 = _fast_counts(node)
             assert s1 == s0, "non-minimal key set took the fast path"
         finally:
+            await node.stop()
+
+    arun(body())
+
+
+def test_coordinator_assist_emits_exact_peer_frames(tmp_dir, arun):
+    """RF>1 client writes ride dbeel_dp_handle_coord: the local write
+    applies natively with a server-assigned timestamp and the emitted
+    peer frame must be BYTE-IDENTICAL to what the Python path would
+    pack (pack_message of the ShardRequest) — proven by unpack →
+    re-pack equality, which also proves canonical encoding."""
+
+    async def body():
+        from dbeel_tpu.cluster.messages import (
+            pack_message,
+            unpack_message,
+        )
+        from dbeel_tpu.server.shard import MyShard
+
+        node = await _start_node(tmp_dir)
+        captured = []
+        real = MyShard.send_packed_to_replicas
+
+        async def spy(self, framed, acks, nodes, ack, kind):
+            captured.append((framed, acks, nodes, ack, kind))
+            return []
+
+        MyShard.send_packed_to_replicas = spy
+        try:
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "co",
+                    "replication_factor": 2,
+                },
+            )
+            dp = node.shards[0].dataplane
+            c0 = dp.stats().get("fast_coord_writes", 0)
+            t0 = 1_000_000_000_000_000_000  # sanity floor for ts
+            payload, t = await _request(
+                port,
+                {
+                    "type": "set",
+                    "collection": "co",
+                    "key": "ck",
+                    "value": {"v": 9},
+                    "consistency": 1,
+                    "timeout": 1234,
+                },
+            )
+            assert t == 2 and msgpack.unpackb(payload) == "OK"
+            payload, t = await _request(
+                port, {"type": "delete", "collection": "co", "key": "ck"}
+            )
+            assert t == 2
+            assert dp.stats()["fast_coord_writes"] == c0 + 2
+            assert len(captured) == 2
+
+            framed, acks, nodes, ack, kind = captured[0]
+            assert (acks, nodes, kind) == (0, 1, "set")  # consistency=1
+            body_bytes = framed[4:]
+            assert int.from_bytes(framed[:4], "little") == len(body_bytes)
+            msg = unpack_message(body_bytes)
+            assert msg[:3] == ["request", "set", "co"]
+            assert msg[3] == msgpack.packb("ck", use_bin_type=True)
+            assert msg[4] == msgpack.packb(
+                {"v": 9}, use_bin_type=True
+            )
+            assert isinstance(msg[5], int) and msg[5] > t0
+            # Canonicality: re-packing reproduces the exact bytes.
+            assert pack_message(msg) == body_bytes
+
+            framed, acks, nodes, ack, kind = captured[1]
+            assert (acks, nodes, kind) == (1, 1, "delete")  # default rf=2
+            msg = unpack_message(framed[4:])
+            assert msg[:3] == ["request", "delete", "co"]
+            assert msg[3] == msgpack.packb("ck", use_bin_type=True)
+            assert len(msg) == 5 and isinstance(msg[4], int)
+            assert pack_message(msg) == framed[4:]
+
+            # The local write really applied (tombstone wins now).
+            tree = node.shards[0].collections["co"].tree
+            assert (
+                await tree.get(msgpack.packb("ck", use_bin_type=True))
+                is None
+            )
+        finally:
+            MyShard.send_packed_to_replicas = real
             await node.stop()
 
     arun(body())
