@@ -135,6 +135,13 @@ class DataPlane:
         replica plane only: peer set/delete/get messages are served
         natively, but client-facing frames punt to Python, which owns
         the replication/consistency fan-out."""
+        if not client_plane and not self._has_shard_plane:
+            # ABI safety gate, owned HERE so no call site can bypass
+            # it: a stale pinned .so (old 7-arg register, no client_ok
+            # flag) would otherwise fast-serve replicated client
+            # writes with NO quorum fan-out.
+            self.unregister(name)
+            return
         if not self.tree_eligible(tree):
             self.unregister(name)
             return

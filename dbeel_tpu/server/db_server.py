@@ -207,41 +207,15 @@ async def handle_request(
                 )
             except asyncio.TimeoutError as e:
                 raise Timeout("get") from e
-            entries = [
-                (bytes(v[0]), v[1]) for v in values if v is not None
-            ]
-            stale_acks = sum(1 for v in values if v is None)
-            if local_value is not None:
-                entries.append(local_value)
-            else:
-                stale_acks += 1
-            # Conflict resolution: max server timestamp wins
-            # (db_server.rs:353-363).
-            if entries:
-                win_value, win_ts = max(entries, key=lambda e: e[1])
-                # Read repair (improvement over the reference, which
-                # has none — SURVEY §5): any replica that answered with
-                # a missing or older entry gets the winning version
-                # re-propagated in the background.  Idempotent: replicas
-                # keep the newest timestamp; duplicates collapse at
-                # compaction.
-                if stale_acks or any(
-                    ts != win_ts for _v, ts in entries
-                ):
-                    my_shard.spawn(
-                        _read_repair(
-                            my_shard,
-                            collection_name,
-                            col,
-                            key,
-                            win_value,
-                            win_ts,
-                            rf - replica_index - 1,
-                        )
-                    )
-                if win_value != TOMBSTONE:
-                    return win_value
-            raise KeyNotFound(repr(key))
+            return _merge_quorum_get(
+                my_shard,
+                collection_name,
+                col,
+                key,
+                local_value,
+                values,
+                rf - replica_index - 1,
+            )
         try:
             value = await asyncio.wait_for(
                 col.tree.get(key), timeout_ms / 1000
@@ -255,6 +229,50 @@ async def handle_request(
     if isinstance(rtype, str):
         raise UnsupportedField(rtype)
     raise BadFieldType("type")
+
+
+def _merge_quorum_get(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    key: bytes,
+    local_value,
+    values,
+    number_of_nodes: int,
+) -> bytes:
+    """The RF>1 get merge brain, shared by the Python punt path and
+    the coordinator-assist path so the two can never diverge.
+    Conflict resolution: max server timestamp wins
+    (db_server.rs:353-363).  Read repair (improvement over the
+    reference, which has none — SURVEY §5): any replica that answered
+    with a missing or older entry gets the winning version
+    re-propagated in the background; idempotent, since replicas keep
+    the newest timestamp and duplicates collapse at compaction.
+    Returns the winning value or raises KeyNotFound
+    (tombstone/absence)."""
+    entries = [(bytes(v[0]), v[1]) for v in values if v is not None]
+    stale_acks = sum(1 for v in values if v is None)
+    if local_value is not None:
+        entries.append((bytes(local_value[0]), local_value[1]))
+    else:
+        stale_acks += 1
+    if entries:
+        win_value, win_ts = max(entries, key=lambda e: e[1])
+        if stale_acks or any(ts != win_ts for _v, ts in entries):
+            my_shard.spawn(
+                _read_repair(
+                    my_shard,
+                    collection_name,
+                    col,
+                    key,
+                    win_value,
+                    win_ts,
+                    number_of_nodes,
+                )
+            )
+        if win_value != TOMBSTONE:
+            return win_value
+    raise KeyNotFound(repr(key))
 
 
 async def _read_repair(
@@ -380,35 +398,22 @@ async def _finish_coord_get(
         values = await asyncio.wait_for(remote, timeout_ms / 1000)
     except asyncio.TimeoutError as e:
         raise Timeout("get") from e
-    entries = [
-        (bytes(v[0]), v[1]) for v in values if v is not None
-    ]
-    stale_acks = sum(1 for v in values if v is None)
-    if local_entry is not None and local_entry[0] != "miss":
-        entries.append((bytes(local_entry[0]), local_entry[1]))
-    else:
-        stale_acks += 1
-    key = None
-    if entries:
-        win_value, win_ts = max(entries, key=lambda e: e[1])
-        if stale_acks or any(ts != win_ts for _v, ts in entries):
-            key = msgs.unpack_message(peer_frame[4:])[3]
-            my_shard.spawn(
-                _read_repair(
-                    my_shard,
-                    col_name,
-                    col,
-                    key,
-                    win_value,
-                    win_ts,
-                    col.replication_factor - 1,
-                )
-            )
-        if win_value != TOMBSTONE:
-            return win_value + bytes([RESPONSE_OK])
-    if key is None:
-        key = msgs.unpack_message(peer_frame[4:])[3]
-    raise KeyNotFound(repr(key))
+    key = msgs.unpack_message(peer_frame[4:])[3]
+    local_value = (
+        None
+        if local_entry is None or local_entry[0] == "miss"
+        else local_entry
+    )
+    win_value = _merge_quorum_get(
+        my_shard,
+        col_name,
+        col,
+        key,
+        local_value,
+        values,
+        col.replication_factor - 1,
+    )
+    return win_value + bytes([RESPONSE_OK])
 
 
 async def _serve_frame(my_shard: MyShard, request_buf: bytes):

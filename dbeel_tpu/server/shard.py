@@ -434,20 +434,14 @@ class MyShard:
                 os.fsync(f.fileno())
         self.collections[name] = Collection(tree, replication_factor)
         if self.dataplane is not None:
-            # RF=1: full client-plane fast path.  RF>1: replica-plane
-            # only (peer set/delete/get with coordinator-assigned
-            # timestamps); the client plane punts so Python keeps the
-            # replication/consistency brain.  RF>1 registration is
-            # gated on the shard-plane ABI being present: a stale
-            # pinned .so (old 7-arg register, no client_ok gate) would
-            # otherwise fast-serve replicated client writes with NO
-            # quorum fan-out.
-            if replication_factor == 1:
-                self.dataplane.register_tree(name, tree)
-            elif self.dataplane._has_shard_plane:
-                self.dataplane.register_tree(
-                    name, tree, client_plane=False
-                )
+            # RF=1: full client-plane fast path.  RF>1: replica plane
+            # + coordinator assist; the client plane punts so Python
+            # keeps the replication/consistency brain.  (register_tree
+            # itself refuses replica-plane registration on a stale
+            # .so without the client_ok ABI.)
+            self.dataplane.register_tree(
+                name, tree, client_plane=replication_factor == 1
+            )
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_CREATED)
 
