@@ -14,6 +14,7 @@ import hashlib
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -37,6 +38,150 @@ RECORD = 16 + KEY_BYTES + VALUE_BYTES  # 96
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+class ProbeManager:
+    """Async liveness probing of the jax backend (dead-tunnel guard).
+
+    Round 3 lost its driver-captured device number to a probe design
+    that burned ~10.5 min of *serial* retries before any bench work,
+    then disabled the device for good — a tunnel waking up mid-bench
+    was a lost round.  This manager runs the probe subprocess
+    CONCURRENTLY with run building and the CPU baselines, relaunches
+    failed attempts until a total wall-clock budget
+    (``DBEEL_PROBE_BUDGET_S``, default 600s from bench start) is
+    spent, and supports a fresh confirmation immediately before the
+    device pass.  Each attempt is a throwaway
+    ``import jax; jax.devices()`` child (same rationale as
+    utils/jax_gate.py: a wedged init blocks in an uninterruptible
+    recvfrom that no in-process except-clause can catch)."""
+
+    _CHILD = "import jax; jax.devices()"
+
+    def __init__(self, per_attempt_s: float, budget_s: float):
+        self.per_attempt = per_attempt_s
+        self.deadline = time.monotonic() + budget_s
+        self.attempt = 0
+        self.verdict = None  # latest completed attempt's verdict
+        self.proc = None
+        self.fast_fails = 0  # consecutive fast non-zero exits
+        self.conclusive = False  # fast-fail verdict: stop relaunching
+        self._launch()
+
+    def _launch(self):
+        self.attempt += 1
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", self._CHILD],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        self.t0 = time.monotonic()
+
+    def _reap(self, rc):
+        self.verdict = rc == 0
+        self.proc = None
+        if not self.verdict:
+            # A FAST non-zero exit is conclusive (jax missing, broken
+            # install) — retrying can't change it; only wedges
+            # (per-attempt timeouts) are worth waiting out.  Two in a
+            # row stop the probe loop instead of burning the budget
+            # on ~2s relaunch cycles.
+            if time.monotonic() - self.t0 < 20.0:
+                self.fast_fails += 1
+                if self.fast_fails >= 2:
+                    log(
+                        "jax backend probe failed conclusively "
+                        f"(exit {rc} twice in seconds); giving up"
+                    )
+                    self.conclusive = True
+                    self.deadline = time.monotonic()
+                    return
+            else:
+                self.fast_fails = 0
+            log(
+                f"jax backend probe attempt {self.attempt} failed; "
+                f"{max(0, self.deadline - time.monotonic()):.0f}s of "
+                f"probe budget left"
+            )
+
+    def check(self):
+        """Non-blocking pump.  True once any attempt has succeeded;
+        False when the budget is exhausted and the last attempt
+        failed; None while an attempt is still in flight."""
+        if self.verdict is True:
+            return True
+        if self.proc is None:
+            if (
+                self.verdict is False
+                and not self.conclusive
+                and time.monotonic() < self.deadline
+            ):
+                self._launch()
+                return None
+            return self.verdict
+        rc = self.proc.poll()
+        if rc is not None:
+            self._reap(rc)
+        elif time.monotonic() - self.t0 > self.per_attempt:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child: abandon, never block the bench
+            log(
+                f"jax backend probe attempt {self.attempt} wedged for "
+                f"{self.per_attempt:.0f}s (dead TPU tunnel?)"
+            )
+            self.verdict = False
+            self.proc = None
+            self.fast_fails = 0  # a wedge is retryable, not conclusive
+        if self.verdict is True:
+            return True
+        if self.verdict is False and time.monotonic() >= self.deadline:
+            return False
+        if self.proc is None:
+            self._launch()
+        return None
+
+    def wait(self, extra_floor_s: float = 0.0):
+        """Block until a probe succeeds or the budget is exhausted.
+        ``extra_floor_s`` guarantees at least that much probing time
+        even if the budget was consumed by concurrent work — used by
+        the pre-device-pass confirmation so one fresh attempt always
+        runs."""
+        floor = time.monotonic() + extra_floor_s
+        while True:
+            r = self.check()
+            if r is True:
+                return True
+            now = time.monotonic()
+            stop = max(self.deadline, floor)
+            if now >= stop:
+                if self.proc is not None:
+                    self.proc.kill()
+                    try:
+                        self.proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    self.proc = None
+                return False
+            if r is False and self.proc is None and not self.conclusive:
+                # Budget says stop but the floor grants more time
+                # (never after a conclusive fast-fail verdict).
+                self._launch()
+            if r is False and self.conclusive:
+                return False
+            time.sleep(min(2.0, stop - now))
+
+    def confirm_fresh(self, floor_s: float):
+        """Discard any cached success and demand a fresh probe —
+        called immediately before the device pass so a tunnel that
+        died during the CPU phase is caught here, not by an unbounded
+        in-process wedge."""
+        self.verdict = None
+        if self.proc is None:
+            self._launch()
+        return self.wait(extra_floor_s=floor_s)
 
 
 def build_runs(
@@ -292,38 +437,22 @@ def main():
 
         # A dead TPU tunnel wedges backend init in an uninterruptible
         # recvfrom (observed in production): probe in a throwaway
-        # subprocess with retries so this bench degrades to an honest
-        # CPU-fallback report instead of hanging the driver forever.
-        from dbeel_tpu.utils.jax_gate import probe_jax_alive
-
+        # subprocess so this bench degrades to an honest CPU-fallback
+        # report instead of hanging the driver forever.  The probe
+        # runs CONCURRENTLY with run building and the CPU baselines
+        # (~2 min of work the round-3 bench wasted sitting in serial
+        # retries), keeps retrying until DBEEL_PROBE_BUDGET_S of
+        # wall clock has passed, and is re-confirmed fresh right
+        # before the device pass — a tunnel that wakes up mid-bench
+        # still produces a device number.
         probe_timeout = float(
             os.environ.get("DBEEL_BENCH_JAX_TIMEOUT_S", "150")
         )
-        retries = int(os.environ.get("DBEEL_BENCH_JAX_RETRIES", "3"))
-        device_ok = False
-        for attempt in range(retries):
-            # force=True always: the bench wants a FRESH health check,
-            # not the process-tree cache (a stale inherited
-            # DBEEL_JAX_PROBED=ok would bypass the wedge protection).
-            if probe_jax_alive(probe_timeout, force=True):
-                device_ok = True
-                break
-            if attempt + 1 < retries:
-                log(
-                    f"jax backend probe failed "
-                    f"(attempt {attempt + 1}/{retries}); retry in 60s"
-                )
-                time.sleep(60)
-        if device_ok:
-            log(
-                f"jax backend: {jax.default_backend()}, "
-                f"devices: {jax.devices()}"
-            )
-        else:
-            log(
-                "jax backend unavailable (wedged/dead TPU tunnel); "
-                "reporting the product's native CPU fallback path"
-            )
+        probe_budget = float(
+            os.environ.get("DBEEL_PROBE_BUDGET_S", "600")
+        )
+        probe = ProbeManager(probe_timeout, probe_budget)
+
         log(f"building {args.runs} runs x {args.keys // args.runs} keys ...")
         t0 = time.perf_counter()
         indices = build_runs(
@@ -331,6 +460,7 @@ def main():
             variable_values=args.variable_values,
         )
         log(f"  build took {time.perf_counter() - t0:.1f}s")
+        probe.check()
 
         # Two CPU baselines, both reported:
         #  * legacy  — the ROUND-1 baseline definition (C++ merge +
@@ -353,6 +483,7 @@ def main():
         finally:
             native_mod.ODIRECT_MIN_BYTES = saved_min
         log(f"  {cpu_rate:,.0f} keys/s ({cpu_t:.2f}s, {cpu_n} out)")
+        probe.check()
 
         # This host's throughput see-saws 2-3x between minutes (shared
         # disk + tunneled TPU), so single-shot timings are noise.  Both
@@ -371,6 +502,30 @@ def main():
             f"  {best_cpu_rate:,.0f} keys/s ({best_t:.2f}s); "
             f"identical: {best_cpu_hash == cpu_hash}"
         )
+
+        # All CPU-side work is done; now spend whatever remains of the
+        # probe budget waiting for a verdict, then demand one FRESH
+        # successful probe immediately before touching the device in
+        # this process (a stale success from minutes ago must not gate
+        # an in-process backend init that can wedge unrecoverably).
+        device_ok = probe.wait()
+        if device_ok:
+            log(
+                "probe succeeded; re-probing fresh before the device "
+                "pass ..."
+            )
+            device_ok = probe.confirm_fresh(floor_s=probe_timeout)
+        os.environ["DBEEL_JAX_PROBED"] = "ok" if device_ok else "fail"
+        if device_ok:
+            log(
+                f"jax backend: {jax.default_backend()}, "
+                f"devices: {jax.devices()}"
+            )
+        else:
+            log(
+                "jax backend unavailable (wedged/dead TPU tunnel); "
+                "reporting the product's native CPU fallback path"
+            )
 
         if device_ok:
             # Untimed same-shape warm pass: jit compile + first-dispatch

@@ -472,11 +472,17 @@ def _pipeline_merge_impl(
             )
 
     # ---- host staging (index columns + O_DIRECT data reads) ---------
-    # One IO thread reads ahead (O_DIRECT, GIL released inside the C
-    # call) while this thread stages the previous run's prefixes.
+    # IO threads read ahead (O_DIRECT, GIL released inside the C
+    # call) while this thread stages completed runs' prefixes.  Two
+    # readers by default: queue depth 2 on the virtio disk overlaps
+    # one run's tail with the next run's head (DBEEL_PIPE_READERS
+    # overrides; 1 restores the round-3 serial-read prologue).
     from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=1) as io:
+    n_readers = max(
+        1, int(_os.environ.get("DBEEL_PIPE_READERS", "2") or 2)
+    )
+    with ThreadPoolExecutor(max_workers=n_readers) as io:
         futs = [io.submit(_read_run, lib, s) for s in sources]
         runs = []
         for f in futs:
@@ -982,49 +988,95 @@ def _pipeline_merge_impl(
             "native writer handle for %s", data_path
         )
         raise _PipelineError("writer thread wedged")
-    _ev("writer close")
+    # Close (final fdatasync + truncate) runs on a thread so the
+    # bloom build overlaps the device write-cache flush (VERDICT r3
+    # #7: the close flush was ~0.5-1s of serial tail).  The bloom
+    # reads only the INPUT runs — never the output file — and the
+    # entry/byte counts are already known from the writer's own
+    # accounting, so nothing here depends on close completing.
+    _ev("writer close (async)")
     data_size = ctypes.c_uint64(0)
-    entries = lib.dbeel_writer_close(handle, ctypes.byref(data_size))
-    _ev("writer closed")
-    if entries < 0:
-        raise _PipelineError("native writer close failed")
-    assert entries == writer_state["wrote"]
+    close_ret = {"entries": -1}
 
-    wrote_bloom = False
-    if int(data_size.value) >= bloom_min_size and entries > 0:
-        from ..storage.bloom import BloomFilter, _SEED1, _SEED2
-
-        bloom = BloomFilter.with_capacity(int(entries))
-        all_sel = (
-            np.concatenate(bloom_sel)
-            if bloom_sel
-            else np.zeros(0, np.int64)
+    def _close():
+        close_ret["entries"] = lib.dbeel_writer_close(
+            handle, ctypes.byref(data_size)
         )
-        for ri, r in enumerate(runs):
-            mask = (all_sel >= run_base[ri]) & (
-                all_sel < run_base[ri + 1]
+
+    t_close = threading.Thread(target=_close, daemon=True)
+    t_close.start()
+
+    entries = writer_state["wrote"]
+    wrote_bloom = False
+    from ..storage.compaction import COMPACT_BLOOM_FILE_EXT
+
+    bloom_path = (
+        f"{dir_path}/{file_name(output_index, COMPACT_BLOOM_FILE_EXT)}"
+    )
+    try:
+        if writer_state["bytes"] >= bloom_min_size and entries > 0:
+            from ..storage.bloom import BloomFilter, _SEED1, _SEED2
+
+            bloom = BloomFilter.with_capacity(int(entries))
+            all_sel = (
+                np.concatenate(bloom_sel)
+                if bloom_sel
+                else np.zeros(0, np.int64)
             )
-            if not mask.any():
-                continue
-            sel_r = all_sel[mask]
-            offs = np.ascontiguousarray(
-                off_cat[sel_r] + np.uint64(ENTRY_HEADER_SIZE)
-            )
-            lens = np.ascontiguousarray(ks_cat[sel_r])
-            lib.dbeel_bloom_add_batch(
-                bloom.bits.ctypes.data_as(
-                    ctypes.POINTER(ctypes.c_uint8)
-                ),
-                ctypes.c_uint64(bloom.num_bits),
-                ctypes.c_uint32(bloom.num_hashes),
-                r.data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-                ctypes.c_uint64(sel_r.size),
-                ctypes.c_uint32(_SEED1),
-                ctypes.c_uint32(_SEED2),
-            )
-        _write_bloom(dir_path, output_index, bloom)
-        wrote_bloom = True
+            for ri, r in enumerate(runs):
+                mask = (all_sel >= run_base[ri]) & (
+                    all_sel < run_base[ri + 1]
+                )
+                if not mask.any():
+                    continue
+                sel_r = all_sel[mask]
+                offs = np.ascontiguousarray(
+                    off_cat[sel_r] + np.uint64(ENTRY_HEADER_SIZE)
+                )
+                lens = np.ascontiguousarray(ks_cat[sel_r])
+                lib.dbeel_bloom_add_batch(
+                    bloom.bits.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint8)
+                    ),
+                    ctypes.c_uint64(bloom.num_bits),
+                    ctypes.c_uint32(bloom.num_hashes),
+                    r.data.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint8)
+                    ),
+                    offs.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint64)
+                    ),
+                    lens.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint32)
+                    ),
+                    ctypes.c_uint64(sel_r.size),
+                    ctypes.c_uint32(_SEED1),
+                    ctypes.c_uint32(_SEED2),
+                )
+            _write_bloom(dir_path, output_index, bloom)
+            wrote_bloom = True
+    except BaseException:
+        # The merge's contract is the whole triplet: a failed bloom
+        # build (ENOSPC, MemoryError) must not leave the data/index
+        # behind looking complete.  Join the async close first — never
+        # unlink under a live fdatasync/truncate.
+        t_close.join(timeout=600)
+        if not t_close.is_alive():
+            _unlink_quiet(data_path, index_path, bloom_path)
+        raise
+
+    t_close.join(timeout=600)
+    _ev("writer closed")
+    if t_close.is_alive():
+        log.error(
+            "pipeline writer close wedged; leaking native writer "
+            "handle for %s", data_path
+        )
+        raise _PipelineError("writer close wedged")
+    if close_ret["entries"] < 0:
+        _unlink_quiet(data_path, index_path, bloom_path)
+        raise _PipelineError("native writer close failed")
+    assert close_ret["entries"] == entries
+    assert int(data_size.value) == writer_state["bytes"]
 
     return MergeResult(int(entries), int(data_size.value), wrote_bloom)

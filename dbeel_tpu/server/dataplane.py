@@ -109,8 +109,7 @@ class DataPlane:
     def tree_eligible(tree) -> bool:
         """Registration requires the native arena memtable (its handle
         IS the C-side memtable).  Write fast-pathing additionally
-        requires a native WAL appender and no wal-sync (sync
-        coalescing is asyncio-side) — see _write_wal_handle; trees
+        requires a native WAL appender — see _write_wal_handle; trees
         that fail only the write conditions still register for native
         GETS (memtable probe + sstable search) with a null WAL, which
         makes the C write path punt."""
@@ -120,11 +119,11 @@ class DataPlane:
     @staticmethod
     def _write_wal_handle(tree):
         wal = getattr(tree, "_wal", None)
-        if (
-            wal is None
-            or getattr(wal, "_native", None) is None
-            or tree.wal_sync
-        ):
+        if wal is None or getattr(wal, "_native", None) is None:
+            return None
+        if tree.wal_sync and getattr(wal, "_syncer", None) is None:
+            # Durable mode without the native group-commit thread:
+            # writes must punt to the Python coalescer.
             return None
         return wal._native
 
@@ -279,9 +278,12 @@ class DataPlane:
 
     def try_handle(
         self, frame: bytes
-    ) -> Optional[Tuple[bytes, bool, Optional[object], str]]:
-        """Returns (response_bytes, keepalive, tree_needing_flush, op)
-        when the frame was fully handled natively; None to punt."""
+    ) -> Optional[Tuple[bytes, bool, Optional[object], str, object]]:
+        """Returns (response_bytes, keepalive, tree_needing_flush, op,
+        defer) when the frame was fully handled natively; None to
+        punt.  ``defer`` is None, or ``(syncer, ticket)`` for
+        wal-sync trees — the caller must park the response until the
+        syncer's watermark covers the ticket."""
         flags = self._lib.dbeel_dp_handle(
             self._handle,
             frame,
@@ -299,14 +301,38 @@ class DataPlane:
                 keepalive,
                 None,
                 "get",
+                None,
             )
         op = "delete" if flags & 8 else "set"
+        # bit4: entry applied but the WAL append failed — out holds
+        # the complete error response; the frame must not re-run.
+        resp = (
+            self._get_buf[: self._out_len.value]
+            if flags & 0x10
+            else OK_RESPONSE
+        )
         return (
-            OK_RESPONSE,
+            resp,
             keepalive,
             self._flush_tree_from_flags(flags),
             op,
+            self._sync_defer_from_flags(flags, 0x20),
         )
+
+    def _sync_defer_from_flags(self, flags: int, bit: int):
+        """(syncer, ticket) for a deferred durable ack, or None.  The
+        ticket is read immediately after the native call on the loop
+        thread, so it is exactly this request's append sequence."""
+        if not flags & bit:
+            return None
+        col_idx = (flags >> 8) & 0xFFFF
+        if not 0 <= col_idx < len(self._slots):
+            return None
+        tree = self._trees.get(self._slots[col_idx])
+        syncer = getattr(getattr(tree, "_wal", None), "_syncer", None)
+        if syncer is None:  # racing a WAL swap: ack immediately
+            return None
+        return (syncer, syncer.ticket())
 
     def _flush_tree_from_flags(self, flags: int):
         """Decode bit1 (memtable-now-full) + the slot index in bits
@@ -331,12 +357,19 @@ class DataPlane:
         frame (4B-LE length + msgpack ShardRequest) to fan out
         verbatim.  Returns None to punt (nothing applied), or
         (op, peer_frame, keepalive, flush_tree, consistency,
-        timeout_ms, collection_name, local_entry) — op is
-        "set"/"delete"/"get"; consistency is None when the request
-        didn't carry a usable int; timeout_ms is 0 for absent/falsy
-        (caller applies the default); local_entry is None except for
-        gets, where it is ((value_bytes, ts)) for a hit (tombstone =
-        empty value) or ("miss",) for authoritative absence."""
+        timeout_ms, collection_name, local_entry, key, error_resp) —
+        op is "set"/"delete"/"get"; consistency is None when the
+        request didn't carry a usable int; timeout_ms is 0 for
+        absent/falsy (caller applies the default); local_entry is
+        None except for gets, where it is ((value_bytes, ts)) for a
+        hit (tombstone = empty value) or ("miss",) for authoritative
+        absence; key is the raw wire key for gets (so the caller
+        never unpacks the peer frame); error_resp, when not None, is
+        the complete client error payload (entry applied but WAL
+        append failed) — send it, skip the fan-out; defer (11th) is
+        None or (syncer, ticket): under wal-sync the local ack only
+        counts once the watermark covers the ticket, so await it
+        alongside the quorum fan-out."""
         if not self._has_coord:
             return None
         flags = self._lib.dbeel_dp_handle_coord(
@@ -350,47 +383,81 @@ class DataPlane:
         if flags < 0:
             return None
         out = self._get_buf[: self._out_len.value]
+        col_idx = (flags >> 8) & 0xFFFF
+        col_name = (
+            self._slots[col_idx]
+            if 0 <= col_idx < len(self._slots)
+            else None
+        )
+        keepalive = bool(flags & 1)
+        flush_tree = self._flush_tree_from_flags(flags)
+        if flags & 0x10:
+            # out = u32-LE length + error payload + type byte; the
+            # caller's response writer re-adds the length prefix.
+            op = "delete" if flags & 4 else "set"
+            return (
+                op,
+                b"",
+                keepalive,
+                flush_tree,
+                None,
+                0,
+                col_name,
+                None,
+                None,
+                out[4:],
+                None,
+            )
         peer_len = 4 + int.from_bytes(out[:4], "little")
         peer_frame = out[:peer_len]
         local_entry = None
+        key = None
         if flags & 8:
             op = "get"
             trailer = out[peer_len:]
+            vlen = int.from_bytes(trailer[1:5], "little")
+            klen = int.from_bytes(trailer[13:17], "little")
             if trailer[0]:
-                vlen = int.from_bytes(trailer[1:5], "little")
                 ts = int.from_bytes(
                     trailer[5:13], "little", signed=True
                 )
-                local_entry = (trailer[13 : 13 + vlen], ts)
+                local_entry = (trailer[17 : 17 + vlen], ts)
             else:
                 local_entry = ("miss",)
+                vlen = 0
+            key = trailer[17 + vlen : 17 + vlen + klen]
         else:
             op = "delete" if flags & 4 else "set"
-        col_idx = (flags >> 8) & 0xFFFF
         cons_p1 = (flags >> 24) & 0xFF
         return (
             op,
             peer_frame,
-            bool(flags & 1),
-            self._flush_tree_from_flags(flags),
+            keepalive,
+            flush_tree,
             cons_p1 - 1 if cons_p1 else None,
             (flags >> 32) & 0x3FFFFFFF,
-            self._slots[col_idx]
-            if 0 <= col_idx < len(self._slots)
-            else None,
+            col_name,
             local_entry,
+            key,
+            None,
+            self._sync_defer_from_flags(flags, 0x20),
         )
 
     def try_handle_shard(
         self, frame: bytes
-    ) -> Optional[Tuple[Optional[bytes], Optional[object], bool]]:
+    ) -> Optional[
+        Tuple[Optional[bytes], Optional[object], bool, object]
+    ]:
         """Replica-plane fast path for one remote-shard-protocol
         message (raw msgpack list bytes, no length prefix).  Returns
-        (response_frame_or_None, tree_needing_flush, notify_set) when
-        handled natively — the response already carries its 4-byte-LE
-        length prefix; notify_set means the caller fires
-        ITEM_SET_FROM_SHARD_MESSAGE (set writes only, matching the
-        Python handler) — or None to punt to handle_shard_message."""
+        (response_frame_or_None, tree_needing_flush, notify_set,
+        defer) when handled natively — the response already carries
+        its 4-byte-LE length prefix; notify_set means the caller
+        fires ITEM_SET_FROM_SHARD_MESSAGE (set writes only, matching
+        the Python handler); defer is None or (syncer, ticket): park
+        the ack (and the notification) until the WAL sync watermark
+        covers the ticket — or None to punt to
+        handle_shard_message."""
         if not self._has_shard_plane:
             return None
         flags = self._lib.dbeel_dp_handle_shard(
@@ -407,7 +474,12 @@ class DataPlane:
         if flags & 4:
             resp = self._get_buf[: self._out_len.value]
         notify_set = bool(flags & 8) and not bool(flags & 0x20)
-        return resp, self._flush_tree_from_flags(flags), notify_set
+        return (
+            resp,
+            self._flush_tree_from_flags(flags),
+            notify_set,
+            self._sync_defer_from_flags(flags, 0x40),
+        )
 
     def stats(self) -> dict:
         out = {

@@ -329,9 +329,20 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
         timeout_ms,
         col_name,
         local_entry,
+        key,
+        error_resp,
+        defer,
     ) = coord
     if flush_tree is not None:
         my_shard.spawn(flush_tree.flush())
+    if error_resp is not None:
+        # Entry applied but the WAL append failed: the C side built
+        # the error response; no fan-out, no re-run.
+        log.error(
+            "native coord %s on %r: wal append failed", op, col_name
+        )
+        my_shard.metrics.record_request(op, started)
+        return error_resp, keepalive
     try:
         col = my_shard.collections.get(col_name)
         if col is None:  # unreachable: registration keeps slots in sync
@@ -347,22 +358,34 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                 col,
                 peer_frame,
                 local_entry,
+                key,
                 consistency,
                 timeout_ms or DEFAULT_GET_TIMEOUT_MS,
             )
         else:
             is_delete = op == "delete"
             try:
+                fan_out = my_shard.send_packed_to_replicas(
+                    peer_frame,
+                    consistency - 1,
+                    rf - 1,
+                    _ACK_DELETE if is_delete else _ACK_SET,
+                    ShardResponse.DELETE
+                    if is_delete
+                    else ShardResponse.SET,
+                )
+                if defer is not None:
+                    # wal-sync: the coordinator's own replica-0 write
+                    # only counts once its fdatasync completes — wait
+                    # for it alongside the remote acks, inside the
+                    # same timeout window (db_server.rs:230-257's
+                    # try_join shape).
+                    syncer, ticket = defer
+                    fan_out = asyncio.gather(
+                        fan_out, syncer.wait(ticket)
+                    )
                 await asyncio.wait_for(
-                    my_shard.send_packed_to_replicas(
-                        peer_frame,
-                        consistency - 1,
-                        rf - 1,
-                        _ACK_DELETE if is_delete else _ACK_SET,
-                        ShardResponse.DELETE
-                        if is_delete
-                        else ShardResponse.SET,
-                    ),
+                    fan_out,
                     (timeout_ms or DEFAULT_SET_TIMEOUT_MS) / 1000,
                 )
             except asyncio.TimeoutError as e:
@@ -380,13 +403,15 @@ async def _finish_coord_get(
     col,
     peer_frame: bytes,
     local_entry,
+    key: bytes,
     consistency: int,
     timeout_ms: int,
 ) -> bytes:
     """Quorum-merge for a coordinator-assisted get: fan the packed
     peer frame out, combine replica results with the native local
     lookup by max server timestamp (db_server.rs:353-363), spawn read
-    repair for stale replicas, and build the client response."""
+    repair for stale replicas, and build the client response.  `key`
+    arrives from the C trailer — no peer-frame unpack on this path."""
     remote = my_shard.send_packed_to_replicas(
         peer_frame,
         consistency - 1,
@@ -398,7 +423,6 @@ async def _finish_coord_get(
         values = await asyncio.wait_for(remote, timeout_ms / 1000)
     except asyncio.TimeoutError as e:
         raise Timeout("get") from e
-    key = msgs.unpack_message(peer_frame[4:])[3]
     local_value = (
         None
         if local_entry is None or local_entry[0] == "miss"
@@ -507,11 +531,33 @@ class _DbProtocol(framed.FramedServerProtocol):
         fast = dp.try_handle(frame)
         if fast is None:
             return framed.FAST_MISS
-        resp, keepalive, flush_tree, op = fast
-        self.transport.write(resp)
-        self.shard.metrics.record_request(op, started)
+        resp, keepalive, flush_tree, op, defer = fast
         if flush_tree is not None:
             self.shard.spawn(flush_tree.flush())
+        if defer is not None:
+            # wal-sync group commit: the OK leaves once a completed
+            # fdatasync covers this append.
+            syncer, ticket = defer
+            entry = self.park_response(resp, keepalive, op, started)
+            syncer.park(ticket, lambda e=entry: self.finish_park(e))
+            if not keepalive:
+                # Reference semantics: one request per non-keepalive
+                # connection — stop applying any already-buffered
+                # frames NOW (the parked ack still goes out; the
+                # flush closes the transport after writing it).
+                self.closing = True
+                return framed.FAST_CLOSE
+            return framed.FAST_HANDLED
+        if self.parked:
+            # Earlier responses on this connection still await their
+            # sync: queue behind them to preserve order.
+            self.park_response(resp, keepalive, op, started, done=True)
+            if not keepalive:
+                self.closing = True
+                return framed.FAST_CLOSE
+            return framed.FAST_HANDLED
+        self.transport.write(resp)
+        self.shard.metrics.record_request(op, started)
         if not keepalive:
             self.closing = True
             self.transport.close()
@@ -532,6 +578,9 @@ class _DbProtocol(framed.FramedServerProtocol):
             buf, keepalive = await _serve_frame(self.shard, frame)
         if self.closing:
             return False
+        # Responses leave in arrival order: queue behind any parked
+        # fast-path acks still awaiting their WAL sync.
+        await self._wait_parked_drained()
         await self.writable.wait()
         if self.closing:
             return False

@@ -57,6 +57,8 @@ class FramedServerProtocol(asyncio.Protocol):
         "closing",
         "paused_reading",
         "writable",
+        "parked",
+        "_parked_drained",
     )
 
     def __init__(self, my_shard) -> None:
@@ -69,6 +71,12 @@ class FramedServerProtocol(asyncio.Protocol):
         self.paused_reading = False
         self.writable = asyncio.Event()
         self.writable.set()
+        # Order-preserving deferred responses (wal-sync group commit:
+        # an ack may only leave once a completed fdatasync covers its
+        # append).  Entries flush strictly in arrival order; later
+        # already-ready responses queue behind a pending head.
+        self.parked: deque = deque()
+        self._parked_drained = None
 
     # -- lifecycle --------------------------------------------------
 
@@ -80,6 +88,8 @@ class FramedServerProtocol(asyncio.Protocol):
     def connection_lost(self, exc) -> None:
         self._registry().discard(self)
         self.writable.set()  # unblock a _drain awaiting writability
+        if self._parked_drained is not None:
+            self._parked_drained.set()
         self._on_disconnect()
 
     # Transport write-buffer backpressure: while the peer reads slowly
@@ -108,6 +118,60 @@ class FramedServerProtocol(asyncio.Protocol):
 
     async def _serve_one(self, frame: bytes) -> bool:
         raise NotImplementedError
+
+    # -- deferred (sync-parked) responses ---------------------------
+
+    def park_response(
+        self, resp, keepalive=True, op=None, started=0.0, done=False
+    ):
+        """Reserve the next in-order response slot.  ``done=False``
+        slots complete later via finish_park (e.g. when the WAL sync
+        watermark covers the write); ``done=True`` queues an
+        already-ready response behind pending ones so per-connection
+        order is preserved.  Returns the entry token."""
+        e = [done, resp, keepalive, op, started]
+        self.parked.append(e)
+        if done:
+            self._flush_parked()
+        return e
+
+    def finish_park(self, e, resp=None) -> None:
+        e[0] = True
+        if resp is not None:
+            e[1] = resp
+        self._flush_parked()
+
+    def _flush_parked(self) -> None:
+        while self.parked and self.parked[0][0]:
+            _, resp, keepalive, op, started = self.parked.popleft()
+            if op is not None:
+                # Metrics stamp at release time: the measured latency
+                # honestly includes the fdatasync wait.
+                self.shard.metrics.record_request(op, started)
+            # Note: ``self.closing`` alone must NOT skip the write —
+            # a parked non-keepalive ack sets closing at park time
+            # (to stop applying later frames) while its own response
+            # is still owed; only a dead transport skips.
+            if self.transport is None or self.transport.is_closing():
+                continue
+            if resp is not None:
+                self.transport.write(resp)
+            if not keepalive:
+                self.closing = True
+                self.transport.close()
+        if not self.parked and self._parked_drained is not None:
+            self._parked_drained.set()
+
+    async def _wait_parked_drained(self) -> None:
+        """Slow-path responses must queue behind any parked fast-path
+        responses on this connection."""
+        if not self.parked:
+            return
+        if self._parked_drained is None:
+            self._parked_drained = asyncio.Event()
+        while self.parked and not self.closing:
+            self._parked_drained.clear()
+            await self._parked_drained.wait()
 
     # -- framing ----------------------------------------------------
 

@@ -111,11 +111,32 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
         # only on this path; the anti-entropy exemption applies to
         # RANGE_* messages, which always punt).
         self.shard.scheduler.fg_mark()
-        resp, flush_tree, notify_set = fast
-        if resp is not None:
-            self.transport.write(resp)
+        resp, flush_tree, notify_set, defer = fast
         if flush_tree is not None:
             self.shard.spawn(flush_tree.flush())
+        if defer is not None:
+            # wal-sync: a replica ack is a durability promise to the
+            # coordinator — park it (and the flow notification, which
+            # the Python handler also fires only after the synced
+            # write) until the fdatasync watermark covers the ticket.
+            syncer, ticket = defer
+            entry = self.park_response(resp)
+            shard = self.shard
+
+            def _release(e=entry, notify=notify_set):
+                self.finish_park(e)
+                if notify:
+                    shard.flow.notify(
+                        FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+                    )
+
+            syncer.park(ticket, _release)
+            return framed.FAST_HANDLED
+        if resp is not None:
+            if self.parked:
+                self.park_response(resp, done=True)
+            else:
+                self.transport.write(resp)
         if notify_set:
             self.shard.flow.notify(
                 FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
@@ -167,6 +188,9 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             and not self.closing
             and not self.transport.is_closing()
         ):
+            # Ack order per stream: queue behind parked fast-path
+            # acks still awaiting their WAL sync.
+            await self._wait_parked_drained()
             await self.writable.wait()
             if self.closing or self.transport.is_closing():
                 return True  # keep applying buffered frames

@@ -192,6 +192,29 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint32,
             ctypes.c_int64,
         ]
+    if hasattr(lib, "dbeel_wal_sync_enable"):
+        # Group-commit syncer (wal-sync mode): a C thread owns the
+        # coalesced fdatasync, completion pings an eventfd.
+        lib.dbeel_wal_sync_enable.restype = ctypes.c_int32
+        lib.dbeel_wal_sync_enable.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.c_int32,
+        ]
+        lib.dbeel_wal_sync_disable.restype = None
+        lib.dbeel_wal_sync_disable.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "dbeel_wal_sync_stop_async"):
+            lib.dbeel_wal_sync_stop_async.restype = None
+            lib.dbeel_wal_sync_stop_async.argtypes = [ctypes.c_void_p]
+        lib.dbeel_wal_seq.restype = ctypes.c_uint64
+        lib.dbeel_wal_seq.argtypes = [ctypes.c_void_p]
+        lib.dbeel_wal_synced.restype = ctypes.c_uint64
+        lib.dbeel_wal_synced.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_dp_handle"):
+        # (continuation of the data-plane prototypes: these must stay
+        # gated on dbeel_dp_handle, NOT on the newer syncer symbols —
+        # a stale .so without the syncer still runs the data plane and
+        # needs every prototype declared.)
         lib.dbeel_dp_new.restype = ctypes.c_void_p
         lib.dbeel_dp_new.argtypes = []
         lib.dbeel_dp_free.restype = None

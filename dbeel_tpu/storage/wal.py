@@ -16,16 +16,160 @@ tail writes detectable (recovery stops at the first invalid record).
 from __future__ import annotations
 
 import asyncio
+import heapq
+import logging
 import os
 import struct
 import zlib
+from collections import deque
 from typing import Iterator, Tuple
 
 from .entry import PAGE_SIZE, decode_entry, encode_entry
 from ..utils.event import LocalEvent
 
+log = logging.getLogger(__name__)
+
 _MAGIC = 0x77A11065
 _HEADER = struct.Struct("<IIII")
+
+
+class _NativeSyncer:
+    """Event-loop bridge for the C group-commit thread (wal-sync
+    mode).  The C side owns the coalesced fdatasync on a dedicated
+    thread (dbeel_wal_sync_enable) and pings an eventfd after each
+    completed sync; this object parks serving-plane responses and
+    slow-path waiters on sync *tickets* (append sequence numbers) and
+    releases them once the published watermark covers them — so a
+    durable ack never leaves before its fdatasync, and the event loop
+    never blocks on one (reference semantics:
+    /root/reference/src/storage_engine/lsm_tree.rs:805-837)."""
+
+    def __init__(self, lib, native, delay_us: int) -> None:
+        self._lib = lib
+        self._native = native
+        self._efd = os.eventfd(0, os.EFD_NONBLOCK | os.EFD_CLOEXEC)
+        if lib.dbeel_wal_sync_enable(native, delay_us, self._efd) != 0:
+            os.close(self._efd)
+            raise OSError("wal sync enable failed")
+        self._loop = None
+        self._parks: deque = deque()  # (ticket, callback), FIFO==ticket order
+        self._waiters: list = []  # heap of (ticket, n, future)
+        self._wseq = 0
+        self._closed = False
+        self._stopping = False
+        self._on_done: list = []
+
+    def ticket(self) -> int:
+        """Current append sequence — call immediately after the
+        append whose durability you need (loop thread only)."""
+        return self._lib.dbeel_wal_seq(self._native)
+
+    def _ensure_reader(self) -> None:
+        if self._loop is None:
+            self._loop = asyncio.get_event_loop()
+            self._loop.add_reader(self._efd, self._on_ready)
+
+    def park(self, ticket: int, cb) -> None:
+        """Run ``cb()`` once a completed sync covers ``ticket``.
+        Calls arrive in ticket order (single loop thread)."""
+        if self._closed:
+            cb()
+            return
+        self._ensure_reader()
+        self._parks.append((ticket, cb))
+
+    async def wait(self, ticket: int) -> None:
+        if self._closed:
+            return
+        if self._lib.dbeel_wal_synced(self._native) >= ticket:
+            return
+        self._ensure_reader()
+        fut = self._loop.create_future()
+        self._wseq += 1
+        heapq.heappush(self._waiters, (ticket, self._wseq, fut))
+        await fut
+
+    def _on_ready(self) -> None:
+        try:
+            os.read(self._efd, 8)  # clear the eventfd counter
+        except (BlockingIOError, OSError):
+            pass
+        self._release(self._lib.dbeel_wal_synced(self._native))
+        if self._stopping and not self._closed:
+            # Async close handshake: the sync thread's exit signal
+            # (final drain published, watermark == seq) finishes the
+            # shutdown here — the join below lands on an
+            # already-exited thread, so the loop never blocks on an
+            # in-flight usleep/fdatasync.
+            seq = self._lib.dbeel_wal_seq(self._native)
+            if self._lib.dbeel_wal_synced(self._native) >= seq:
+                self._finish_close()
+
+    def _release(self, synced: int) -> None:
+        while self._parks and self._parks[0][0] <= synced:
+            _, cb = self._parks.popleft()
+            try:
+                cb()
+            except Exception:
+                log.exception("parked wal-sync ack release failed")
+        while self._waiters and self._waiters[0][0] <= synced:
+            _, _, fut = heapq.heappop(self._waiters)
+            if not fut.done():
+                fut.set_result(None)
+
+    def close(self, on_done=None) -> None:
+        """Stop the C sync thread (its final drain covers every
+        outstanding append) and release everything parked.  Called
+        before the WAL closes — by then flush has made the contents
+        durable via the sstable, so releasing is correct even if the
+        final fdatasync raced the close.
+
+        When an event-loop reader is active this is ASYNCHRONOUS: the
+        stop is signalled, the thread finishes its final drain off
+        the loop, and its exit ping completes the shutdown from the
+        eventfd callback (the loop never blocks on an in-flight
+        usleep/fdatasync — review r4).  ``on_done`` runs after the
+        native side is fully released (the WAL uses it to defer
+        closing its fd/handle).  Without a reader (no loop engaged)
+        it degrades to the synchronous join."""
+        if self._closed:
+            if on_done is not None:
+                on_done()
+            return
+        if self._stopping:
+            if on_done is not None:
+                self._on_done.append(on_done)
+            return
+        if on_done is not None:
+            self._on_done.append(on_done)
+        if self._loop is not None:
+            self._stopping = True
+            if hasattr(self._lib, "dbeel_wal_sync_stop_async"):
+                self._lib.dbeel_wal_sync_stop_async(self._native)
+                return  # _on_ready finishes via the exit ping
+        self._finish_close()
+
+    def _finish_close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # Joins the sync thread: already exited on the async path
+        # (its exit ping got us here), a real join on the sync path.
+        self._lib.dbeel_wal_sync_disable(self._native)
+        if self._loop is not None:
+            try:
+                self._loop.remove_reader(self._efd)
+            except Exception:
+                pass
+        self._release(self._lib.dbeel_wal_seq(self._native))
+        os.close(self._efd)
+        self._efd = -1
+        for cb in self._on_done:
+            try:
+                cb()
+            except Exception:
+                log.exception("wal close completion callback failed")
+        self._on_done = []
 
 
 def _padded(n: int) -> int:
@@ -71,6 +215,30 @@ class Wal:
         self._sync_event = LocalEvent()
         self._inflight_syncs = 0
         self._closing = False
+        # Native group-commit syncer: a C thread owns the coalesced
+        # fdatasync and completion arrives via eventfd — replaces the
+        # executor-hop path AND lets the serving data plane fast-path
+        # durable writes (acks parked on sync tickets).  Falls back
+        # to the executor coalescer when unavailable.
+        # DBEEL_NO_WAL_SYNCER=1 disables the native group-commit
+        # thread (A/B benching): durable writes then punt to the
+        # executor-coalesced fdatasync path.
+        self._syncer = None
+        if (
+            sync
+            and self._native is not None
+            and hasattr(os, "eventfd")
+            and os.environ.get("DBEEL_NO_WAL_SYNCER", "0")
+            in ("", "0")
+        ):
+            try:
+                if hasattr(self._lib, "dbeel_wal_sync_enable"):
+                    self._syncer = _NativeSyncer(
+                        self._lib, self._native, sync_delay_us
+                    )
+            except Exception:
+                log.exception("native wal syncer unavailable")
+                self._syncer = None
 
     async def append(self, key: bytes, value: bytes, timestamp: int) -> None:
         if self._native is not None:
@@ -117,6 +285,11 @@ class Wal:
         (coalescing a la lsm_tree.rs:817-832, but watermark-correct)."""
         if not self._sync:
             return
+        if self._syncer is not None:
+            # Ticket = the native appender's sequence (it counted this
+            # append); no await happened since, so it is exactly ours.
+            await self._syncer.wait(self._syncer.ticket())
+            return
         my_seq = self._seq
         while self._synced_seq < my_seq and not self._closing:
             if self._syncing:
@@ -143,8 +316,21 @@ class Wal:
 
     def close(self) -> None:
         self._closing = True
+        if self._syncer is not None:
+            # Async shutdown: the C thread's final drain runs off the
+            # loop; fd/handle teardown (and file unlink, see delete)
+            # defer to its completion callback.  dbeel_wal_free's own
+            # sync_disable then joins an already-exited thread.
+            syncer, self._syncer = self._syncer, None
+            syncer.close(on_done=self._close_when_unreferenced)
+            return
         self._sync_event.notify()  # release riders; contents now owned
         if self._inflight_syncs == 0:  # by the flushed sstable
+            self._really_close()
+
+    def _close_when_unreferenced(self) -> None:
+        self._sync_event.notify()
+        if self._inflight_syncs == 0:
             self._really_close()
 
     def delete(self) -> None:

@@ -61,28 +61,45 @@ def wait_up(port, deadline=120.0):
     raise SystemExit("server never came up")
 
 
-def run_load(port, duration, tag):
-    """Connect-per-request Sets (the reference client dialect) for
-    ``duration`` seconds; returns sorted latency list in seconds."""
+def run_load(port, duration, tag, op="set", key_count=0):
+    """Connect-per-request Sets or Gets (the reference client
+    dialect) for ``duration`` seconds; returns (sorted latency list
+    in seconds, outliers) — outliers are (offset_s, latency_ms) for
+    every op over 30 ms, time-stamped from the phase start so stalls
+    can be correlated with server events (flush, compaction end).
+    Get phases cycle over the ``key_count`` keys a previous set phase
+    wrote under the same ``tag``."""
     lat = []
-    t_end = time.time() + duration
+    outliers = []
+    t0 = time.time()
+    t_end = t0 + duration
     i = 0
     while time.time() < t_end:
         ta = time.time()
-        t, b = req(
-            port,
-            {
+        if op == "set":
+            body = {
                 "type": "set",
                 "collection": "c",
                 "key": f"lb{tag}{i:08d}",
                 "value": i,
-            },
-        )
-        assert t == 2, (t, b)
-        lat.append(time.time() - ta)
+            }
+            t, b = req(port, body)
+            assert t == 2, (t, b)
+        else:
+            body = {
+                "type": "get",
+                "collection": "c",
+                "key": f"lb{tag}{i % max(1, key_count):08d}",
+            }
+            t, b = req(port, body)
+            assert t == 1, (t, b)  # the key was written: must hit
+        dt = time.time() - ta
+        lat.append(dt)
+        if dt > 0.03:
+            outliers.append((round(ta - t0, 3), round(dt * 1e3, 1)))
         i += 1
     lat.sort()
-    return lat
+    return lat, outliers
 
 
 def pct(lat, p):
@@ -150,7 +167,10 @@ def main():
         wait_up(args.port)
         t, _ = req(args.port, {"type": "create_collection", "name": "c"})
         assert t == 2, "create failed"
-        quiet = run_load(args.port, args.duration, "q")
+        quiet, quiet_out = run_load(args.port, args.duration, "q")
+        quiet_get, quiet_get_out = run_load(
+            args.port, args.duration, "q", op="get", key_count=len(quiet)
+        )
     finally:
         p1.terminate()
         p1.wait(timeout=20)
@@ -177,7 +197,10 @@ def main():
         wait_up(port2)
         # Give the startup compaction a beat to actually begin.
         time.sleep(0.5)
-        busy = run_load(port2, args.duration, "b")
+        busy, busy_out = run_load(port2, args.duration, "b")
+        busy_get, busy_get_out = run_load(
+            port2, args.duration, "b", op="get", key_count=len(busy)
+        )
         # Compaction evidence: an odd output index exists (in-flight
         # compact_* or finished .data).
         names = os.listdir(col_dir)
@@ -204,24 +227,38 @@ def main():
         p2.terminate()
         p2.wait(timeout=30)
 
+    def summary(lat):
+        return {
+            "ops": len(lat),
+            "p50_us": round(pct(lat, 0.50) * 1e6, 1),
+            "p90_us": round(pct(lat, 0.90) * 1e6, 1),
+            "p99_us": round(pct(lat, 0.99) * 1e6, 1),
+            "p999_us": round(pct(lat, 0.999) * 1e6, 1),
+            "max_ms": round(lat[-1] * 1e3, 2),
+        }
+
+    for name, outs in (
+        ("quiet set", quiet_out),
+        ("quiet get", quiet_get_out),
+        ("compacting set", busy_out),
+        ("compacting get", busy_get_out),
+    ):
+        if outs:
+            print(
+                f"{name} outliers >30ms (offset_s, ms): {outs}",
+                file=sys.stderr,
+            )
+
     out = {
         "metric": "set_p99_under_major_compaction",
         "unit": "us",
         "keys": args.keys,
         "backend": args.backend,
         "server_args": args.server_arg,
-        "quiet": {
-            "ops": len(quiet),
-            "p50_us": round(pct(quiet, 0.50) * 1e6, 1),
-            "p99_us": round(pct(quiet, 0.99) * 1e6, 1),
-            "max_ms": round(quiet[-1] * 1e3, 2),
-        },
-        "compacting": {
-            "ops": len(busy),
-            "p50_us": round(pct(busy, 0.50) * 1e6, 1),
-            "p99_us": round(pct(busy, 0.99) * 1e6, 1),
-            "max_ms": round(busy[-1] * 1e3, 2),
-        },
+        "quiet": summary(quiet),
+        "quiet_get": summary(quiet_get),
+        "compacting": summary(busy),
+        "compacting_get": summary(busy_get),
         "compaction_observed": compacted,
         "p99_ratio": round(
             pct(busy, 0.99) / max(pct(quiet, 0.99), 1e-9), 2

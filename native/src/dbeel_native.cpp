@@ -25,8 +25,13 @@
 #include <cstdlib>
 #include <thread>
 #include <ctime>
+#include <atomic>
+#include <condition_variable>
+#include <mutex>
 #include <fcntl.h>
+#include <map>
 #include <string>
+#include <string_view>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -955,11 +960,15 @@ int64_t dbeel_memtable_flush_write(void* h, const char* dir,
       return -1;
     }
 
-    if (want_bloom && entries > 0) {
+    if (want_bloom) {
       // BloomFilter.with_capacity(n, fp=0.01):
       //   m = int(-n ln fp / (ln 2)^2) + 1; k = max(1, round(m/n ln 2))
       // then num_bits = max(64, m), bits = ceil(num_bits/8) bytes.
-      const double n_items = (double)entries;
+      // Capacity is max(1, entries) — the Python writer's exact
+      // formula, which also emits a (tiny) bloom for an empty table
+      // when bloom_min_size allows it, keeping the triplet formats
+      // byte-identical on that edge.
+      const double n_items = (double)(entries ? entries : 1);
       const double ln2 = 0.6931471805599453;
       const double m_f = -n_items * std::log(0.01) / (ln2 * ln2);
       const uint64_t m = (uint64_t)m_f + 1;  // int() truncation + 1
@@ -1057,7 +1066,61 @@ struct NativeWal {
   int fd;
   uint64_t offset;
   std::vector<uint8_t> buf;
+  // Group-commit (wal-sync) state — reference wal-sync-delay
+  // semantics (/root/reference/src/storage_engine/lsm_tree.rs:805-837,
+  // args.rs:135-150): a dedicated sync thread owns fdatasync, the
+  // loop thread appends and kicks, and an ack releases only once a
+  // COMPLETED fdatasync covers its append (`synced >= ticket`) — the
+  // watermark grab happens before fdatasync so riders of an
+  // in-flight sync wait for the next one.  Completion is signalled
+  // into the event loop via an eventfd the loop polls.
+  std::atomic<uint64_t> seq{0};     // appends so far
+  std::atomic<uint64_t> synced{0};  // appends covered by a done sync
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread syncer;
+  std::atomic<bool> sync_enabled{false};
+  bool stop = false;
+  int efd = -1;
+  uint64_t delay_us = 0;
 };
+
+static void wal_sync_eventfd_signal(NativeWal* w) {
+  uint64_t one = 1;
+  ssize_t r;
+  do {
+    r = ::write(w->efd, &one, 8);
+  } while (r < 0 && errno == EINTR);
+}
+
+static void wal_sync_loop(NativeWal* w) {
+  std::unique_lock<std::mutex> lk(w->mu);
+  for (;;) {
+    w->cv.wait(lk, [w] {
+      return w->stop || w->seq.load(std::memory_order_acquire) >
+                            w->synced.load(std::memory_order_relaxed);
+    });
+    if (w->stop) break;
+    lk.unlock();
+    if (w->delay_us) ::usleep((useconds_t)w->delay_us);
+    // Watermark BEFORE the sync: appends whose pwrite completed
+    // before this load are covered; later arrivals ride the next
+    // cycle (storage/wal.py's _maybe_sync discipline).
+    const uint64_t s = w->seq.load(std::memory_order_acquire);
+    ::fdatasync(w->fd);  // best-effort like the Python path
+    w->synced.store(s, std::memory_order_release);
+    wal_sync_eventfd_signal(w);
+    lk.lock();
+  }
+  lk.unlock();
+  // Final drain on disable: cover appends that raced the stop so
+  // every outstanding ticket resolves (close() then releases all
+  // parked acks — by that point the flushed sstable owns durability).
+  const uint64_t s = w->seq.load(std::memory_order_acquire);
+  if (s > w->synced.load(std::memory_order_relaxed)) ::fdatasync(w->fd);
+  w->synced.store(s, std::memory_order_release);
+  wal_sync_eventfd_signal(w);
+}
 
 // ------------------------- msgpack subset ----------------------------
 
@@ -1345,6 +1408,12 @@ struct FastCollection {
 
 struct DataPlane {
   std::vector<FastCollection> cols;
+  // name -> slot in cols.  O(log n) per-request lookup (the former
+  // linear memcmp scan was measurable at hundreds of collections);
+  // std::less<> gives heterogeneous string_view probes, so the hot
+  // path never allocates regardless of name length.  Kept in sync by
+  // dp_register/dp_unregister.
+  std::map<std::string, size_t, std::less<>> col_map;
   // Ownership of replica_index=0: mode 0 = punt everything,
   // 1 = own all hashes (single-shard ring), 2 = cyclic range (lo, hi].
   int32_t own_mode = 0;
@@ -1355,6 +1424,17 @@ struct DataPlane {
   std::vector<uint8_t> keybuf;  // probe scratch (grown on demand)
   std::vector<uint8_t> valbuf;  // table_find value scratch
 };
+
+// Collection lookup by wire name slice — heterogeneous string_view
+// probe, allocation-free for any name length.
+static FastCollection* dp_find_col(DataPlane* dp, const uint8_t* s,
+                                   uint32_t n, int32_t* idx_out) {
+  const auto it =
+      dp->col_map.find(std::string_view((const char*)s, n));
+  if (it == dp->col_map.end()) return nullptr;
+  *idx_out = (int32_t)it->second;
+  return &dp->cols[it->second];
+}
 
 static void dp_close_tables(FastCollection& col) {
   for (auto& t : col.tables) {
@@ -1564,21 +1644,29 @@ static size_t bytes_repr(const uint8_t* s, uint32_t n, uint8_t* out) {
   return o;
 }
 
-// msgpack str header exactly as msgpack-python packs it.
-static size_t mp_put_strhdr(uint8_t* out, size_t len) {
-  if (len < 32) {
-    out[0] = (uint8_t)(0xa0 | len);
+// msgpack str header exactly as msgpack-python packs it (single
+// definition for every caller in this TU — a second size_t overload
+// capped at str16 used to coexist and silently truncated >=64KiB
+// strings when picked by overload resolution).
+static size_t mp_put_strhdr(uint8_t* o, uint32_t n) {
+  if (n <= 31) {
+    o[0] = (uint8_t)(0xa0 | n);
     return 1;
   }
-  if (len < 256) {
-    out[0] = 0xd9;
-    out[1] = (uint8_t)len;
+  if (n <= 0xff) {
+    o[0] = 0xd9;
+    o[1] = (uint8_t)n;
     return 2;
   }
-  out[0] = 0xda;
-  out[1] = (uint8_t)(len >> 8);
-  out[2] = (uint8_t)len;
-  return 3;
+  if (n <= 0xffff) {
+    o[0] = 0xda;
+    o[1] = (uint8_t)(n >> 8);
+    o[2] = (uint8_t)n;
+    return 3;
+  }
+  o[0] = 0xdb;
+  for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(n >> (24 - 8 * i));
+  return 5;
 }
 
 // Full KeyNotFound wire response for `key`: u32-LE length +
@@ -1612,6 +1700,30 @@ static bool slice_eq(const uint8_t* s, uint32_t n, const char* lit) {
   return n == ln && std::memcmp(s, lit, ln) == 0;
 }
 
+// Client-plane error envelope: u32-LE length + msgpack
+// ["Internal", msg] + RESPONSE_ERR(0) — the same wire shape Python's
+// _error_response emits for non-Dbeel exceptions (message text is
+// not a parity contract on IO-error paths; the envelope is).
+static bool internal_error_response(const char* msg, uint8_t* out,
+                                    uint32_t out_cap,
+                                    uint32_t* out_len) {
+  const size_t mlen = std::strlen(msg);
+  if ((uint64_t)4 + 2 + 8 + 5 + mlen + 1 > out_cap) return false;
+  size_t o = 4;
+  out[o++] = 0x92;  // fixarray(2)
+  out[o++] = 0xa8;  // fixstr(8)
+  std::memcpy(out + o, "Internal", 8);
+  o += 8;
+  o += mp_put_strhdr(out + o, (uint32_t)mlen);
+  std::memcpy(out + o, msg, mlen);
+  o += mlen;
+  out[o++] = 0;  // RESPONSE_ERR
+  const uint32_t body = (uint32_t)(o - 4);
+  std::memcpy(out, &body, 4);
+  *out_len = (uint32_t)o;
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -1629,10 +1741,72 @@ void* dbeel_wal_new(int32_t fd, uint64_t offset) {
   }
 }
 
-void dbeel_wal_free(void* h) { delete static_cast<NativeWal*>(h); }
+void dbeel_wal_sync_disable(void* h) {
+  auto* w = static_cast<NativeWal*>(h);
+  if (!w->sync_enabled.load(std::memory_order_relaxed)) return;
+  {
+    std::lock_guard<std::mutex> lg(w->mu);
+    w->stop = true;
+  }
+  w->cv.notify_one();
+  if (w->syncer.joinable()) w->syncer.join();
+  w->sync_enabled.store(false, std::memory_order_relaxed);
+  w->stop = false;
+}
+
+// Non-blocking half of disable: tell the sync thread to finish (it
+// runs its final drain, publishes the watermark, signals the eventfd
+// once more, then exits).  The caller completes the shutdown with
+// dbeel_wal_sync_disable — which then joins an already-exited
+// thread — from the eventfd callback, so the event loop never waits
+// out an in-flight usleep/fdatasync (review r4: close() stalled the
+// shard at every memtable rotation).
+void dbeel_wal_sync_stop_async(void* h) {
+  auto* w = static_cast<NativeWal*>(h);
+  if (!w->sync_enabled.load(std::memory_order_relaxed)) return;
+  {
+    std::lock_guard<std::mutex> lg(w->mu);
+    w->stop = true;
+  }
+  w->cv.notify_one();
+}
+
+void dbeel_wal_free(void* h) {
+  auto* w = static_cast<NativeWal*>(h);
+  dbeel_wal_sync_disable(w);
+  delete w;
+}
 
 uint64_t dbeel_wal_offset(void* h) {
   return static_cast<NativeWal*>(h)->offset;
+}
+
+// Start the group-commit sync thread for this WAL.  `efd` is an
+// eventfd owned by the caller (the event loop polls it; each
+// completed fdatasync writes 1).  Returns 0, or -1 if already
+// enabled / thread start failed.
+int32_t dbeel_wal_sync_enable(void* h, uint64_t delay_us,
+                              int32_t efd) try {
+  auto* w = static_cast<NativeWal*>(h);
+  if (w->sync_enabled.load(std::memory_order_relaxed)) return -1;
+  w->efd = efd;
+  w->delay_us = delay_us;
+  w->stop = false;
+  w->syncer = std::thread(wal_sync_loop, w);
+  w->sync_enabled.store(true, std::memory_order_release);
+  return 0;
+} catch (...) {
+  return -1;
+}
+
+uint64_t dbeel_wal_seq(void* h) {
+  return static_cast<NativeWal*>(h)->seq.load(
+      std::memory_order_acquire);
+}
+
+uint64_t dbeel_wal_synced(void* h) {
+  return static_cast<NativeWal*>(h)->synced.load(
+      std::memory_order_acquire);
 }
 
 // Append one page-padded record (layout identical to storage/wal.py:
@@ -1675,6 +1849,13 @@ uint64_t dbeel_wal_append(void* h, const uint8_t* key, uint32_t klen,
     done += (uint64_t)ret;
   }
   w->offset += padded;
+  w->seq.fetch_add(1, std::memory_order_release);
+  if (w->sync_enabled.load(std::memory_order_relaxed)) {
+    // Lock-then-notify closes the missed-wakeup window against the
+    // syncer's predicate check; uncontended this is ~20ns.
+    { std::lock_guard<std::mutex> lg(w->mu); }
+    w->cv.notify_one();
+  }
   return w->offset;
 } catch (...) {
   return 0;
@@ -1714,15 +1895,15 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
                           int32_t client_plane) try {
   auto* dp = static_cast<DataPlane*>(h);
   const std::string n((const char*)name, nlen);
-  for (size_t i = 0; i < dp->cols.size(); i++) {
-    if (dp->cols[i].name == n) {
-      dp->cols[i].active = active;
-      dp->cols[i].flushing = flushing;
-      dp->cols[i].wal = static_cast<NativeWal*>(wal);
-      dp->cols[i].capacity = capacity;
-      dp->cols[i].client_ok = client_plane != 0;
-      return (int32_t)i;
-    }
+  const auto it = dp->col_map.find(n);
+  if (it != dp->col_map.end()) {
+    const size_t i = it->second;
+    dp->cols[i].active = active;
+    dp->cols[i].flushing = flushing;
+    dp->cols[i].wal = static_cast<NativeWal*>(wal);
+    dp->cols[i].capacity = capacity;
+    dp->cols[i].client_ok = client_plane != 0;
+    return (int32_t)i;
   }
   FastCollection col;
   col.name = n;
@@ -1732,6 +1913,7 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
   col.capacity = capacity;
   col.client_ok = client_plane != 0;
   dp->cols.push_back(std::move(col));
+  dp->col_map.emplace(n, dp->cols.size() - 1);
   return (int32_t)dp->cols.size() - 1;
 } catch (...) {
   return -1;
@@ -1740,13 +1922,15 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
 void dbeel_dp_unregister(void* h, const uint8_t* name, uint32_t nlen) {
   auto* dp = static_cast<DataPlane*>(h);
   const std::string n((const char*)name, nlen);
-  for (size_t i = 0; i < dp->cols.size(); i++) {
-    if (dp->cols[i].name == n) {
-      dp_close_tables(dp->cols[i]);
-      dp->cols.erase(dp->cols.begin() + i);
-      return;
-    }
-  }
+  const auto it = dp->col_map.find(n);
+  if (it == dp->col_map.end()) return;
+  const size_t i = it->second;
+  dp_close_tables(dp->cols[i]);
+  dp->cols.erase(dp->cols.begin() + i);
+  dp->col_map.erase(it);
+  // The erase shifted every later slot down by one.
+  for (auto& kv : dp->col_map)
+    if (kv.second > i) kv.second--;
 }
 
 // Replace a collection's sstable registry (descs newest-first, the
@@ -1758,13 +1942,9 @@ void dbeel_dp_unregister(void* h, const uint8_t* name, uint32_t nlen) {
 int32_t dbeel_dp_set_tables(void* h, const uint8_t* name, uint32_t nlen,
                             const FastTable* descs, int32_t n) try {
   auto* dp = static_cast<DataPlane*>(h);
-  const std::string nm((const char*)name, nlen);
-  FastCollection* col = nullptr;
-  for (auto& c : dp->cols)
-    if (c.name == nm) {
-      col = &c;
-      break;
-    }
+  int32_t col_idx = -1;
+  FastCollection* col = dp_find_col(dp, name, nlen, &col_idx);
+  (void)col_idx;
   if (col == nullptr) return -1;
   if (n < 0) {
     col->tables_valid = false;
@@ -1947,6 +2127,10 @@ static bool dp_parse_client_frame(const uint8_t* frame, uint32_t len,
 // Returns -1 to punt to the Python handler; otherwise a flags word:
 //   bit0 keepalive, bit1 memtable-now-full (Python spawns the flush),
 //   bit2 this was a get (out buffer holds the response), bit3 delete,
+//   bit4 write-path error (entry applied, WAL append failed; out
+//   holds the complete error response — the frame must NOT re-run),
+//   bit5 ack deferred: wal-sync tree, park the OK on the WAL's sync
+//   ticket (dbeel_wal_seq at return time),
 //   bits 8.. collection slot index.
 // For gets, *out (capacity out_cap) receives the complete wire
 // response: u32-LE length + value bytes + type byte.  Sets need no
@@ -1978,16 +2162,8 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   if (is_set && val_raw == nullptr) return -1;
   if (replica_index != 0) return -1;
 
-  FastCollection* col = nullptr;
   int32_t col_idx = -1;
-  for (size_t i = 0; i < dp->cols.size(); i++) {
-    if (dp->cols[i].name.size() == coll_n &&
-        std::memcmp(dp->cols[i].name.data(), coll_s, coll_n) == 0) {
-      col = &dp->cols[i];
-      col_idx = (int32_t)i;
-      break;
-    }
-  }
+  FastCollection* col = dp_find_col(dp, coll_s, coll_n, &col_idx);
   if (col == nullptr) return -1;
   if (!col->client_ok) return -1;  // RF>1: replication brain is Python
 
@@ -2050,6 +2226,10 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   // Write path: server-assigned timestamp (CLOCK_REALTIME ns, the
   // same clock as Python's time.time_ns).
   if (col->wal == nullptr) return -1;  // gets-only registration
+  // The WAL-failure error response must be emittable from HERE: a
+  // punt after the memtable apply would re-run the frame through
+  // Python and double-apply it with a new timestamp (ADVICE r3).
+  if (out_cap < 96) return -1;
   struct timespec tsp;
   clock_gettime(CLOCK_REALTIME, &tsp);
   const int64_t ts = (int64_t)tsp.tv_sec * 1000000000ll + tsp.tv_nsec;
@@ -2058,14 +2238,26 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       col->active, key_raw, key_n, is_set ? val_raw : nullptr,
       is_set ? val_n : 0, ts, &old_len);
   if (rc < 0) return -1;  // capacity/alloc: Python waits for the flush
-  if (dbeel_wal_append(col->wal, key_raw, key_n,
-                       is_set ? val_raw : nullptr, is_set ? val_n : 0,
-                       ts) == 0)
-    return -1;  // wal IO error: Python path surfaces it properly
-  dp->fast_sets++;
   int64_t flags = ((int64_t)col_idx << 8) | (keepalive ? 1 : 0);
   if (is_del) flags |= 8;
   if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (dbeel_wal_append(col->wal, key_raw, key_n,
+                       is_set ? val_raw : nullptr, is_set ? val_n : 0,
+                       ts) == 0) {
+    // Applied-but-not-WALed: answer with an error natively (the
+    // reference also keeps the memtable entry and errors the client,
+    // lsm_tree.rs:752-771 + write_to_wal Err propagation).
+    if (!internal_error_response("wal append failed", out, out_cap,
+                                 out_len))
+      return -1;  // unreachable: out_cap >= 96 checked pre-apply
+    return flags | 0x10;
+  }
+  dp->fast_sets++;
+  // wal-sync tree: the OK must not leave until a completed fdatasync
+  // covers this append — Python parks the response on the WAL's sync
+  // ticket (bit5).
+  if (col->wal->sync_enabled.load(std::memory_order_relaxed))
+    flags |= 0x20;
   return flags;
 } catch (...) {
   return -1;
@@ -2128,27 +2320,6 @@ size_t mp_put_int64(uint8_t* o, int64_t v) {
   const uint64_t u = (uint64_t)v;
   for (int i = 0; i < 8; i++) o[1 + i] = (uint8_t)(u >> (56 - 8 * i));
   return 9;
-}
-
-size_t mp_put_strhdr(uint8_t* o, uint32_t n) {
-  if (n <= 31) {
-    o[0] = (uint8_t)(0xa0 | n);
-    return 1;
-  }
-  if (n <= 0xff) {
-    o[0] = 0xd9;
-    o[1] = (uint8_t)n;
-    return 2;
-  }
-  if (n <= 0xffff) {
-    o[0] = 0xda;
-    o[1] = (uint8_t)(n >> 8);
-    o[2] = (uint8_t)n;
-    return 3;
-  }
-  o[0] = 0xdb;
-  for (int i = 0; i < 4; i++) o[1 + i] = (uint8_t)(n >> (24 - 8 * i));
-  return 5;
 }
 
 size_t mp_put_binhdr(uint8_t* o, uint32_t n) {
@@ -2242,9 +2413,11 @@ extern "C" {
 // -1 and the frame re-runs through the Python handler unchanged.
 // Returns flags: bit1 memtable-now-full (Python spawns the flush),
 // bit2 response present in out (4B-LE length + msgpack payload),
-// bit3 this was a write, bit5 the write was a delete (set writes get
-// the ITEM_SET_FROM_SHARD_MESSAGE flow notification from Python;
-// deletes don't, matching handle_shard_request), bits 8.. collection
+// bit3 this was a write, bit5 suppress the SET flow notification
+// (deletes, and writes whose WAL append failed — Python notifies
+// ITEM_SET_FROM_SHARD_MESSAGE only for fully successful sets,
+// matching handle_shard_request), bit6 ack deferred (wal-sync tree:
+// park the response on the WAL's sync ticket), bits 8.. collection
 // slot.
 int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
                               uint32_t len, uint8_t* out,
@@ -2285,16 +2458,8 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if ((k_set || k_del) && !mp_read_int64(c, &ts)) return -1;
   if (c.p != c.end) return -1;
 
-  FastCollection* col = nullptr;
   int32_t col_idx = -1;
-  for (size_t i = 0; i < dp->cols.size(); i++) {
-    if (dp->cols[i].name.size() == coll_n &&
-        std::memcmp(dp->cols[i].name.data(), coll_s, coll_n) == 0) {
-      col = &dp->cols[i];
-      col_idx = (int32_t)i;
-      break;
-    }
-  }
+  FastCollection* col = dp_find_col(dp, coll_s, coll_n, &col_idx);
   if (col == nullptr) return -1;
 
   if (k_get) {
@@ -2346,9 +2511,10 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
 
   // Writes: the coordinator assigned ts; apply verbatim.
   if (col->wal == nullptr) return -1;
-  // The ack is up to 4 + 21 bytes: punt BEFORE applying (a post-write
-  // punt would re-run the frame through Python and apply it twice).
-  if (is_req && out_cap < 32) return -1;
+  // The ack is up to 4 + 21 bytes and the WAL-failure error reply up
+  // to 4 + 41: punt BEFORE applying (a post-write punt would re-run
+  // the frame through Python and apply it twice).
+  if (is_req && out_cap < 64) return -1;
   uint32_t old_len = 0;
   const int32_t rc = dbeel_memtable_set(
       col->active, key_s, key_n, k_set ? val_s : nullptr,
@@ -2356,8 +2522,38 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (rc < 0) return -1;  // capacity: Python waits for the flush
   if (dbeel_wal_append(col->wal, key_s, key_n,
                        k_set ? val_s : nullptr, k_set ? val_n : 0,
-                       ts) == 0)
-    return -1;
+                       ts) == 0) {
+    // Applied-but-not-WALed (ADVICE r3): never punt — the frame
+    // would re-execute.  Requests get the shard-plane error reply
+    // ["response","error","Internal","wal append failed"]; events
+    // have no reply channel (the Python handler only logs there).
+    // 0x20 suppresses the SET flow notification either way (Python
+    // notifies only on full success).
+    int64_t eflags = ((int64_t)col_idx << 8) | 8 | 0x20;
+    if (dbeel_memtable_len(col->active) >= col->capacity) eflags |= 2;
+    if (is_req) {
+      uint8_t* o = out + 4;
+      size_t n = 0;
+      o[n++] = 0x94;  // fixarray(4)
+      o[n++] = 0xa8;
+      std::memcpy(o + n, "response", 8);
+      n += 8;
+      o[n++] = 0xa5;
+      std::memcpy(o + n, "error", 5);
+      n += 5;
+      o[n++] = 0xa8;
+      std::memcpy(o + n, "Internal", 8);
+      n += 8;
+      o[n++] = 0xb1;  // fixstr(17)
+      std::memcpy(o + n, "wal append failed", 17);
+      n += 17;
+      const uint32_t n32 = (uint32_t)n;
+      std::memcpy(out, &n32, 4);
+      *out_len = 4 + n32;
+      eflags |= 4;
+    }
+    return eflags;
+  }
   int64_t flags = ((int64_t)col_idx << 8) | 8;
   if (k_del) flags |= 0x20;  // delete: no SET flow notification
   if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
@@ -2384,6 +2580,12 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     *out_len = 4 + n32;
     flags |= 4;
   }
+  // wal-sync tree: a replica ack is a durability promise to the
+  // coordinator — park it on the sync ticket (bit6).  Events have no
+  // ack, but their ITEM_SET flow notification must ALSO wait for the
+  // sync (the Python handler notifies only after the synced write).
+  if (col->wal->sync_enabled.load(std::memory_order_relaxed))
+    flags |= 0x40;
   dp->fast_replica_ops++;
   return flags;
 } catch (...) {
@@ -2399,14 +2601,22 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
 // ["request","set",coll,key,value,ts] / ["request","delete",coll,
 // key,ts] / ["request","get",coll,key]) ready to write verbatim to
 // each replica stream.  For gets the peer frame is followed by the
-// local lookup result: u8 found, u32 vlen, i64 ts, value bytes.
+// local lookup result: u8 found, u32 vlen, i64 ts, u32 klen, value
+// bytes, key bytes (the raw canonical wire key — what Python would
+// recover by unpacking the peer frame, returned here so the hot path
+// never re-pays that msgpack decode; ADVICE r3).
 // Python keeps the replication brain: it picks the replica
 // connections, awaits the quorum acks, merges get results by max
 // timestamp, and answers the client (shards.rs:500-539,
 // db_server.rs:353-363 parity).  Returns -1 to punt (nothing
 // applied); otherwise flags:
 //   bit0 keepalive, bit1 memtable-now-full (spawn the flush),
-//   bit2 delete, bit3 get, bits 8..23 collection slot,
+//   bit2 delete, bit3 get, bit4 write-path error (entry applied,
+//   WAL append failed; out holds the complete client error response
+//   — send it, no fan-out, never re-run the frame),
+//   bit5 local ack deferred (wal-sync tree: await the WAL sync
+//   ticket alongside the quorum fan-out),
+//   bits 8..23 collection slot,
 //   bits 24..31 consistency+1 from the request (0 = absent),
 //   bits 32..61 timeout_ms from the request (0 = absent/falsy).
 int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
@@ -2426,17 +2636,9 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   if (is_set && f.val_raw == nullptr) return -1;
   if (f.replica_index != 0) return -1;
 
-  FastCollection* col = nullptr;
   int32_t col_idx = -1;
-  for (size_t i = 0; i < dp->cols.size(); i++) {
-    if (dp->cols[i].name.size() == f.coll_n &&
-        std::memcmp(dp->cols[i].name.data(), f.coll_s, f.coll_n) ==
-            0) {
-      col = &dp->cols[i];
-      col_idx = (int32_t)i;
-      break;
-    }
-  }
+  FastCollection* col =
+      dp_find_col(dp, f.coll_s, f.coll_n, &col_idx);
   if (col == nullptr) return -1;
   if (col->client_ok) return -1;  // RF=1: plain fast path territory
   if (!is_get && col->wal == nullptr) return -1;
@@ -2467,9 +2669,10 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
                                &ets);
     if (found < 0) return -1;  // cold page: Python async read path
     // Worst-case fixed overhead: 1 (array) + 8 ("request") + 7
-    // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9 (int64) = 40.
+    // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9 (int64) = 40; the
+    // trailer carries the value AND the raw key (17B fixed header).
     const uint64_t need =
-        4ull + 40 + f.coll_n + f.key_n + 13ull + vn;
+        4ull + 40 + f.coll_n + (uint64_t)f.key_n * 2 + 17ull + vn;
     if (need > out_cap) return -1;
     uint8_t* o = out + 4;
     size_t n = 0;
@@ -2492,8 +2695,11 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     t[0] = found ? 1 : 0;
     std::memcpy(t + 1, &vn, 4);
     std::memcpy(t + 5, &ets, 8);
-    if (found && vn != 0) std::memcpy(t + 13, v, vn);
-    *out_len = 4 + n32 + 13 + (found ? vn : 0);
+    std::memcpy(t + 13, &f.key_n, 4);
+    const uint32_t tvn = found ? vn : 0;
+    if (tvn != 0) std::memcpy(t + 17, v, tvn);
+    std::memcpy(t + 17 + tvn, f.key_raw, f.key_n);
+    *out_len = 4 + n32 + 17 + tvn + f.key_n;
     dp->fast_coord_gets++;
     return base_flags | 8;
   }
@@ -2517,8 +2723,18 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     return -1;  // capacity/alloc: Python waits for the flush
   if (dbeel_wal_append(col->wal, f.key_raw, f.key_n,
                        is_set ? f.val_raw : nullptr,
-                       is_set ? f.val_n : 0, ts) == 0)
-    return -1;  // wal IO error: Python path surfaces it properly
+                       is_set ? f.val_n : 0, ts) == 0) {
+    // Applied-but-not-WALed (ADVICE r3): emit the client error
+    // response natively — no fan-out, and the frame never re-runs
+    // (a punt here would double-apply with a new timestamp).
+    if (!internal_error_response("wal append failed", out, out_cap,
+                                 out_len))
+      return -1;  // unreachable: `need` >= the error envelope size
+    int64_t eflags = base_flags | 0x10;
+    if (dbeel_memtable_len(col->active) >= col->capacity) eflags |= 2;
+    if (is_del) eflags |= 4;
+    return eflags;
+  }
 
   uint8_t* o = out + 4;
   size_t n = 0;
@@ -2555,6 +2771,11 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   int64_t flags = base_flags;
   if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
   if (is_del) flags |= 4;
+  // wal-sync tree: the coordinator's own (replica-0) write only
+  // counts as an ack once synced — Python awaits the sync ticket
+  // alongside the quorum fan-out (bit5).
+  if (col->wal->sync_enabled.load(std::memory_order_relaxed))
+    flags |= 0x20;
   return flags;
 } catch (...) {
   return -1;

@@ -1,0 +1,180 @@
+"""Native wal-sync group commit: under ``--wal-sync`` the data plane
+must keep serving writes natively (no wholesale punt to Python), but
+an OK may only leave once a COMPLETED fdatasync covers the append —
+acks park on sync tickets released by the C sync thread's eventfd.
+Reference semantics: /root/reference/src/storage_engine/lsm_tree.rs:
+805-837 (write_to_wal + delayed fdatasync coalescing).
+"""
+
+import asyncio
+import os
+import struct
+
+import msgpack
+import pytest
+
+from dbeel_tpu.storage.native import native_available, load_if_built
+
+from conftest import run
+
+
+def _syncer_available() -> bool:
+    if not native_available() or not hasattr(os, "eventfd"):
+        return False
+    lib = load_if_built()
+    return lib is not None and hasattr(lib, "dbeel_wal_sync_enable")
+
+
+pytestmark = pytest.mark.skipif(
+    not _syncer_available(),
+    reason="native wal syncer unavailable",
+)
+
+
+def test_wal_native_syncer_unit(tmp_dir):
+    """Wal(sync=True) gets a native syncer; appends resolve their
+    sync tickets; records survive in the file; parked callbacks fire
+    in order."""
+    from dbeel_tpu.storage import wal as wal_mod
+
+    async def main():
+        w = wal_mod.Wal(f"{tmp_dir}/w.wal", sync=True)
+        assert w._syncer is not None, "native syncer must engage"
+        for i in range(10):
+            await w.append(b"k%d" % i, b"v%d" % i, 1000 + i)
+        # All acked appends are covered by a completed fdatasync.
+        lib = w._lib
+        assert lib.dbeel_wal_synced(w._native) >= 10
+        fired = []
+        # Already-covered ticket: parked callback releases on the
+        # next watermark event — force one with another append.
+        w._syncer.park(lib.dbeel_wal_seq(w._native), lambda: fired.append(1))
+        await w.append(b"kx", b"vx", 2000)
+        for _ in range(200):
+            if fired:
+                break
+            await asyncio.sleep(0.005)
+        assert fired == [1]
+        w.close()
+        got = list(wal_mod.replay(f"{tmp_dir}/w.wal"))
+        assert len(got) == 11
+        assert got[0] == (b"k0", b"v0", 1000)
+
+    run(main(), timeout=30)
+
+
+async def _request(port, body: dict):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        payload = msgpack.packb(body, use_bin_type=True)
+        writer.write(struct.pack("<H", len(payload)) + payload)
+        await writer.drain()
+        hdr = await reader.readexactly(4)
+        (size,) = struct.unpack("<I", hdr)
+        buf = await reader.readexactly(size)
+        return buf[:-1], buf[-1]
+    finally:
+        writer.close()
+
+
+def test_wal_sync_serving_stays_native(tmp_dir):
+    """A --wal-sync node must serve client writes through the C data
+    plane (fast_sets advances; round 3 punted every durable write) and
+    still answer byte-identical OKs — parked until the sync covers
+    them."""
+    from harness import ClusterNode, make_config
+
+    async def main():
+        cfg = make_config(tmp_dir, wal_sync=True)
+        node = await ClusterNode(cfg).start()
+        try:
+            dp = node.shards[0].dataplane
+            assert dp is not None
+            port = node.config.port
+            await _request(
+                port,
+                {
+                    "type": "create_collection",
+                    "name": "w",
+                    "replication_factor": 1,
+                },
+            )
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            try:
+                for i in range(25):
+                    payload = msgpack.packb(
+                        {
+                            "type": "set",
+                            "collection": "w",
+                            "key": f"k{i:03}",
+                            "value": {"i": i},
+                            "keepalive": True,
+                        },
+                        use_bin_type=True,
+                    )
+                    writer.write(
+                        struct.pack("<H", len(payload)) + payload
+                    )
+                    await writer.drain()
+                    hdr = await reader.readexactly(4)
+                    (size,) = struct.unpack("<I", hdr)
+                    buf = await reader.readexactly(size)
+                    assert buf == msgpack.packb("OK") + b"\x02", buf
+            finally:
+                writer.close()
+            stats = dp.stats()
+            assert stats["fast_sets"] >= 25, stats
+            # Every acked write is under a completed fdatasync.
+            tree = node.shards[0].collections["w"].tree
+            w = tree._wal
+            assert w._syncer is not None
+            assert w._lib.dbeel_wal_synced(w._native) >= 25
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_wal_sync_acked_then_crash_loses_nothing(tmp_dir):
+    """End-to-end durability through the NATIVE path: acked writes on
+    a wal-sync node survive a hard crash (the round-2 test ran the
+    Python punt path; this one asserts the C path carried the load)."""
+    from dbeel_tpu.client import DbeelClient
+    from harness import ClusterNode, make_config
+
+    async def main():
+        cfg = make_config(tmp_dir, wal_sync=True)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        col = await client.create_collection("d")
+        for i in range(80):
+            await col.set(f"k{i:03}", {"i": i})
+        dp_stats = node.shards[0].dataplane.stats()
+        assert dp_stats["fast_sets"] >= 80, dp_stats
+        await node.crash()
+
+        node2 = await ClusterNode(cfg).start()
+        try:
+            client2 = await DbeelClient.from_seed_nodes(
+                [node2.db_address]
+            )
+            col2 = client2.collection("d")
+            lost = [
+                i
+                for i in range(80)
+                if await _missing(col2, f"k{i:03}", {"i": i})
+            ]
+            assert not lost, f"lost acked writes: {lost[:5]}"
+        finally:
+            await node2.stop()
+
+    run(main(), timeout=60)
+
+
+async def _missing(col, key, expect):
+    try:
+        return (await col.get(key)) != expect
+    except Exception:
+        return True
