@@ -127,13 +127,12 @@ def main_native(args):
     except DbeelError as e:
         if "CollectionAlreadyExists" not in str(e):
             raise
-    consistency = {"default": 0, "one": 1, "all": rf}.get(
-        args.consistency
-    )
-    if consistency is None:
-        raise SystemExit(
-            "--native-client supports default/one/all consistency"
-        )
+    consistency = {
+        "default": 0,
+        "one": 1,
+        "quorum": rf // 2 + 1,
+        "all": rf,
+    }[args.consistency]
     time.sleep(0.3)  # collection fan-out to sibling shards
 
     keys = [f"key-{i:08}" for i in range(args.clients * args.requests)]

@@ -149,13 +149,30 @@ def _read_run(lib, source) -> _Run:
     return _Run(buf, size, offs.astype(np.uint64), ks, fs)
 
 
-def _stage_prefixes(run: _Run) -> None:
+def _stage_prefixes(run: _Run, lib=None) -> None:
     """Fill run.prefix64: the zero-padded 8-byte big-endian key prefix
     per entry as one >u8 value (splitters, searchsorted, and the
-    per-partition rebase that feeds the device operand)."""
+    per-partition rebase that feeds the device operand).  Prefers the
+    C stager — the numpy paths held the GIL ~90ms per 1.25M-key run,
+    measured as back-to-back serving stalls at compaction start."""
     n = run.offsets.size
     if n == 0:
         run.prefix64 = np.zeros(0, dtype=">u8")
+        return
+    if lib is not None and hasattr(lib, "dbeel_stage_prefixes"):
+        pref = np.empty(n * 8, dtype=np.uint8)
+        offs = np.ascontiguousarray(run.offsets, dtype=np.uint64)
+        ks = np.ascontiguousarray(run.key_size, dtype=np.uint32)
+        lib.dbeel_stage_prefixes(
+            run.data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_uint64(run.size),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.c_uint64(n),
+            ctypes.c_uint64(ENTRY_HEADER_SIZE),
+            pref.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        run.prefix64 = pref.view(">u8").reshape(n)
         return
     rec = int(run.full_size[0]) if run.full_size.size else 0
     uniform = (
@@ -487,7 +504,7 @@ def _pipeline_merge_impl(
         runs = []
         for f in futs:
             r = f.result()
-            _stage_prefixes(r)
+            _stage_prefixes(r, lib)
             runs.append(r)
     chosen = _choose_partitions(runs)
     if chosen is None:

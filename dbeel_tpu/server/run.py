@@ -19,6 +19,7 @@ import asyncio
 import logging
 import os
 import sys
+import time
 from typing import List, Optional
 
 from ..config import Config, parse_args
@@ -305,11 +306,58 @@ def run_node_processes(config: Config, num_shards: int) -> None:
         sys.exit(1)
 
 
+def _start_loop_watchdog() -> None:
+    """DBEEL_LOOP_WATCHDOG=1: a sampling stall profiler for the shard
+    event loop.  A loop task bumps a heartbeat every 5ms; a daemon
+    thread watches it and, when the loop hasn't run for >25ms,
+    samples the loop thread's Python stack (sys._current_frames) to
+    stderr.  If the stall is a GIL hold the sample lands right after
+    release (the top frame then points at the holder); if the loop
+    thread is blocked in a syscall with the GIL released, the sample
+    catches the exact frame.  Diagnostic aid for tail-latency work —
+    zero cost unless enabled."""
+    import threading
+    import traceback
+
+    state = {"beat": time.monotonic()}
+    loop_thread_id = threading.get_ident()
+
+    async def heartbeat():
+        while True:
+            state["beat"] = time.monotonic()
+            await asyncio.sleep(0.005)
+
+    def watch():
+        last_reported = 0.0
+        while True:
+            time.sleep(0.005)
+            now = time.monotonic()
+            stall = now - state["beat"]
+            if stall > 0.025 and now - last_reported > 0.05:
+                last_reported = now
+                frames = sys._current_frames()
+                f = frames.get(loop_thread_id)
+                stack = (
+                    "".join(traceback.format_stack(f)) if f else "?"
+                )
+                print(
+                    f"[loopwatch] loop stalled {stall*1e3:.0f}ms; "
+                    f"loop thread at:\n{stack}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
+    asyncio.ensure_future(heartbeat())
+    threading.Thread(target=watch, daemon=True).start()
+
+
 async def run_node(
     config: Config, num_shards: Optional[int] = None
 ) -> None:
     """main.rs:17-72: one shard per core on a single loop."""
     _eager_jax_init(config)
+    if os.environ.get("DBEEL_LOOP_WATCHDOG") == "1":
+        _start_loop_watchdog()
     n = num_shards or config.shards or os.cpu_count() or 1
     connections = [LocalShardConnection(i) for i in range(n)]
     shards = [create_shard(config, i, connections) for i in range(n)]

@@ -152,6 +152,14 @@ class MyShard:
         from .dataplane import create_dataplane
 
         self.dataplane = create_dataplane()
+        # Native quorum fan-out engine (VERDICT r3 #2): the packed
+        # peer frame goes out on persistent raw sockets and acks are
+        # byte-compared in C; Python keeps quorum counting/merge/
+        # repair.  None when the native library lacks it — the
+        # asyncio fan-out below is always the fallback.
+        from ..cluster.native_fanout import create_quorum_fanout
+
+        self.quorum_fanout = create_quorum_fanout(self)
         self.local_connection = local_connection
         self.stop_event = local_connection.stop_event
         # Live public-API connections (protocol objects) for the
@@ -412,6 +420,11 @@ class MyShard:
                 if self.dataplane is not None
                 else None
             ),
+            "quorum_fanout": (
+                self.quorum_fanout.stats()
+                if self.quorum_fanout is not None
+                else None
+            ),
             "collections": collections,
         }
 
@@ -644,7 +657,28 @@ class MyShard:
         on each replica stream, and each raw response payload is
         byte-compared against ``expected_ack`` — msgpack unpacking
         happens only on mismatch (error responses) or when a failed
-        replica's hint needs the request as a list."""
+        replica's hint needs the request as a list.  When the native
+        fan-out engine has live streams to every replica, the whole
+        mechanism (socket writes, response reads, ack compare) runs
+        in C (shards.rs:463-543 parity); the asyncio fan-out below is
+        the always-available fallback."""
+        hint_request_fn = lambda: msgs.unpack_message(framed[4:])  # noqa: E731
+        connections = self._replica_connections(number_of_nodes)
+        qf = self.quorum_fanout
+        if qf is not None and all(
+            not isinstance(c, LocalShardConnection)
+            for _n, c in connections
+        ):
+            fut = qf.try_submit(
+                framed,
+                connections,
+                number_of_acks,
+                expected_ack,
+                expected_kind,
+                hint_request_fn,
+            )
+            if fut is not None:
+                return await fut
 
         def interpret(payload: bytes):
             if payload == expected_ack:
@@ -656,19 +690,15 @@ class MyShard:
         return await self._fan_out_to_replicas(
             lambda c: c.send_packed(framed),
             interpret,
-            lambda: msgs.unpack_message(framed[4:]),
+            hint_request_fn,
             number_of_acks,
             number_of_nodes,
+            connections=connections,
         )
 
-    async def _fan_out_to_replicas(
-        self,
-        send_fn,
-        interpret_fn,
-        hint_request_fn,
-        number_of_acks: int,
-        number_of_nodes: int,
-    ) -> List:
+    def _replica_connections(self, number_of_nodes: int) -> List[tuple]:
+        """First ``number_of_nodes`` distinct-OTHER-node shards on the
+        rotated ring (the replica walk, shards.rs:463-497)."""
         nodes: set = set()
         connections: List[tuple] = []
         for s in self.shards:
@@ -680,6 +710,19 @@ class MyShard:
             connections.append((s.node_name, s.connection))
             if len(connections) >= number_of_nodes:
                 break
+        return connections
+
+    async def _fan_out_to_replicas(
+        self,
+        send_fn,
+        interpret_fn,
+        hint_request_fn,
+        number_of_acks: int,
+        number_of_nodes: int,
+        connections: Optional[List[tuple]] = None,
+    ) -> List:
+        if connections is None:
+            connections = self._replica_connections(number_of_nodes)
 
         result_future: asyncio.Future = (
             asyncio.get_event_loop().create_future()
@@ -1358,5 +1401,7 @@ class MyShard:
 
     def close(self) -> None:
         self.close_db_connections()
+        if self.quorum_fanout is not None:
+            self.quorum_fanout.close()
         for col in self.collections.values():
             col.tree.close()

@@ -116,6 +116,14 @@ def start_server(d, port, backend, extra=()):
             else ""
         ),
     }
+    # DBEEL_SERVER_LOG=<path>: capture server stderr (e.g. the
+    # DBEEL_LOOP_WATCHDOG stall stacks) instead of discarding it.
+    log_path = os.environ.get("DBEEL_SERVER_LOG")
+    out = (
+        open(f"{log_path}.{port}", "wb")
+        if log_path
+        else subprocess.DEVNULL
+    )
     return subprocess.Popen(
         [
             sys.executable,
@@ -136,8 +144,195 @@ def start_server(d, port, backend, extra=()):
             *extra,
         ],
         env=env,
-        stdout=subprocess.DEVNULL,
+        stdout=out,
         stderr=subprocess.STDOUT,
+    )
+
+
+def start_cluster_node(
+    d, port, backend, name, seeds, shards=2, extra=()
+):
+    """One cluster node as its own OS process (config-5 shape)."""
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO
+        + (
+            ":" + os.environ["PYTHONPATH"]
+            if os.environ.get("PYTHONPATH")
+            else ""
+        ),
+    }
+    log_path = os.environ.get("DBEEL_SERVER_LOG")
+    out = (
+        open(f"{log_path}.{port}", "wb")
+        if log_path
+        else subprocess.DEVNULL
+    )
+    argv = [
+        sys.executable,
+        "-m",
+        "dbeel_tpu.server.run",
+        "--dir",
+        d,
+        "--name",
+        name,
+        "--port",
+        str(port),
+        "--remote-shard-port",
+        str(port + 10000),
+        "--gossip-port",
+        str(port + 20000),
+        "--shards",
+        str(shards),
+        "--compaction-backend",
+        backend,
+        *(("--seed-nodes", *seeds) if seeds else ()),
+        *extra,
+    ]
+    return subprocess.Popen(
+        argv, env=env, stdout=out, stderr=subprocess.STDOUT
+    )
+
+
+def run_quorum_load(port, duration, tag, op="set", key_count=0):
+    """Connect-per-request quorum ops (consistency=2 on an RF=3
+    collection) against the coordinator node."""
+    lat = []
+    outliers = []
+    t0 = time.time()
+    i = 0
+    ports = (port, port + 1)  # 2 shards on the coordinator node
+    while time.time() < t0 + duration:
+        ta = time.time()
+        body = {
+            "collection": "c",
+            "key": f"qb{tag}{i:08d}"
+            if op == "set"
+            else f"qb{tag}{i % max(1, key_count):08d}",
+            "consistency": 2,
+        }
+        if op == "set":
+            body["type"] = "set"
+            body["value"] = i
+        else:
+            body["type"] = "get"
+        # Naive-client replica walk: try each shard port until the
+        # key is owned (KeyNotOwnedByShard punts to the next).
+        ok = False
+        for p in ports:
+            t, b = req(p, body)
+            if t == 0:
+                err = msgpack.unpackb(b, raw=False)
+                if err and err[0] == "KeyNotOwnedByShard":
+                    continue
+                if op == "get" and err and err[0] == "KeyNotFound":
+                    ok = True  # raced a not-yet-written key: fine
+                    break
+                raise AssertionError(err)
+            ok = True
+            break
+        assert ok, "no shard owned the key"
+        dt = time.time() - ta
+        lat.append(dt)
+        if dt > 0.03:
+            outliers.append((round(ta - t0, 3), round(dt * 1e3, 1)))
+        i += 1
+    lat.sort()
+    return lat, outliers
+
+
+def quorum_main(args):
+    """BASELINE config-5-shaped latency run (VERDICT r3 #9): RF=3
+    quorum Sets AND Gets measured while the coordinator node
+    major-compacts pre-built runs — the BgThrottle story on the
+    replicated plane."""
+    base = tempfile.mkdtemp(prefix="latbench_q_")
+    dirs = [os.path.join(base, f"n{i}") for i in range(3)]
+    for d in dirs:
+        os.makedirs(d)
+    # Pre-built runs + RF=3 metadata on the coordinator node so its
+    # startup compaction majors them during the measurement.
+    col_dir = os.path.join(dirs[0], "c-0")
+    os.makedirs(col_dir)
+    with open(os.path.join(dirs[0], "c.metadata"), "wb") as f:
+        f.write(msgpack.packb({"replication_factor": 3}))
+    print(
+        f"building {args.runs} runs x {args.keys // args.runs} keys ...",
+        file=sys.stderr,
+    )
+    from bench import build_runs
+
+    build_runs(col_dir, args.keys, args.runs)
+
+    p0 = args.port
+    procs = [
+        start_cluster_node(
+            dirs[0], p0, args.backend, "n0", [], extra=args.server_arg
+        )
+    ]
+    try:
+        wait_up(p0)
+        seed = f"127.0.0.1:{p0 + 10000}"
+        for i in (1, 2):
+            procs.append(
+                start_cluster_node(
+                    dirs[i],
+                    p0 + 2 * i,
+                    args.backend,
+                    f"n{i}",
+                    [seed],
+                    extra=args.server_arg,
+                )
+            )
+            wait_up(p0 + 2 * i)
+        # Let discovery/gossip settle and compaction start.
+        time.sleep(2.0)
+        qset, qset_out = run_quorum_load(p0, args.duration, "s")
+        qget, qget_out = run_quorum_load(
+            p0, args.duration, "s", op="get", key_count=len(qset)
+        )
+        compacted = any(
+            n.split(".")[0].isdigit() and int(n.split(".")[0]) % 2 == 1
+            for n in os.listdir(col_dir)
+        ) or any("compact" in n for n in os.listdir(col_dir))
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def summary(lat):
+        return {
+            "ops": len(lat),
+            "p50_us": round(pct(lat, 0.50) * 1e6, 1),
+            "p90_us": round(pct(lat, 0.90) * 1e6, 1),
+            "p99_us": round(pct(lat, 0.99) * 1e6, 1),
+            "p999_us": round(pct(lat, 0.999) * 1e6, 1),
+            "max_ms": round(lat[-1] * 1e3, 2),
+        }
+
+    for name, outs in (("quorum set", qset_out), ("quorum get", qget_out)):
+        if outs:
+            print(
+                f"{name} outliers >30ms (offset_s, ms): {outs}",
+                file=sys.stderr,
+            )
+    print(
+        json.dumps(
+            {
+                "metric": "quorum_latency_under_major_compaction",
+                "unit": "us",
+                "keys": args.keys,
+                "backend": args.backend,
+                "server_args": args.server_arg,
+                "quorum_set": summary(qset),
+                "quorum_get": summary(qget),
+                "compaction_observed": compacted,
+            }
+        )
     )
 
 
@@ -149,6 +344,12 @@ def main():
     ap.add_argument("--port", type=int, default=12600)
     ap.add_argument("--duration", type=float, default=8.0)
     ap.add_argument(
+        "--quorum",
+        action="store_true",
+        help="config-5 shape: 3 nodes x 2 shards, RF=3, quorum "
+        "set/get latency during the coordinator's major compaction",
+    )
+    ap.add_argument(
         "--server-arg",
         action="append",
         default=[],
@@ -157,6 +358,9 @@ def main():
         "the merge throttle for comparison",
     )
     args = ap.parse_args()
+    if args.quorum:
+        quorum_main(args)
+        return
 
     from bench import build_runs  # noqa: E402 (repo-root import)
 

@@ -383,6 +383,86 @@ int64_t dbeel_write_file(const char* path, const uint8_t* data,
   return (f.close_sync() && ok) ? 0 : -1;
 }
 
+// Throttled variants (intra-merge latency classes, VERDICT r3 #4):
+// unbroken multi-hundred-MB reads/writes saturate this host's virtio
+// queue and starve the serving loop — measured as 40-200ms stalls at
+// compaction start.  These chunk the transfer and invoke tick()
+// between chunks (the BgThrottle then sleeps elapsed*fg/bg while
+// serving is busy, pacing the IO burst; an idle shard pays nothing).
+int64_t dbeel_read_file_cb(const char* path, uint8_t* dst,
+                           uint64_t size, dbeel_tick_fn tick,
+                           uint64_t chunk) {
+  if (tick == nullptr || chunk == 0 || chunk >= size)
+    return dbeel_read_file(path, dst, size);
+  chunk &= ~(KALIGN - 1);
+  if (chunk == 0) chunk = KALIGN;
+  const bool aligned = (reinterpret_cast<uintptr_t>(dst) % KALIGN) == 0;
+  const uint64_t body = size & ~(KALIGN - 1);
+  uint64_t done = 0;
+  if (aligned && body) {
+    int fd = ::open(path, O_RDONLY | O_DIRECT);
+    if (fd >= 0) {
+      while (done < body) {
+        const uint64_t want = std::min(chunk, body - done);
+        uint64_t got = 0;
+        while (got < want) {
+          ssize_t r = ::pread(fd, dst + done + got, want - got,
+                              done + got);
+          if (r <= 0) break;
+          got += (uint64_t)r;
+        }
+        done += got;
+        if (got < want) break;
+        tick();
+      }
+      ::close(fd);
+    }
+  }
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -(int64_t)errno;
+  // Buffered remainder/fallback (e.g. an unaligned destination):
+  // still chunk + tick — an unthrottled fallback would silently
+  // reintroduce the full-speed burst this function exists to pace.
+  uint64_t since_tick = 0;
+  while (done < size) {
+    const uint64_t want = std::min(chunk, size - done);
+    ssize_t r = ::pread(fd, dst + done, want, done);
+    if (r < 0) {
+      ::close(fd);
+      return -(int64_t)errno;
+    }
+    if (r == 0) break;
+    done += (uint64_t)r;
+    since_tick += (uint64_t)r;
+    if (since_tick >= chunk && done < size) {
+      since_tick = 0;
+      tick();
+    }
+  }
+  ::close(fd);
+  return (int64_t)done;
+}
+
+int64_t dbeel_write_file_cb(const char* path, const uint8_t* data,
+                            uint64_t size, dbeel_tick_fn tick,
+                            uint64_t chunk) {
+  StreamFile f;
+  if (!f.open_for_write(path)) return -1;
+  bool ok = true;
+  if (tick == nullptr || chunk == 0) {
+    ok = f.append(data, size);
+  } else {
+    uint64_t done = 0;
+    while (done < size && ok) {
+      const uint64_t n = std::min(chunk, size - done);
+      ok = f.append(data + done, n);
+      done += n;
+      if (done < size) tick();
+    }
+  }
+  return (f.close_sync() && ok) ? 0 : -1;
+}
+
 void* dbeel_writer_open(const char* data_path, const char* index_path) {
   auto* w = new GatherWriter();
   if (!w->data.open_for_write(data_path) ||
@@ -454,6 +534,31 @@ void dbeel_writer_abort(void* handle) {
   w->data.abort_close();
   w->index.abort_close();
   delete w;
+}
+
+// Stage the pipeline's 8-byte big-endian key prefixes for one run:
+// out[i] = first 8 key bytes at offsets[i]+16, zero padded to the
+// key length.  The Python version (_stage_prefixes) held the GIL for
+// ~90ms of numpy per 1.25M-key run — measured as back-to-back
+// serving stalls at compaction start (latency_bench outliers);
+// ctypes releases the GIL around this call so the shard loop keeps
+// serving while the merge thread stages.  Output is the raw
+// big-endian byte order (Python views it as '>u8').
+void dbeel_stage_prefixes(const uint8_t* data, uint64_t data_size,
+                          const uint64_t* offsets,
+                          const uint32_t* key_sizes, uint64_t n,
+                          uint64_t entry_header, uint8_t* out) {
+  for (uint64_t i = 0; i < n; i++) {
+    const uint64_t pos = offsets[i] + entry_header;
+    const uint32_t kn = key_sizes[i];
+    uint8_t* o = out + i * 8;
+    if (pos + 8 <= data_size && kn >= 8) {
+      std::memcpy(o, data + pos, 8);
+      continue;
+    }
+    for (uint32_t j = 0; j < 8; j++)
+      o[j] = (j < kn && pos + j < data_size) ? data[pos + j] : 0;
+  }
 }
 
 // One-pass decode of the kernel's bit-packed run-id stream (the
@@ -2990,6 +3095,352 @@ int dbeel_uring_reap(void* h, uint64_t* tags, int32_t* results,
   __atomic_store_n(u->cq_head, head, __ATOMIC_RELEASE);
   if (n > 0 && u->in_flight >= (unsigned)n) u->in_flight -= n;
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Native quorum fan-out (VERDICT r3 #2) — the coordinator side of
+// RF>1 replication.  Role parity with the reference's compiled
+// replica fan-out (/root/reference/src/shards.rs:463-543 +
+// remote_shard_connection.rs:59-94): one persistent stream per peer
+// node, the packed peer frame written to each replica socket and the
+// acks byte-compared entirely in C.  Python keeps the replication
+// BRAIN — quorum counting, max-timestamp merge, read repair, hinted
+// handoff — consuming per-response events from this engine instead
+// of running per-op asyncio tasks/wait_for/wait machinery.
+//
+// Threading contract: single-threaded (the shard event loop).  The
+// loop registers each stream fd with its selector and calls
+// dbeel_qf_on_readable from the read callback; writes that would
+// block park in a per-stream buffer and the loop adds a writer
+// callback until dbeel_qf_on_writable drains it.  Responses on one
+// stream arrive in request order (the peer's remote shard server
+// answers a persistent connection in arrival order), so a FIFO of
+// op ids per stream pairs frames with ops.
+// ---------------------------------------------------------------------
+
+#include <sys/socket.h>
+
+#include <deque>
+#include <unordered_map>
+
+namespace {
+
+struct QfEvent {
+  uint64_t op_id;
+  int32_t peer_id;
+  int32_t kind;  // 0 = ack (byte-identical), 1 = payload, 2 = dead
+  std::vector<uint8_t> payload;
+};
+
+struct QfStream {
+  int fd = -1;
+  std::deque<uint64_t> fifo;  // op ids awaiting responses, in order
+  std::vector<uint8_t> rbuf;  // partial frame reassembly
+  std::vector<uint8_t> wbuf;  // unsent bytes (EAGAIN backlog)
+  size_t woff = 0;
+  bool dead = true;
+};
+
+struct QfOp {
+  std::vector<uint8_t> ack;  // expected ack payload (may be empty)
+  uint32_t waiting = 0;
+};
+
+struct QuorumFan {
+  std::vector<QfStream> peers;   // index = peer_id
+  std::unordered_map<uint64_t, QfOp> ops;
+  std::deque<QfEvent> events;
+  uint64_t next_op = 1;
+  uint64_t fast_fanout_ops = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dbeel_qf_new(void) try {
+  return new QuorumFan();
+} catch (...) {
+  return nullptr;
+}
+
+void dbeel_qf_free(void* h) {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (q == nullptr) return;
+  for (auto& s : q->peers)
+    if (s.fd >= 0) ::close(s.fd);
+  delete q;
+}
+
+// Install a CONNECTED non-blocking socket for peer_id (the engine
+// owns the fd from here; the caller must have removed any selector
+// registration for the PREVIOUS fd first).  Replaces any previous
+// stream; in-flight ops on the old stream get dead events.
+static void qf_fail_stream(QuorumFan* q, int32_t peer_id);
+
+int32_t dbeel_qf_set_stream(void* h, int32_t peer_id, int32_t fd) try {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id < 0 || peer_id > 4096) return -1;
+  if ((size_t)peer_id >= q->peers.size())
+    q->peers.resize(peer_id + 1);
+  QfStream& s = q->peers[peer_id];
+  if (s.fd >= 0) {
+    qf_fail_stream(q, peer_id);
+    ::close(s.fd);
+  }
+  s.fd = fd;
+  s.dead = false;
+  s.rbuf.clear();
+  s.wbuf.clear();
+  s.woff = 0;
+  return 0;
+} catch (...) {
+  return -1;
+}
+
+int32_t dbeel_qf_stream_alive(void* h, int32_t peer_id) {
+  auto* q = static_cast<QuorumFan*>(h);
+  return (peer_id >= 0 && (size_t)peer_id < q->peers.size() &&
+          !q->peers[peer_id].dead)
+             ? 1
+             : 0;
+}
+
+}  // extern "C"
+
+namespace {
+
+// Mark a stream dead and emit dead events for every op still
+// awaiting a response on it.  The fd is NOT closed here: Python owns
+// the selector registration and must remove_reader before the fd is
+// closed (dbeel_qf_close_stream) — closing under a live epoll
+// registration invites fd-number reuse collisions.
+void qf_fail_stream_impl(QuorumFan* q, int32_t peer_id) {
+  QfStream& s = q->peers[peer_id];
+  s.dead = true;
+  for (uint64_t op_id : s.fifo) {
+    auto it = q->ops.find(op_id);
+    if (it == q->ops.end()) continue;
+    q->events.push_back(QfEvent{op_id, peer_id, 2, {}});
+    if (--it->second.waiting == 0) q->ops.erase(it);
+  }
+  s.fifo.clear();
+  s.rbuf.clear();
+  s.wbuf.clear();
+  s.woff = 0;
+}
+
+}  // namespace
+
+static void qf_fail_stream(QuorumFan* q, int32_t peer_id) {
+  qf_fail_stream_impl(q, peer_id);
+}
+
+extern "C" {
+
+void dbeel_qf_kill_stream(void* h, int32_t peer_id) {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id >= 0 && (size_t)peer_id < q->peers.size())
+    qf_fail_stream(q, peer_id);
+}
+
+// Close a (dead) stream's fd after the caller has removed its
+// selector registration.
+void dbeel_qf_close_stream(void* h, int32_t peer_id) {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id < 0 || (size_t)peer_id >= q->peers.size()) return;
+  QfStream& s = q->peers[peer_id];
+  if (!s.dead) qf_fail_stream(q, peer_id);
+  if (s.fd >= 0) ::close(s.fd);
+  s.fd = -1;
+}
+
+// Submit one op: write `frame` (already 4B-LE length prefixed) to
+// every peer in `peer_ids`, expecting `ack` back from each.  Returns
+// the op id (> 0), or 0 if ANY listed peer has no live stream — the
+// caller then runs the op through its own (Python) fan-out path and
+// repairs the streams out of band; nothing was sent.
+uint64_t dbeel_qf_submit(void* h, const uint8_t* frame, uint32_t len,
+                         const int32_t* peer_ids, uint32_t n_peers,
+                         const uint8_t* ack, uint32_t ack_len) try {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (n_peers == 0) return 0;
+  for (uint32_t i = 0; i < n_peers; i++) {
+    const int32_t p = peer_ids[i];
+    if (p < 0 || (size_t)p >= q->peers.size() || q->peers[p].dead)
+      return 0;
+  }
+  const uint64_t id = q->next_op++;
+  QfOp op;
+  op.ack.assign(ack, ack + ack_len);
+  op.waiting = n_peers;
+  q->ops.emplace(id, std::move(op));
+  for (uint32_t i = 0; i < n_peers; i++) {
+    QfStream& s = q->peers[peer_ids[i]];
+    s.fifo.push_back(id);
+    if (s.wbuf.size() > s.woff) {
+      // Earlier bytes still parked: keep strict order.
+      s.wbuf.insert(s.wbuf.end(), frame, frame + len);
+      continue;
+    }
+    size_t done = 0;
+    while (done < len) {
+      const ssize_t r =
+          ::send(s.fd, frame + done, len - done, MSG_NOSIGNAL);
+      if (r > 0) {
+        done += (size_t)r;
+        continue;
+      }
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        s.wbuf.assign(frame + done, frame + len);
+        s.woff = 0;
+        break;
+      }
+      if (r < 0 && errno == EINTR) continue;
+      // Connection error: the op still counts this peer; fail the
+      // stream (dead event covers it).
+      qf_fail_stream(q, peer_ids[i]);
+      break;
+    }
+  }
+  q->fast_fanout_ops++;
+  return id;
+} catch (...) {
+  return 0;
+}
+
+// True when a peer's stream has parked write bytes (the loop should
+// add a writable watcher for its fd).
+int32_t dbeel_qf_wants_write(void* h, int32_t peer_id) {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id < 0 || (size_t)peer_id >= q->peers.size()) return 0;
+  const QfStream& s = q->peers[peer_id];
+  return (!s.dead && s.wbuf.size() > s.woff) ? 1 : 0;
+}
+
+// Flush parked writes.  Returns 1 while more remains (keep the
+// watcher), 0 when drained (remove it), -1 if the stream died.
+int32_t dbeel_qf_on_writable(void* h, int32_t peer_id) try {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id < 0 || (size_t)peer_id >= q->peers.size()) return -1;
+  QfStream& s = q->peers[peer_id];
+  if (s.dead) return -1;
+  while (s.woff < s.wbuf.size()) {
+    const ssize_t r = ::send(s.fd, s.wbuf.data() + s.woff,
+                             s.wbuf.size() - s.woff, MSG_NOSIGNAL);
+    if (r > 0) {
+      s.woff += (size_t)r;
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return 1;
+    if (r < 0 && errno == EINTR) continue;
+    qf_fail_stream(q, peer_id);
+    return -1;
+  }
+  s.wbuf.clear();
+  s.woff = 0;
+  return 0;
+} catch (...) {
+  return -1;
+}
+
+// Drain a readable stream: parse 4B-LE frames, pair each with the
+// FIFO-front op, byte-compare against the op's expected ack, queue
+// events.  Returns the number of events queued, or -1 if the stream
+// died (the caller removes its reader and may reconnect).
+int32_t dbeel_qf_on_readable(void* h, int32_t peer_id) try {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (peer_id < 0 || (size_t)peer_id >= q->peers.size()) return -1;
+  QfStream& s = q->peers[peer_id];
+  if (s.dead) return -1;
+  int32_t emitted = 0;
+  uint8_t chunk[16384];
+  for (;;) {
+    const ssize_t r = ::recv(s.fd, chunk, sizeof(chunk), 0);
+    if (r > 0) {
+      s.rbuf.insert(s.rbuf.end(), chunk, chunk + r);
+      // Parse complete frames.
+      size_t off = 0;
+      while (s.rbuf.size() - off >= 4) {
+        uint32_t flen;
+        std::memcpy(&flen, s.rbuf.data() + off, 4);
+        if (flen > (64u << 20)) {  // insane frame: protocol break
+          qf_fail_stream(q, peer_id);
+          return -1;
+        }
+        if (s.rbuf.size() - off < 4ull + flen) break;
+        if (s.fifo.empty()) {  // response with no request: break
+          qf_fail_stream(q, peer_id);
+          return -1;
+        }
+        const uint64_t op_id = s.fifo.front();
+        s.fifo.pop_front();
+        auto it = q->ops.find(op_id);
+        if (it != q->ops.end()) {
+          QfOp& op = it->second;
+          const uint8_t* payload = s.rbuf.data() + off + 4;
+          const bool is_ack =
+              !op.ack.empty() && flen == op.ack.size() &&
+              std::memcmp(payload, op.ack.data(), flen) == 0;
+          QfEvent ev;
+          ev.op_id = op_id;
+          ev.peer_id = peer_id;
+          ev.kind = is_ack ? 0 : 1;
+          if (!is_ack)
+            ev.payload.assign(payload, payload + flen);
+          q->events.push_back(std::move(ev));
+          emitted++;
+          if (--op.waiting == 0) q->ops.erase(it);
+        }
+        off += 4ull + flen;
+      }
+      if (off) s.rbuf.erase(s.rbuf.begin(), s.rbuf.begin() + off);
+      if ((size_t)r < sizeof(chunk)) break;  // buffer drained
+      continue;
+    }
+    if (r == 0) {  // peer closed
+      qf_fail_stream(q, peer_id);
+      return -1;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+    if (errno == EINTR) continue;
+    qf_fail_stream(q, peer_id);
+    return -1;
+  }
+  return emitted;
+} catch (...) {
+  return -1;
+}
+
+// Pop the next event.  Returns 1 with out params filled (payload
+// truncated to cap; plen carries the true length), 0 when empty.
+int32_t dbeel_qf_next_event(void* h, uint64_t* op_id,
+                            int32_t* peer_id, int32_t* kind,
+                            uint8_t* payload, uint32_t cap,
+                            uint32_t* plen) {
+  auto* q = static_cast<QuorumFan*>(h);
+  if (q->events.empty()) return 0;
+  QfEvent& ev = q->events.front();
+  *op_id = ev.op_id;
+  *peer_id = ev.peer_id;
+  *kind = ev.kind;
+  const uint32_t n = (uint32_t)ev.payload.size();
+  *plen = n;
+  if (n && cap) std::memcpy(payload, ev.payload.data(),
+                            n < cap ? n : cap);
+  if (n > cap) {
+    // Caller's buffer too small: leave the event queued so it can
+    // retry with a bigger buffer.
+    return -2;
+  }
+  q->events.pop_front();
+  return 1;
+}
+
+uint64_t dbeel_qf_fanout_ops(void* h) {
+  return static_cast<QuorumFan*>(h)->fast_fanout_ops;
 }
 
 }  // extern "C"
