@@ -208,6 +208,12 @@ class FramedServerProtocol(asyncio.Protocol):
                 and not self.pending
                 and not self.closing
                 and self.writable.is_set()
+                # Parked (sync-deferred) acks are bounded like pending
+                # frames: past the high-water mark new frames take the
+                # slow path, whose queue pauses reading — otherwise a
+                # pipelining client against a slow fdatasync could grow
+                # the parked deque without bound.
+                and len(self.parked) <= self.PENDING_HIGH
             ):
                 verdict = self._try_fast(frame)
                 if verdict == FAST_CLOSE:

@@ -142,7 +142,11 @@ class _NativeSyncer:
             return
         if on_done is not None:
             self._on_done.append(on_done)
-        if self._loop is not None:
+        # The async handshake needs a LIVE loop to deliver the exit
+        # ping; after loop shutdown (process teardown, __del__) fall
+        # back to the synchronous join or the native handle, eventfd,
+        # and any pending unlink would leak forever.
+        if self._loop is not None and self._loop.is_running():
             self._stopping = True
             if hasattr(self._lib, "dbeel_wal_sync_stop_async"):
                 self._lib.dbeel_wal_sync_stop_async(self._native)

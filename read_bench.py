@@ -71,12 +71,90 @@ def pcts(lat):
     }
 
 
+def fresh_flush_ab(args):
+    """VERDICT r3 #6: first-read latency on a JUST-FLUSHED table.
+
+    A: the native GIL-free flush (dbeel_memtable_flush_write) — no
+       user-space page-cache mirroring; first reads miss the W-TinyLFU
+       cache and fall to preadv2 against the OS page cache (the flush
+       just wrote those bytes buffered, so the kernel still has them).
+    B: the Python EntryWriter with cache mirroring (the reference's
+       entry_writer.rs:94-138 behavior — every filled page lands in
+       the user-space cache during the write).
+
+    The gap, if real, is the cost of a user-space miss + pread vs a
+    cache hit on the very first post-flush reads."""
+    from dbeel_tpu.storage.entry_writer import EntryWriter
+    from dbeel_tpu.storage.memtable import ArenaMemtable
+
+    n = args.keys
+    rng = random.Random(11)
+    items = sorted(
+        (
+            f"fk{rng.randrange(1 << 60):019d}".encode(),
+            (b"v" * 64, 1000 + i),
+        )
+        for i, _ in enumerate(range(n))
+    )
+
+    results = {}
+    for mode in ("native_flush", "mirroring_writer"):
+        d = tempfile.mkdtemp(prefix=f"dbeel_fresh_{mode}_")
+        cache = PartitionPageCache("c", PageCache(1 << 14))
+        t0 = time.perf_counter()
+        if mode == "native_flush":
+            mt = ArenaMemtable(n + 1)
+            for k, (v, ts) in items:
+                mt.set(k, v, ts)
+            count = mt.flush_to_sstable(d, 1, 1 << 30)  # no bloom
+            assert count == len(items)
+        else:
+            w = EntryWriter(d, 1, cache)
+            for k, (v, ts) in items:
+                w.write(k, v, ts)
+            w.close()
+        write_s = time.perf_counter() - t0
+        table = SSTable(d, 1, cache)
+        table.warm()  # the off-loop prewarm the serving path gets
+        picks = random.Random(5).sample(items, args.lookups)
+        lat = []
+        for k, (v, _ts) in picks:
+            t0 = time.perf_counter()
+            hit = table.get(k)
+            lat.append(time.perf_counter() - t0)
+            assert hit is not None and hit[0] == v
+        results[mode] = {"write_s": round(write_s, 3), **pcts(lat)}
+        log(f"{mode}: write {write_s:.3f}s first-reads {pcts(lat)}")
+        table.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "first_read_after_flush",
+                "keys": n,
+                "lookups": args.lookups,
+                **results,
+            }
+        )
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=10_000_000)
     ap.add_argument("--lookups", type=int, default=5000)
     ap.add_argument("--dir", default=None)
+    ap.add_argument(
+        "--fresh-flush",
+        action="store_true",
+        help="A/B: first-read latency on a just-flushed table, native "
+        "flush (no cache mirroring) vs Python mirroring writer "
+        "(pair with --keys ~200000)",
+    )
     args = ap.parse_args()
+    if args.fresh_flush:
+        fresh_flush_ab(args)
+        return
 
     d = args.dir or tempfile.mkdtemp(prefix="dbeel_readbench_")
     os.makedirs(d, exist_ok=True)
