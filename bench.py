@@ -171,7 +171,16 @@ class ProbeManager:
                 self._launch()
             if r is False and self.conclusive:
                 return False
-            time.sleep(min(2.0, stop - now))
+            step = min(2.0, stop - now)
+            if self.proc is not None:
+                # Wake for the in-flight attempt's own timeout too —
+                # a coarse fixed sleep would skip the kill+relaunch
+                # when per_attempt is shorter than the step.
+                step = min(
+                    step,
+                    max(0.05, self.per_attempt - (now - self.t0) + 0.01),
+                )
+            time.sleep(step)
 
     def confirm_fresh(self, floor_s: float):
         """Discard any cached success and demand a fresh probe —

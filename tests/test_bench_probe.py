@@ -1,0 +1,86 @@
+"""bench.py's ProbeManager: the dead-tunnel guard that round 3's
+driver artifact died on.  The child command is monkeypatched so the
+three weather modes — healthy, conclusively broken (fast non-zero
+exit), and wedged (never exits) — run in milliseconds."""
+
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def make_pm(monkeypatch):
+    def _pm(child, per_attempt_s, budget_s):
+        # Patch for the whole test: retries relaunch with _CHILD too.
+        monkeypatch.setattr(bench.ProbeManager, "_CHILD", child)
+        return bench.ProbeManager(per_attempt_s, budget_s)
+
+    return _pm
+
+
+def test_healthy_backend_probes_true_quickly(make_pm):
+    pm = make_pm("import sys; sys.exit(0)", 5.0, 10.0)
+    t0 = time.monotonic()
+    assert pm.wait() is True
+    assert time.monotonic() - t0 < 5.0
+    # A fresh confirmation also succeeds.
+    assert pm.confirm_fresh(floor_s=5.0) is True
+
+
+def test_conclusive_failure_gives_up_fast(make_pm):
+    """Two fast non-zero exits are conclusive (jax missing/broken):
+    the manager must stop relaunching instead of burning the budget
+    in ~2s cycles (round-4 review finding)."""
+    pm = make_pm("import sys; sys.exit(3)", 5.0, 60.0)
+    t0 = time.monotonic()
+    assert pm.wait() is False
+    took = time.monotonic() - t0
+    assert took < 30.0, f"burned {took:.0f}s on a conclusive failure"
+    assert pm.conclusive
+    # The floor must NOT resurrect a conclusive verdict either.
+    t0 = time.monotonic()
+    assert pm.wait(extra_floor_s=30.0) is False
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_wedged_backend_retries_until_budget(make_pm):
+    """A wedge (child never exits) is retryable weather: attempts are
+    killed at per_attempt and relaunched until the budget ends."""
+    pm = make_pm("import time; time.sleep(600)", 0.4, 1.5)
+    t0 = time.monotonic()
+    assert pm.wait() is False
+    took = time.monotonic() - t0
+    assert 1.0 <= took < 10.0, took
+    assert not pm.conclusive  # wedges never conclude
+    assert pm.attempt >= 2  # it actually retried
+
+
+def test_nonblocking_check_while_working(make_pm):
+    """check() must never block (the bench calls it between build/CPU
+    phases while the probe child runs)."""
+    pm = make_pm("import time; time.sleep(600)", 5.0, 6.0)
+    t0 = time.monotonic()
+    for _ in range(5):
+        assert pm.check() is None  # in flight, budget remains
+    assert time.monotonic() - t0 < 1.0
+    # Cleanup: abandon the wedged child.
+    pm.deadline = time.monotonic()
+    pm.wait()
+
+
+def test_late_waking_tunnel_still_wins(make_pm, tmp_path):
+    """A tunnel that comes alive mid-bench produces a device verdict:
+    the first attempt fails fast, a later relaunch succeeds (round-3's
+    design lost the whole round in this scenario)."""
+    flag = tmp_path / "alive"
+    child = (
+        "import os, sys;"
+        f" p = {str(flag)!r};"
+        " sys.exit(0) if os.path.exists(p)"
+        " else (open(p, 'w').close(), sys.exit(7))[1]"
+    )
+    pm = make_pm(child, 5.0, 30.0)
+    assert pm.wait() is True  # attempt 1 fails, attempt 2 succeeds
+    assert pm.attempt >= 2

@@ -186,6 +186,22 @@ def test_native_merge_cb_ticks_and_matches(tmp_dir):
     assert n_ticks == n_total // 4096
 
 
+def _native_merge(tmp_dir, out_index, throttle):
+    """Merge the fixture tables at indices 0 and 2 through the native
+    strategy (shared by the throttle-variant tests)."""
+    from dbeel_tpu.storage import native
+    from dbeel_tpu.storage.sstable import SSTable
+
+    s = native.NativeMergeStrategy()
+    s.throttle = throttle
+    sources = [SSTable(tmp_dir, 0, None), SSTable(tmp_dir, 2, None)]
+    try:
+        return s.merge(sources, tmp_dir, out_index, None, True, 1 << 30)
+    finally:
+        for t in sources:
+            t.close()
+
+
 def test_native_strategy_merge_with_and_without_throttle(tmp_dir):
     """Regression: the no-throttle path must pass a NULL fn pointer to
     dbeel_merge_cb (a bare None for a CFUNCTYPE argtype raises
@@ -194,7 +210,6 @@ def test_native_strategy_merge_with_and_without_throttle(tmp_dir):
 
     from dbeel_tpu.server.scheduler import ShareScheduler
     from dbeel_tpu.storage import native
-    from dbeel_tpu.storage.sstable import SSTable
 
     if not native.native_available():
         pytest.skip("native lib unavailable")
@@ -206,18 +221,10 @@ def test_native_strategy_merge_with_and_without_throttle(tmp_dir):
     write_sstable_fixture(tmp_dir, 0, entries_a)
     write_sstable_fixture(tmp_dir, 2, entries_b)
 
-    def merge(out_index, throttle):
-        s = native.NativeMergeStrategy()
-        s.throttle = throttle
-        sources = [SSTable(tmp_dir, 0, None), SSTable(tmp_dir, 2, None)]
-        try:
-            return s.merge(sources, tmp_dir, out_index, None, True, 1 << 30)
-        finally:
-            for t in sources:
-                t.close()
-
-    r1 = merge(1, None)  # no throttle: NULL callback path
-    r2 = merge(3, ShareScheduler().thread_throttle())
+    r1 = _native_merge(tmp_dir, 1, None)  # no throttle: NULL callback
+    r2 = _native_merge(
+        tmp_dir, 3, ShareScheduler().thread_throttle()
+    )
     assert r1.entry_count == r2.entry_count == 200
     from dbeel_tpu.storage.entry import (
         COMPACT_DATA_FILE_EXT,
@@ -227,3 +234,68 @@ def test_native_strategy_merge_with_and_without_throttle(tmp_dir):
     d1 = open(f"{tmp_dir}/{file_name(1, COMPACT_DATA_FILE_EXT)}", "rb").read()
     d3 = open(f"{tmp_dir}/{file_name(3, COMPACT_DATA_FILE_EXT)}", "rb").read()
     assert d1 == d3 and len(d1) > 0
+
+
+def test_chunked_throttled_merge_io_byte_identical(tmp_dir, monkeypatch):
+    """The chunk+tick IO path (dbeel_read_file_cb / dbeel_write_file_cb
+    — VERDICT r3 #4's virtio-burst pacing) must produce byte-identical
+    merges and actually tick between chunks.  Real sizes never fit a
+    test, so the chunk size shrinks to 4KiB and O_DIRECT writes engage
+    at zero bytes."""
+    import pytest
+
+    from dbeel_tpu.server.scheduler import ShareScheduler
+    from dbeel_tpu.storage import native
+
+    if not native.native_available():
+        pytest.skip("native lib unavailable")
+    lib = native.load_if_built()
+    if not hasattr(lib, "dbeel_read_file_cb"):
+        pytest.skip("chunked IO entry points unavailable")
+
+    from conftest import write_sstable_fixture
+
+    entries_a = [
+        (b"c%05d" % i, b"A" * 96, 5) for i in range(0, 2000, 2)
+    ]
+    entries_b = [
+        (b"c%05d" % i, b"B" * 96, 6) for i in range(1, 2000, 2)
+    ]
+    write_sstable_fixture(tmp_dir, 0, entries_a)
+    write_sstable_fixture(tmp_dir, 2, entries_b)
+
+    # Plain path (no throttle -> whole-file reads, buffered writer).
+    r_plain = _native_merge(tmp_dir, 1, None)
+
+    # Chunked path: tiny chunks + O_DIRECT from byte 0, tick counted.
+    monkeypatch.setattr(native, "_IO_CHUNK_BYTES", 4096)
+    monkeypatch.setattr(native, "ODIRECT_MIN_BYTES", 0)
+    class CountingThrottle:
+        def __init__(self, inner):
+            self.inner = inner
+            self.n = 0
+
+        def tick(self):
+            self.n += 1
+            self.inner.tick()
+
+    t = CountingThrottle(ShareScheduler().thread_throttle())
+    r_chunked = _native_merge(tmp_dir, 3, t)
+
+    assert r_plain.entry_count == r_chunked.entry_count == 2000
+    from dbeel_tpu.storage.entry import (
+        COMPACT_DATA_FILE_EXT,
+        COMPACT_INDEX_FILE_EXT,
+        file_name,
+    )
+
+    for ext in (COMPACT_DATA_FILE_EXT, COMPACT_INDEX_FILE_EXT):
+        a = open(f"{tmp_dir}/{file_name(1, ext)}", "rb").read()
+        b = open(f"{tmp_dir}/{file_name(3, ext)}", "rb").read()
+        assert a == b and len(a) > 0, ext
+    # The READS alone (2x ~118KB data + 2x 16KB index at 4KiB chunks)
+    # account for ~65 ticks; requiring >100 means the WRITE side
+    # (dbeel_write_file_cb, ~65 more) must have ticked too — a
+    # regression that silently stops pacing the output burst fails
+    # here.
+    assert t.n > 100, t.n
