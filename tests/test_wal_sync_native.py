@@ -137,6 +137,86 @@ def test_wal_sync_serving_stays_native(tmp_dir):
     run(main(), timeout=60)
 
 
+def test_pipelined_durable_acks_stay_ordered(tmp_dir):
+    """A keepalive client that pipelines writes against wal-sync gets
+    every ack exactly once, in order — the parked-response FIFO
+    (framed.park_response) + the high-water gate that routes overflow
+    frames to the slow path must agree on ordering."""
+    from harness import ClusterNode, make_config
+
+    async def main():
+        cfg = make_config(tmp_dir, wal_sync=True, wal_sync_delay_us=3000)
+        node = await ClusterNode(cfg).start()
+        try:
+            port = node.config.port
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port
+            )
+            try:
+                p = msgpack.packb(
+                    {
+                        "type": "create_collection",
+                        "name": "pl",
+                        "replication_factor": 1,
+                        "keepalive": True,
+                    },
+                    use_bin_type=True,
+                )
+                writer.write(struct.pack("<H", len(p)) + p)
+                hdr = await reader.readexactly(4)
+                await reader.readexactly(
+                    int.from_bytes(hdr, "little")
+                )
+                N = 200  # > PENDING_HIGH: exercises the overflow gate
+                for i in range(N):
+                    p = msgpack.packb(
+                        {
+                            "type": "set",
+                            "collection": "pl",
+                            "key": f"o{i:04}",
+                            "value": i,
+                            "keepalive": True,
+                        },
+                        use_bin_type=True,
+                    )
+                    writer.write(struct.pack("<H", len(p)) + p)
+                await writer.drain()
+                for i in range(N):
+                    hdr = await reader.readexactly(4)
+                    buf = await reader.readexactly(
+                        int.from_bytes(hdr, "little")
+                    )
+                    assert buf == msgpack.packb("OK") + b"\x02", (
+                        i,
+                        buf,
+                    )
+                # Reads see every pipelined write.
+                for i in (0, 101, 199):
+                    p = msgpack.packb(
+                        {
+                            "type": "get",
+                            "collection": "pl",
+                            "key": f"o{i:04}",
+                            "keepalive": True,
+                        },
+                        use_bin_type=True,
+                    )
+                    writer.write(struct.pack("<H", len(p)) + p)
+                    hdr = await reader.readexactly(4)
+                    buf = await reader.readexactly(
+                        int.from_bytes(hdr, "little")
+                    )
+                    assert buf[-1] == 1 and msgpack.unpackb(
+                        buf[:-1], raw=False
+                    ) == i, (i, buf)
+            finally:
+                writer.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
 def test_wal_sync_acked_then_crash_loses_nothing(tmp_dir):
     """End-to-end durability through the NATIVE path: acked writes on
     a wal-sync node survive a hard crash (the round-2 test ran the
