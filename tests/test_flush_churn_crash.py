@@ -18,6 +18,8 @@ import time
 import msgpack
 import pytest
 
+from harness import make_config
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -40,7 +42,7 @@ def _req(port, obj, timeout=10.0):
     return body[-1], msgpack.unpackb(body[:-1], raw=False)
 
 
-def _start(d, port):
+def _start(cfg):
     env = {
         **os.environ,
         "PYTHONPATH": REPO
@@ -57,13 +59,13 @@ def _start(d, port):
             "-m",
             "dbeel_tpu.server.run",
             "--dir",
-            d,
+            cfg.dir,
             "--port",
-            str(port),
+            str(cfg.port),
             "--remote-shard-port",
-            str(port + 10000),
+            str(cfg.remote_shard_port),
             "--gossip-port",
-            str(port + 20000),
+            str(cfg.gossip_port),
             "--shards",
             "1",
             "--wal-sync",
@@ -91,11 +93,12 @@ def _wait_up(port, deadline=60.0):
 def test_sigkill_mid_flush_churn_loses_no_acked_writes(
     tmp_dir, kill_after_ops
 ):
-    # Distinct port block per parametrized case (60, 137, 301 are
-    # distinct mod 100) so parallel runs can't collide on bind.
-    port = 14640 + kill_after_ops % 100
-    d = os.path.join(tmp_dir, "node")
-    proc = _start(d, port)
+    # Collision-free ports from the harness allocator (each call gets
+    # its own block — safe under parallel runs and future params).
+    cfg = make_config(tmp_dir)
+    port = cfg.port
+    d = cfg.dir
+    proc = _start(cfg)
     acked = []
     try:
         _wait_up(port)
@@ -133,7 +136,7 @@ def test_sigkill_mid_flush_churn_loses_no_acked_writes(
     ]
     assert 1 <= len(wals) <= 2, f"WAL invariant broken: {wals}"
 
-    proc2 = _start(d, port)
+    proc2 = _start(cfg)
     try:
         _wait_up(port)
         lost = []
