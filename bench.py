@@ -7,6 +7,16 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 (vs_baseline = device keys/sec ÷ best-CPU keys/sec on the same input).
 Detail goes to stderr.
+
+Dead-tunnel resilience (ProbeManager): the jax backend is probed in
+throwaway subprocesses CONCURRENTLY with run building and the CPU
+baselines, retried until ``DBEEL_PROBE_BUDGET_S`` of wall clock
+(default 600s) has passed, and re-confirmed fresh immediately before
+the device pass — so a tunnel that wakes up mid-bench still produces
+a device number, and a dead one degrades to an honest CPU-fallback
+report (``device_unavailable: true``) instead of hanging the driver.
+``DBEEL_BENCH_JAX_TIMEOUT_S`` bounds each probe attempt (default
+150s); conclusive fast failures (jax missing) stop probing early.
 """
 
 import argparse

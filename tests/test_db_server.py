@@ -126,10 +126,12 @@ def test_multi_shard_routing(tmp_dir):
         finally:
             await node.stop()
 
-    # 60s: 128 round-trips over 4 in-process shards is comfortably
+    # 120s: 128 round-trips over 4 in-process shards is comfortably
     # sub-second alone, but the full suite shares one core with
-    # earlier modules' background work — 30s has proven flaky there.
-    run(main(), timeout=60)
+    # earlier modules' background work and the host's throughput
+    # see-saws 2-3x between minutes — 30s and then 60s have both
+    # proven flaky there (r4: one trip at 60s on a degraded day).
+    run(main(), timeout=120)
 
 
 def test_get_stats(tmp_dir):

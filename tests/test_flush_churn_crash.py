@@ -23,7 +23,7 @@ from harness import make_config
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _req(port, obj, timeout=10.0):
+def _req(port, obj, timeout=30.0):  # suite-load tolerant
     s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
     b = msgpack.packb(obj, use_bin_type=True)
     s.sendall(struct.pack("<H", len(b)) + b)
@@ -42,7 +42,15 @@ def _req(port, obj, timeout=10.0):
     return body[-1], msgpack.unpackb(body[:-1], raw=False)
 
 
-def _start(cfg):
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _start(cfg, log_path):
     env = {
         **os.environ,
         "PYTHONPATH": REPO
@@ -53,7 +61,12 @@ def _start(cfg):
         ),
         "DBEEL_JAX_PROBED": "fail",
     }
-    return subprocess.Popen(
+    # Popen dups the fd; close ours right after so nothing leaks.
+    log_fd = os.open(
+        log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+    )
+    try:
+        return subprocess.Popen(
         [
             sys.executable,
             "-m",
@@ -73,12 +86,14 @@ def _start(cfg):
             "48",
         ],
         env=env,
-        stdout=subprocess.DEVNULL,
+        stdout=log_fd,
         stderr=subprocess.STDOUT,
-    )
+        )
+    finally:
+        os.close(log_fd)
 
 
-def _wait_up(port, deadline=60.0):
+def _wait_up(port, deadline=90.0):
     t0 = time.time()
     while time.time() - t0 < deadline:
         try:
@@ -86,19 +101,26 @@ def _wait_up(port, deadline=60.0):
             return
         except OSError:
             time.sleep(0.2)
-    raise AssertionError("server never came up")
+    raise AssertionError(
+        f"server never came up on {port} within {deadline}s"
+    )
 
 
 @pytest.mark.parametrize("kill_after_ops", [60, 137, 301])
 def test_sigkill_mid_flush_churn_loses_no_acked_writes(
     tmp_dir, kill_after_ops
 ):
-    # Collision-free ports from the harness allocator (each call gets
-    # its own block — safe under parallel runs and future params).
-    cfg = make_config(tmp_dir)
+    # OS-assigned free ports: collision-free even across concurrent
+    # pytest processes (the harness allocator is only per-process).
+    cfg = make_config(tmp_dir).replace(
+        port=_free_port(),
+        remote_shard_port=_free_port(),
+        gossip_port=_free_port(),
+    )
     port = cfg.port
     d = cfg.dir
-    proc = _start(cfg)
+    log_path = os.path.join(tmp_dir, "server.log")
+    proc = _start(cfg, log_path)
     acked = []
     try:
         _wait_up(port)
@@ -136,7 +158,7 @@ def test_sigkill_mid_flush_churn_loses_no_acked_writes(
     ]
     assert 1 <= len(wals) <= 2, f"WAL invariant broken: {wals}"
 
-    proc2 = _start(cfg)
+    proc2 = _start(cfg, log_path)
     try:
         _wait_up(port)
         lost = []
@@ -146,7 +168,13 @@ def test_sigkill_mid_flush_churn_loses_no_acked_writes(
             )
             if not (t == 1 and v == {"i": i}):
                 lost.append((i, t, v))
-        assert not lost, f"lost {len(lost)} acked writes: {lost[:5]}"
+        if lost:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-2000:]
+            raise AssertionError(
+                f"lost {len(lost)} acked writes: {lost[:5]}; "
+                f"server log tail: {tail!r}"
+            )
     finally:
         proc2.terminate()
         try:
