@@ -338,20 +338,26 @@ class QuorumFanout:
             now = (
                 self._loop.time() if self._loop is not None else 0.0
             )
-            expired = [
-                op
-                for op in self._ops.values()
-                if op.pending and now > op.deadline
-            ]
-            stalled = set()
-            for op in expired:
-                stalled.update(op.pending)
-            for pid in stalled:
-                log.error(
-                    "replica %s timed out; dropping its stream",
-                    self._names.get(pid),
-                )
-                self._drop_stream(pid)
+            # A stream is stalled only when its FIFO-HEAD op (lowest
+            # pending op id — responses arrive in submit order) has
+            # passed its deadline.  Killing on any expired op would
+            # dead-event every newer in-flight op still within its
+            # own deadline on a stream that is actively progressing,
+            # losing their acks and recording spurious hinted
+            # handoffs (review r4).
+            head = {}  # pid -> (op_id, deadline) of its FIFO head
+            for op_id, op in self._ops.items():
+                for pid in op.pending:
+                    cur = head.get(pid)
+                    if cur is None or op_id < cur[0]:
+                        head[pid] = (op_id, op.deadline)
+            for pid, (_op_id, deadline) in head.items():
+                if now > deadline:
+                    log.error(
+                        "replica %s timed out; dropping its stream",
+                        self._names.get(pid),
+                    )
+                    self._drop_stream(pid)
 
     # ---- lifecycle -----------------------------------------------------
 

@@ -100,6 +100,10 @@ class FramedServerProtocol(asyncio.Protocol):
 
     def resume_writing(self) -> None:
         self.writable.set()
+        # Parked responses released while the transport was
+        # write-paused deferred here (see _flush_parked).
+        if self.parked:
+            self._flush_parked()
 
     def _registry(self) -> set:
         raise NotImplementedError
@@ -143,6 +147,17 @@ class FramedServerProtocol(asyncio.Protocol):
 
     def _flush_parked(self) -> None:
         while self.parked and self.parked[0][0]:
+            if (
+                not self.writable.is_set()
+                and self.transport is not None
+                and not self.transport.is_closing()
+            ):
+                # Transport write-paused (pause_writing): honor the
+                # backpressure gate every other response path honors
+                # instead of bursting parked acks into the kernel
+                # buffer of a slow-reading client; resume_writing
+                # re-enters this flush (review r4).
+                return
             _, resp, keepalive, op, started = self.parked.popleft()
             if op is not None:
                 # Metrics stamp at release time: the measured latency
