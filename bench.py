@@ -45,9 +45,77 @@ KEY_BYTES = 16
 VALUE_BYTES = 64
 RECORD = 16 + KEY_BYTES + VALUE_BYTES  # 96
 
+# Last-good device artifact (tunnel-proof evidence).  Two driver
+# rounds in a row ran with the TPU tunnel dead for the entire bench
+# window, so the round artifact carried zero device numbers even
+# though the tunnel was alive at other times.  Every SUCCESSFUL
+# byte-identical device pass now persists its result here (keyed by
+# input shape), and a tunnel-down fallback run embeds the entry for
+# its shape under ``last_good_device`` — provenance-labeled, never
+# the headline ``value``.
+LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "DEVICE_LAST_GOOD.json"
+)
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _shape_key(args) -> str:
+    kind = "var" if args.variable_values else "fixed"
+    return f"{kind}_runs{args.runs}_keys{args.keys}"
+
+
+def _git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _load_last_good() -> dict:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def save_last_good(args, report: dict, output_sha256: str) -> None:
+    """Persist a successful byte-identical device measurement keyed by
+    input shape, with enough provenance for a later round to cite it.
+
+    The load-modify-replace runs under an flock: the device_capture.py
+    watcher and a driver bench run can both succeed near-simultaneously
+    (different shapes), and an unserialized second writer would
+    resurrect its stale snapshot of the other shape's entry."""
+    import fcntl
+
+    with open(LAST_GOOD_PATH + ".lock", "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        data = _load_last_good()
+        data[_shape_key(args)] = {
+            "timestamp_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "git_rev": _git_rev(),
+            "output_sha256": output_sha256,
+            "bench": report,
+        }
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, LAST_GOOD_PATH)
+    log(f"last-good device artifact updated: {LAST_GOOD_PATH}")
 
 
 class ProbeManager:
@@ -623,39 +691,46 @@ def main():
         if kernel_rate:
             log(f"device kernel-only: {kernel_rate:,.0f} keys/s")
 
-        print(
-            json.dumps(
-                {
-                    "metric": "compaction_keys_per_sec_10M_major",
-                    "value": round(dev_rate),
-                    "unit": "keys/s",
-                    "vs_baseline": round(dev_rate / cpu_rate, 3),
-                    "cpu_keys_per_sec": round(cpu_rate),
-                    "best_cpu_keys_per_sec": round(best_cpu_rate),
-                    "vs_best_cpu": round(
-                        dev_rate / best_cpu_rate, 3
-                    ),
-                    "kernel_keys_per_sec": (
-                        round(kernel_rate) if kernel_rate else None
-                    ),
-                    "vs_baseline_kernel": (
-                        round(kernel_rate / cpu_rate, 3)
-                        if kernel_rate
-                        else None
-                    ),
-                    "byte_identical": identical,
-                    "keys": args.keys,
-                    "runs": args.runs,
-                    # Present (true) only when the TPU tunnel was down
-                    # and the device column is the CPU fallback path.
-                    **(
-                        {}
-                        if device_ok
-                        else {"device_unavailable": True}
-                    ),
-                }
-            )
-        )
+        report = {
+            "metric": "compaction_keys_per_sec_10M_major",
+            "value": round(dev_rate),
+            "unit": "keys/s",
+            "vs_baseline": round(dev_rate / cpu_rate, 3),
+            "cpu_keys_per_sec": round(cpu_rate),
+            "best_cpu_keys_per_sec": round(best_cpu_rate),
+            "vs_best_cpu": round(dev_rate / best_cpu_rate, 3),
+            "kernel_keys_per_sec": (
+                round(kernel_rate) if kernel_rate else None
+            ),
+            "vs_baseline_kernel": (
+                round(kernel_rate / cpu_rate, 3) if kernel_rate else None
+            ),
+            "byte_identical": identical,
+            "keys": args.keys,
+            "runs": args.runs,
+            "variable_values": bool(args.variable_values),
+            # Present (true) only when the TPU tunnel was down
+            # and the device column is the CPU fallback path.
+            **({} if device_ok else {"device_unavailable": True}),
+        }
+        if device_ok and identical:
+            try:
+                save_last_good(args, report, dev_hash)
+            except Exception as e:  # artifact write must never kill a run
+                log(f"last-good artifact write failed ({e!r})")
+        elif not device_ok:
+            # Embed the most recent successful device measurement for
+            # THIS input shape, clearly labeled with its provenance —
+            # the headline value above stays the honest CPU fallback.
+            entry = _load_last_good().get(_shape_key(args))
+            if entry:
+                report["last_good_device"] = entry
+                log(
+                    "embedding last-good device measurement from "
+                    f"{entry.get('timestamp_utc')} "
+                    f"(rev {str(entry.get('git_rev'))[:12]})"
+                )
+        print(json.dumps(report))
     finally:
         if args.dir is None:
             shutil.rmtree(d, ignore_errors=True)

@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""Opportunistic device-evidence capture (tunnel-proof benching).
+
+The tunneled TPU backend on this host flaps: it can be dead for the
+entire window in which the driver runs ``bench.py`` (two consecutive
+rounds produced CPU-only artifacts) while being alive at other times.
+This daemon decouples *when the evidence is captured* from *when the
+driver asks for it*:
+
+  watch mode (``--watch``): every ``--interval`` seconds, probe jax
+  backend init in a throwaway subprocess (a dead tunnel wedges init in
+  an uninterruptible recvfrom — same rationale as utils/jax_gate.py).
+  When the probe succeeds, run the full ``bench.py`` config-2 pass
+  (and, with ``--config4``, the 64-way variable-length config-4 pass).
+  A successful byte-identical device pass makes bench.py itself
+  persist ``DEVICE_LAST_GOOD.json`` keyed by input shape; a later
+  tunnel-down bench run embeds that entry under ``last_good_device``.
+
+  one-shot mode (default): one probe, one capture attempt, exit 0 on
+  a captured device number and 1 otherwise.
+
+Skip conditions in watch mode keep the daemon polite: a capture is
+only attempted when the artifact for the shape is missing, stale
+(``--max-age``), or from a different git revision than HEAD; and the
+pause file (``--pause-file``, default /tmp/dbeel_capture_pause)
+suspends capture cycles while latency-sensitive benches run.
+
+The compaction shape being captured matches the reference's k-way
+merge loop (/root/reference/src/storage_engine/lsm_tree.rs:1038-1066);
+see BASELINE.md configs 2 and 4.
+"""
+
+import argparse
+import calendar
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (owns the artifact schema + helpers)
+
+
+def log(*a):
+    print(f"[capture {time.strftime('%H:%M:%S')}]", *a, file=sys.stderr,
+          flush=True)
+
+
+def probe_alive(timeout_s: float) -> bool:
+    """One throwaway-subprocess probe of jax backend init."""
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        return child.wait(timeout=timeout_s) == 0
+    except subprocess.TimeoutExpired:
+        child.kill()
+        try:
+            child.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass  # D-state child: abandon
+        return False
+
+
+def shape_key(runs: int, keys: int, variable: bool) -> str:
+    """The artifact key is OWNED by bench.py (_shape_key / save_last_good
+    keyed on it); delegate so the two can never drift."""
+    ns = argparse.Namespace(variable_values=variable, runs=runs, keys=keys)
+    return bench._shape_key(ns)
+
+
+def needs_capture(key: str, max_age_s: float) -> bool:
+    entry = bench._load_last_good().get(key)
+    if not entry:
+        return True
+    if entry.get("git_rev") != bench._git_rev():
+        return True
+    try:
+        # timestamp_utc is stamped with time.gmtime() — decode as UTC
+        # (timegm), not local time, or the age is off by the DST shift.
+        ts = calendar.timegm(time.strptime(
+            entry["timestamp_utc"], "%Y-%m-%dT%H:%M:%SZ"
+        ))
+    except Exception:
+        return True
+    return (time.time() - ts) > max_age_s
+
+
+def run_capture(runs: int, keys: int, variable: bool,
+                timeout_s: float) -> bool:
+    """Run bench.py once; True iff it produced a live device number
+    (bench.py itself persists the artifact on byte-identical output)."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--keys", str(keys), "--runs", str(runs)]
+    if variable:
+        cmd.append("--variable-values")
+    env = dict(os.environ)
+    # The tunnel was just probed alive; don't let a flap burn an hour.
+    env.setdefault("DBEEL_PROBE_BUDGET_S", "300")
+    log("running:", " ".join(cmd))
+    try:
+        p = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log("bench run timed out; abandoning this cycle")
+        return False
+    tail = p.stderr.strip().splitlines()[-8:]
+    for ln in tail:
+        log(" |", ln)
+    if p.returncode != 0:
+        log(f"bench exited {p.returncode}")
+        return False
+    try:
+        rep = json.loads(p.stdout.strip().splitlines()[-1])
+    except Exception:
+        log("bench produced no JSON line")
+        return False
+    if rep.get("device_unavailable"):
+        log("tunnel died between probe and device pass")
+        return False
+    log(
+        f"captured: {rep.get('value'):,} keys/s, "
+        f"vs_best_cpu {rep.get('vs_best_cpu')}, "
+        f"byte_identical {rep.get('byte_identical')}"
+    )
+    return bool(rep.get("byte_identical"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--interval", type=float, default=900.0,
+                    help="watch-mode sleep between cycles (s)")
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--bench-timeout", type=float, default=3600.0)
+    ap.add_argument("--max-age", type=float, default=3 * 3600.0,
+                    help="re-capture when the artifact is older (s)")
+    ap.add_argument("--keys", type=int, default=10_000_000)
+    ap.add_argument("--config4", action="store_true",
+                    help="also capture the 64-way variable-length shape")
+    ap.add_argument("--pause-file", default="/tmp/dbeel_capture_pause")
+    args = ap.parse_args()
+
+    shapes = [(8, args.keys, False)]
+    if args.config4:
+        shapes.append((64, args.keys, True))
+
+    while True:
+        if os.path.exists(args.pause_file):
+            log("paused (pause file present)")
+        else:
+            todo = [s for s in shapes
+                    if needs_capture(shape_key(*s), args.max_age)]
+            if not todo:
+                log("artifact fresh for all shapes; nothing to do")
+                if not args.watch:
+                    return 0
+            else:
+                log(f"probing tunnel ({args.probe_timeout:.0f}s cap) ...")
+                if probe_alive(args.probe_timeout):
+                    log("tunnel ALIVE; capturing")
+                    ok = True
+                    for runs, keys, variable in todo:
+                        if os.path.exists(args.pause_file):
+                            log("pause file appeared; stopping cycle")
+                            ok = False
+                            break
+                        ok = run_capture(
+                            runs, keys, variable, args.bench_timeout
+                        ) and ok
+                    if not args.watch:
+                        return 0 if ok else 1
+                else:
+                    log("tunnel dead/wedged")
+                    if not args.watch:
+                        return 1
+        if not args.watch:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,3 +84,51 @@ def test_late_waking_tunnel_still_wins(make_pm, tmp_path):
     pm = make_pm(child, 5.0, 30.0)
     assert pm.wait() is True  # attempt 1 fails, attempt 2 succeeds
     assert pm.attempt >= 2
+
+
+class _ShapeArgs:
+    def __init__(self, runs=8, keys=10_000_000, variable_values=False):
+        self.runs = runs
+        self.keys = keys
+        self.variable_values = variable_values
+
+
+def test_last_good_artifact_roundtrip(monkeypatch, tmp_path):
+    """A successful device pass persists DEVICE_LAST_GOOD.json keyed
+    by input shape; a later fallback run for the SAME shape finds it,
+    other shapes don't (the wide config-4 capture must not masquerade
+    as config 2)."""
+    path = tmp_path / "DEVICE_LAST_GOOD.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+    a2 = _ShapeArgs()
+    a4 = _ShapeArgs(runs=64, variable_values=True)
+    rep = {"value": 3_000_000, "vs_best_cpu": 1.7, "byte_identical": True}
+    bench.save_last_good(a2, rep, "ab" * 32)
+
+    data = bench._load_last_good()
+    entry = data[bench._shape_key(a2)]
+    assert entry["bench"]["value"] == 3_000_000
+    assert entry["output_sha256"] == "ab" * 32
+    assert entry["timestamp_utc"].endswith("Z")
+    assert bench._shape_key(a4) not in data
+
+    # Second shape lands beside, not over, the first.
+    bench.save_last_good(a4, {"value": 5}, "cd" * 32)
+    data = bench._load_last_good()
+    assert data[bench._shape_key(a2)]["bench"]["value"] == 3_000_000
+    assert data[bench._shape_key(a4)]["bench"]["value"] == 5
+
+
+def test_last_good_artifact_corrupt_is_empty(monkeypatch, tmp_path):
+    """A corrupt/absent artifact degrades to {} — it must never kill a
+    driver bench run."""
+    path = tmp_path / "DEVICE_LAST_GOOD.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+    assert bench._load_last_good() == {}
+    path.write_text("{not json")
+    assert bench._load_last_good() == {}
+    # save over a corrupt file works (treats it as empty)
+    bench.save_last_good(_ShapeArgs(), {"value": 1}, "ee" * 32)
+    assert bench._load_last_good()[bench._shape_key(_ShapeArgs())][
+        "bench"
+    ]["value"] == 1
