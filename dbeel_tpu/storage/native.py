@@ -309,6 +309,23 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dbeel_wal_seq.argtypes = [ctypes.c_void_p]
         lib.dbeel_wal_synced.restype = ctypes.c_uint64
         lib.dbeel_wal_synced.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_walsync_hub_new"):
+        # Loop-driven io_uring group commit: fsyncs are SQEs on a
+        # loop-owned ring, zero sync threads (wal.py _SyncHub).
+        lib.dbeel_walsync_hub_new.restype = ctypes.c_void_p
+        lib.dbeel_walsync_hub_new.argtypes = [ctypes.c_uint32]
+        lib.dbeel_walsync_hub_free.restype = None
+        lib.dbeel_walsync_hub_free.argtypes = [ctypes.c_void_p]
+        lib.dbeel_walsync_hub_eventfd.restype = ctypes.c_int32
+        lib.dbeel_walsync_hub_eventfd.argtypes = [ctypes.c_void_p]
+        lib.dbeel_walsync_hub_reap.restype = None
+        lib.dbeel_walsync_hub_reap.argtypes = [ctypes.c_void_p]
+        lib.dbeel_wal_sync_attach.restype = ctypes.c_int32
+        lib.dbeel_wal_sync_attach.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+        ]
     if hasattr(lib, "dbeel_dp_handle"):
         # (continuation of the data-plane prototypes: these must stay
         # gated on dbeel_dp_handle, NOT on the newer syncer symbols —

@@ -33,24 +33,104 @@ _MAGIC = 0x77A11065
 _HEADER = struct.Struct("<IIII")
 
 
+class _SyncHub:
+    """Process-wide io_uring group-commit hub (zero sync threads).
+
+    Thread-mode wal-sync costs one dedicated fdatasync thread per WAL
+    (64 shards/collections => 64 threads) plus a
+    cv->thread->eventfd->epoll wake chain per durable ack.  The hub
+    (native dbeel_walsync_hub_*) queues IORING_OP_FSYNC SQEs straight
+    from the append path on a ring whose registered eventfd the loop
+    polls — no threads at all, and fsyncs for different WALs overlap
+    in the kernel.  Reference analog: glommio runs the WAL fdatasync
+    on the same per-core io_uring reactor
+    (/root/reference/src/storage_engine/lsm_tree.rs:805-837).
+
+    Single-threaded contract: all attached WALs append from the one
+    loop thread (server run_node / per-shard process / test loop).
+    Across sequential loops (tests), the eventfd reader rebinds to
+    the currently-running loop on first use."""
+
+    _instance = None  # None = untried, False = unavailable
+
+    def __init__(self, lib, handle) -> None:
+        self._lib = lib
+        self._h = handle
+        self._efd = lib.dbeel_walsync_hub_eventfd(handle)
+        self._syncers: set = set()
+        self._loop = None
+
+    @classmethod
+    def get(cls, lib):
+        if cls._instance is None:
+            cls._instance = False
+            try:
+                if hasattr(lib, "dbeel_walsync_hub_new"):
+                    h = lib.dbeel_walsync_hub_new(128)
+                    if h:
+                        cls._instance = cls(lib, h)
+            except Exception:
+                log.exception("wal sync hub unavailable")
+        return cls._instance or None
+
+    def register(self, syncer) -> None:
+        self._syncers.add(syncer)
+        loop = asyncio.get_event_loop()
+        if self._loop is not loop:
+            if self._loop is not None:
+                try:
+                    self._loop.remove_reader(self._efd)
+                except Exception:
+                    pass  # previous loop already torn down
+            self._loop = loop
+            loop.add_reader(self._efd, self._on_ready)
+
+    def unregister(self, syncer) -> None:
+        self._syncers.discard(syncer)
+
+    def _on_ready(self) -> None:
+        try:
+            os.read(self._efd, 8)
+        except (BlockingIOError, OSError):
+            pass
+        self._lib.dbeel_walsync_hub_reap(self._h)
+        for s in list(self._syncers):
+            s._pump()
+
+
 class _NativeSyncer:
-    """Event-loop bridge for the C group-commit thread (wal-sync
-    mode).  The C side owns the coalesced fdatasync on a dedicated
-    thread (dbeel_wal_sync_enable) and pings an eventfd after each
-    completed sync; this object parks serving-plane responses and
+    """Event-loop bridge for native wal-sync group commit.  Two
+    backends behind one park/wait/ticket surface:
+
+    * hub mode (preferred): the io_uring _SyncHub above — the fsync
+      is a SQE submitted from the append path, completion arrives on
+      the hub's shared eventfd, zero threads.
+    * thread mode (fallback, no io_uring): a dedicated C thread owns
+      the coalesced fdatasync (dbeel_wal_sync_enable) and pings a
+      per-WAL eventfd.
+
+    Either way this object parks serving-plane responses and
     slow-path waiters on sync *tickets* (append sequence numbers) and
     releases them once the published watermark covers them — so a
     durable ack never leaves before its fdatasync, and the event loop
     never blocks on one (reference semantics:
     /root/reference/src/storage_engine/lsm_tree.rs:805-837)."""
 
-    def __init__(self, lib, native, delay_us: int) -> None:
+    def __init__(self, lib, native, delay_us: int, hub=None) -> None:
         self._lib = lib
         self._native = native
-        self._efd = os.eventfd(0, os.EFD_NONBLOCK | os.EFD_CLOEXEC)
-        if lib.dbeel_wal_sync_enable(native, delay_us, self._efd) != 0:
-            os.close(self._efd)
-            raise OSError("wal sync enable failed")
+        self._hub = hub
+        if hub is not None:
+            if lib.dbeel_wal_sync_attach(native, hub._h, delay_us) != 0:
+                raise OSError("wal sync attach failed")
+            self._efd = -1
+        else:
+            self._efd = os.eventfd(0, os.EFD_NONBLOCK | os.EFD_CLOEXEC)
+            if lib.dbeel_wal_sync_enable(
+                native, delay_us, self._efd
+            ) != 0:
+                os.close(self._efd)
+                raise OSError("wal sync enable failed")
         self._loop = None
         self._parks: deque = deque()  # (ticket, callback), FIFO==ticket order
         self._waiters: list = []  # heap of (ticket, n, future)
@@ -67,7 +147,10 @@ class _NativeSyncer:
     def _ensure_reader(self) -> None:
         if self._loop is None:
             self._loop = asyncio.get_event_loop()
-            self._loop.add_reader(self._efd, self._on_ready)
+            if self._hub is not None:
+                self._hub.register(self)
+            else:
+                self._loop.add_reader(self._efd, self._on_ready)
 
     def park(self, ticket: int, cb) -> None:
         """Run ``cb()`` once a completed sync covers ``ticket``.
@@ -94,13 +177,19 @@ class _NativeSyncer:
             os.read(self._efd, 8)  # clear the eventfd counter
         except (BlockingIOError, OSError):
             pass
+        self._pump()
+
+    def _pump(self) -> None:
+        """Release everything a completed sync now covers (called
+        from the per-WAL eventfd callback in thread mode, from the
+        hub dispatcher in hub mode)."""
         self._release(self._lib.dbeel_wal_synced(self._native))
         if self._stopping and not self._closed:
-            # Async close handshake: the sync thread's exit signal
-            # (final drain published, watermark == seq) finishes the
-            # shutdown here — the join below lands on an
-            # already-exited thread, so the loop never blocks on an
-            # in-flight usleep/fdatasync.
+            # Async close handshake: the backend's exit signal (final
+            # drain published, watermark == seq) finishes the
+            # shutdown here — the disable below then lands on an
+            # already-exited thread / empty hub slot, so the loop
+            # never blocks on an in-flight usleep/fdatasync.
             seq = self._lib.dbeel_wal_seq(self._native)
             if self._lib.dbeel_wal_synced(self._native) >= seq:
                 self._finish_close()
@@ -157,16 +246,20 @@ class _NativeSyncer:
         if self._closed:
             return
         self._closed = True
-        # Joins the sync thread: already exited on the async path
-        # (its exit ping got us here), a real join on the sync path.
+        # Thread mode: joins the sync thread (already exited on the
+        # async path — its exit ping got us here).  Hub mode: detaches
+        # the slot, draining any straggler SQE.
         self._lib.dbeel_wal_sync_disable(self._native)
-        if self._loop is not None:
+        if self._hub is not None:
+            self._hub.unregister(self)
+        elif self._loop is not None:
             try:
                 self._loop.remove_reader(self._efd)
             except Exception:
                 pass
         self._release(self._lib.dbeel_wal_seq(self._native))
-        os.close(self._efd)
+        if self._efd >= 0:
+            os.close(self._efd)
         self._efd = -1
         for cb in self._on_done:
             try:
@@ -224,14 +317,15 @@ class Wal:
         self._dispose_future = None
         self._dispose_waiter = None
         self._sync_closing = False
-        # Native group-commit syncer: a C thread owns the coalesced
-        # fdatasync and completion arrives via eventfd — replaces the
-        # executor-hop path AND lets the serving data plane fast-path
-        # durable writes (acks parked on sync tickets).  Falls back
-        # to the executor coalescer when unavailable.
-        # DBEEL_NO_WAL_SYNCER=1 disables the native group-commit
-        # thread (A/B benching): durable writes then punt to the
-        # executor-coalesced fdatasync path.
+        self._closing_syncer = None
+        # Native group-commit syncer — hub mode (io_uring SQEs from
+        # the append path, zero threads) with a dedicated-C-thread
+        # fallback when io_uring is unavailable.  Either way the
+        # serving data plane fast-paths durable writes (acks parked
+        # on sync tickets); without any native backend, durable
+        # writes punt to the executor-coalesced fdatasync path.
+        # DBEEL_NO_WAL_SYNCER=1 disables both native backends;
+        # DBEEL_NO_WAL_HUB=1 forces thread mode (A/B benching).
         self._syncer = None
         if (
             sync
@@ -240,14 +334,26 @@ class Wal:
             and os.environ.get("DBEEL_NO_WAL_SYNCER", "0")
             in ("", "0")
         ):
-            try:
-                if hasattr(self._lib, "dbeel_wal_sync_enable"):
+            hub = None
+            if os.environ.get("DBEEL_NO_WAL_HUB", "0") in ("", "0"):
+                hub = _SyncHub.get(self._lib)
+            if hub is not None:
+                try:
                     self._syncer = _NativeSyncer(
-                        self._lib, self._native, sync_delay_us
+                        self._lib, self._native, sync_delay_us, hub
                     )
-            except Exception:
-                log.exception("native wal syncer unavailable")
-                self._syncer = None
+                except Exception:
+                    log.exception("wal sync hub attach failed")
+                    self._syncer = None
+            if self._syncer is None:
+                try:
+                    if hasattr(self._lib, "dbeel_wal_sync_enable"):
+                        self._syncer = _NativeSyncer(
+                            self._lib, self._native, sync_delay_us
+                        )
+                except Exception:
+                    log.exception("native wal syncer unavailable")
+                    self._syncer = None
 
     async def append(self, key: bytes, value: bytes, timestamp: int) -> None:
         if self._native is not None:
@@ -384,7 +490,18 @@ class Wal:
         if self._sync_closing:
             # Async syncer shutdown already pending: a second close()
             # (__del__, delete()) must NOT free the native handle the
-            # in-flight eventfd callback still dereferences.
+            # in-flight eventfd callback still dereferences — UNLESS
+            # the loop has stopped for good, in which case the exit
+            # ping will never be delivered and the handshake must be
+            # finished synchronously here (the C disable joins the
+            # already-exiting thread) or the native handle, eventfd,
+            # WAL fd, and a delete()'s unlink all leak (review r4).
+            s = self._closing_syncer
+            if s is not None and (
+                s._loop is None or not s._loop.is_running()
+            ):
+                self._closing_syncer = None
+                s._finish_close()
             return
         if self._syncer is not None:
             # Async shutdown: the C thread's final drain runs off the
@@ -393,6 +510,7 @@ class Wal:
             # sync_disable then joins an already-exited thread.
             self._sync_closing = True
             syncer, self._syncer = self._syncer, None
+            self._closing_syncer = syncer
             syncer.close(on_done=self._close_when_unreferenced)
             return
         self._sync_event.notify()  # release riders; contents now owned
@@ -401,6 +519,7 @@ class Wal:
 
     def _close_when_unreferenced(self) -> None:
         self._sync_closing = False
+        self._closing_syncer = None
         self._sync_event.notify()
         if self._inflight_syncs == 0:
             self._really_close()

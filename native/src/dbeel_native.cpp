@@ -1188,7 +1188,17 @@ struct NativeWal {
   bool stop = false;
   int efd = -1;
   uint64_t delay_us = 0;
+  // Hub mode (loop-driven io_uring group commit, zero threads): set
+  // by dbeel_wal_sync_attach instead of the dedicated-thread enable.
+  void* hub = nullptr;
+  int32_t hub_slot = -1;
 };
+
+// Hub-mode entry points, defined with the WalSyncHub at the bottom of
+// this file (they need the raw io_uring plumbing declared there).
+static void walsync_kick(NativeWal* w);
+static void walsync_stop_async(NativeWal* w);
+static void walsync_detach(NativeWal* w);
 
 static void wal_sync_eventfd_signal(NativeWal* w) {
   uint64_t one = 1;
@@ -1848,6 +1858,10 @@ void* dbeel_wal_new(int32_t fd, uint64_t offset) {
 
 void dbeel_wal_sync_disable(void* h) {
   auto* w = static_cast<NativeWal*>(h);
+  if (w->hub != nullptr) {
+    walsync_detach(w);
+    return;
+  }
   if (!w->sync_enabled.load(std::memory_order_relaxed)) return;
   {
     std::lock_guard<std::mutex> lg(w->mu);
@@ -1868,6 +1882,10 @@ void dbeel_wal_sync_disable(void* h) {
 // shard at every memtable rotation).
 void dbeel_wal_sync_stop_async(void* h) {
   auto* w = static_cast<NativeWal*>(h);
+  if (w->hub != nullptr) {
+    walsync_stop_async(w);
+    return;
+  }
   if (!w->sync_enabled.load(std::memory_order_relaxed)) return;
   {
     std::lock_guard<std::mutex> lg(w->mu);
@@ -1955,7 +1973,11 @@ uint64_t dbeel_wal_append(void* h, const uint8_t* key, uint32_t klen,
   }
   w->offset += padded;
   w->seq.fetch_add(1, std::memory_order_release);
-  if (w->sync_enabled.load(std::memory_order_relaxed)) {
+  if (w->hub != nullptr) {
+    // Hub mode: arm an IORING_OP_FSYNC (or the coalescing timeout)
+    // on the loop-owned ring — no thread handoff at all.
+    walsync_kick(w);
+  } else if (w->sync_enabled.load(std::memory_order_relaxed)) {
     // Lock-then-notify closes the missed-wakeup window against the
     // syncer's predicate check; uncontended this is ~20ns.
     { std::lock_guard<std::mutex> lg(w->mu); }
@@ -3441,6 +3463,299 @@ int32_t dbeel_qf_next_event(void* h, uint64_t* op_id,
 
 uint64_t dbeel_qf_fanout_ops(void* h) {
   return static_cast<QuorumFan*>(h)->fast_fanout_ops;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// WAL sync hub — loop-driven io_uring group commit (VERDICT r4 #4).
+//
+// Thread-mode wal-sync (dbeel_wal_sync_enable above) costs one
+// dedicated fdatasync thread PER WAL — 64 shards would mean 64
+// threads — plus a cv->thread->eventfd->epoll wake chain on every
+// durable ack (~30us/op measured).  The hub replaces the thread
+// entirely: the append path queues an IORING_OP_FSYNC (with
+// IORING_FSYNC_DATASYNC) on a ring owned by the shard event loop,
+// the kernel runs the fdatasync asynchronously, and the completion
+// signals the ring's registered eventfd, which the loop already
+// polls.  Zero extra threads regardless of shard/collection count,
+// and syncs for different WALs overlap in the kernel instead of
+// serializing on a pool thread.  This is the closest host-side
+// analog of the reference's reactor-owned coalesced WAL sync
+// (/root/reference/src/storage_engine/lsm_tree.rs:805-837: glommio
+// DmaFile fdatasync on the same io_uring reactor).
+//
+// Ticket semantics are identical to thread mode: the watermark a
+// sync covers is grabbed at SUBMIT time (appends that land later
+// ride the next fsync), `synced` publishes only on completion, and
+// `wal_sync_delay` arms an IORING_OP_TIMEOUT first so riders
+// coalesce.  Single-threaded contract: all hub calls happen on the
+// loop thread (same as the UringReader above); the one exception is
+// walsync_detach, which may run at teardown with no loop and then
+// drains its slot with a blocking GETEVENTS enter.
+// ---------------------------------------------------------------------
+
+namespace {
+
+struct WalSlot {
+  NativeWal* wal = nullptr;
+  uint32_t gen = 0;           // stale-CQE guard across slot reuse
+  bool fsync_inflight = false;
+  bool timer_armed = false;
+  bool closing = false;       // stop_async: finish handshake via efd
+  uint64_t inflight_s = 0;    // watermark the in-flight fsync covers
+  uint64_t delay_us = 0;
+  struct __kernel_timespec ts {};  // stable storage for timeout SQEs
+};
+
+struct WalSyncHub {
+  UringReader* u = nullptr;  // reuses the raw-ring plumbing above
+  // deque: slot references (incl. &ts handed to the kernel) must
+  // stay stable while the deque grows.
+  std::deque<WalSlot> slots;
+  std::vector<int32_t> free_slots;
+};
+
+constexpr uint64_t kHubFsync = 1;
+constexpr uint64_t kHubTimer = 2;
+
+uint64_t hub_tag(int32_t slot, uint32_t gen, uint64_t kind) {
+  return ((uint64_t)gen << 40) | ((uint64_t)(uint32_t)slot << 8) |
+         kind;
+}
+
+bool hub_queue(WalSyncHub* hb, uint8_t opcode, int fd, uint64_t addr,
+               uint32_t len, uint32_t fsync_flags, uint64_t tag) {
+  UringReader* u = hb->u;
+  if (u->in_flight + u->queued >= u->cq_entries) return false;
+  const unsigned head = __atomic_load_n(u->sq_head, __ATOMIC_ACQUIRE);
+  const unsigned tail = *u->sq_tail;
+  if (tail - head >= u->sq_entries) return false;
+  const unsigned idx = tail & *u->sq_mask;
+  io_uring_sqe* sqe = &u->sqes[idx];
+  std::memset(sqe, 0, sizeof(*sqe));
+  sqe->opcode = opcode;
+  sqe->fd = fd;
+  sqe->addr = addr;
+  sqe->len = len;
+  sqe->fsync_flags = fsync_flags;  // union with timeout_flags
+  sqe->user_data = tag;
+  u->sq_array[idx] = idx;
+  __atomic_store_n(u->sq_tail, tail + 1, __ATOMIC_RELEASE);
+  u->queued++;
+  return true;
+}
+
+void hub_signal(WalSyncHub* hb) {
+  uint64_t one = 1;
+  ssize_t r;
+  do {
+    r = ::write(hb->u->efd, &one, 8);
+  } while (r < 0 && errno == EINTR);
+}
+
+// Arm the next step for a dirty, idle slot: the coalescing timeout
+// when wal_sync_delay is set, the fsync itself otherwise.  Caller
+// flushes the ring.
+void hub_arm(WalSyncHub* hb, int32_t si) {
+  WalSlot& s = hb->slots[si];
+  NativeWal* w = s.wal;
+  if (w == nullptr || s.fsync_inflight || s.timer_armed) return;
+  if (s.delay_us > 0 && !s.closing) {
+    s.ts.tv_sec = (long long)(s.delay_us / 1000000ull);
+    s.ts.tv_nsec = (long long)((s.delay_us % 1000000ull) * 1000ull);
+    if (hub_queue(hb, IORING_OP_TIMEOUT, -1,
+                  (uint64_t)(uintptr_t)&s.ts, 1, 0,
+                  hub_tag(si, s.gen, kHubTimer)))
+      s.timer_armed = true;
+    return;
+  }
+  s.inflight_s = w->seq.load(std::memory_order_acquire);
+  if (hub_queue(hb, IORING_OP_FSYNC, w->fd, 0, 0,
+                IORING_FSYNC_DATASYNC,
+                hub_tag(si, s.gen, kHubFsync)))
+    s.fsync_inflight = true;
+}
+
+void hub_process_cqe(WalSyncHub* hb, uint64_t tag) {
+  const uint64_t kind = tag & 0xFF;
+  const int32_t si = (int32_t)((tag >> 8) & 0xFFFFFFFFu);
+  const uint32_t gen = (uint32_t)(tag >> 40);
+  if (si < 0 || (size_t)si >= hb->slots.size()) return;
+  WalSlot& s = hb->slots[si];
+  if (s.gen != gen || s.wal == nullptr) return;  // reused slot
+  NativeWal* w = s.wal;
+  if (kind == kHubFsync) {
+    s.fsync_inflight = false;
+    // Best-effort like thread mode: a failed fdatasync still
+    // publishes (::fdatasync's result was ignored there too).
+    w->synced.store(s.inflight_s, std::memory_order_release);
+  } else if (kind == kHubTimer) {
+    s.timer_armed = false;
+  }
+  if (s.closing) {
+    if (!s.fsync_inflight && !s.timer_armed)
+      // Release-all at close: the flushed sstable owns durability
+      // by the time wal.py closes a WAL (same contract as the
+      // thread-mode final drain).
+      w->synced.store(w->seq.load(std::memory_order_acquire),
+                      std::memory_order_release);
+    return;
+  }
+  if (kind == kHubTimer) {
+    // Coalescing window elapsed: sync everything appended so far.
+    s.inflight_s = w->seq.load(std::memory_order_acquire);
+    if (hub_queue(hb, IORING_OP_FSYNC, w->fd, 0, 0,
+                  IORING_FSYNC_DATASYNC,
+                  hub_tag(si, s.gen, kHubFsync)))
+      s.fsync_inflight = true;
+  } else if (w->seq.load(std::memory_order_acquire) >
+             w->synced.load(std::memory_order_relaxed)) {
+    hub_arm(hb, si);  // appends landed while the fsync ran
+  }
+}
+
+// Drain the CQ, publish watermarks, re-arm dirty slots, submit.
+void hub_reap(WalSyncHub* hb) {
+  uint64_t tags[64];
+  int32_t res[64];
+  int n;
+  do {
+    n = dbeel_uring_reap(hb->u, tags, res, 64);
+    for (int i = 0; i < n; i++) hub_process_cqe(hb, tags[i]);
+  } while (n == 64);
+  dbeel_uring_flush(hb->u);
+}
+
+static void walsync_kick(NativeWal* w) {
+  auto* hb = static_cast<WalSyncHub*>(w->hub);
+  if (hb == nullptr || w->hub_slot < 0) return;
+  // Opportunistic reap first: completions may be parked in the CQ
+  // with their eventfd wake not yet dispatched; reaping here
+  // publishes watermarks sooner and frees ring capacity.
+  hub_reap(hb);
+  hub_arm(hb, w->hub_slot);
+  dbeel_uring_flush(hb->u);
+}
+
+static void walsync_stop_async(NativeWal* w) {
+  auto* hb = static_cast<WalSyncHub*>(w->hub);
+  if (hb == nullptr || w->hub_slot < 0) return;
+  WalSlot& s = hb->slots[w->hub_slot];
+  s.closing = true;
+  if (!s.fsync_inflight && !s.timer_armed) {
+    // Idle slot: no CQE will arrive, so publish the release-all
+    // watermark and wake the loop by hand.
+    w->synced.store(w->seq.load(std::memory_order_acquire),
+                    std::memory_order_release);
+    hub_signal(hb);
+  }
+  // Otherwise the in-flight CQE finishes the handshake (the ring's
+  // registered eventfd fires on every completion).
+}
+
+static void walsync_detach(NativeWal* w) {
+  auto* hb = static_cast<WalSyncHub*>(w->hub);
+  if (hb == nullptr || w->hub_slot < 0) {
+    w->hub = nullptr;
+    w->hub_slot = -1;
+    return;
+  }
+  const int32_t si = w->hub_slot;
+  WalSlot& s = hb->slots[si];
+  s.closing = true;
+  // Bounded drain: at most one in-flight fsync plus one coalescing
+  // timer.  Runs blocking (GETEVENTS) — the async stop handshake has
+  // normally emptied the slot before this is called; the blocking
+  // path only fires at loop-less teardown.
+  while (s.fsync_inflight || s.timer_armed) {
+    dbeel_uring_flush(hb->u);
+    if (sys_uring_enter(hb->u->ring_fd, 0, 1, IORING_ENTER_GETEVENTS) <
+            0 &&
+        errno != EINTR && errno != EAGAIN)
+      break;
+    hub_reap(hb);
+  }
+  w->synced.store(w->seq.load(std::memory_order_acquire),
+                  std::memory_order_release);
+  s.wal = nullptr;
+  s.gen++;
+  s.closing = false;
+  hb->free_slots.push_back(si);
+  w->hub = nullptr;
+  w->hub_slot = -1;
+  w->sync_enabled.store(false, std::memory_order_relaxed);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dbeel_walsync_hub_new(uint32_t entries) try {
+  void* ring = dbeel_uring_create(entries ? entries : 128);
+  if (ring == nullptr) return nullptr;  // no io_uring: thread fallback
+  auto* hb = new WalSyncHub();
+  hb->u = static_cast<UringReader*>(ring);
+  return hb;
+} catch (...) {
+  return nullptr;
+}
+
+void dbeel_walsync_hub_free(void* h) {
+  auto* hb = static_cast<WalSyncHub*>(h);
+  if (hb == nullptr) return;
+  for (size_t i = 0; i < hb->slots.size(); i++)
+    if (hb->slots[i].wal != nullptr) walsync_detach(hb->slots[i].wal);
+  dbeel_uring_destroy(hb->u);
+  delete hb;
+}
+
+int32_t dbeel_walsync_hub_eventfd(void* h) {
+  return static_cast<WalSyncHub*>(h)->u->efd;
+}
+
+// Loop eventfd callback: drain completions, publish watermarks,
+// re-arm dirty slots.  Python then releases parked acks per WAL by
+// reading dbeel_wal_synced.
+void dbeel_walsync_hub_reap(void* h) {
+  hub_reap(static_cast<WalSyncHub*>(h));
+}
+
+// Attach a WAL to the hub (instead of dbeel_wal_sync_enable's
+// dedicated thread).  Returns 0, or -1 when already enabled/attached
+// or the ring lacks capacity (2 outstanding SQEs per slot max).
+int32_t dbeel_wal_sync_attach(void* wal_h, void* hub_h,
+                              uint64_t delay_us) try {
+  auto* w = static_cast<NativeWal*>(wal_h);
+  auto* hb = static_cast<WalSyncHub*>(hub_h);
+  if (w == nullptr || hb == nullptr) return -1;
+  if (w->sync_enabled.load(std::memory_order_relaxed) ||
+      w->hub != nullptr)
+    return -1;
+  int32_t si;
+  if (!hb->free_slots.empty()) {
+    si = hb->free_slots.back();
+    hb->free_slots.pop_back();
+  } else {
+    if ((hb->slots.size() + 1) * 2 >= hb->u->cq_entries) return -1;
+    si = (int32_t)hb->slots.size();
+    hb->slots.emplace_back();
+  }
+  WalSlot& s = hb->slots[si];
+  s.wal = w;
+  s.delay_us = delay_us;
+  s.fsync_inflight = false;
+  s.timer_armed = false;
+  s.closing = false;
+  s.inflight_s = 0;
+  w->hub = hb;
+  w->hub_slot = si;
+  w->delay_us = delay_us;
+  w->efd = -1;  // hub mode signals the ring's shared eventfd
+  w->sync_enabled.store(true, std::memory_order_release);
+  return 0;
+} catch (...) {
+  return -1;
 }
 
 }  // extern "C"
