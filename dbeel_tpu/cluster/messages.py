@@ -18,6 +18,7 @@ from typing import Any, List, Optional, Tuple
 import msgpack
 
 from ..errors import DbeelError, ProtocolError, from_wire
+from ..utils.murmur import murmur3_32
 
 
 @dataclass(frozen=True)
@@ -94,6 +95,7 @@ class ShardRequest:
     SET = "set"
     DELETE = "delete"
     GET = "get"
+    GET_DIGEST = "get_digest"
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
@@ -129,6 +131,14 @@ class ShardRequest:
     @staticmethod
     def get(collection: str, key: bytes) -> list:
         return ["request", ShardRequest.GET, collection, key]
+
+    @staticmethod
+    def get_digest(collection: str, key: bytes) -> list:
+        """Digest read (quorum-get fast path, beyond the reference —
+        db_server.rs:318-370 ships RF full entries): the replica
+        answers (timestamp, murmur3_32(value)) instead of the value,
+        so agreeing replicas cost a byte-compare, not a payload."""
+        return ["request", ShardRequest.GET_DIGEST, collection, key]
 
     @staticmethod
     def range_digest(
@@ -193,6 +203,7 @@ class ShardResponse:
     SET = "set"
     DELETE = "delete"
     GET = "get"
+    GET_DIGEST = "get_digest"
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
@@ -229,6 +240,24 @@ class ShardResponse:
             "response",
             ShardResponse.GET,
             list(entry) if entry is not None else None,
+        ]
+
+    @staticmethod
+    def get_digest(entry: Optional[Tuple[bytes, int]]) -> list:
+        """Digest of a replica's entry: [timestamp, murmur3_32(value)]
+        — or [] for authoritative absence (NOT nil: a byte-matched
+        ack surfaces as None at the coordinator, so absence needs a
+        distinct unpacked shape).  The encoding must stay canonical
+        msgpack (minimal ints): the coordinator predicts these exact
+        bytes from its local entry and the fan-out engine
+        byte-compares them in C."""
+        if entry is None:
+            return ["response", ShardResponse.GET_DIGEST, []]
+        value, ts = entry
+        return [
+            "response",
+            ShardResponse.GET_DIGEST,
+            [ts, murmur3_32(bytes(value))],
         ]
 
     @staticmethod

@@ -50,6 +50,10 @@ RESPONSE_BYTES = 2
 DEFAULT_SET_TIMEOUT_MS = 5000  # db_server.rs:31-32
 DEFAULT_GET_TIMEOUT_MS = 5000
 
+# "No local read happened yet" marker for the RF>1 get path (None is
+# a legitimate local read result: key absent).
+_NO_LOCAL_READ = object()
+
 
 def _extract(map_: dict, field: str):
     if field not in map_:
@@ -194,6 +198,39 @@ async def handle_request(
         consistency = min(consistency, rf)
 
         if rf > 1:
+            deadline = (
+                asyncio.get_event_loop().time() + timeout_ms / 1000
+            )
+            local_value = _NO_LOCAL_READ
+            if _digest_reads_enabled():
+                # Digest round: local read first (it anchors the
+                # predicted digest bytes), then (ts, hash) fan-out —
+                # full entries move only when a replica is newer.
+                try:
+                    local_value = await asyncio.wait_for(
+                        col.tree.get_entry(key), timeout_ms / 1000
+                    )
+                except asyncio.TimeoutError as e:
+                    raise Timeout("get") from e
+                if await _digest_quorum_round(
+                    my_shard,
+                    collection_name,
+                    col,
+                    key,
+                    local_value,
+                    consistency,
+                    rf - replica_index - 1,
+                    max(
+                        0.001,
+                        deadline - asyncio.get_event_loop().time(),
+                    ),
+                ):
+                    if (
+                        local_value is None
+                        or bytes(local_value[0]) == TOMBSTONE
+                    ):
+                        raise KeyNotFound(repr(key))
+                    return bytes(local_value[0])
             remote = my_shard.send_request_to_replicas(
                 ShardRequest.get(collection_name, key),
                 consistency - 1,
@@ -201,10 +238,26 @@ async def handle_request(
                 ShardResponse.GET,
             )
             try:
-                local_value, values = await asyncio.wait_for(
-                    asyncio.gather(col.tree.get_entry(key), remote),
-                    timeout_ms / 1000,
-                )
+                if local_value is _NO_LOCAL_READ:
+                    local_value, values = await asyncio.wait_for(
+                        asyncio.gather(col.tree.get_entry(key), remote),
+                        max(
+                            0.001,
+                            deadline
+                            - asyncio.get_event_loop().time(),
+                        ),
+                    )
+                else:
+                    # The digest round already read the local entry;
+                    # don't pay a second tree lookup on fallback.
+                    values = await asyncio.wait_for(
+                        remote,
+                        max(
+                            0.001,
+                            deadline
+                            - asyncio.get_event_loop().time(),
+                        ),
+                    )
             except asyncio.TimeoutError as e:
                 raise Timeout("get") from e
             return _merge_quorum_get(
@@ -229,6 +282,91 @@ async def handle_request(
     if isinstance(rtype, str):
         raise UnsupportedField(rtype)
     raise BadFieldType("type")
+
+
+def _digest_reads_enabled() -> bool:
+    import os
+
+    return os.environ.get("DBEEL_NO_DIGEST_READS", "0") in ("", "0")
+
+
+async def _digest_quorum_round(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    key: bytes,
+    local_value,
+    consistency: int,
+    number_of_nodes: int,
+    timeout_s: float,
+):
+    """Digest-read round for an RF>1 get (beyond the reference, which
+    ships RF full entries — db_server.rs:318-370): replicas answer
+    (timestamp, murmur3_32(value)) digests; the coordinator predicts
+    the exact response bytes from its LOCAL entry, so an agreeing
+    replica costs a byte-compare — which the native fan-out engine
+    (QuorumFan) performs in C — instead of a value payload + unpack.
+
+    Returns True when the local entry is authoritative (every
+    consulted replica agreed or was stale; stale ones got read
+    repair spawned) — the caller answers from ``local_value``.
+    Returns False when some replica holds a NEWER version (or a
+    same-timestamp divergent value): the caller must run the
+    full-entry round, which merges by max timestamp and read-repairs
+    as before.  Raises Timeout like the full round would."""
+    digest = pack_message(
+        ShardRequest.get_digest(collection_name, key)
+    )
+    framed = struct.pack("<I", len(digest)) + digest
+    expected = pack_message(ShardResponse.get_digest(local_value))
+    local_ts = None if local_value is None else local_value[1]
+    try:
+        results = await asyncio.wait_for(
+            my_shard.send_packed_to_replicas(
+                framed,
+                consistency - 1,
+                number_of_nodes,
+                expected,
+                ShardResponse.GET_DIGEST,
+            ),
+            timeout_s,
+        )
+    except asyncio.TimeoutError as e:
+        raise Timeout("get") from e
+    newer = False
+    stale = 0
+    for r in results:
+        if r is None:
+            continue  # byte-matched ack: replica agrees exactly
+        if not r:  # []: replica has no entry
+            if local_value is not None:
+                stale += 1
+            continue
+        r_ts = r[0]
+        if local_ts is None or r_ts > local_ts:
+            newer = True  # replica holds a newer version
+        elif r_ts < local_ts:
+            stale += 1
+        else:
+            # Same timestamp, different value hash: divergence the
+            # LWW model says cannot happen — resolve via the full
+            # round rather than guessing.
+            newer = True
+    if newer:
+        return False
+    if stale and local_value is not None:
+        my_shard.spawn(
+            _read_repair(
+                my_shard,
+                collection_name,
+                col,
+                key,
+                bytes(local_value[0]),
+                local_value[1],
+                number_of_nodes,
+            )
+        )
+    return True
 
 
 def _merge_quorum_get(
@@ -407,11 +545,39 @@ async def _finish_coord_get(
     consistency: int,
     timeout_ms: int,
 ) -> bytes:
-    """Quorum-merge for a coordinator-assisted get: fan the packed
-    peer frame out, combine replica results with the native local
-    lookup by max server timestamp (db_server.rs:353-363), spawn read
-    repair for stale replicas, and build the client response.  `key`
-    arrives from the C trailer — no peer-frame unpack on this path."""
+    """Quorum-merge for a coordinator-assisted get: digest round
+    first (replicas answer (ts, hash); agreement = C byte-compare in
+    the fan-out engine), full-entry round only when a replica holds a
+    newer version.  The full round combines replica results with the
+    native local lookup by max server timestamp (db_server.rs:353-363),
+    spawns read repair for stale replicas, and builds the client
+    response.  `key` arrives from the C trailer — no peer-frame
+    unpack on this path."""
+    local_value = (
+        None
+        if local_entry is None or local_entry[0] == "miss"
+        else local_entry
+    )
+    deadline = (
+        asyncio.get_event_loop().time() + timeout_ms / 1000
+    )
+    if _digest_reads_enabled():
+        if await _digest_quorum_round(
+            my_shard,
+            col_name,
+            col,
+            key,
+            local_value,
+            consistency,
+            col.replication_factor - 1,
+            timeout_ms / 1000,
+        ):
+            if (
+                local_value is None
+                or bytes(local_value[0]) == TOMBSTONE
+            ):
+                raise KeyNotFound(repr(key))
+            return bytes(local_value[0]) + bytes([RESPONSE_OK])
     remote = my_shard.send_packed_to_replicas(
         peer_frame,
         consistency - 1,
@@ -420,14 +586,12 @@ async def _finish_coord_get(
         ShardResponse.GET,
     )
     try:
-        values = await asyncio.wait_for(remote, timeout_ms / 1000)
+        values = await asyncio.wait_for(
+            remote,
+            max(0.001, deadline - asyncio.get_event_loop().time()),
+        )
     except asyncio.TimeoutError as e:
         raise Timeout("get") from e
-    local_value = (
-        None
-        if local_entry is None or local_entry[0] == "miss"
-        else local_entry
-    )
     win_value = _merge_quorum_get(
         my_shard,
         col_name,

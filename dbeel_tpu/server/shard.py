@@ -860,6 +860,16 @@ class MyShard:
             if col is not None:
                 entry = await col.tree.get_entry(bytes(request[3]))
             return ShardResponse.get(entry)
+        if kind == ShardRequest.GET_DIGEST:
+            # Digest read (quorum-get fast path): answer (ts, value
+            # hash) only — canonical bytes, so an agreeing replica's
+            # response byte-matches the coordinator's prediction and
+            # never needs unpacking (fan-out engine compares in C).
+            col = self.collections.get(request[2])
+            entry = None
+            if col is not None:
+                entry = await col.tree.get_entry(bytes(request[3]))
+            return ShardResponse.get_digest(entry)
         if kind == ShardRequest.RANGE_DIGEST:
             col = self.collections.get(request[2])
             # Clamp both sides: nb sizes two local allocations, so an

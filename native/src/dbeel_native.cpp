@@ -2568,8 +2568,9 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   const bool k_set = slice_eq(kind_s, kind_n, "set");
   const bool k_del = is_req && slice_eq(kind_s, kind_n, "delete");
   const bool k_get = is_req && slice_eq(kind_s, kind_n, "get");
+  const bool k_dig = is_req && slice_eq(kind_s, kind_n, "get_digest");
   if (is_event && !k_set) return -1;
-  if (!(k_set || k_del || k_get)) return -1;
+  if (!(k_set || k_del || k_get || k_dig)) return -1;
   const uint32_t want =
       k_set ? 6u : k_del ? 5u : 4u;
   if (nelem != want) return -1;
@@ -2588,6 +2589,47 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   int32_t col_idx = -1;
   FastCollection* col = dp_find_col(dp, coll_s, coll_n, &col_idx);
   if (col == nullptr) return -1;
+
+  if (k_dig) {
+    // Digest read (quorum-get fast path, beyond the reference):
+    // answer [ts, murmur3_32(value)] — or [] for absence — in
+    // canonical msgpack, byte-identical to the Python handler's
+    // ShardResponse.get_digest, so an agreeing replica's response
+    // matches the coordinator's predicted ack byte-for-byte.
+    const uint8_t* v = nullptr;
+    uint32_t vn = 0;
+    int64_t ets = 0;
+    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
+    const int found =
+        col_find(dp, col, key_s, key_n, dp->valbuf.data(), kDpValMax,
+                 &v, &vn, &ets);
+    if (found < 0) return -1;
+    // ["response","get_digest",[ts,hash]|[]]
+    uint8_t hdr[48];
+    size_t o = 0;
+    hdr[o++] = 0x93;
+    hdr[o++] = 0xa8;
+    std::memcpy(hdr + o, "response", 8);
+    o += 8;
+    hdr[o++] = 0xaa;
+    std::memcpy(hdr + o, "get_digest", 10);
+    o += 10;
+    if (found) {
+      hdr[o++] = 0x92;
+      o += mp_put_int64(hdr + o, ets);
+      o += mp_put_int64(hdr + o,
+                        (int64_t)murmur3_32(v, vn, 0));
+    } else {
+      hdr[o++] = 0x90;  // []: authoritative absence
+    }
+    if ((uint64_t)4 + o > out_cap) return -1;
+    const uint32_t t32 = (uint32_t)o;
+    std::memcpy(out, &t32, 4);
+    std::memcpy(out + 4, hdr, o);
+    *out_len = 4 + t32;
+    dp->fast_replica_ops++;
+    return ((int64_t)col_idx << 8) | 4;
+  }
 
   if (k_get) {
     const uint8_t* v = nullptr;

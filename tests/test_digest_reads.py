@@ -1,0 +1,246 @@
+"""Digest reads for RF>1 gets (beyond the reference, which ships RF
+full entries per quorum get — /root/reference/src/tasks/db_server.rs:
+318-370): replicas answer (timestamp, murmur3_32(value)) digests, the
+coordinator predicts the exact response bytes from its local entry,
+and agreement is a byte-compare (run in C by the fan-out engine).
+Full entries cross the wire only when a replica holds a newer
+version; read repair semantics are unchanged."""
+
+import asyncio
+import struct
+
+import msgpack
+
+from dbeel_tpu.client import DbeelClient, Consistency
+from dbeel_tpu.cluster import messages as msgs
+from dbeel_tpu.flow_events import FlowEvent
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+def _three_nodes(tmp_dir, **kw):
+    cfg = make_config(tmp_dir, **kw)
+    cfgs = [cfg]
+    for i in (1, 2):
+        cfgs.append(
+            next_node_config(cfg, i, tmp_dir).replace(
+                seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"], **kw
+            )
+        )
+    return cfgs
+
+
+async def _shard_roundtrip(port: int, request: list) -> bytes:
+    """One framed request to a remote shard port; returns the raw
+    response payload (no length prefix)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        payload = msgs.pack_message(request)
+        writer.write(struct.pack("<I", len(payload)) + payload)
+        await writer.drain()
+        hdr = await reader.readexactly(4)
+        (size,) = struct.unpack("<I", hdr)
+        return await reader.readexactly(size)
+    finally:
+        writer.close()
+
+
+def test_digest_response_byte_identity(tmp_dir):
+    """The C replica plane's get_digest response must be
+    byte-identical to Python's ShardResponse.get_digest for hits,
+    tombstones, and misses — the coordinator's predicted-ack compare
+    depends on it."""
+
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection("dg")
+            await col.set("hit", {"x": 1})
+            await col.set("dead", "gone")
+            await col.delete("dead")
+
+            tree = node.shards[0].collections["dg"].tree
+            port = node.config.remote_port(0)
+            for label in ("hit", "dead", "absent"):
+                key = msgpack.packb(label, use_bin_type=True)
+                entry = await tree.get_entry(key)
+                if label == "absent":
+                    assert entry is None
+                expected = msgs.pack_message(
+                    msgs.ShardResponse.get_digest(entry)
+                )
+                got = await _shard_roundtrip(
+                    port, msgs.ShardRequest.get_digest("dg", key)
+                )
+                assert got == expected, (label, got, expected)
+            # The hits rode the native replica plane when available.
+            dp = node.shards[0].dataplane
+            if dp is not None:
+                assert dp.stats().get("fast_replica_ops", 0) >= 1
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_converged_quorum_gets_skip_full_entries(tmp_dir, monkeypatch):
+    """On a converged RF=3 cluster every quorum get is answered by
+    the digest round alone: the full-entry merge must never run
+    (monkeypatched to explode), and values still come back right."""
+
+    async def main():
+        from dbeel_tpu.server import db_server
+
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
+            col = await client.create_collection(
+                "cv", replication_factor=3
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            for i in range(12):
+                await col.set(
+                    f"k{i}", {"i": i}, consistency=Consistency.ALL
+                )
+
+            def boom(*a, **kw):
+                raise AssertionError(
+                    "full-entry merge ran on a converged read"
+                )
+
+            monkeypatch.setattr(db_server, "_merge_quorum_get", boom)
+            for i in range(12):
+                assert await col.get(
+                    f"k{i}", consistency=Consistency.ALL
+                ) == {"i": i}
+            # Absent keys too: all replicas agree on the miss digest.
+            try:
+                await col.get("nope", consistency=Consistency.ALL)
+                raise AssertionError("expected KeyNotFound")
+            except Exception as e:
+                assert "KeyNotFound" in type(e).__name__ or (
+                    "not found" in str(e).lower()
+                    or "KeyNotFound" in str(e)
+                ), e
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_stale_replica_triggers_full_round_and_repair(tmp_dir):
+    """A replica holding an OLDER version: the digest round detects
+    the divergence; the answer is still the newest value and the
+    stale replica is repaired (read-repair semantics unchanged)."""
+
+    async def main():
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
+            col = await client.create_collection(
+                "st", replication_factor=3
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            await col.set("k", "v1", consistency=Consistency.ALL)
+            # Make one replica stale: write newer data directly into
+            # the other two trees with a bumped timestamp (no fan-out
+            # — deterministic divergence without node churn).
+            key = msgpack.packb("k", use_bin_type=True)
+            v2 = msgpack.packb("v2", use_bin_type=True)
+            trees = [
+                n.shards[0].collections["st"].tree for n in nodes
+            ]
+            entry = await trees[0].get_entry(key)
+            assert entry is not None
+            newer_ts = entry[1] + 1_000_000
+            repaired = nodes[2].flow_event(
+                0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+            )
+            await trees[0].set_with_timestamp(key, v2, newer_ts)
+            await trees[1].set_with_timestamp(key, v2, newer_ts)
+            # Quorum read: whatever node coordinates, at least one
+            # digest disagrees => full round => newest value.
+            assert await col.get(
+                "k", consistency=Consistency.ALL
+            ) == "v2"
+            await asyncio.wait_for(repaired, 10)
+            stale = await trees[2].get(key)
+            assert stale == v2, "stale replica not repaired"
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_digest_reads_kill_switch(tmp_dir, monkeypatch):
+    """DBEEL_NO_DIGEST_READS=1 restores the reference-shaped
+    full-entry quorum get (A/B lever for the bench)."""
+    monkeypatch.setenv("DBEEL_NO_DIGEST_READS", "1")
+
+    async def main():
+        from dbeel_tpu.server import db_server
+
+        cfgs = _three_nodes(tmp_dir)
+        nodes = [await ClusterNode(cfgs[0]).start()]
+        for c in cfgs[1:]:
+            alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            nodes.append(await ClusterNode(c).start())
+            await alive
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [nodes[0].db_address]
+            )
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in nodes
+            ]
+            col = await client.create_collection(
+                "ab", replication_factor=3
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            await col.set("k", {"v": 9}, consistency=Consistency.ALL)
+            calls = []
+            orig = db_server._merge_quorum_get
+
+            def spy(*a, **kw):
+                calls.append(1)
+                return orig(*a, **kw)
+
+            monkeypatch.setattr(db_server, "_merge_quorum_get", spy)
+            assert await col.get(
+                "k", consistency=Consistency.ALL
+            ) == {"v": 9}
+            assert calls, "full merge must run with digests disabled"
+        finally:
+            for n in reversed(nodes):
+                await n.stop()
+
+    run(main(), timeout=60)
