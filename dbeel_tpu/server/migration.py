@@ -36,6 +36,22 @@ def _between(hash_: int, start: int, end: int) -> bool:
     return start <= hash_ < end
 
 
+def _in_migration_range(hash_: int, start: int, end: int) -> bool:
+    """Ownership-convention range membership: (start, end].
+
+    Migration plans carry raw shard hashes and ownership is
+    end-INCLUSIVE — the first shard with hash >= h owns h (owns_key /
+    the client walk), so shard S owns (pred, S].  Feeding the raw
+    hashes through the half-open [start, end) filter drops a key that
+    hashes exactly onto S (owned, never migrated) and over-sends one
+    that hashes exactly onto pred.  Same +1-shift convention as the
+    anti-entropy plane (shard.py _in_ae_range); the reference applies
+    its migration ranges unshifted (migration.rs:54-60 over raw
+    plan hashes) and inherits the boundary hole — found by
+    tests/test_membership_fuzz.py."""
+    return _between((hash_ - 1) & 0xFFFFFFFF, start, end)
+
+
 async def migrate_actions(
     my_shard,
     collection_name: str,
@@ -60,7 +76,7 @@ async def migrate_actions(
         index = next(
             i
             for i, (s, e) in enumerate(ranges)
-            if _between(h, s, e)
+            if _in_migration_range(h, s, e)
         )
         ra = ranges_and_actions[index]
         if ra.action == MigrationAction.DELETE:
@@ -77,7 +93,8 @@ async def migrate_actions(
     # (glommio bg-queue parity) instead of racing it for the loop.
     agen = tree.iter_filter(
         lambda k, v, t: any(
-            _between(hash_bytes(k), s, e) for s, e in ranges
+            _in_migration_range(hash_bytes(k), s, e)
+            for s, e in ranges
         )
     ).__aiter__()
     try:

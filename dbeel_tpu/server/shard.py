@@ -1231,32 +1231,43 @@ class MyShard:
             )
             if migrate_to is None:
                 continue
+            start = self.shards[-1].hash
+            # REFERENCE BUG (the fourth documented one, PARITY.md):
+            # the reference (shards.rs:889-920) only sends when a
+            # removed shard sat in the FORWARD span (me, migrate_to],
+            # and then truncates the range to the absorbed slice
+            # (new_pred, closest-removed-below-me] when the dead node
+            # was also my ring predecessor.  Two holes: (a) with the
+            # dead node both behind me and in my replica walk, the
+            # new tail owner receives the absorbed slice but never my
+            # original primary slice; (b) with the dead node ONLY
+            # behind me, no send fires at all although the absorbed
+            # slice's walk shifted and its new tail owner holds
+            # nothing.  Found by tests/test_membership_fuzz.py
+            # invariant D.  Exactly one node dies per DEAD event, so
+            # the single gained owner of every affected slice is
+            # migrate_to (the new rf-th distinct node): when any
+            # removed shard lies in (new_pred, me] (absorption) or in
+            # (me, migrate_to] (walk shift), send the FULL new
+            # primary range (new_pred, me] there — slices migrate_to
+            # already held merge idempotently (LWW).  The two arcs
+            # are tested separately, not as one (new_pred,
+            # migrate_to] span: with few nodes the walk can wrap far
+            # enough that migrate_to IS my new predecessor, and the
+            # single-span test degenerates to an empty arc.
             if not any(
-                is_between(s.hash, self.hash, migrate_to.hash)
+                is_between(s.hash, start, self.hash)
+                or is_between(s.hash, self.hash, migrate_to.hash)
                 for s in removed_shards
             ):
                 continue
-            start = self.shards[-1].hash
-            candidates = [
-                s.hash
-                for s in removed_shards
-                if is_between(s.hash, start, self.hash)
-            ]
-            end = (
-                min(
-                    candidates,
-                    key=lambda h: (self.hash - h) & 0xFFFFFFFF,
-                )
-                if candidates
-                else self.hash
-            )
             actions.append(
                 (
                     name,
                     [
                         RangeAndAction(
                             start,
-                            end,
+                            self.hash,
                             MigrationAction.SEND,
                             migrate_to.connection,
                         )
@@ -1292,8 +1303,35 @@ class MyShard:
                 continue
             previous_shard_hash = prev_hashes[0]
 
-            # Step 1: send (prev, me] range to the closest added shard
-            # within this shard's replica span.
+            # The executor dispatches each key to the FIRST matching
+            # range (migration.py process), so steps 1 and 2 must emit
+            # DISJOINT ranges.  Added shards that landed between my
+            # predecessor and me split my old primary range
+            # (prev, me]: after the add I own only (A_max, me], and
+            # each behind-me added shard owns its slice of the rest.
+            between = [
+                s
+                for s in added_shards
+                if is_between(s.hash, previous_shard_hash, self.hash)
+            ]
+            between.sort(
+                key=lambda s: (s.hash - previous_shard_hash)
+                & 0xFFFFFFFF
+            )
+            my_range_start = (
+                between[-1].hash if between else previous_shard_hash
+            )
+
+            # Step 1: send my (new) primary range to the closest added
+            # shard within this shard's replica span — it became one
+            # of that range's replicas.  The range is (A_max, me], NOT
+            # the reference's (prev, me] (shards.rs:978-994): the
+            # slices behind A_max now belong to the added node's
+            # behind-me shards (step 2) and the forward-span shard is
+            # not in their walk (same node as A_max, which already
+            # represents it) — the reference's wider range both
+            # over-sends unowned data and, under first-match dispatch,
+            # shadows the step-2 slices.
             in_span = [
                 s
                 for s in added_shards
@@ -1307,28 +1345,35 @@ class MyShard:
                 )
                 col_actions.append(
                     RangeAndAction(
-                        previous_shard_hash,
+                        my_range_start,
                         self.hash,
                         MigrationAction.SEND,
                         migrate_to.connection,
                     )
                 )
 
-            # Step 2: chain ranges between added shards that landed
-            # between my predecessor and me.
-            between = [
-                s
-                for s in added_shards
-                if is_between(s.hash, previous_shard_hash, self.hash)
-            ]
-            if len(between) > 1:
-                between.sort(
-                    key=lambda s: (s.hash - self.hash) & 0xFFFFFFFF
-                )
-                for a, b in zip(between, between[1:]):
+            # Step 2: I am the only holder of (prev, me], so I stream
+            # each behind-me added shard the slice it now owns as
+            # primary: (prev, A1] -> A1, (A1, A2] -> A2, ...
+            #
+            # REFERENCE BUG (the third documented one, PARITY.md): the
+            # reference chains only BETWEEN added shards
+            # (shards.rs:996-1026, `tuple_windows`), claiming the
+            # "farthest" one is covered by the previous shard's step 1
+            # — but prev's step 1 sends its OWN primary range
+            # (prevprev, prev], never (prev, A1].  A new shard thus
+            # never receives the primary range it took over: reads at
+            # consistency=1 routed to it see missing keys until read
+            # repair / anti-entropy backfill.  Found by
+            # tests/test_membership_fuzz.py invariant B.
+            if between:
+                starts = [previous_shard_hash] + [
+                    s.hash for s in between[:-1]
+                ]
+                for start, b in zip(starts, between):
                     col_actions.append(
                         RangeAndAction(
-                            a.hash,
+                            start,
                             b.hash,
                             MigrationAction.SEND,
                             b.connection,
