@@ -77,6 +77,10 @@ _SHIFT_DUP_LIMIT = 0.10
 # Partitions per device launch: tunneled TPUs pay a large fixed
 # round-trip per launch, so same-mode partitions are vmapped together.
 _LAUNCH_BATCH = 4
+# Multi-batch partitioning (>=2 launch batches for stage overlap) only
+# above this many total input rows — below it the extra per-launch
+# dispatch outweighs the overlap.
+_MULTIBATCH_MIN_ROWS = 1 << 19
 # Background fdatasync stride: flush the output's device write cache
 # every this many written bytes concurrently with the write stream.
 # DISABLED by default (0): on this virtio disk a concurrent fdatasync
@@ -201,26 +205,48 @@ def _stage_prefixes(run: _Run, lib=None) -> None:
     run.prefix64 = pref.view(">u8").reshape(n)
 
 
-def _choose_partitions(runs: List[_Run]):
+def _choose_partitions(runs: List[_Run], launch_batch: int = None):
     """Pick (splitters, per-run bounds, p2): keyspace cut points such
     that every run's slice fits the pow2 kernel rows ``p2`` with little
-    padding.  Returns None if an equal-prefix group exceeds the kernel
-    (the caller then falls back)."""
+    padding.  ``launch_batch`` is the EFFECTIVE launch width (mesh mode
+    widens it to a device multiple).  Returns None if an equal-prefix
+    group exceeds the kernel (the caller then falls back)."""
+    if launch_batch is None:
+        launch_batch = _LAUNCH_BATCH
     max_run = max((r.prefix64.size for r in runs), default=0)
+    total_rows = sum(r.prefix64.size for r in runs)
     if max_run == 0:
         return np.zeros(0, dtype=">u8"), None, 8
-    # Prefer >=4 partitions: the pipeline's whole point is overlapping
-    # read/upload/kernel/download/write across partitions, so a
-    # padding-optimal single partition (e.g. 64 small runs whose
-    # max_run is already a near-pow2) would serialize every stage.
-    parts = None
-    for cand in (*range(4, 65), 1, 2, 3):  # preference order
-        p2 = _pow2(-(-max_run // cand))
+    # Prefer enough partitions to fill at least TWO launch batches:
+    # the pipeline's whole point is overlapping read/upload/kernel/
+    # download/write, and with every partition in one batch the stages
+    # run strictly serially (measured on the 64-way config-4 shape:
+    # all four writer puts + consumes landed AFTER the single
+    # kernel+d2h, costing ~0.4s of unoverlapped host work on 2M keys).
+    # Within the two-to-four-batch band take the smallest viable count
+    # (fewest launches — each costs ~40ms dispatch through the TPU
+    # tunnel); below it, fall back to >=4 partitions, then any.
+    viable = []
+    for cand in range(1, 65):
+        p2c = _pow2(-(-max_run // cand))
         if (
-            p2 <= _MAX_P2
-            and cand * p2 / max_run - 1.0 <= _PAD_WASTE_LIMIT
+            p2c <= _MAX_P2
+            and cand * p2c / max_run - 1.0 <= _PAD_WASTE_LIMIT
         ):
-            parts = cand
+            viable.append(cand)
+    # The multi-batch band only pays when there is real host work to
+    # overlap: a tiny merge split into two launches just buys a second
+    # ~40ms tunnel dispatch.
+    bands = (
+        ((2 * launch_batch, 4 * launch_batch),)
+        if total_rows >= _MULTIBATCH_MIN_ROWS
+        else ()
+    ) + ((4, 64), (1, 3))
+    parts = None
+    for lo, hi in bands:
+        sel = [c for c in viable if lo <= c <= hi]
+        if sel:
+            parts = sel[0]
             break
     if parts is None:
         parts = -(-max_run // _MAX_P2)
@@ -506,17 +532,11 @@ def _pipeline_merge_impl(
             r = f.result()
             _stage_prefixes(r, lib)
             runs.append(r)
-    chosen = _choose_partitions(runs)
-    if chosen is None:
-        return None
-    _splitters, bounds, p2 = chosen
-    _ev("prologue done (read+stage+choose)")
-    n_parts = (bounds[0].size - 1) if bounds is not None else 0
-    k2 = _pow2(max(1, len(runs)))
-    pack_bits = rid_pack_bits(k2)
-
     # Mesh mode: widen the launch batch to a device multiple and shard
     # the batch axis — each device merges its own keyspace partitions.
+    # Computed BEFORE partitioning: the multi-batch preference must
+    # target the EFFECTIVE launch width, or a wide mesh swallows every
+    # partition into one launch and re-serializes the stages.
     launch_j = _LAUNCH_BATCH
     shard32 = shard64 = shard_counts = None
     if mesh is not None and mesh.devices.size > 1:
@@ -530,6 +550,15 @@ def _pipeline_merge_impl(
             mesh, PartitionSpec(axis, None, None, None)
         )
         shard_counts = NamedSharding(mesh, PartitionSpec(axis, None))
+
+    chosen = _choose_partitions(runs, launch_j)
+    if chosen is None:
+        return None
+    _splitters, bounds, p2 = chosen
+    _ev("prologue done (read+stage+choose)")
+    n_parts = (bounds[0].size - 1) if bounds is not None else 0
+    k2 = _pow2(max(1, len(runs)))
+    pack_bits = rid_pack_bits(k2)
 
     counts_all = np.array(
         [r.offsets.size for r in runs], dtype=np.int64
