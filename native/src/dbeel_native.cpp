@@ -1519,7 +1519,25 @@ struct FastCollection {
   // (dbeel_dp_handle_shard — explicit-timestamp peer traffic) touches
   // them natively.
   bool client_ok = true;
+  // WAL appends into the CURRENT active memtable (reset when
+  // dp_register swaps the handle).  Update-heavy workloads rewriting
+  // fewer than ``capacity`` hot keys never trip the distinct-key full
+  // check, so the page-padded WAL grows without bound (a 17-minute
+  // chaos soak wrote 910 MB of WAL for 240 live keys); the append
+  // count trips the same memtable-now-full flag instead.  Mirrors
+  // LSMTree._appends_since_swap on the Python path; the two streams
+  // are disjoint (each plane counts only its own writes), so mixed
+  // native/punt traffic flushes by ~2x capacity appends worst-case —
+  // still a hard bound.
+  uint64_t appends = 0;
 };
+
+// Memtable-now-full check (flag bit1): distinct-key capacity OR the
+// append-count trigger (see FastCollection::appends).
+static inline bool dp_col_full(const FastCollection* col) {
+  return dbeel_memtable_len(col->active) >= col->capacity ||
+         col->appends >= col->capacity;
+}
 
 struct DataPlane {
   std::vector<FastCollection> cols;
@@ -2025,6 +2043,7 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
   const auto it = dp->col_map.find(n);
   if (it != dp->col_map.end()) {
     const size_t i = it->second;
+    if (dp->cols[i].active != active) dp->cols[i].appends = 0;
     dp->cols[i].active = active;
     dp->cols[i].flushing = flushing;
     dp->cols[i].wal = static_cast<NativeWal*>(wal);
@@ -2365,9 +2384,10 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       col->active, key_raw, key_n, is_set ? val_raw : nullptr,
       is_set ? val_n : 0, ts, &old_len);
   if (rc < 0) return -1;  // capacity/alloc: Python waits for the flush
+  col->appends++;
   int64_t flags = ((int64_t)col_idx << 8) | (keepalive ? 1 : 0);
   if (is_del) flags |= 8;
-  if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (dp_col_full(col)) flags |= 2;
   if (dbeel_wal_append(col->wal, key_raw, key_n,
                        is_set ? val_raw : nullptr, is_set ? val_n : 0,
                        ts) == 0) {
@@ -2689,6 +2709,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
       col->active, key_s, key_n, k_set ? val_s : nullptr,
       k_set ? val_n : 0, ts, &old_len);
   if (rc < 0) return -1;  // capacity: Python waits for the flush
+  col->appends++;
   if (dbeel_wal_append(col->wal, key_s, key_n,
                        k_set ? val_s : nullptr, k_set ? val_n : 0,
                        ts) == 0) {
@@ -2699,7 +2720,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     // 0x20 suppresses the SET flow notification either way (Python
     // notifies only on full success).
     int64_t eflags = ((int64_t)col_idx << 8) | 8 | 0x20;
-    if (dbeel_memtable_len(col->active) >= col->capacity) eflags |= 2;
+    if (dp_col_full(col)) eflags |= 2;
     if (is_req) {
       uint8_t* o = out + 4;
       size_t n = 0;
@@ -2725,7 +2746,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   }
   int64_t flags = ((int64_t)col_idx << 8) | 8;
   if (k_del) flags |= 0x20;  // delete: no SET flow notification
-  if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (dp_col_full(col)) flags |= 2;
   if (is_req) {
     // ["response","set"] / ["response","delete"] (out_cap >= 32
     // checked above, before the write applied)
@@ -2890,6 +2911,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
                          is_set ? f.val_raw : nullptr,
                          is_set ? f.val_n : 0, ts, &old_len) < 0)
     return -1;  // capacity/alloc: Python waits for the flush
+  col->appends++;
   if (dbeel_wal_append(col->wal, f.key_raw, f.key_n,
                        is_set ? f.val_raw : nullptr,
                        is_set ? f.val_n : 0, ts) == 0) {
@@ -2900,7 +2922,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
                                  out_len))
       return -1;  // unreachable: `need` >= the error envelope size
     int64_t eflags = base_flags | 0x10;
-    if (dbeel_memtable_len(col->active) >= col->capacity) eflags |= 2;
+    if (dp_col_full(col)) eflags |= 2;
     if (is_del) eflags |= 4;
     return eflags;
   }
@@ -2938,7 +2960,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   dp->fast_coord_writes++;
 
   int64_t flags = base_flags;
-  if (dbeel_memtable_len(col->active) >= col->capacity) flags |= 2;
+  if (dp_col_full(col)) flags |= 2;
   if (is_del) flags |= 4;
   // wal-sync tree: the coordinator's own (replica-0) write only
   // counts as an ack once synced — Python awaits the sync ticket

@@ -357,3 +357,54 @@ def test_flush_during_compaction_stays_newest(tmp_dir):
         tree.close()
 
     run(main())
+
+
+def test_update_heavy_workload_bounds_wal(tmp_dir):
+    """Hammering FEWER than ``capacity`` distinct keys must still
+    flush (append-count trigger): without it the page-padded WAL
+    grows without bound — the 17-minute chaos soak wrote a 3.6 GB
+    WAL for 240 live keys — and a crash replays all of it.  The
+    reference only flushes on distinct-key capacity
+    (lsm_tree.rs:747-755) and inherits the unbounded growth."""
+
+    async def main():
+        tree = make_tree(tmp_dir)
+        # 8 hot keys, CAP*6 updates: never "full" by distinct count.
+        for i in range(CAP * 6):
+            await tree.set(f"hot{i % 8}".encode(), f"v{i}".encode())
+        await tree.flush()
+        # Flushes happened: sstables exist and the WAL index moved on.
+        indices = [i for i, _ in tree.sstable_indices_and_sizes()]
+        assert indices, "update-heavy workload never flushed"
+        assert tree._index >= 2
+        # On-disk WAL bytes stay bounded by ~capacity pages, not by
+        # the total update count.
+        def _size(p):
+            try:
+                return os.path.getsize(p)
+            except FileNotFoundError:
+                return 0  # raced the off-loop WAL disposal unlink
+
+        tree_dir = os.path.join(tmp_dir, "tree")
+        wal_files = [
+            f
+            for f in os.listdir(tree_dir)
+            if f.endswith(".memtable")  # MEMTABLE_FILE_EXT
+        ]
+        assert wal_files, "expected live WAL files"
+        wal_bytes = sum(
+            _size(os.path.join(tree_dir, f)) for f in wal_files
+        )
+        assert wal_bytes <= (CAP + 2) * 2 * PAGE_SIZE, wal_bytes
+        # Latest values survive a reopen (WAL replay + sstables).
+        tree.close()
+        tree2 = make_tree(tmp_dir)
+        for k in range(8):
+            expect = max(
+                i for i in range(CAP * 6) if i % 8 == k
+            )
+            got = await tree2.get(f"hot{k}".encode())
+            assert got == f"v{expect}".encode(), (k, got)
+        tree2.close()
+
+    run(main())
