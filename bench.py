@@ -603,9 +603,11 @@ def main():
             )
             device_ok = probe.confirm_fresh(floor_s=probe_timeout)
         os.environ["DBEEL_JAX_PROBED"] = "ok" if device_ok else "fail"
+        device_platform = None
         if device_ok:
+            device_platform = jax.default_backend()
             log(
-                f"jax backend: {jax.default_backend()}, "
+                f"jax backend: {device_platform}, "
                 f"devices: {jax.devices()}"
             )
         else:
@@ -709,11 +711,17 @@ def main():
             "keys": args.keys,
             "runs": args.runs,
             "variable_values": bool(args.variable_values),
+            # Which jax backend executed the device column (None on
+            # tunnel-down fallback, where no backend ran).  "cpu"
+            # means jax initialized but WITHOUT the accelerator (e.g.
+            # a forced-cpu profiling run): the pass is a valid
+            # product-path measurement but NOT device evidence.
+            "device_platform": device_platform,
             # Present (true) only when the TPU tunnel was down
             # and the device column is the CPU fallback path.
             **({} if device_ok else {"device_unavailable": True}),
         }
-        if device_ok and identical:
+        if device_ok and identical and device_platform != "cpu":
             try:
                 save_last_good(args, report, dev_hash)
             except Exception as e:  # artifact write must never kill a run

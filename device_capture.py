@@ -125,6 +125,13 @@ def run_capture(runs: int, keys: int, variable: bool,
     if rep.get("device_unavailable"):
         log("tunnel died between probe and device pass")
         return False
+    if rep.get("device_platform") in (None, "cpu"):
+        # jax initialized WITHOUT the accelerator (jax always exposes
+        # cpu devices, so the liveness probe can pass anyway): bench
+        # deliberately refuses to persist this as device evidence —
+        # don't claim a capture, and don't hot-loop re-benching.
+        log("jax ran on the cpu backend; not device evidence")
+        return False
     log(
         f"captured: {rep.get('value'):,} keys/s, "
         f"vs_best_cpu {rep.get('vs_best_cpu')}, "
