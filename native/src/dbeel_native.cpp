@@ -1654,7 +1654,19 @@ static void prefix_range(const FastTable& t, const uint8_t* key,
 
 static const uint32_t kDpKeyMax = 64u << 10;  // bigger keys punt
 
-static const uint32_t kDpValMax = 255u << 10;  // bigger values punt
+static const uint32_t kDpValMax = 255u << 10;  // staging floor
+
+// Absolute native-path size bound for keys, values and grown scratch:
+// above this the interpreted path (io_uring reads, Python fan-out)
+// serves the request.  The reference's compiled path takes any u32
+// size (entry_writer.rs:72-74); 16 MiB keeps hostile inputs from
+// ballooning per-shard scratch while covering every realistic entry.
+static const uint32_t kDpHardMax = 16u << 20;
+
+// Envelope slack on top of kDpHardMax for grow-and-retry (-2) size
+// reports: headers plus up to a u16-frame-bounded key echoed twice.
+// Python's _GET_BUF_HARD_CAP mirrors kDpHardMax + this slack.
+static const uint32_t kDpGrowSlack = 256u << 10;
 
 // Binary-search one table for `key` via NOWAIT preads.
 // Returns 1 found (value pread into dst, *val_out = dst, *vlen/*ts
@@ -1665,11 +1677,11 @@ static const uint32_t kDpValMax = 255u << 10;  // bigger values punt
 static int table_find(DataPlane* dp, const FastTable& t,
                       const uint8_t* key, uint32_t kn, uint8_t* dst,
                       uint32_t dst_cap, const uint8_t** val_out,
-                      uint32_t* vlen_out, int64_t* ts_out) {
+                      uint32_t* vlen_out, int64_t* ts_out,
+                      uint32_t* needed_out) {
   uint64_t lo, hi;
   prefix_range(t, key, kn, &lo, &hi);
   if (dp->keybuf.size() < kDpKeyMax) dp->keybuf.resize(kDpKeyMax);
-  uint8_t* keybuf = dp->keybuf.data();
   uint8_t rec[16];
   while (lo < hi) {
     const uint64_t mid = lo + (hi - lo) / 2;
@@ -1678,7 +1690,9 @@ static int table_find(DataPlane* dp, const FastTable& t,
     uint32_t ksz;
     std::memcpy(&off, rec, 8);
     std::memcpy(&ksz, rec + 8, 4);
-    if (ksz > kDpKeyMax) return -1;
+    if (ksz > kDpHardMax) return -1;  // exotic: interpreted path
+    if (dp->keybuf.size() < ksz) dp->keybuf.resize(ksz);
+    uint8_t* keybuf = dp->keybuf.data();
     if (ksz != 0 && !pread_nw(t.data_fd, keybuf, ksz, off + 16))
       return -1;
     int cmp = std::memcmp(keybuf, key, ksz < kn ? ksz : kn);
@@ -1692,7 +1706,12 @@ static int table_find(DataPlane* dp, const FastTable& t,
       std::memcpy(&vlen, hdr + 4, 4);
       std::memcpy(&ts, hdr + 8, 8);
       if (klen != ksz) return -1;  // corrupt index: let Python judge
-      if (vlen > dst_cap) return -1;
+      if (vlen > dst_cap) {
+        // Not a punt: the caller can grow its buffer and retry (the
+        // index/key pages just probed stay warm).
+        if (needed_out != nullptr) *needed_out = vlen;
+        return -2;
+      }
       if (vlen != 0 &&
           !pread_nw(t.data_fd, dst, vlen, off + 16 + klen))
         return -1;
@@ -1718,7 +1737,8 @@ static int col_find(DataPlane* dp, FastCollection* col,
                     const uint8_t* key, uint32_t kn, uint8_t* dst,
                     uint32_t dst_cap, const uint8_t** val_out,
                     uint32_t* vlen_out, int64_t* ts_out,
-                    bool skip_memtables = false) {
+                    bool skip_memtables = false,
+                    uint32_t* needed_out = nullptr) {
   if (!skip_memtables) {
     int32_t found = dbeel_memtable_get(col->active, key, kn, val_out,
                                        vlen_out, ts_out);
@@ -1731,10 +1751,33 @@ static int col_find(DataPlane* dp, FastCollection* col,
   for (const auto& t : col->tables) {
     if (t.entry_count == 0 || !bloom_maybe(t, key, kn)) continue;
     const int r = table_find(dp, t, key, kn, dst, dst_cap, val_out,
-                             vlen_out, ts_out);
+                             vlen_out, ts_out, needed_out);
     if (r != 0) return r;  // found (incl. tombstone) or punt
   }
   return 0;
+}
+
+// col_find staging in dp->valbuf with one grow-and-retry when the
+// value exceeds the current scratch (bounded by kDpHardMax; the
+// index/key pages probed by the first attempt stay warm).  Shared by
+// the digest, replica-get and coordinator-get planes so the retry
+// condition can never diverge between them.
+static int col_find_grown(DataPlane* dp, FastCollection* col,
+                          const uint8_t* key, uint32_t kn,
+                          const uint8_t** val_out, uint32_t* vlen_out,
+                          int64_t* ts_out) {
+  if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
+  uint32_t needed = 0;
+  int found = col_find(dp, col, key, kn, dp->valbuf.data(),
+                       (uint32_t)dp->valbuf.size(), val_out, vlen_out,
+                       ts_out, false, &needed);
+  if (found == -2 && needed <= kDpHardMax) {
+    dp->valbuf.resize(needed);
+    found = col_find(dp, col, key, kn, dp->valbuf.data(),
+                     (uint32_t)dp->valbuf.size(), val_out, vlen_out,
+                     ts_out, false, &needed);
+  }
+  return found;
 }
 
 // Python bytes.__repr__ mirror (Objects/bytesobject.c): b'...' with
@@ -2344,14 +2387,29 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       // one copy total.  Reserve 5 bytes for the length prefix + the
       // trailing type byte.
       if (out_cap < 5) return -1;
+      uint32_t needed = 0;
       found = col_find(dp, col, key_raw, key_n, out + 4, out_cap - 5,
                        &v, &vn, &ts,
-                       /*skip_memtables=*/true);
+                       /*skip_memtables=*/true, &needed);
+      if (found == -2 && needed <= kDpHardMax) {
+        // Value larger than the response buffer: report the required
+        // size so Python grows the buffer and retries this
+        // side-effect-free frame natively instead of punting to the
+        // interpreted path (a 10-20x cliff on big-value gets).
+        *out_len = (uint64_t)needed + 5;
+        return -2;
+      }
       if (found < 0) return -1;
     }
     if (found && vn != 0) {
       const uint32_t resp_len = vn + 1;  // value + type byte
-      if ((uint64_t)out_cap < (uint64_t)4 + resp_len) return -1;
+      if ((uint64_t)out_cap < (uint64_t)4 + resp_len) {
+        if ((uint64_t)4 + resp_len <= (uint64_t)kDpHardMax + 5) {
+          *out_len = (uint64_t)4 + resp_len;
+          return -2;  // memtable-resident big value: grow and retry
+        }
+        return -1;
+      }
       std::memcpy(out, &resp_len, 4);
       if (v != out + 4)  // memtable hit: value still in the memtable
         std::memcpy(out + 4, v, vn);
@@ -2619,10 +2677,8 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     const uint8_t* v = nullptr;
     uint32_t vn = 0;
     int64_t ets = 0;
-    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
     const int found =
-        col_find(dp, col, key_s, key_n, dp->valbuf.data(), kDpValMax,
-                 &v, &vn, &ets);
+        col_find_grown(dp, col, key_s, key_n, &v, &vn, &ets);
     if (found < 0) return -1;
     // ["response","get_digest",[ts,hash]|[]]
     uint8_t hdr[48];
@@ -2658,10 +2714,8 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     // Stage table values in valbuf: the msgpack bin header ahead of
     // the value is variable-width, so the final offset isn't known
     // until the length is.
-    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
     const int found =
-        col_find(dp, col, key_s, key_n, dp->valbuf.data(), kDpValMax,
-                 &v, &vn, &ets);
+        col_find_grown(dp, col, key_s, key_n, &v, &vn, &ets);
     if (found < 0) return -1;
     // ["response","get", [value, ts] | nil]
     uint8_t hdr[32];
@@ -2681,7 +2735,10 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
       uint8_t tsbuf[9];
       const size_t tslen = mp_put_int64(tsbuf, ets);
       total = o + vn + tslen;
-      if ((uint64_t)4 + total > out_cap) return -1;
+      if ((uint64_t)4 + total > out_cap) {
+        *out_len = (uint64_t)4 + total;
+        return -2;  // grow and retry (read path: no side effects)
+      }
       std::memcpy(out + 4, hdr, o);
       if (vn) std::memcpy(out + 4 + o, v, vn);
       std::memcpy(out + 4 + o + vn, tsbuf, tslen);
@@ -2853,17 +2910,19 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     const uint8_t* v = nullptr;
     uint32_t vn = 0;
     int64_t ets = 0;
-    if (dp->valbuf.size() < kDpValMax) dp->valbuf.resize(kDpValMax);
-    const int found = col_find(dp, col, f.key_raw, f.key_n,
-                               dp->valbuf.data(), kDpValMax, &v, &vn,
-                               &ets);
+    const int found =
+        col_find_grown(dp, col, f.key_raw, f.key_n, &v, &vn, &ets);
     if (found < 0) return -1;  // cold page: Python async read path
     // Worst-case fixed overhead: 1 (array) + 8 ("request") + 7
     // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9 (int64) = 40; the
     // trailer carries the value AND the raw key (17B fixed header).
     const uint64_t need =
         4ull + 40 + f.coll_n + (uint64_t)f.key_n * 2 + 17ull + vn;
-    if (need > out_cap) return -1;
+    if (need > out_cap) {
+      if (need > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
+      *out_len = need;
+      return -2;  // grow and retry (read path: no side effects)
+    }
     uint8_t* o = out + 4;
     size_t n = 0;
     o[n++] = 0x94;
@@ -2900,7 +2959,13 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   // delete kind ("delete", 7) + 5-byte str/bin headers peak at 35.
   const uint64_t need = 4ull + 40 + f.coll_n + f.key_n +
                         (is_set ? (uint64_t)f.val_n + 5 : 0);
-  if (need > out_cap) return -1;
+  if (need > out_cap) {
+    if (need <= (uint64_t)kDpHardMax + kDpGrowSlack) {
+      *out_len = need;
+      return -2;  // pre-apply: safe to grow the buffer and retry
+    }
+    return -1;
+  }
 
   struct timespec tsp;
   clock_gettime(CLOCK_REALTIME, &tsp);

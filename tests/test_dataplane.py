@@ -768,3 +768,92 @@ def test_coordinator_assist_emits_exact_peer_frames(tmp_dir, arun):
             await node.stop()
 
     arun(body())
+
+
+def test_big_values_served_natively_with_buffer_growth(
+    tmp_dir, arun
+):
+    """Values above the 256 KiB staging floor used to PUNT the get to
+    the interpreted path (VERDICT r4 #7: a 10-20x cliff the
+    reference's any-size compiled path doesn't have,
+    entry_writer.rs:72-74).  The native planes now return -2 with the
+    required size and the dataplane grows its response buffer and
+    retries the side-effect-free frame — big values written over the
+    u32-framed replica plane read back natively, memtable- AND
+    sstable-resident."""
+
+    async def body():
+        import struct as _struct
+
+        from dbeel_tpu.cluster.messages import (
+            ShardRequest,
+            pack_message,
+            unpack_message,
+        )
+
+        node = await _start_node(tmp_dir)
+        try:
+            port = node.config.port
+            await _request(
+                port, {"type": "create_collection", "name": "big"}
+            )
+            dp = node.shards[0].dataplane
+
+            # 1 MiB value in over the peer plane (u32 frames — the
+            # client request plane is u16-framed by the reference's
+            # wire protocol, so big values enter via replica /
+            # migration traffic or the library surface).
+            val = bytes(
+                (i * 131) & 0xFF for i in range(1 << 20)
+            )
+            key = b"jumbo"
+            # Peer-plane keys are the msgpack ENCODING of the client
+            # key (what a coordinator fans out).
+            key_wire = msgpack.packb(key, use_bin_type=True)
+            shard_port = node.config.remote_shard_port
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", shard_port
+            )
+            msg = pack_message(
+                ShardRequest.set(
+                    "big", key_wire, val, 1_700_000_000_000_000_000
+                )
+            )
+            w.write(_struct.pack("<I", len(msg)) + msg)
+            await w.drain()
+            (size,) = _struct.unpack(
+                "<I", await r.readexactly(4)
+            )
+            resp = unpack_message(await r.readexactly(size))
+            assert resp[:2] == ["response", "set"], resp
+            w.close()
+
+            async def get_big():
+                payload, t = await _request(
+                    port,
+                    {"type": "get", "collection": "big", "key": key},
+                )
+                assert t == 1, (t, payload[:64])  # RESPONSE_OK
+                assert payload == val
+
+            # Memtable-resident: the grow path triggers on the
+            # client plane's direct-into-response copy.
+            mem_gets0 = dp.stats()["fast_gets"]
+            await get_big()
+            assert dp.stats()["fast_gets"] == mem_gets0 + 1, (
+                "memtable big-value get was not served natively"
+            )
+
+            # Sstable-resident: flush, then the table staging path
+            # grows (old behavior: kDpValMax punt).
+            tree = node.shards[0].collections["big"].tree
+            await tree.flush()
+            tbl_gets0 = dp.stats()["fast_table_gets"]
+            await get_big()
+            assert (
+                dp.stats()["fast_table_gets"] == tbl_gets0 + 1
+            ), "sstable big-value get was not served natively"
+        finally:
+            await node.stop()
+
+    arun(body())
