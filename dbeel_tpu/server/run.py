@@ -259,6 +259,11 @@ async def run_shard_process(
     except (AttributeError, OSError):
         pass
     _eager_jax_init(config)
+    # Same stall profiler the single-process path gets (run_node):
+    # the config-5 quorum shape runs 6 shard processes + the bench,
+    # and tail attribution needs the watchdog in EVERY one.
+    if os.environ.get("DBEEL_LOOP_WATCHDOG") == "1":
+        _start_loop_watchdog()
     my_shard = create_shard_for_process(config, shard_id, total_shards)
     await run_shard(my_shard, is_node_managing=shard_id == 0)
 
@@ -330,8 +335,33 @@ def _start_loop_watchdog() -> None:
     def watch():
         last_reported = 0.0
         while True:
+            # Timed across the SLEEP only: the previous iteration's
+            # stack-sample/print cost must not masquerade as
+            # descheduling.
+            sleep_start = time.monotonic()
             time.sleep(0.005)
             now = time.monotonic()
+            # The watch thread's OWN oversleep distinguishes the two
+            # stall classes: if this 5ms sleep took >25ms, the whole
+            # PROCESS was descheduled (vCPU contention) — the
+            # heartbeat usually wins the wake-up race and resets the
+            # beat before we sample it, so without this line a
+            # contention-bound host reports nothing at all (observed:
+            # 556ms p999 with zero loop-stall samples on the 1-core
+            # config-5 shape).
+            wake_gap = now - sleep_start
+            if wake_gap > 0.025:
+                print(
+                    f"[loopwatch] process descheduled "
+                    f"{wake_gap*1e3:.0f}ms (vCPU contention)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                # The descheduling already explains a stale beat this
+                # iteration; sampling the loop stack now would
+                # double-count one contention event as a (spuriously
+                # innocent-looking) loop stall.
+                continue
             stall = now - state["beat"]
             if stall > 0.025 and now - last_reported > 0.05:
                 last_reported = now
