@@ -206,14 +206,46 @@ async def worker(wid, stop, acks: Acks, client):
         await asyncio.sleep(0)
 
 
-async def churn(nodes, stop, period, down_time, seeds, stats):
+async def churn(
+    nodes, stop, period, down_time, seeds, stats, scale_churn=False
+):
+    """Kill/restart a random base node each cycle; with
+    ``scale_churn``, every other cycle instead ADDS a brand-new node
+    (fresh dir — addition migration streams it its ranges under load)
+    and SIGKILLs it at the end of the cycle (removal migration +
+    failure detection), exercising the planner paths the membership
+    fuzz checks, at soak scale."""
     rng = random.Random(7)
+    cycle = 0
+    extra_i = N_NODES
     while not stop.is_set():
         try:
             await asyncio.wait_for(stop.wait(), period)
             return
         except asyncio.TimeoutError:
             pass
+        cycle += 1
+        if scale_churn and cycle % 2 == 0:
+            extra = Node(extra_i)
+            extra_i += 1
+            log(f"CHURN: scale-out {extra.name} joins")
+            extra.start(seeds)
+            if not await wait_port(extra.db_port):
+                log(f"CHURN: {extra.name} never came up!")
+                stats["restart_failures"] += 1
+                extra.kill()  # don't leak an orphan past the soak
+                continue
+            stats["scale_outs"] += 1
+            try:
+                await asyncio.wait_for(
+                    stop.wait(), max(down_time * 2, 25.0)
+                )
+            except asyncio.TimeoutError:
+                pass
+            log(f"CHURN: scale-in — SIGKILL {extra.name}")
+            extra.kill()
+            stats["kills"] += 1
+            continue
         victim = rng.choice(nodes)
         log(f"CHURN: SIGKILL {victim.name}")
         victim.kill()
@@ -271,6 +303,11 @@ async def final_checks(nodes, acks, report):
     report["acked_keys_checked"] = len(acks.last)
     report["acked_writes_lost"] = len(lost)
     report["loss_samples"] = lost[:20]
+    by_worker = {}
+    for k, _why in lost:
+        wid = k.split("k", 1)[0]
+        by_worker[wid] = by_worker.get(wid, 0) + 1
+    report["lost_by_worker"] = by_worker
     if lost:
         log("ACKED-WRITE LOSS:", lost[:10])
 
@@ -300,31 +337,91 @@ async def final_checks(nodes, acks, report):
         )
         return resp[2]
 
-    divergent = []
-    for key in sorted(acks.last):
-        key_b = msgpack.packb(key, use_bin_type=True)
-        h = hash_bytes(key_b)
+    async def divergence_scan():
         import bisect
 
-        start = bisect.bisect_left([r[0] for r in ring], h) % len(ring)
-        owners = []
-        seen = set()
-        for off in range(len(ring)):
-            _hh, name, sid = ring[(start + off) % len(ring)]
-            if name in seen:
-                continue
-            seen.add(name)
-            owners.append((name, sid))
-            if len(owners) == RF:
-                break
-        digests = []
-        for name, sid in owners:
+        out = []
+        for key in sorted(acks.last):
+            key_b = msgpack.packb(key, use_bin_type=True)
+            h = hash_bytes(key_b)
+            start = bisect.bisect_left(
+                [r[0] for r in ring], h
+            ) % len(ring)
+            owners = []
+            seen = set()
+            for off in range(len(ring)):
+                _hh, name, sid = ring[(start + off) % len(ring)]
+                if name in seen:
+                    continue
+                seen.add(name)
+                owners.append((name, sid))
+                if len(owners) == RF:
+                    break
+            digests = []
+            for name, sid in owners:
+                try:
+                    digests.append(
+                        await digest_of(name, sid, key_b)
+                    )
+                except Exception as e:
+                    digests.append(f"ERR {repr(e)[:60]}")
+            if any(d != digests[0] for d in digests[1:]):
+                out.append((key, owners, digests))
+        return out
+
+    # Post-churn convergence is ASYMPTOTIC (hint replay + bucketed
+    # anti-entropy catch a just-restarted replica up over a few
+    # cycles): poll until every key's replicas byte-agree and report
+    # the time it took, instead of a single snapshot that punishes a
+    # short quiet window.
+    t_conv0 = time.time()
+    deadline = t_conv0 + 150
+    while True:
+        divergent = await divergence_scan()
+        if not divergent or time.time() > deadline:
+            break
+        log(
+            f"{len(divergent)} keys still divergent; waiting on "
+            "anti-entropy ..."
+        )
+        await asyncio.sleep(5)
+    report["convergence_s"] = round(time.time() - t_conv0, 1)
+    if lost:
+        # Post-mortem: every node's view of the ring + where each
+        # lost key's value lives (per-shard digest with ts).
+        views = {}
+        for n in nodes:
             try:
-                digests.append(await digest_of(name, sid, key_b))
+                cl = await DbeelClient.from_seed_nodes(
+                    [("127.0.0.1", n.db_port)]
+                )
+                mdv = await cl.get_cluster_metadata()
+                views[n.name] = sorted(m.name for m in mdv.nodes)
+                cl.close()
             except Exception as e:
-                digests.append(f"ERR {repr(e)[:60]}")
-        if any(d != digests[0] for d in digests[1:]):
-            divergent.append((key, owners, digests))
+                views[n.name] = f"ERR {repr(e)[:60]}"
+        report["ring_views"] = views
+        log("ring views:", views)
+        probe = {}
+        for key, why in lost[:6]:
+            key_b = msgpack.packb(key, use_bin_type=True)
+            row = {}
+            for n in nodes:
+                for sid in range(SHARDS):
+                    addr = f"127.0.0.1:{n.remote_port + sid}"
+                    try:
+                        conn = RemoteShardConnection(addr)
+                        resp = await conn.send_request(
+                            ShardRequest.get_digest(
+                                COLLECTION, key_b
+                            )
+                        )
+                        row[f"{n.name}-{sid}"] = resp[2]
+                    except Exception as e:
+                        row[f"{n.name}-{sid}"] = repr(e)[:40]
+            probe[key] = {"why": why, "digests": row}
+            log("probe", key, probe[key])
+        report["loss_probe"] = probe
     report["keys_digest_checked"] = len(acks.last)
     report["divergent_keys"] = len(divergent)
     report["divergent_samples"] = [
@@ -346,6 +443,16 @@ async def main():
     ap.add_argument("--workers", type=int, default=6)
     ap.add_argument("--quiet-window", type=float, default=30.0)
     ap.add_argument("--report", default="chaos_soak_report.json")
+    ap.add_argument(
+        "--keep-on-fail", action="store_true",
+        help="leave the cluster running when invariants fail "
+        "(live autopsy); prints the ports",
+    )
+    ap.add_argument(
+        "--scale-churn", action="store_true",
+        help="every other churn cycle adds a brand-new node under "
+        "load (addition migration), then SIGKILLs it (removal)",
+    )
     args = ap.parse_args()
 
     nodes = [Node(i) for i in range(N_NODES)]
@@ -366,7 +473,7 @@ async def main():
 
     acks = Acks()
     stop = asyncio.Event()
-    stats = {"kills": 0, "restart_failures": 0}
+    stats = {"kills": 0, "restart_failures": 0, "scale_outs": 0}
     samples = []
     t0 = time.time()
     tasks = [
@@ -377,7 +484,7 @@ async def main():
         asyncio.create_task(
             churn(
                 nodes, stop, args.churn_period, args.down_time,
-                seeds, stats,
+                seeds, stats, args.scale_churn,
             )
         )
     )
@@ -401,6 +508,18 @@ async def main():
             await wait_port(n.db_port)
     log(f"quiet window {args.quiet_window:.0f}s (anti-entropy)...")
     await asyncio.sleep(args.quiet_window)
+    if args.scale_churn:
+        # The last scale-churn node may still be gossiped Dead /
+        # migrating out: wait until metadata is back to the base set.
+        cl = await DbeelClient.from_seed_nodes(
+            [("127.0.0.1", nodes[0].db_port)]
+        )
+        for _ in range(60):
+            md = await cl.get_cluster_metadata()
+            if len(md.nodes) == N_NODES:
+                break
+            await asyncio.sleep(1.0)
+        cl.close()
 
     report = {
         "duration_s": round(time.time() - t0, 1),
@@ -410,6 +529,7 @@ async def main():
         "acked_deletes": acks.deletes,
         "op_errors_during_churn": acks.errors,
         "kills": stats["kills"],
+        "scale_outs": stats["scale_outs"],
         "restart_failures": stats["restart_failures"],
     }
     ok = await final_checks(nodes, acks, report)
@@ -441,6 +561,11 @@ async def main():
         json.dump(report, f, indent=1)
         f.write("\n")
     log(json.dumps(report, indent=1))
+    if not ok and args.keep_on_fail:
+        log("KEEPING CLUSTER UP for autopsy:",
+            [(n.name, n.db_port, n.proc.pid if n.proc else None)
+             for n in nodes])
+        return 1
     for n in nodes:
         n.kill()
     return 0 if ok else 1

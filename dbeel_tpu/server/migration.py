@@ -21,6 +21,23 @@ log = logging.getLogger(__name__)
 
 MIGRATION_BATCH_ENTRIES = 128  # one share-scheduler unit
 
+# DBEEL_MIGRATION_DELETE=0 turns migration DELETE actions into no-ops
+# (data stays until overwritten; space-only cost).  Default on =
+# reference behavior (tombstone the evacuated range).  Escape hatch
+# because tombstoning carries a THEORETICAL hazard the scale-churn
+# soak was built to probe: the tombstones get CURRENT timestamps, so
+# if ownership of the range later reverts (the node that took it over
+# dies), a tombstone written after an acked value can shadow it under
+# LWW.  The soak's losses turned out to be a different cause (rejoin
+# partition — see MyShard.persist_peers) and repeated soak runs with
+# deletes ON show zero acked-write loss, but the hazard window is
+# real and this flag documents + disables it if ever observed.
+import os as _os  # noqa: E402
+
+_MIGRATION_DELETE = _os.environ.get(
+    "DBEEL_MIGRATION_DELETE", "1"
+) != "0"
+
 
 def _between(hash_: int, start: int, end: int) -> bool:
     """Half-open wrap-around range [start, end).
@@ -80,7 +97,8 @@ async def migrate_actions(
         )
         ra = ranges_and_actions[index]
         if ra.action == MigrationAction.DELETE:
-            await tree.delete(key)
+            if _MIGRATION_DELETE:
+                await tree.delete(key)
             return
         msg = ShardEvent.set(collection_name, key, value, ts)
         if streams[index] is not None:

@@ -27,6 +27,7 @@ from ..errors import DbeelError, ShardStopped
 from ..flow_events import FlowEvent
 from ..cluster import messages as msgs
 from ..cluster.local_comm import LocalShardConnection
+from ..cluster.messages import NodeMetadata
 from ..cluster.remote_comm import RemoteShardConnection
 from ..storage.entry import PAGE_SIZE
 from ..storage.page_cache import PageCache
@@ -79,29 +80,78 @@ async def discover_collections(my_shard: MyShard) -> None:
             log.error("seed %s collection discovery failed: %s", seed, e)
 
 
+def _persisted_peer_seeds(my_shard: MyShard) -> list:
+    """Extra discovery candidates from ``{dir}/peers.json`` (written
+    by MyShard.persist_peers on every membership change) — the
+    system.peers pattern: a node restarted after the cluster forgot
+    it (failure detection) can re-announce via its remembered peers
+    even when its configured seeds are dead or itself.  The reference
+    keeps the ring only in memory and such a node stays partitioned
+    alone forever (found by chaos_soak.py --scale-churn)."""
+    import json as _json
+
+    path = os.path.join(my_shard.config.dir, "peers.json")
+    try:
+        with open(path) as f:
+            peers = [NodeMetadata.from_wire(w) for w in _json.load(f)]
+    except Exception:
+        # Best-effort hint file: unreadable, unparsable OR wrong-shape
+        # contents (hand-edited, written by another version) must
+        # never block a node boot.
+        return []
+    return [
+        f"{p.ip}:{p.remote_shard_base_port}"
+        for p in peers
+        if p.name != my_shard.config.name
+    ]
+
+
 async def discover_nodes(my_shard: MyShard) -> None:
-    """run_shard.rs:80-108: seed get_metadata → nodes map + ring."""
-    if not my_shard.config.seed_nodes:
+    """run_shard.rs:80-108: seed get_metadata → nodes map + ring.
+
+    Deviation: the reference stops at the FIRST reachable seed; we
+    merge metadata from every configured seed AND every persisted
+    peer — a seed that answers with a partial view (e.g. the node's
+    own half of a partition) must not mask peers that know more."""
+    candidates = list(my_shard.config.seed_nodes)
+    for extra in _persisted_peer_seeds(my_shard):
+        if extra not in candidates:
+            candidates.append(extra)
+    if not candidates:
         return
-    for seed in my_shard.config.seed_nodes:
-        try:
-            conn = RemoteShardConnection.from_config(
-                seed, my_shard.config
-            )
-            nodes = await conn.get_metadata()
-            new_nodes = [
-                n
-                for n in nodes
-                if n.name != my_shard.config.name
-                and n.name not in my_shard.nodes
-            ]
-            for n in new_nodes:
-                my_shard.nodes[n.name] = n
-            my_shard.add_shards_of_nodes(new_nodes)
-            return
-        except DbeelError as e:
-            log.error("seed %s node discovery failed: %s", seed, e)
-    log.warning("no seed node reachable; starting standalone")
+
+    async def _query(seed):
+        conn = RemoteShardConnection.from_config(
+            seed, my_shard.config
+        )
+        return await conn.get_metadata()
+
+    # Probe candidates CONCURRENTLY: dead persisted peers are exactly
+    # the restart-into-churn scenario this path serves, and serial
+    # 5s connect timeouts would delay boot linearly with them.
+    results = await asyncio.gather(
+        *(_query(seed) for seed in candidates),
+        return_exceptions=True,
+    )
+    reached = 0
+    for seed, res in zip(candidates, results):
+        if isinstance(res, BaseException):
+            log.error("seed %s node discovery failed: %s", seed, res)
+            continue
+        reached += 1
+        new_nodes = [
+            n
+            for n in res
+            if n.name != my_shard.config.name
+            and n.name not in my_shard.nodes
+        ]
+        for n in new_nodes:
+            my_shard.nodes[n.name] = n
+        my_shard.add_shards_of_nodes(new_nodes)
+    if not reached:
+        log.warning("no seed node reachable; starting standalone")
+    elif my_shard.nodes:
+        my_shard.persist_peers()
 
 
 async def run_shard(

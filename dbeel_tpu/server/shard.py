@@ -11,6 +11,7 @@ handling and hash-range migration planning.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import re
@@ -324,6 +325,48 @@ class MyShard:
     # ------------------------------------------------------------------
     # Node metadata
     # ------------------------------------------------------------------
+
+    def persist_peers(self) -> None:
+        """Write the known OTHER nodes to ``{dir}/peers.json`` (wire
+        form, tmp+rename) — the system.peers pattern the reference
+        lacks: its ring lives only in memory, so a node restarted
+        after every OTHER node forgot it (failure detection removed
+        it) and whose configured seeds are dead or itself stays
+        PARTITIONED ALONE FOREVER — found by chaos_soak.py
+        --scale-churn, where the self-seeded restart of the seed node
+        split the cluster and 145 acked writes became unreadable
+        through it.  Discovery (run.py discover_nodes) merges these
+        persisted peers with the configured seeds, so a restart can
+        always re-announce to someone who remembers the rest.
+
+        Only the node-managing view (shard 0) writes; every
+        membership change (discovery, Alive-add, death) refreshes."""
+        if self.id != 0 or not self.config.dir:
+            return
+        # Snapshot on the loop, write OFF-loop (this fires inside the
+        # gossip Alive / dead-node handlers; a slow disk must not
+        # stall every shard's request handling — same discipline as
+        # the off-loop WAL disposal).
+        wire = [n.to_wire() for n in self.nodes.values()]
+        dir_path = self.config.dir
+
+        def _write():
+            try:
+                os.makedirs(dir_path, exist_ok=True)
+                path = os.path.join(dir_path, "peers.json")
+                # Unique tmp per write: two queued executor writes
+                # must not interleave in one tmp file.
+                tmp = f"{path}.tmp{os.getpid()}-{id(wire)}"
+                with open(tmp, "w") as f:
+                    json.dump(wire, f)
+                os.replace(tmp, path)
+            except OSError:
+                log.warning("peers.json write failed", exc_info=True)
+
+        try:
+            asyncio.get_running_loop().run_in_executor(None, _write)
+        except RuntimeError:
+            _write()  # no loop (direct construction in tests)
 
     def get_node_metadata(self) -> NodeMetadata:
         # All shards of THIS node — local queues in single-process mode,
@@ -1126,6 +1169,7 @@ class MyShard:
                 if newly_added:
                     self.nodes[node.name] = node
                     self.add_shards_of_nodes([node])
+                    self.persist_peers()
                 # State transition resets the opposite epidemic
                 # counters (sources are name#boot_id salted).
                 self._reset_gossip_counters(
@@ -1202,6 +1246,7 @@ class MyShard:
             len(self.nodes),
             len(self.shards),
         )
+        self.persist_peers()
         self.flow.notify(FlowEvent.DEAD_NODE_REMOVED)
         await self.migrate_data_on_node_removal(removed)
 
