@@ -94,11 +94,16 @@ class DataPlane:
         ) and os.environ.get("DBEEL_DP_NO_TABLES", "0") in ("", "0")
         # DBEEL_DP_NO_SHARD_PLANE=1 disables the native replica-plane
         # handler (A/B benching); "0"/"" keep it enabled.
-        self._has_shard_plane = hasattr(
-            lib, "dbeel_dp_handle_shard"
-        ) and os.environ.get("DBEEL_DP_NO_SHARD_PLANE", "0") in (
-            "",
-            "0",
+        # dbeel_dp_set_watermark is part of the shard-plane ABI: a
+        # stale .so without it would blind-apply replica writes below
+        # the flush watermark (the stale-shadow bug, PARITY.md
+        # deviation #9) — refuse the plane entirely instead.
+        self._has_shard_plane = (
+            hasattr(lib, "dbeel_dp_handle_shard")
+            and hasattr(lib, "dbeel_dp_set_watermark")
+            and os.environ.get(
+                "DBEEL_DP_NO_SHARD_PLANE", "0"
+            ) in ("", "0")
         )
         # DBEEL_DP_NO_COORD=1 disables the native coordinator assist
         # for RF>1 client writes (A/B benching).
@@ -177,6 +182,18 @@ class DataPlane:
             log.warning("dataplane registration failed for %s", name)
             self.unregister(name)
             return
+        if hasattr(self._lib, "dbeel_dp_set_watermark"):
+            # Shard-plane writes with ts <= the tree's flush
+            # watermark punt to the read-guarded Python apply (an
+            # old-ts entry above a flushed newer one would be served
+            # by first-match point reads).  Refreshed here because
+            # registration re-runs on every flush swap.
+            self._lib.dbeel_dp_set_watermark(
+                self._handle,
+                nm,
+                len(nm),
+                int(getattr(tree, "max_flushed_ts", 0)),
+            )
         self._trees[name] = tree
         if name not in self._slots:
             self._slots.append(name)

@@ -425,7 +425,12 @@ async def _read_repair(
     from ..flow_events import FlowEvent
 
     try:
-        await col.tree.set_with_timestamp(key, value, ts)
+        # Read-guarded local apply: win_ts came from layer-ordered
+        # quorum reads and can be OLDER than a flushed version — a
+        # blind insert would recreate the stale-shadow state
+        # (PARITY.md deviation #9).  apply_if_newer is also the
+        # correct read-repair semantic.
+        await my_shard.apply_if_newer(col.tree, key, value, ts)
         if number_of_nodes > 0:
             await my_shard.send_request_to_replicas(
                 ShardRequest.set(collection_name, key, value, ts),

@@ -35,7 +35,7 @@ from ..errors import (
 from ..flow_events import FlowEvent
 from ..storage import DEFAULT_TREE_CAPACITY
 from ..storage.compaction import get_strategy
-from ..storage.lsm_tree import LSMTree
+from ..storage.lsm_tree import LSMTree, TOMBSTONE
 from ..storage.page_cache import PageCache, PartitionPageCache
 from ..utils.event import LocalEvent
 from ..utils.murmur import hash_bytes, hash_string
@@ -863,7 +863,19 @@ class MyShard:
         self, collection: str, key: bytes, value: bytes, ts: int
     ) -> None:
         col = self.get_collection(collection)
-        await col.tree.set_with_timestamp(key, value, ts)
+        if ts <= col.tree.max_flushed_ts or not (
+            await col.tree.set_with_timestamp(
+                key, value, ts, stale_abort=True
+            )
+        ):
+            # A delayed/replayed write (hint replay, late replica
+            # frame, migration stream) no newer than the flushed
+            # layers: blind memtable insert would put the OLDER ts in
+            # a NEWER layer and first-match point reads would serve
+            # it — apply read-guarded instead.  stale_abort closes
+            # the race where a capacity wait spans a flush swap that
+            # advances the watermark past ts.
+            await self.apply_if_newer(col.tree, key, value, ts)
         self.flow.notify(FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
 
     async def handle_shard_request(self, request: list) -> list:
@@ -893,9 +905,16 @@ class MyShard:
         if kind == ShardRequest.DELETE:
             col = self.collections.get(request[2])
             if col is not None:
-                await col.tree.delete_with_timestamp(
-                    bytes(request[3]), request[4]
-                )
+                ts = request[4]
+                if ts <= col.tree.max_flushed_ts or not (
+                    await col.tree.set_with_timestamp(
+                        bytes(request[3]), TOMBSTONE, ts,
+                        stale_abort=True,
+                    )
+                ):
+                    await self.apply_if_newer(
+                        col.tree, bytes(request[3]), TOMBSTONE, ts
+                    )
             return ShardResponse.empty(ShardResponse.DELETE)
         if kind == ShardRequest.GET:
             col = self.collections.get(request[2])

@@ -156,6 +156,15 @@ class LSMTree:
         # WAL appends into the active memtable since its last swap —
         # the update-heavy flush trigger (see set_with_timestamp).
         self._appends_since_swap = 0
+        # Newest timestamp that may exist in a FLUSHED layer
+        # (conservative: stamped with wall clock at each swap and at
+        # recovery).  Explicit-timestamp replica/hint/AE writes at or
+        # below it must take the read-guarded apply path: point reads
+        # resolve by LAYER order (first match), so inserting an
+        # OLDER-ts version into a fresh memtable above a flushed
+        # newer one would serve the stale value until compaction —
+        # the stuck-divergence class the scale-churn soak caught.
+        self.max_flushed_ts = 0
         self._flushing: Optional[Memtable] = None
         self._sstables = SSTableList([])
         self._wal: Optional[wal_mod.Wal] = None
@@ -300,6 +309,15 @@ class LSMTree:
             sync=self.wal_sync,
             sync_delay_us=self.wal_sync_delay_us,
         )
+        if data_indices or wal_indices:
+            # Anything recovered from disk may hold entries up to
+            # "now" (or beyond, under clock skew — cover the replayed
+            # WAL's real newest ts); later old-ts writes must go
+            # read-guarded.
+            self.max_flushed_ts = max(
+                now_nanos(),
+                int(getattr(self._active, "max_ts", 0) or 0),
+            )
         self._notify_write_state()
 
     def _notify_write_state(self) -> None:
@@ -402,10 +420,24 @@ class LSMTree:
         await self.set_with_timestamp(key, value, now_nanos())
 
     async def set_with_timestamp(
-        self, key: bytes, value: bytes, timestamp: int
-    ) -> None:
+        self, key: bytes, value: bytes, timestamp: int,
+        stale_abort: bool = False,
+    ) -> bool:
+        """Insert (key, value, timestamp).  With ``stale_abort``,
+        return False WITHOUT inserting if, at the moment of the
+        actual memtable insert, ``timestamp`` is no newer than the
+        flush watermark — closing the race where a capacity wait
+        spans a flush swap and the pre-checked guard in the shard
+        layer goes stale (the caller then applies read-guarded).
+        The check sits synchronously before the insert (no awaits
+        between), so it cannot itself race a swap."""
         while True:
             try:
+                if (
+                    stale_abort
+                    and timestamp <= self.max_flushed_ts
+                ):
+                    return False
                 self._active.set(key, value, timestamp)
                 break
             except MemtableCapacityReached:
@@ -435,6 +467,7 @@ class LSMTree:
             or self._appends_since_swap >= self.capacity
         ):
             self._spawn_flush()
+        return True
 
     async def delete(self, key: bytes) -> None:
         await self.set_with_timestamp(key, TOMBSTONE, now_nanos())
@@ -479,6 +512,13 @@ class LSMTree:
                 self._flushing = self._active
                 self._active = self._memtable_cls(self.capacity)
                 self._appends_since_swap = 0
+                # Conservative: wall clock, AND the swapped-out
+                # memtable's real newest ts (remote-coordinator
+                # timestamps can exceed local now under clock skew).
+                self.max_flushed_ts = max(
+                    now_nanos(),
+                    int(getattr(self._flushing, "max_ts", 0) or 0),
+                )
                 self._wal = new_wal
                 self._index = next_index
                 self._notify_write_state()

@@ -38,6 +38,7 @@ class MemtableBase:
         self.capacity = capacity
         self._map = self._new_map()
         self.data_bytes = 0  # approximate on-disk size of contents
+        self.max_ts = 0  # newest timestamp ever inserted
 
     def _new_map(self):
         raise NotImplementedError
@@ -51,6 +52,8 @@ class MemtableBase:
     def set(self, key: bytes, value: bytes, timestamp: int) -> None:
         """Insert/overwrite; errors at capacity for *new* keys, mirroring
         the arena's capacity error (rbtree_arena/src/lib.rs:7-10)."""
+        if timestamp > self.max_ts:
+            self.max_ts = timestamp
         prev = self._map.get(key)
         if prev is None:
             if len(self._map) >= self.capacity:
@@ -135,6 +138,18 @@ class ArenaMemtable(MemtableBase):
 
     def is_full(self) -> bool:
         return len(self) >= self.capacity
+
+    @property
+    def max_ts(self) -> int:
+        # The C side tracks it (the native data plane writes bypass
+        # this wrapper entirely).
+        if hasattr(self._lib, "dbeel_memtable_max_ts"):
+            return int(self._lib.dbeel_memtable_max_ts(self._handle))
+        return 0
+
+    @max_ts.setter
+    def max_ts(self, _v) -> None:
+        pass  # base __init__ assigns 0; the C counter is the truth
 
     def set(self, key: bytes, value: bytes, timestamp: int) -> None:
         ct = self._ctypes

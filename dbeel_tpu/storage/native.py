@@ -309,6 +309,19 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dbeel_wal_seq.argtypes = [ctypes.c_void_p]
         lib.dbeel_wal_synced.restype = ctypes.c_uint64
         lib.dbeel_wal_synced.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_memtable_max_ts"):
+        lib.dbeel_memtable_max_ts.restype = ctypes.c_int64
+        lib.dbeel_memtable_max_ts.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_dp_set_watermark"):
+        # Flush-watermark guard: shard-plane writes at or below it
+        # punt to Python's read-guarded apply (dataplane.py).
+        lib.dbeel_dp_set_watermark.restype = None
+        lib.dbeel_dp_set_watermark.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_int64,
+        ]
     if hasattr(lib, "dbeel_walsync_hub_new"):
         # Loop-driven io_uring group commit: fsyncs are SQEs on a
         # loop-owned ring, zero sync threads (wal.py _SyncHub).

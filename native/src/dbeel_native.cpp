@@ -643,6 +643,7 @@ struct ArenaMemtable {
   uint32_t root = NIL;
   uint32_t capacity;
   uint64_t live_bytes = 0;  // key+value bytes still referenced
+  int64_t max_ts = 0;       // newest timestamp ever applied
 
   explicit ArenaMemtable(uint32_t cap) : capacity(cap) {
     nodes.reserve(cap);
@@ -788,6 +789,10 @@ void dbeel_memtable_free(void* h) {
   delete static_cast<ArenaMemtable*>(h);
 }
 
+int64_t dbeel_memtable_max_ts(void* h) {
+  return static_cast<ArenaMemtable*>(h)->max_ts;
+}
+
 uint32_t dbeel_memtable_len(void* h) {
   return (uint32_t)static_cast<ArenaMemtable*>(h)->nodes.size();
 }
@@ -802,6 +807,10 @@ uint64_t dbeel_memtable_bytes(void* h) {
 int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
                            const uint8_t* value, uint32_t vlen,
                            int64_t ts, uint32_t* old_val_len) try {
+  // Track the newest applied ts for the flush watermark (clock-skew
+  // coverage: remote-coordinator timestamps can exceed local now).
+  auto* t_mts = static_cast<ArenaMemtable*>(h);
+  if (ts > t_mts->max_ts) t_mts->max_ts = ts;
   auto* t = static_cast<ArenaMemtable*>(h);
   uint32_t parent = NIL;
   uint32_t cur = t->root;
@@ -1519,6 +1528,14 @@ struct FastCollection {
   // (dbeel_dp_handle_shard — explicit-timestamp peer traffic) touches
   // them natively.
   bool client_ok = true;
+  // Explicit-timestamp replica writes at or below this watermark
+  // PUNT to Python's read-guarded apply (apply_if_newer): a delayed
+  // or replayed write whose ts is not newer than the flushed layers
+  // would otherwise land the OLDER version in a NEWER layer, and
+  // first-match-by-layer point reads would serve the stale value
+  // until compaction.  Updated by dbeel_dp_set_watermark on every
+  // flush swap (the re-registration path).
+  int64_t ts_watermark = 0;
   // WAL appends into the CURRENT active memtable (reset when
   // dp_register swaps the handle).  Update-heavy workloads rewriting
   // fewer than ``capacity`` hot keys never trip the distinct-key full
@@ -2106,6 +2123,15 @@ int32_t dbeel_dp_register(void* h, const uint8_t* name, uint32_t nlen,
   return (int32_t)dp->cols.size() - 1;
 } catch (...) {
   return -1;
+}
+
+void dbeel_dp_set_watermark(void* h, const uint8_t* name,
+                            uint32_t nlen, int64_t ts) {
+  auto* dp = static_cast<DataPlane*>(h);
+  const auto it = dp->col_map.find(
+      std::string((const char*)name, nlen));
+  if (it != dp->col_map.end())
+    dp->cols[it->second].ts_watermark = ts;
 }
 
 void dbeel_dp_unregister(void* h, const uint8_t* name, uint32_t nlen) {
@@ -2762,6 +2788,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   // the frame through Python and apply it twice).
   if (is_req && out_cap < 64) return -1;
   uint32_t old_len = 0;
+  if (ts <= col->ts_watermark) return -1;  // read-guarded path
   const int32_t rc = dbeel_memtable_set(
       col->active, key_s, key_n, k_set ? val_s : nullptr,
       k_set ? val_n : 0, ts, &old_len);

@@ -857,3 +857,90 @@ def test_big_values_served_natively_with_buffer_growth(
             await node.stop()
 
     arun(body())
+
+
+def test_stale_replica_write_cannot_shadow_flushed_newer_value(
+    tmp_dir, arun
+):
+    """A delayed/replayed replica write (hint replay, late frame)
+    whose ts is OLDER than a flushed version of the key must not
+    land in the fresh memtable: point reads resolve by LAYER order
+    (first match), so the older version would be served until
+    compaction — the stuck-divergence class the scale-churn soak
+    caught (get_digest stale while RANGE_PULL saw the newer entry).
+    The flush watermark routes such writes through the read-guarded
+    apply on BOTH planes (C punts; Python apply_if_newer)."""
+
+    async def body():
+        import struct as _struct
+
+        from dbeel_tpu.cluster.messages import (
+            ShardRequest,
+            pack_message,
+            unpack_message,
+        )
+
+        node = await _start_node(tmp_dir)
+        try:
+            port = node.config.port
+            await _request(
+                port, {"type": "create_collection", "name": "wm"}
+            )
+            key_b = msgpack.packb("stale", use_bin_type=True)
+            shard_port = node.config.remote_shard_port
+            r, w = await asyncio.open_connection(
+                "127.0.0.1", shard_port
+            )
+
+            async def shard_set(val, ts):
+                m = pack_message(
+                    ShardRequest.set("wm", key_b, val, ts)
+                )
+                w.write(_struct.pack("<I", len(m)) + m)
+                await w.drain()
+                (size,) = _struct.unpack(
+                    "<I", await r.readexactly(4)
+                )
+                resp = unpack_message(await r.readexactly(size))
+                assert resp[:2] == ["response", "set"], resp
+
+            async def shard_digest():
+                m = pack_message(
+                    ShardRequest.get_digest("wm", key_b)
+                )
+                w.write(_struct.pack("<I", len(m)) + m)
+                await w.drain()
+                (size,) = _struct.unpack(
+                    "<I", await r.readexactly(4)
+                )
+                resp = unpack_message(await r.readexactly(size))
+                assert resp[:2] == ["response", "get_digest"], resp
+                return resp[2]
+
+            # PAST timestamps (the real delayed-write shape): the
+            # watermark is wall-clock-conservative, so only writes
+            # older than the last flush swap take the guarded path.
+            t_new = 1_700_000_000_000_000_000
+            await shard_set(b"NEW", t_new)
+            tree = node.shards[0].collections["wm"].tree
+            await tree.flush()
+            assert tree.max_flushed_ts > 0
+
+            # The late frame: strictly older ts, arrives after the
+            # flush.  Must NOT become the served version.
+            await shard_set(b"OLD", t_new - 1_000_000)
+
+            ts, _vh = await shard_digest()
+            assert ts == t_new, (
+                f"stale write shadowed the flushed value: {ts}"
+            )
+            payload, t = await _request(
+                port, {"type": "get", "collection": "wm",
+                       "key": "stale"},
+            )
+            assert t == 1 and payload == b"NEW", (t, payload)
+            w.close()
+        finally:
+            await node.stop()
+
+    arun(body())

@@ -377,27 +377,20 @@ def test_update_heavy_workload_bounds_wal(tmp_dir):
         indices = [i for i, _ in tree.sstable_indices_and_sizes()]
         assert indices, "update-heavy workload never flushed"
         assert tree._index >= 2
+        # Measure AFTER close: the retired WAL's unlink runs off-loop
+        # and close() joins it, making the on-disk state
+        # deterministic.
+        tree.close()
         # On-disk WAL bytes stay bounded by ~capacity pages, not by
         # the total update count.
-        def _size(p):
-            try:
-                return os.path.getsize(p)
-            except FileNotFoundError:
-                return 0  # raced the off-loop WAL disposal unlink
-
         tree_dir = os.path.join(tmp_dir, "tree")
-        wal_files = [
-            f
+        wal_bytes = sum(
+            os.path.getsize(os.path.join(tree_dir, f))
             for f in os.listdir(tree_dir)
             if f.endswith(".memtable")  # MEMTABLE_FILE_EXT
-        ]
-        assert wal_files, "expected live WAL files"
-        wal_bytes = sum(
-            _size(os.path.join(tree_dir, f)) for f in wal_files
         )
         assert wal_bytes <= (CAP + 2) * 2 * PAGE_SIZE, wal_bytes
         # Latest values survive a reopen (WAL replay + sstables).
-        tree.close()
         tree2 = make_tree(tmp_dir)
         for k in range(8):
             expect = max(
