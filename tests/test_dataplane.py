@@ -849,9 +849,15 @@ def test_big_values_served_natively_with_buffer_growth(
             tree = node.shards[0].collections["big"].tree
             await tree.flush()
             tbl_gets0 = dp.stats()["fast_table_gets"]
-            await get_big()
+            # A COLD page punts to the io_uring path by design (and
+            # warms the OS cache); retry so slow-host IO pressure
+            # can't flake the native-served assertion.
+            for _ in range(4):
+                await get_big()
+                if dp.stats()["fast_table_gets"] > tbl_gets0:
+                    break
             assert (
-                dp.stats()["fast_table_gets"] == tbl_gets0 + 1
+                dp.stats()["fast_table_gets"] > tbl_gets0
             ), "sstable big-value get was not served natively"
         finally:
             await node.stop()
