@@ -862,7 +862,7 @@ def test_big_values_served_natively_with_buffer_growth(
         finally:
             await node.stop()
 
-    arun(body())
+    arun(body(), timeout=60)
 
 
 def test_stale_replica_write_cannot_shadow_flushed_newer_value(
@@ -949,4 +949,4 @@ def test_stale_replica_write_cannot_shadow_flushed_newer_value(
         finally:
             await node.stop()
 
-    arun(body())
+    arun(body(), timeout=60)
