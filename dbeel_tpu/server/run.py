@@ -324,6 +324,25 @@ def _process_entry(config: Config, shard_id: int, total: int) -> None:
         format=f"%(asctime)s %(levelname).1s shard{shard_id} "
         "%(name)s: %(message)s",
     )
+    # Die with the parent: a SIGKILLed/terminated node process must
+    # not leave shard children squatting its ports forever (observed:
+    # a benched node's children outlived it by hours, holding the db
+    # ports and breaking every later bind on the block).  PDEATHSIG
+    # is the Linux backstop for the parent's own signal forwarding.
+    try:
+        import ctypes as _ct
+        import signal as _sig
+
+        _ct.CDLL(None).prctl(1, _sig.SIGTERM)  # PR_SET_PDEATHSIG
+        # PDEATHSIG only fires for deaths AFTER the call: if the
+        # parent died during this child's spawn bootstrap we are
+        # already reparented (to init/subreaper) — exit now.
+        if os.getppid() == 1:
+            sys.exit(0)
+    except SystemExit:
+        raise
+    except Exception:
+        pass
     try:
         asyncio.run(run_shard_process(config, shard_id, total))
     except KeyboardInterrupt:
@@ -347,6 +366,23 @@ def run_node_processes(config: Config, num_shards: int) -> None:
     ]
     for p in procs:
         p.start()
+    # Forward SIGTERM to the children: `terminate()` on THIS process
+    # (benches, service managers) must tear the whole node down, not
+    # orphan the shard processes on their ports.
+    import signal as _signal
+
+    term_requested = False
+
+    def _forward(_sig, _frm):
+        nonlocal term_requested
+        term_requested = True
+        for p in procs:
+            p.terminate()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _forward)
+    except ValueError:
+        pass  # non-main thread: PDEATHSIG still covers the children
     try:
         for p in procs:
             p.join()
@@ -355,6 +391,10 @@ def run_node_processes(config: Config, num_shards: int) -> None:
             p.terminate()
         for p in procs:
             p.join()
+    if term_requested:
+        # Operator-initiated shutdown: children exiting with
+        # -SIGTERM is the CLEAN outcome, not a failure.
+        return
     failed = [p.name for p in procs if p.exitcode not in (0, None)]
     if failed:
         log.error("shard processes failed: %s", failed)

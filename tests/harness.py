@@ -76,7 +76,43 @@ class ClusterNode:
             for i, s in enumerate(self.shards)
         ]
         if wait_started:
-            await asyncio.gather(*started)
+            # Race the started-events against the shard tasks: a
+            # shard that dies during startup (bind failure, startup
+            # bug) would otherwise leave the events unresolved and
+            # this await hanging until the test timeout, SWALLOWING
+            # the real exception.
+            started_all = asyncio.ensure_future(
+                asyncio.gather(*started)
+            )
+            await asyncio.wait(
+                [started_all, *self.tasks],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            dead = [t for t in self.tasks if t.done()]
+            if dead and not started_all.done():
+                # ANY finished shard task (exception, cancellation,
+                # clean return) before START_TASKS means startup
+                # failed — surface it instead of hanging, and tear
+                # the sibling shards down so they don't leak into
+                # later tests.
+                started_all.cancel()
+                cause = next(
+                    (
+                        t.exception()
+                        for t in dead
+                        if not t.cancelled() and t.exception()
+                    ),
+                    None,
+                )
+                for t in self.tasks:
+                    t.cancel()
+                await asyncio.gather(
+                    *self.tasks, return_exceptions=True
+                )
+                raise RuntimeError(
+                    "shard task died during startup"
+                ) from cause
+            await started_all
             await asyncio.sleep(0)  # let listeners settle
         return self
 
