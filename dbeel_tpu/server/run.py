@@ -60,24 +60,57 @@ def create_shard(
     return MyShard(config, shard_id, shards, cache, local)
 
 
+def _discovery_candidates(my_shard: MyShard) -> list:
+    """Configured seeds + persisted peers, deduped, order-preserving —
+    the ONE candidate policy both discovery passes share."""
+    candidates = list(my_shard.config.seed_nodes)
+    for extra in _persisted_peer_seeds(my_shard):
+        if extra not in candidates:
+            candidates.append(extra)
+    return candidates
+
+
 async def discover_collections(my_shard: MyShard) -> None:
-    """run_shard.rs:42-63: disk scan + seed query."""
+    """run_shard.rs:42-63: disk scan + seed query.
+
+    Persisted peers serve as extra candidates and results MERGE
+    across every reachable candidate, probed concurrently (same
+    rationale and shape as discover_nodes): a collection created
+    while this node was DOWN exists nowhere on its disk and its
+    create gossip is long gone — and one reachable-but-stale seed
+    must not mask a remembered peer that knows it, nor dead peers
+    serialize the boot."""
     for name, rf in my_shard.get_collections_from_disk():
         try:
             await my_shard.create_collection(name, rf)
         except DbeelError:
             pass
-    for seed in my_shard.config.seed_nodes:
-        try:
-            conn = RemoteShardConnection.from_config(
-                seed, my_shard.config
+    candidates = _discovery_candidates(my_shard)
+    if not candidates:
+        return
+
+    async def _query(seed):
+        conn = RemoteShardConnection.from_config(
+            seed, my_shard.config
+        )
+        return await conn.get_collections()
+
+    results = await asyncio.gather(
+        *(_query(seed) for seed in candidates),
+        return_exceptions=True,
+    )
+    for seed, res in zip(candidates, results):
+        if isinstance(res, BaseException):
+            log.error(
+                "seed %s collection discovery failed: %s", seed, res
             )
-            for name, rf in await conn.get_collections():
-                if name not in my_shard.collections:
+            continue
+        for name, rf in res:
+            if name not in my_shard.collections:
+                try:
                     await my_shard.create_collection(name, rf)
-            return
-        except DbeelError as e:
-            log.error("seed %s collection discovery failed: %s", seed, e)
+                except DbeelError:
+                    pass
 
 
 def _persisted_peer_seeds(my_shard: MyShard) -> list:
@@ -113,10 +146,7 @@ async def discover_nodes(my_shard: MyShard) -> None:
     merge metadata from every configured seed AND every persisted
     peer — a seed that answers with a partial view (e.g. the node's
     own half of a partition) must not mask peers that know more."""
-    candidates = list(my_shard.config.seed_nodes)
-    for extra in _persisted_peer_seeds(my_shard):
-        if extra not in candidates:
-            candidates.append(extra)
+    candidates = _discovery_candidates(my_shard)
     if not candidates:
         return
 

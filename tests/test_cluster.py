@@ -712,6 +712,21 @@ def test_restart_rejoins_via_persisted_peers(tmp_dir):
         await nodes[0].crash()
         await asyncio.wait_for(asyncio.gather(*removed), 15)
 
+        # A collection created while node 0 is DOWN: its create
+        # gossip never reaches node 0 and node 0's disk has no trace
+        # — only asking a remembered peer at rejoin can surface it.
+        client2 = await DbeelClient.from_seed_nodes(
+            [nodes[1].db_address]
+        )
+        late_visible = [
+            n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+            for n in nodes[1:]
+        ]
+        await client2.create_collection("late")
+        # Both LIVE nodes must know it before node 0 restarts, or
+        # discovery could ask the one the gossip hasn't reached yet.
+        await asyncio.wait_for(asyncio.gather(*late_visible), 10)
+
         # Restart node 0 with its original config: NO seed nodes.
         # Without peers.json it would stand alone forever; with it,
         # discovery contacts the remembered peers and re-announces.
@@ -735,6 +750,11 @@ def test_restart_rejoins_via_persisted_peers(tmp_dir):
                 n.config.name,
                 list(n.shards[0].nodes),
             )
+        # ...including the collection born during its downtime
+        # (discover_collections consults persisted peers too).
+        assert "late" in nodes[0].shards[0].collections, list(
+            nodes[0].shards[0].collections
+        )
         for n in nodes:
             await n.stop()
 
