@@ -11,6 +11,7 @@ ring and retry on ``KeyNotOwnedByShard``, and offer per-op consistency
 from __future__ import annotations
 
 import asyncio
+import random
 import struct
 from bisect import bisect_left
 from dataclasses import dataclass
@@ -23,7 +24,10 @@ from ..errors import (
     DbeelError,
     KeyNotOwnedByShard,
     ProtocolError,
+    Timeout,
+    classify_error,
     from_wire,
+    is_retryable_class,
 )
 from ..cluster.messages import ClusterMetadata
 from ..utils.murmur import hash_bytes, hash_string
@@ -64,14 +68,25 @@ class _RingShard:
 class DbeelClient:
     """``pooled=True`` (default) reuses connections via the keepalive
     protocol extension; pass False for strict reference behavior
-    (connect per request)."""
+    (connect per request).
+
+    Failure-aware routing: every keyed op carries a per-op deadline
+    budget (``op_deadline_s``).  Connection-class failures walk to the
+    next ring replica; an exhausted walk resyncs the ring (churn moves
+    ownership) and retries after capped exponential backoff with
+    jitter, until the budget runs out.  Benign application outcomes
+    (KeyNotFound et al.) are final immediately."""
 
     MAX_POOL_PER_TARGET = 8
+    OP_DEADLINE_S = 10.0
+    BACKOFF_BASE_S = 0.02
+    BACKOFF_CAP_S = 0.5
 
     def __init__(
         self,
         seed_addresses: Sequence[Tuple[str, int]],
         pooled: bool = True,
+        op_deadline_s: Optional[float] = None,
     ):
         self._seeds = list(seed_addresses)
         self._ring: List[_RingShard] = []
@@ -79,33 +94,59 @@ class DbeelClient:
         self._collections: dict = {}
         self._pooled = pooled
         self._pool: dict = {}  # (host, port) -> [(reader, writer)]
+        self._op_deadline_s = (
+            self.OP_DEADLINE_S if op_deadline_s is None else op_deadline_s
+        )
+        self._rng = random.Random()
 
     # -- bootstrap / metadata sync (lib.rs:85-152) ---------------------
 
     @classmethod
     async def from_seed_nodes(
-        cls, addresses: Sequence[Tuple[str, int]]
+        cls, addresses: Sequence[Tuple[str, int]], **kwargs
     ) -> "DbeelClient":
-        client = cls(addresses)
+        client = cls(addresses, **kwargs)
         await client.sync_metadata()
         return client
 
     async def sync_metadata(self) -> None:
+        # Failover: metadata can come from ANY live ring member, not
+        # just the configured seeds — a client whose only seed is the
+        # dead node would otherwise keep a stale ring forever and
+        # bounce on KeyNotOwnedByShard through the whole churn window.
+        candidates: List[Tuple[str, int]] = list(self._seeds)
+        seen = set(candidates)
+        for s in self._ring:
+            addr = (s.ip, s.db_port)
+            if addr not in seen:
+                seen.add(addr)
+                candidates.append(addr)
         last_error: Optional[Exception] = None
-        for host, port in self._seeds:
+        for host, port in candidates:
             try:
-                raw = await self._send_to(
-                    host, port, {"type": "get_cluster_metadata"}
+                # Per-candidate bound: _send_to's bare open_connection
+                # would otherwise ride the OS connect timeout
+                # (~2 min) on a SYN-black-holed member.
+                raw = await asyncio.wait_for(
+                    self._send_to(
+                        host, port, {"type": "get_cluster_metadata"}
+                    ),
+                    5.0,
                 )
                 metadata = ClusterMetadata.from_wire(
                     msgpack.unpackb(raw, raw=False)
                 )
                 self._apply_metadata(metadata)
                 return
-            except (DbeelError, OSError) as e:
+            except (
+                DbeelError,
+                OSError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+            ) as e:
                 last_error = e
         raise ConnectionError_(
-            f"no seed reachable: {last_error!r}"
+            f"no seed or ring member reachable: {last_error!r}"
         )
 
     def _apply_metadata(self, metadata: ClusterMetadata) -> None:
@@ -215,6 +256,18 @@ class DbeelClient:
                 break
         return out
 
+    @classmethod
+    def _backoff_s(
+        cls, attempt: int, rng: random.Random
+    ) -> float:
+        """Capped exponential backoff with jitter: uniform in
+        [d/2, d] for d = min(cap, base * 2^attempt) — bounded above
+        by BACKOFF_CAP_S, never zero (no synchronized retry storms
+        from many clients hitting one churn event)."""
+        shift = min(attempt, 20)  # 1<<unbounded overflows float mult
+        d = min(cls.BACKOFF_CAP_S, cls.BACKOFF_BASE_S * (1 << shift))
+        return d * (0.5 + 0.5 * rng.random())
+
     async def _sharded_request(
         self, key: Any, request: dict, rf: int
     ) -> bytes:
@@ -223,31 +276,104 @@ class DbeelClient:
         request = dict(request)
         request["hash"] = key_hash
 
-        for attempt in (0, 1):
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self._op_deadline_s
+        attempt = 0
+        last_error: Optional[Exception] = None
+        while True:
             replicas = self._shards_for_key(key_hash, max(1, rf))
-            last_error: Optional[Exception] = None
+            not_owned = False
+            # Sticky per-round transport flag (C walk parity,
+            # dbeel_client.cpp): once any replica was unreachable the
+            # key's state is UNKNOWN — a later replica's KeyNotFound
+            # must not downgrade the op to a final "not found".
+            transport_error: Optional[Exception] = None
             for replica_index, shard in enumerate(replicas):
+                budget = deadline - loop.time()
+                if budget <= 0:
+                    break
                 request["replica_index"] = replica_index
+                # Bound the coordinator's own quorum wait to what is
+                # left of OUR budget, so a stalled quorum still
+                # leaves room to walk to the next coordinator.
+                request["timeout"] = max(
+                    100, min(5000, int(budget * 1000))
+                )
                 try:
-                    return await self._send_to(
-                        shard.ip, shard.db_port, request
+                    return await asyncio.wait_for(
+                        self._send_to(
+                            shard.ip, shard.db_port, request
+                        ),
+                        budget,
                     )
                 except KeyNotOwnedByShard as e:
                     # Stale ring: resync and retry (lib.rs:392-409).
                     last_error = e
+                    not_owned = True
                     break
-                except (DbeelError, OSError) as e:
+                except asyncio.TimeoutError:
+                    # Our own budget expired mid-request: transport-
+                    # class (state UNKNOWN) — it must never be
+                    # downgraded by another replica's KeyNotFound.
+                    if transport_error is None:
+                        transport_error = Timeout(
+                            f"op deadline ({self._op_deadline_s:.1f}s)"
+                            " exhausted"
+                        )
+                    break
+                except (
+                    DbeelError,
+                    OSError,
+                    asyncio.IncompleteReadError,
+                ) as e:
+                    # Reference walk semantics (lib.rs:368-383): record
+                    # and advance — connect refused/reset, a dead
+                    # coordinator's quorum-timeout, or an application
+                    # error; the next replica may answer.
                     last_error = e
+                    if not isinstance(e, DbeelError) or (
+                        is_retryable_class(classify_error(e))
+                    ):
+                        transport_error = e
                     continue
-            if attempt == 0 and isinstance(
-                last_error, KeyNotOwnedByShard
-            ):
-                await self.sync_metadata()
-                continue
-            raise last_error if last_error else ConnectionError_(
-                "no replica reachable"
+            if transport_error is not None:
+                # Unknown state beats any benign outcome seen on OTHER
+                # replicas this round — raise/retry the transport
+                # error, never the downgraded KeyNotFound.
+                last_error = transport_error
+            # Walk exhausted.  Application outcomes are final; the
+            # infrastructure classes retry after backoff while budget
+            # remains — under churn the ring heals in well under an
+            # op deadline.
+            retryable = not_owned or is_retryable_class(
+                classify_error(last_error)
+                if last_error is not None
+                else None
             )
-        raise ConnectionError_("unreachable")
+            if not retryable or loop.time() >= deadline:
+                break
+            if not_owned or not isinstance(last_error, DbeelError):
+                # Ring is stale (wrong owner) or nodes vanished
+                # (transport errors): refresh the view before the
+                # next round.  Best-effort — with every seed briefly
+                # down we keep walking the last known ring.
+                try:
+                    await asyncio.wait_for(
+                        self.sync_metadata(),
+                        max(0.05, deadline - loop.time()),
+                    )
+                except (DbeelError, OSError, asyncio.TimeoutError):
+                    pass
+            pause = min(
+                self._backoff_s(attempt, self._rng),
+                max(0.0, deadline - loop.time()),
+            )
+            if pause > 0:
+                await asyncio.sleep(pause)
+            attempt += 1
+        raise last_error if last_error else ConnectionError_(
+            "no replica reachable"
+        )
 
     # -- public API (lib.rs:482-619) -------------------------------------
 

@@ -40,6 +40,14 @@ def _bind(lib) -> None:
     lib.dbeel_cli_ring_size.argtypes = [ctypes.c_void_p]
     lib.dbeel_cli_last_error.restype = ctypes.c_char_p
     lib.dbeel_cli_last_error.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_cli_set_retry"):  # stale .so tolerance
+        lib.dbeel_cli_set_retry.restype = None
+        lib.dbeel_cli_set_retry.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
     lib.dbeel_cli_create_collection.restype = ctypes.c_int
     lib.dbeel_cli_create_collection.argtypes = [
         ctypes.c_void_p,
@@ -129,6 +137,24 @@ class NativeDbeelClient:
     def sync_metadata(self) -> None:
         if self._lib.dbeel_cli_sync(self._h) != 0:
             raise DbeelError(self._err())
+
+    def set_retry(
+        self,
+        op_deadline_ms: int = 0,
+        backoff_base_ms: int = 0,
+        backoff_cap_ms: int = 0,
+    ) -> bool:
+        """Tune the C walk's failure budget (0 keeps a knob's current
+        value: 10 s deadline, 20 ms backoff base, 500 ms cap).
+        Returns False on a stale .so without the retry ABI — the C
+        walk then still advances past dead coordinators, just with
+        its single-round pre-deadline behavior."""
+        if not hasattr(self._lib, "dbeel_cli_set_retry"):
+            return False
+        self._lib.dbeel_cli_set_retry(
+            self._h, op_deadline_ms, backoff_base_ms, backoff_cap_ms
+        )
+        return True
 
     def create_collection(
         self, name: str, replication_factor: int = 1

@@ -325,6 +325,19 @@ class QuorumFanout:
                     op.future.set_result(list(op.results))
                 del self._ops[self._op_id.value]
 
+    def drop_node(self, addresses) -> None:
+        """Kill live streams to a node marked Dead: the queued dead
+        events hint and release every in-flight op still waiting on
+        it, so the detector bounds the blind window on the native
+        plane exactly like the asyncio fan-out's mid-flight
+        cancellation (streams reconnect lazily if the node returns)."""
+        if self._closed:
+            return
+        for addr in addresses:
+            pid = self._peer_ids.get(addr)
+            if pid is not None and self._fds.get(pid) is not None:
+                self._drop_stream(pid)
+
     # ---- stalled-stream sweep ----------------------------------------
 
     async def _sweep(self) -> None:

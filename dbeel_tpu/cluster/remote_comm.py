@@ -27,6 +27,52 @@ from .messages import (
 _LEN = struct.Struct("<I")
 MAX_MESSAGE = 64 << 20
 
+# ---------------------------------------------------------------------
+# Test-only fault-injection seam (failure-aware request plane): tests
+# mark a peer address as refusing / black-holing / delaying its
+# remote-shard plane, and every RemoteShardConnection to it behaves
+# accordingly — deterministic dead-peer scenarios with no real node
+# kills, no OS-level tricks.  Production never touches this: the dict
+# stays empty and the per-call check is one hash miss.
+# ---------------------------------------------------------------------
+
+FAULT_REFUSE = "refuse"  # connect refused / reset instantly
+FAULT_BLACKHOLE = "blackhole"  # accepts, never answers (cancellable)
+
+_faults: dict = {}  # "<ip>:<port>" -> mode | ("delay", seconds)
+
+
+def set_fault(address: str, mode) -> None:
+    """Arm a fault for one peer address (``None`` disarms)."""
+    if mode is None:
+        _faults.pop(address, None)
+    else:
+        _faults[address] = mode
+
+
+def clear_faults() -> None:
+    _faults.clear()
+
+
+async def _apply_fault(conn: "RemoteShardConnection") -> None:
+    """Raise/stall per the armed fault for this connection, if any."""
+    mode = _faults.get(conn.address)
+    if mode is None:
+        return
+    if mode == FAULT_REFUSE:
+        raise ConnectionError_(
+            f"connect to {conn.address}: [fault] connection refused"
+        )
+    if mode == FAULT_BLACKHOLE:
+        # Hang like a partitioned peer: nothing comes back until the
+        # read timeout (or the caller cancels us — the detector-bound
+        # mid-flight cancellation path).
+        await asyncio.sleep(conn.read_timeout)
+        raise Timeout(f"rpc to {conn.address} [fault blackhole]")
+    kind, seconds = mode  # ("delay", s)
+    assert kind == "delay"
+    await asyncio.sleep(seconds)
+
 
 async def send_message_to_stream(
     writer: asyncio.StreamWriter, message: list
@@ -131,6 +177,8 @@ class RemoteShardConnection:
         """Run ``op(reader, writer) -> result`` with the pooled
         persistent-stream semantics when enabled, else
         connect-per-request (remote_shard_connection.rs:50-72)."""
+        if _faults:
+            await _apply_fault(self)
         if self.pooled:
             while self._pool:
                 reader, writer = self._pool.pop()
@@ -208,6 +256,8 @@ class RemoteShardConnection:
 
     async def send_event(self, event: list) -> None:
         """Fire one ShardEvent (no reply expected) and close."""
+        if _faults:
+            await _apply_fault(self)
         reader, writer = await self._connect()
         try:
             await asyncio.wait_for(

@@ -54,12 +54,93 @@ NoRemoteShardsFound = _mk(
 TooManyWalFiles = _mk(
     "TooManyWalFiles", "More than two WAL files found on open."
 )
+PeerDead = _mk(
+    "PeerDead",
+    "A replica needed for this op is marked Dead by the failure "
+    "detector.",
+)
 
 _BY_KIND = {
     cls.kind: cls
     for cls in list(globals().values())
     if isinstance(cls, type) and issubclass(cls, DbeelError)
 }
+
+
+# ---------------------------------------------------------------------
+# Failure taxonomy: every client-visible FAILURE maps to one stable
+# class, shared by server metrics, the smart clients, and the chaos
+# soak report, so an error rate can always be broken down the same way
+# on both sides of the wire.
+# ---------------------------------------------------------------------
+
+ERROR_CLASS_COORDINATOR_DEAD = "coordinator-dead"
+ERROR_CLASS_QUORUM_TIMEOUT = "quorum-timeout"
+ERROR_CLASS_PEER_DEAD = "peer-dead"
+ERROR_CLASS_NOT_OWNED = "not-owned"
+ERROR_CLASS_OTHER = "other"
+ERROR_CLASSES = (
+    ERROR_CLASS_COORDINATOR_DEAD,
+    ERROR_CLASS_QUORUM_TIMEOUT,
+    ERROR_CLASS_PEER_DEAD,
+    ERROR_CLASS_NOT_OWNED,
+    ERROR_CLASS_OTHER,
+)
+
+# Application OUTCOMES, not failures: a get of an absent key or a
+# duplicate create_collection is the protocol working as designed.
+_BENIGN_KINDS = frozenset(
+    {
+        "KeyNotFound",
+        "CollectionNotFound",
+        "CollectionAlreadyExists",
+    }
+)
+
+# Errors whose cause is the coordinator (or the path to it) being
+# unreachable: the client should walk to the next replica.
+_CONNECTION_KINDS = frozenset({"ConnectionError", "ProtocolError"})
+
+
+def classify_error(exc: BaseException) -> "str | None":
+    """Taxonomy class of a client-visible failure, or None for benign
+    application outcomes (KeyNotFound et al.) that are not failures."""
+    import asyncio
+
+    if isinstance(exc, DbeelError):
+        kind = exc.kind
+        if kind in _BENIGN_KINDS:
+            return None
+        if kind == "KeyNotOwnedByShard":
+            return ERROR_CLASS_NOT_OWNED
+        if kind == "Timeout":
+            return ERROR_CLASS_QUORUM_TIMEOUT
+        if kind == "PeerDead":
+            return ERROR_CLASS_PEER_DEAD
+        if kind in _CONNECTION_KINDS:
+            return ERROR_CLASS_COORDINATOR_DEAD
+        return ERROR_CLASS_OTHER
+    if isinstance(exc, asyncio.TimeoutError):
+        return ERROR_CLASS_QUORUM_TIMEOUT
+    if isinstance(
+        exc, (OSError, asyncio.IncompleteReadError, EOFError)
+    ):
+        # Connect refused/reset, half-closed stream: the coordinator
+        # (or the node being dialed) is gone.
+        return ERROR_CLASS_COORDINATOR_DEAD
+    return ERROR_CLASS_OTHER
+
+
+def is_retryable_class(error_class: "str | None") -> bool:
+    """Should a smart client walk to the next replica / retry after
+    backoff for this failure class?  Benign outcomes and application
+    errors are final; infrastructure failures are not."""
+    return error_class in (
+        ERROR_CLASS_COORDINATOR_DEAD,
+        ERROR_CLASS_QUORUM_TIMEOUT,
+        ERROR_CLASS_PEER_DEAD,
+        ERROR_CLASS_NOT_OWNED,
+    )
 
 
 def from_wire(payload: Any) -> DbeelError:

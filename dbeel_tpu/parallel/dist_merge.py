@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # newer jax exports shard_map at top level...
+    from jax import shard_map
+except ImportError:  # ...older releases keep it in experimental
+    from jax.experimental.shard_map import shard_map
 
 from ..storage import columnar
 from ..ops import bitonic

@@ -26,8 +26,10 @@ from ..errors import (
     KeyNotFound,
     KeyNotOwnedByShard,
     MissingField,
+    PeerDead,
     Timeout,
     UnsupportedField,
+    classify_error,
 )
 from ..cluster import messages as msgs
 from ..cluster.messages import (
@@ -53,6 +55,21 @@ DEFAULT_GET_TIMEOUT_MS = 5000
 # "No local read happened yet" marker for the RF>1 get path (None is
 # a legitimate local read result: key absent).
 _NO_LOCAL_READ = object()
+
+
+def _quorum_error(my_shard: MyShard, op: str, op_status: dict):
+    """Error for a quorum deadline expiry: ``PeerDead`` when a
+    fan-out target was marked Dead during (or just before) the wait —
+    the op stalled on a dead replica, distinct from a merely slow
+    quorum — else plain ``Timeout``."""
+    targets = op_status.get("targets", ())
+    if op_status.get("peer_dead") or any(
+        t in my_shard.dead_nodes for t in targets
+    ):
+        return PeerDead(
+            f"{op}: replica marked Dead during quorum wait"
+        )
+    return Timeout(op)
 
 
 def _extract(map_: dict, field: str):
@@ -164,11 +181,13 @@ async def handle_request(
                 if rtype == "set"
                 else ShardResponse.DELETE
             )
+            op_status: dict = {}
             remote = my_shard.send_request_to_replicas(
                 remote_request,
                 consistency - 1,
                 rf - replica_index - 1,
                 expected,
+                op_status=op_status,
             )
             try:
                 await asyncio.wait_for(
@@ -176,7 +195,9 @@ async def handle_request(
                     timeout_ms / 1000,
                 )
             except asyncio.TimeoutError as e:
-                raise Timeout(rtype) from e
+                raise _quorum_error(
+                    my_shard, rtype, op_status
+                ) from e
         else:
             try:
                 await asyncio.wait_for(local_write(), timeout_ms / 1000)
@@ -201,6 +222,7 @@ async def handle_request(
             deadline = (
                 asyncio.get_event_loop().time() + timeout_ms / 1000
             )
+            op_status = {}
             local_value = _NO_LOCAL_READ
             if _digest_reads_enabled():
                 # Digest round: local read first (it anchors the
@@ -224,6 +246,7 @@ async def handle_request(
                         0.001,
                         deadline - asyncio.get_event_loop().time(),
                     ),
+                    op_status=op_status,
                 ):
                     if (
                         local_value is None
@@ -236,6 +259,7 @@ async def handle_request(
                 consistency - 1,
                 rf - replica_index - 1,
                 ShardResponse.GET,
+                op_status=op_status,
             )
             try:
                 if local_value is _NO_LOCAL_READ:
@@ -259,7 +283,7 @@ async def handle_request(
                         ),
                     )
             except asyncio.TimeoutError as e:
-                raise Timeout("get") from e
+                raise _quorum_error(my_shard, "get", op_status) from e
             return _merge_quorum_get(
                 my_shard,
                 collection_name,
@@ -299,6 +323,7 @@ async def _digest_quorum_round(
     consistency: int,
     number_of_nodes: int,
     timeout_s: float,
+    op_status: Optional[dict] = None,
 ):
     """Digest-read round for an RF>1 get (beyond the reference, which
     ships RF full entries — db_server.rs:318-370): replicas answer
@@ -320,6 +345,8 @@ async def _digest_quorum_round(
     framed = struct.pack("<I", len(digest)) + digest
     expected = pack_message(ShardResponse.get_digest(local_value))
     local_ts = None if local_value is None else local_value[1]
+    if op_status is None:
+        op_status = {}
     try:
         results = await asyncio.wait_for(
             my_shard.send_packed_to_replicas(
@@ -328,11 +355,12 @@ async def _digest_quorum_round(
                 number_of_nodes,
                 expected,
                 ShardResponse.GET_DIGEST,
+                op_status=op_status,
             ),
             timeout_s,
         )
     except asyncio.TimeoutError as e:
-        raise Timeout("get") from e
+        raise _quorum_error(my_shard, "get", op_status) from e
     newer = False
     stale = 0
     for r in results:
@@ -507,6 +535,7 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
             )
         else:
             is_delete = op == "delete"
+            op_status: dict = {}
             try:
                 fan_out = my_shard.send_packed_to_replicas(
                     peer_frame,
@@ -516,6 +545,7 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                     ShardResponse.DELETE
                     if is_delete
                     else ShardResponse.SET,
+                    op_status=op_status,
                 )
                 if defer is not None:
                     # wal-sync: the coordinator's own replica-0 write
@@ -532,9 +562,10 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                     (timeout_ms or DEFAULT_SET_TIMEOUT_MS) / 1000,
                 )
             except asyncio.TimeoutError as e:
-                raise Timeout(op) from e
+                raise _quorum_error(my_shard, op, op_status) from e
             buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
     except Exception as e:  # defensive: never kill the connection task
+        my_shard.metrics.record_error(classify_error(e))
         buf = _error_response(e)
     my_shard.metrics.record_request(op, started)
     return buf, keepalive
@@ -566,6 +597,7 @@ async def _finish_coord_get(
     deadline = (
         asyncio.get_event_loop().time() + timeout_ms / 1000
     )
+    op_status: dict = {}
     if _digest_reads_enabled():
         if await _digest_quorum_round(
             my_shard,
@@ -576,6 +608,7 @@ async def _finish_coord_get(
             consistency,
             col.replication_factor - 1,
             timeout_ms / 1000,
+            op_status=op_status,
         ):
             if (
                 local_value is None
@@ -589,6 +622,7 @@ async def _finish_coord_get(
         col.replication_factor - 1,
         b"",  # no constant ack for gets: always unpack
         ShardResponse.GET,
+        op_status=op_status,
     )
     try:
         values = await asyncio.wait_for(
@@ -596,7 +630,7 @@ async def _finish_coord_get(
             max(0.001, deadline - asyncio.get_event_loop().time()),
         )
     except asyncio.TimeoutError as e:
-        raise Timeout("get") from e
+        raise _quorum_error(my_shard, "get", op_status) from e
     win_value = _merge_quorum_get(
         my_shard,
         col_name,
@@ -630,6 +664,7 @@ async def _serve_frame(my_shard: MyShard, request_buf: bytes):
         else:
             buf = payload + bytes([RESPONSE_OK])
     except Exception as e:  # defensive: never kill the connection task
+        my_shard.metrics.record_error(classify_error(e))
         buf = _error_response(e)
     my_shard.metrics.record_request(op, started)
     return buf, keepalive

@@ -96,6 +96,22 @@ class ShardMetrics:
     def __init__(self) -> None:
         self.requests: Dict[str, LatencyHistogram] = {}
         self.slow_ops = 0
+        # Failure-taxonomy counters (errors.ERROR_CLASSES): every
+        # client-visible failure this shard answered with an error
+        # frame, by class — the server-side half of the soak report's
+        # per-class breakdown.
+        from ..errors import ERROR_CLASSES
+
+        self.errors: Dict[str, int] = {c: 0 for c in ERROR_CLASSES}
+
+    def record_error(self, error_class: Optional[str]) -> None:
+        """Count one client-visible failure by taxonomy class (None =
+        benign application outcome, not counted)."""
+        if error_class is None:
+            return
+        if error_class not in self.errors:
+            error_class = "other"
+        self.errors[error_class] += 1
 
     def record_request(self, op: str, started: float) -> None:
         """``started`` from time.monotonic() at frame receipt."""
@@ -117,4 +133,5 @@ class ShardMetrics:
                 for op, hist in self.requests.items()
             },
             "slow_ops": self.slow_ops,
+            "errors": dict(self.errors),
         }

@@ -244,11 +244,22 @@ async def run_shard(
         # and protocol connections have no owning task to cancel.
         my_shard.close_db_connections()
         background = list(my_shard._background_tasks)
+        # One cancel() per task is NOT enough on py<3.12:
+        # asyncio.wait_for can swallow a cancellation when its inner
+        # future completes in the same tick (bpo-37658), leaving the
+        # task alive in its next loop iteration — the detector/AE
+        # loops ping on short wait_fors constantly, so shutdown used
+        # to hang on this race.  Re-cancel until everything is done.
+        pending = {*task_set, *background}
+        while pending:
+            for t in pending:
+                t.cancel()
+            _done, pending = await asyncio.wait(
+                pending, timeout=1.0
+            )
         for t in (*task_set, *background):
-            t.cancel()
-        await asyncio.gather(
-            *task_set, *background, return_exceptions=True
-        )
+            if not t.cancelled():
+                t.exception()  # consume (gather(return_exceptions))
         # Announce our death (run_shard.rs:158-166) — unless this is a
         # simulated crash, which must look like the reference's
         # executor cancel: no cleanup, no goodbye.

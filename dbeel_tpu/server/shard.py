@@ -17,6 +17,7 @@ import os
 import re
 import secrets
 import socket
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple, Union
@@ -128,6 +129,22 @@ class MyShard:
         # none — SURVEY §5): mutations whose replica fan-out failed,
         # keyed by the unreachable node, replayed on its next Alive.
         self.hints: Dict[str, deque] = {}
+        # Failure-aware request plane: nodes the failure detector (or
+        # Dead gossip) declared dead.  Fan-outs treat these peers as
+        # immediately failed instead of stalling into connect/read
+        # timeouts; cleared on Alive.
+        self.dead_nodes: set = set()
+        # In-flight replica fan-out futures by target node: a death
+        # mark cancels them on the spot, so a client op blocked on a
+        # black-holed peer unblocks the moment detection fires (the
+        # blind window is bounded by the detector, not the timeouts).
+        self._inflight_by_node: Dict[str, set] = {}
+        # peers.json write serialization (ADVICE r5 low #1): a
+        # monotonic snapshot version + lock so an older snapshot can
+        # never os.replace a newer one when two executor writes race.
+        self._peers_version = 0
+        self._peers_written_version = 0
+        self._peers_write_lock = threading.Lock()
         self.cache = cache
         # Shares discipline (glommio task-queue parity): serving marks
         # foreground activity; compaction/migration/hint-replay units
@@ -348,25 +365,40 @@ class MyShard:
         # stall every shard's request handling — same discipline as
         # the off-loop WAL disposal).
         wire = [n.to_wire() for n in self.nodes.values()]
-        dir_path = self.config.dir
+        # Version assignment happens on the loop thread (serialized);
+        # the write-side lock + version check order the executor
+        # writes so an older snapshot can never replace a newer one.
+        self._peers_version += 1
+        version = self._peers_version
+        try:
+            asyncio.get_running_loop().run_in_executor(
+                None, self._persist_peers_write, wire, version
+            )
+        except RuntimeError:
+            # No loop (direct construction in tests).
+            self._persist_peers_write(wire, version)
 
-        def _write():
-            try:
+    def _persist_peers_write(self, wire: list, version: int) -> None:
+        """Executor-side peers.json write, serialized by version: a
+        snapshot older than what's already on disk is a no-op
+        (ADVICE r5 low #1 — two racing pool threads used to be able
+        to os.replace a newer peers.json with a stale one)."""
+        try:
+            with self._peers_write_lock:
+                if version <= self._peers_written_version:
+                    return  # a newer snapshot already landed
+                dir_path = self.config.dir
                 os.makedirs(dir_path, exist_ok=True)
                 path = os.path.join(dir_path, "peers.json")
                 # Unique tmp per write: two queued executor writes
                 # must not interleave in one tmp file.
-                tmp = f"{path}.tmp{os.getpid()}-{id(wire)}"
+                tmp = f"{path}.tmp{os.getpid()}-{version}"
                 with open(tmp, "w") as f:
                     json.dump(wire, f)
                 os.replace(tmp, path)
-            except OSError:
-                log.warning("peers.json write failed", exc_info=True)
-
-        try:
-            asyncio.get_running_loop().run_in_executor(None, _write)
-        except RuntimeError:
-            _write()  # no loop (direct construction in tests)
+                self._peers_written_version = version
+        except OSError:
+            log.warning("peers.json write failed", exc_info=True)
 
     def get_node_metadata(self) -> NodeMetadata:
         # All shards of THIS node — local queues in single-process mode,
@@ -443,10 +475,17 @@ class MyShard:
                 "sstables": tree.sstable_indices_and_sizes(),
                 "replication_factor": col.replication_factor,
             }
+        from ..storage.wal import hub_fsync_errors
+
         return {
             "shard": self.shard_name,
             "nodes_known": len(self.nodes),
             "ring_size": len(self.shards),
+            "dead_nodes": sorted(self.dead_nodes),
+            "hints_queued": {
+                n: len(q) for n, q in self.hints.items()
+            },
+            "wal_fsync_errors": hub_fsync_errors(),
             "cache": {
                 "pages": len(self.cache),
                 "hits": self.cache.hits,
@@ -672,11 +711,14 @@ class MyShard:
         number_of_acks: int,
         number_of_nodes: int,
         expected_kind: str,
+        op_status: Optional[dict] = None,
     ) -> List:
         """Send to the first ``number_of_nodes`` distinct-node remote
         shards on the ring; return after ``number_of_acks`` successes,
         drain the rest in the background.  Failed mutations become
-        hints for the unreachable node."""
+        hints for the unreachable node.  ``op_status`` (when given)
+        collects failure context for the caller's error frame:
+        ``peer_dead`` / ``peer_unreachable`` flags."""
         return await self._fan_out_to_replicas(
             lambda c: c.send_request(request),
             lambda resp: msgs.response_to_result(
@@ -685,6 +727,7 @@ class MyShard:
             lambda: request,
             number_of_acks,
             number_of_nodes,
+            op_status=op_status,
         )
 
     async def send_packed_to_replicas(
@@ -694,6 +737,7 @@ class MyShard:
         number_of_nodes: int,
         expected_ack: bytes,
         expected_kind: str,
+        op_status: Optional[dict] = None,
     ) -> List:
         """send_request_to_replicas for a PRE-PACKED peer frame (the
         native coordinator's output): the frame bytes go out verbatim
@@ -707,6 +751,11 @@ class MyShard:
         the always-available fallback."""
         hint_request_fn = lambda: msgs.unpack_message(framed[4:])  # noqa: E731
         connections = self._replica_connections(number_of_nodes)
+        if op_status is not None:
+            # The walk targets, for PeerDead-vs-Timeout attribution
+            # at the op deadline (db_server._quorum_error) — recorded
+            # here so the native fan-out path carries them too.
+            op_status["targets"] = [n for n, _c in connections]
         qf = self.quorum_fanout
         if qf is not None and all(
             not isinstance(c, LocalShardConnection)
@@ -737,6 +786,7 @@ class MyShard:
             number_of_acks,
             number_of_nodes,
             connections=connections,
+            op_status=op_status,
         )
 
     def _replica_connections(self, number_of_nodes: int) -> List[tuple]:
@@ -755,6 +805,16 @@ class MyShard:
                 break
         return connections
 
+    def _register_inflight(self, name: str, fut) -> None:
+        self._inflight_by_node.setdefault(name, set()).add(fut)
+
+    def _unregister_inflight(self, name: str, fut) -> None:
+        futs = self._inflight_by_node.get(name)
+        if futs is not None:
+            futs.discard(fut)
+            if not futs:
+                self._inflight_by_node.pop(name, None)
+
     async def _fan_out_to_replicas(
         self,
         send_fn,
@@ -763,20 +823,81 @@ class MyShard:
         number_of_acks: int,
         number_of_nodes: int,
         connections: Optional[List[tuple]] = None,
+        op_status: Optional[dict] = None,
     ) -> List:
         if connections is None:
             connections = self._replica_connections(number_of_nodes)
+        if op_status is not None:
+            op_status.setdefault(
+                "targets", [name for name, _c in connections]
+            )
 
         result_future: asyncio.Future = (
             asyncio.get_event_loop().create_future()
         )
 
         async def fan_out():
-            fut_node = {
-                asyncio.ensure_future(send_fn(c)): name
-                for name, c in connections
-            }
+            # A peer already marked Dead is failed on the spot: hint
+            # and skip the dial — never a connect/read-timeout stall
+            # (the detector-bounded blind window, failure_detector.rs
+            # parity).  Normally ring removal keeps dead peers out of
+            # the walk; this guard covers the race where the
+            # connection list was snapshotted before the death mark.
+            live = []
+            for name, c in connections:
+                if name in self.dead_nodes:
+                    if op_status is not None:
+                        op_status["peer_dead"] = True
+                    log.warning(
+                        "replica %s marked Dead: fast-fail", name
+                    )
+                    self._record_hint(name, hint_request_fn())
+                else:
+                    live.append((name, c))
+            fut_node = {}
+            for name, c in live:
+                fut = asyncio.ensure_future(send_fn(c))
+                fut_node[fut] = name
+                self._register_inflight(name, fut)
             pending = set(fut_node)
+
+            def settle(fut) -> bool:
+                """Interpret one finished future; True on ack."""
+                name = fut_node[fut]
+                self._unregister_inflight(name, fut)
+                try:
+                    results.append(interpret_fn(fut.result()))
+                    return True
+                except asyncio.CancelledError:
+                    # Cancelled by a mid-flight death mark
+                    # (handle_dead_node): treat like unreachable.
+                    if op_status is not None:
+                        op_status["peer_dead"] = True
+                    log.error(
+                        "replica %s died mid-request: cancelled", name
+                    )
+                    self._record_hint(name, hint_request_fn())
+                except (Timeout, ConnectionError_) as e:
+                    # Unreachable replica: hand off later.
+                    if op_status is not None:
+                        op_status["peer_unreachable"] = True
+                    log.error("unreachable replica: %s", e)
+                    self._record_hint(name, hint_request_fn())
+                except DbeelError as e:
+                    # Application-level error from a LIVE replica
+                    # (e.g. CollectionNotFound during gossip
+                    # propagation) — not a handoff case.
+                    log.error(
+                        "failed response from replica: %s", e
+                    )
+                except Exception as e:
+                    # Anything else (garbled pooled-stream payload
+                    # blowing up interpret_fn, etc.): log and keep
+                    # settling — one bad response must not abort the
+                    # drain and strand the other stragglers unhinted.
+                    log.error("replica response failed: %s", e)
+                return False
+
             results: List = []
             acks = 0
             try:
@@ -788,38 +909,20 @@ class MyShard:
                         pending, return_when=asyncio.FIRST_COMPLETED
                     )
                     for fut in done:
-                        try:
-                            results.append(
-                                interpret_fn(fut.result())
-                            )
+                        if settle(fut):
                             acks += 1
-                        except (Timeout, ConnectionError_) as e:
-                            # Unreachable replica: hand off later.
-                            log.error(
-                                "unreachable replica: %s", e
-                            )
-                            self._record_hint(
-                                fut_node[fut], hint_request_fn()
-                            )
-                        except DbeelError as e:
-                            # Application-level error from a LIVE
-                            # replica (e.g. CollectionNotFound during
-                            # gossip propagation) — not a handoff case.
-                            log.error(
-                                "failed response from replica: %s", e
-                            )
             finally:
                 if not result_future.done():
                     result_future.set_result(results)
             # Drain stragglers in the background (shards.rs:530-539).
             for fut in pending:
                 try:
-                    await fut
-                except (Timeout, ConnectionError_) as e:
-                    log.error("replica request in background: %s", e)
-                    self._record_hint(fut_node[fut], hint_request_fn())
-                except Exception as e:
-                    log.error("replica request in background: %s", e)
+                    await asyncio.wait({fut})
+                except asyncio.CancelledError:
+                    # The fan-out TASK itself is being cancelled
+                    # (shard shutdown): stop draining.
+                    raise
+                settle(fut)
 
         self.spawn(fan_out())
         return await result_future
@@ -997,21 +1100,29 @@ class MyShard:
         memtable).  The anti-entropy apply primitive: a replayed old
         entry must never shadow a newer value that was already flushed
         out of the memtable."""
-        local = await tree.get_entry(key)
-        if local is not None and local[1] >= ts:
-            return False
-        # Close the probe/write race: a concurrent client write may
-        # have landed during get_entry's awaits (and even been swapped
-        # to the flushing memtable).  Re-probe the memtables with NO
-        # awaits between this check and set_with_timestamp's
-        # synchronous memtable insert.  (Residual window: a
-        # capacity-wait inside set_with_timestamp can still interleave
-        # — the same width the replication fan-out itself has.)
-        newest = tree.newest_memtable_ts(key)
-        if newest is not None and newest >= ts:
-            return False
-        await tree.set_with_timestamp(key, value, ts)
-        return True
+        while True:
+            local = await tree.get_entry(key)
+            if local is not None and local[1] >= ts:
+                return False
+            # Close the probe/write race: a concurrent client write
+            # may have landed during get_entry's awaits (and even been
+            # swapped to the flushing memtable).  Re-probe the
+            # memtables with NO awaits between this check and
+            # set_with_timestamp's synchronous memtable insert.
+            watermark = tree.max_flushed_ts
+            newest = tree.newest_memtable_ts(key)
+            if newest is not None and newest >= ts:
+                return False
+            if await tree.set_with_timestamp(
+                key, value, ts, stale_abort_from=watermark
+            ):
+                return True
+            # A capacity wait inside the insert spanned a flush swap
+            # that advanced the watermark past ts (the last
+            # stale-shadow window, ADVICE r5 low #2): the probe above
+            # is stale — re-probe against the newly flushed layers
+            # and retry.  Terminates: each extra round requires a NEW
+            # swap during the insert.
 
     @staticmethod
     def _in_ae_range(h: int, start: int, end: int) -> bool:
@@ -1169,9 +1280,16 @@ class MyShard:
             try:
                 sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
                 sock.setblocking(False)
-                await loop.sock_sendto(
-                    sock, buf, (node.ip, node.gossip_port)
-                )
+                if hasattr(loop, "sock_sendto"):
+                    await loop.sock_sendto(
+                        sock, buf, (node.ip, node.gossip_port)
+                    )
+                else:
+                    # py3.10: loop.sock_sendto doesn't exist.  A UDP
+                    # sendto on a non-blocking socket never blocks —
+                    # it either queues the datagram or drops it
+                    # (EAGAIN), and gossip is fire-and-forget.
+                    sock.sendto(buf, (node.ip, node.gossip_port))
                 sock.close()
             except OSError as e:
                 log.error("gossip send to %s failed: %s", node.name, e)
@@ -1184,6 +1302,7 @@ class MyShard:
         if kind == GossipEvent.ALIVE:
             node = NodeMetadata.from_wire(event[1])
             if node.name != self.config.name:
+                self.dead_nodes.discard(node.name)
                 newly_added = node.name not in self.nodes
                 if newly_added:
                     self.nodes[node.name] = node
@@ -1247,6 +1366,30 @@ class MyShard:
     async def handle_dead_node(self, node_name: str) -> None:
         if self.nodes.pop(node_name, None) is None:
             return
+        # Failure-aware request plane: mark first, THEN cancel any
+        # replica request already in flight to the dead peer — a
+        # client op blocked on a black-holed socket unblocks now (and
+        # the mutation is hinted), instead of riding the 15 s read
+        # timeout.  The mark makes new fan-outs fast-fail during the
+        # removal race, and handle_request uses it to answer PeerDead
+        # instead of a bare quorum Timeout.
+        self.dead_nodes.add(node_name)
+        for fut in list(self._inflight_by_node.get(node_name, ())):
+            fut.cancel()
+        if self.quorum_fanout is not None:
+            # The native fan-out plane holds its own streams: drop
+            # them too, so its in-flight ops dead-event (hint +
+            # release) now instead of riding the C read timeout.
+            self.quorum_fanout.drop_node(
+                [
+                    s.connection.address
+                    for s in self.shards
+                    if s.node_name == node_name
+                    and isinstance(
+                        s.connection, RemoteShardConnection
+                    )
+                ]
+            )
         # Allow the node's next Alive announcement through the gossip
         # dedup immediately (see the matching reset in
         # handle_gossip_event).

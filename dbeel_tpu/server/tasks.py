@@ -618,8 +618,26 @@ async def run_failure_detector(my_shard: MyShard) -> None:
         node = random.choice(candidates)
         await asyncio.sleep(interval)
         port = node.remote_shard_base_port + random.choice(node.ids)
-        connection = RemoteShardConnection.from_config(
-            f"{node.ip}:{port}", my_shard.config
+        # Detection probes get TIGHT timeouts (bounded blind window):
+        # with the config's serving timeouts (5 s connect / 15 s
+        # read), a black-holed peer would stay undetected for 15+ s
+        # while client ops stall against it.  A ping is tiny — cap
+        # its round trip at ~4 detection intervals (floor 1 s), so
+        # the worst-case blind window tracks the detector cadence.
+        probe_ms = max(1000, int(interval * 4000))
+        connection = RemoteShardConnection(
+            f"{node.ip}:{port}",
+            connect_timeout_ms=min(
+                probe_ms,
+                my_shard.config.remote_shard_connect_timeout_ms,
+            ),
+            read_timeout_ms=min(
+                probe_ms, my_shard.config.remote_shard_read_timeout_ms
+            ),
+            write_timeout_ms=min(
+                probe_ms,
+                my_shard.config.remote_shard_write_timeout_ms,
+            ),
         )
         try:
             await connection.ping()

@@ -422,6 +422,7 @@ class LSMTree:
     async def set_with_timestamp(
         self, key: bytes, value: bytes, timestamp: int,
         stale_abort: bool = False,
+        stale_abort_from: "int | None" = None,
     ) -> bool:
         """Insert (key, value, timestamp).  With ``stale_abort``,
         return False WITHOUT inserting if, at the moment of the
@@ -430,11 +431,25 @@ class LSMTree:
         spans a flush swap and the pre-checked guard in the shard
         layer goes stale (the caller then applies read-guarded).
         The check sits synchronously before the insert (no awaits
-        between), so it cannot itself race a swap."""
+        between), so it cannot itself race a swap.
+
+        ``stale_abort_from=wm`` is the read-guarded variant (the
+        apply_if_newer final insert, ADVICE r5 low #2): abort only
+        when the watermark has MOVED past ``wm`` since the caller's
+        probe AND covers ``timestamp`` — an already-below-watermark
+        ts whose probe proved it newest for its key must still land
+        (the plain flag would starve it forever), while a swap that
+        raced the probe forces a re-probe against the new layers."""
         while True:
             try:
                 if (
                     stale_abort
+                    and timestamp <= self.max_flushed_ts
+                ):
+                    return False
+                if (
+                    stale_abort_from is not None
+                    and self.max_flushed_ts > stale_abort_from
                     and timestamp <= self.max_flushed_ts
                 ):
                     return False

@@ -21,7 +21,10 @@ from __future__ import annotations
 
 from typing import Iterator, List, Optional, Tuple
 
-from sortedcontainers import SortedDict
+try:
+    from sortedcontainers import SortedDict
+except ImportError:  # no wheel in the image: bisect-backed fallback
+    from ..utils.sorteddict import SortedDict
 
 from ..errors import MemtableCapacityReached
 

@@ -339,6 +339,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_walsync_errors"):
+        # Failed-fsync counter (gated separately: stale .so tolerance).
+        lib.dbeel_walsync_errors.restype = ctypes.c_uint64
+        lib.dbeel_walsync_errors.argtypes = []
     if hasattr(lib, "dbeel_dp_handle"):
         # (continuation of the data-plane prototypes: these must stay
         # gated on dbeel_dp_handle, NOT on the newer syncer symbols —

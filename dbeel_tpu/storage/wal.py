@@ -30,6 +30,20 @@ from ..utils.event import LocalEvent
 log = logging.getLogger(__name__)
 
 _MAGIC = 0x77A11065
+
+
+def hub_fsync_errors() -> "int | None":
+    """Process-wide count of FAILED IORING_OP_FSYNC completions in
+    the native wal-sync hub (ADVICE r5 low #3): a non-zero value
+    means the device rejected syncs and durable acks were held back
+    and retried.  None when the native hub (or its counter ABI) is
+    unavailable."""
+    from . import native as native_mod
+
+    lib = native_mod.load_if_built()
+    if lib is None or not hasattr(lib, "dbeel_walsync_errors"):
+        return None
+    return int(lib.dbeel_walsync_errors())
 _HEADER = struct.Struct("<IIII")
 
 

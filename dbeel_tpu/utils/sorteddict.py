@@ -1,0 +1,111 @@
+"""Minimal pure-python SortedDict fallback.
+
+The arena-image python has no ``sortedcontainers`` wheel; the sorted
+memtable only needs a small slice of its API (ordered ``items()``,
+``irange`` scans, plain dict reads/writes), so this module provides a
+drop-in for exactly that slice and ``storage.memtable`` imports it
+when the real package is absent.  Keys are kept in a bisect-maintained
+list: O(n) worst-case insert for a NEW key, O(log n) lookup — fine for
+capacity-bounded memtables, and the hash/arena memtables don't pass
+through here at all.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+class SortedDict:
+    __slots__ = ("_data", "_keys")
+
+    def __init__(self, *args, **kwargs) -> None:
+        self._data: dict = {}
+        self._keys: List[Any] = []
+        if args or kwargs:
+            for k, v in dict(*args, **kwargs).items():
+                self[k] = v
+
+    # -- writes --------------------------------------------------------
+
+    def __setitem__(self, key, value) -> None:
+        if key not in self._data:
+            insort(self._keys, key)
+        self._data[key] = value
+
+    def __delitem__(self, key) -> None:
+        del self._data[key]
+        i = bisect_left(self._keys, key)
+        del self._keys[i]
+
+    def pop(self, key, *default):
+        if key in self._data:
+            value = self._data[key]
+            del self[key]
+            return value
+        if default:
+            return default[0]
+        raise KeyError(key)
+
+    def clear(self) -> None:
+        self._data.clear()
+        self._keys.clear()
+
+    # -- reads ---------------------------------------------------------
+
+    def __getitem__(self, key):
+        return self._data[key]
+
+    def get(self, key, default=None):
+        return self._data.get(key, default)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __bool__(self) -> bool:
+        return bool(self._data)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._keys)
+
+    def keys(self):
+        return list(self._keys)
+
+    def values(self):
+        return [self._data[k] for k in self._keys]
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        return iter([(k, self._data[k]) for k in self._keys])
+
+    def peekitem(self, index: int = -1) -> Tuple[Any, Any]:
+        key = self._keys[index]
+        return key, self._data[key]
+
+    def irange(
+        self,
+        minimum: Optional[Any] = None,
+        maximum: Optional[Any] = None,
+        inclusive: Tuple[bool, bool] = (True, True),
+        reverse: bool = False,
+    ) -> Iterator:
+        """Ordered key scan over [minimum, maximum] (bounds optional,
+        inclusive by default — the sortedcontainers contract)."""
+        lo = 0
+        hi = len(self._keys)
+        if minimum is not None:
+            lo = (
+                bisect_left(self._keys, minimum)
+                if inclusive[0]
+                else bisect_right(self._keys, minimum)
+            )
+        if maximum is not None:
+            hi = (
+                bisect_right(self._keys, maximum)
+                if inclusive[1]
+                else bisect_left(self._keys, maximum)
+            )
+        span = self._keys[lo:hi]
+        return iter(reversed(span) if reverse else span)

@@ -15,6 +15,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -179,6 +180,13 @@ struct Client {
   std::vector<RingShard> ring;  // sorted by hash
   std::map<std::pair<std::string, uint16_t>, int> conns;
   std::string last_error;
+  // Failure-aware walk budget (mirrors the Python client): per-op
+  // deadline, capped exponential backoff with jitter between walk
+  // rounds when every replica failed with a transport error.
+  uint32_t op_deadline_ms = 10000;
+  uint32_t backoff_base_ms = 20;
+  uint32_t backoff_cap_ms = 500;
+  unsigned rng_state = 0x5eed5eed;
 
   ~Client() {
     for (auto& kv : conns) {
@@ -186,6 +194,39 @@ struct Client {
     }
   }
 };
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
+}
+
+void sleep_ms(uint64_t ms) {
+  struct timespec ts;
+  ts.tv_sec = (time_t)(ms / 1000ull);
+  ts.tv_nsec = (long)((ms % 1000ull) * 1000000ull);
+  while (nanosleep(&ts, &ts) != 0 && errno == EINTR) {
+  }
+}
+
+// xorshift32: cheap deterministic jitter source (no libc rand state).
+uint32_t next_rand(Client* c) {
+  unsigned x = c->rng_state;
+  x ^= x << 13;
+  x ^= x >> 17;
+  x ^= x << 5;
+  c->rng_state = x;
+  return x;
+}
+
+// Backoff for walk round `round`: uniform in [d/2, d] with
+// d = min(cap, base << round) — same formula as the Python client.
+uint64_t backoff_ms(Client* c, int round) {
+  uint64_t d = (uint64_t)c->backoff_base_ms << (round > 20 ? 20 : round);
+  if (d > c->backoff_cap_ms) d = c->backoff_cap_ms;
+  if (d == 0) return 0;
+  return d / 2 + next_rand(c) % (d - d / 2 + 1);
+}
 
 int connect_to(Client* c, const std::string& ip, uint16_t port) {
   auto key = std::make_pair(ip, port);
@@ -322,15 +363,14 @@ void common_fields(MpBuf* m, const char* type,
   }
 }
 
-int sync_metadata(Client* c) {
+int sync_metadata_from(Client* c, const std::string& ip,
+                       uint16_t port) {
   MpBuf m;
   m.map_header(2);
   common_fields(&m, "get_cluster_metadata", "", true);
   std::vector<uint8_t> body;
   uint8_t rtype = 0;
-  // Bootstrap from the seed; after the first sync any ring member
-  // works, but the seed stays the canonical fallback.
-  if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype)) {
+  if (!round_trip(c, ip, port, m, &body, &rtype)) {
     return -1;  // last_error already carries the transport cause
   }
   if (rtype == 0) {
@@ -384,6 +424,41 @@ int sync_metadata(Client* c) {
   return 0;
 }
 
+// Failover resync (mirrors the Python client): the configured seed
+// first, then known ring members — a client seeded only on the node
+// that just died must still be able to heal its ring.  Candidates
+// are (ip,port)-deduped (multi-shard nodes repeat per shard) and the
+// loop re-checks ``deadline_ms`` before each dial: with 5 s socket
+// timeouts per dead candidate, an unbounded sweep could otherwise
+// blow minutes past the caller's op budget.
+int sync_metadata_deadline(Client* c, uint64_t deadline_ms) {
+  if (now_ms() >= deadline_ms) return -1;
+  if (sync_metadata_from(c, c->seed_ip, c->seed_port) == 0) return 0;
+  // Iterate a COPY: a successful sync replaces c->ring mid-loop.
+  std::vector<RingShard> members = c->ring;
+  std::vector<std::pair<std::string, uint16_t>> tried;
+  tried.emplace_back(c->seed_ip, c->seed_port);
+  for (const RingShard& s : members) {
+    auto key = std::make_pair(s.ip, s.db_port);
+    bool seen = false;
+    for (const auto& t : tried) {
+      if (t == key) {
+        seen = true;
+        break;
+      }
+    }
+    if (seen) continue;
+    if (now_ms() >= deadline_ms) return -1;
+    tried.push_back(key);
+    if (sync_metadata_from(c, s.ip, s.db_port) == 0) return 0;
+  }
+  return -1;
+}
+
+int sync_metadata(Client* c) {
+  return sync_metadata_deadline(c, now_ms() + c->op_deadline_ms);
+}
+
 // The replica walk (lib.rs:336-417): first ring shard at/after the
 // hash, then forward skipping same-node shards.
 std::vector<const RingShard*> shards_for_key(const Client* c,
@@ -430,16 +505,28 @@ int keyed_request(Client* c, const char* type,
   bool is_set = std::strcmp(type, "set") == 0;
   // Like the Python client and the reference walk
   // (lib.rs:368-383): server errors record the last outcome and
-  // ADVANCE to the next replica; only KeyNotOwnedByShard breaks out
-  // (stale ring -> resync once and retry).
+  // ADVANCE to the next replica; KeyNotOwnedByShard breaks out
+  // (stale ring -> resync and retry).  Transport-failed rounds
+  // resync too (churn moved the ring) and retry after capped
+  // exponential backoff + jitter, until the per-op deadline budget
+  // is spent — a dead coordinator costs the walk hop, not the op.
   int last_rc = -2;
-  for (int attempt = 0; attempt < 2; attempt++) {
+  const uint64_t deadline = now_ms() + c->op_deadline_ms;
+  for (int attempt = 0;; attempt++) {
     auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
     bool not_owned = false;
     // Per attempt: a post-resync walk that cleanly answers is not
     // tainted by pre-resync failures against the stale ring.
     bool transport_failed = false;
     for (size_t ri = 0; ri < replicas.size(); ri++) {
+      if (now_ms() >= deadline && ri > 0) {
+        // Budget spent mid-walk (each dial can cost a socket
+        // timeout): stop dialing; state is UNKNOWN, never "not
+        // found".  ri==0 always dials so a zero/tiny deadline still
+        // makes one attempt.
+        transport_failed = true;
+        break;
+      }
       MpBuf m;
       // type, collection, keepalive, key, hash, replica_index
       // (+ value on set, + consistency when requested).
@@ -491,25 +578,35 @@ int keyed_request(Client* c, const char* type,
       }
       // walk on: the next replica may have the key / be healthy
     }
-    if (not_owned && attempt == 0) {
-      if (sync_metadata(c) != 0) return -2;
-      continue;
+    if (!not_owned && !transport_failed) {
+      // Walk finished on application outcomes only: final.
+      if (last_rc == -2 && c->last_error.empty()) {
+        c->last_error = "no replica reachable";
+      }
+      return last_rc;
     }
-    if (not_owned) {
-      c->last_error = "KeyNotOwnedByShard after resync";
+    if (now_ms() >= deadline) {
+      if (not_owned) {
+        c->last_error = "KeyNotOwnedByShard after resync";
+      } else if (c->last_error.empty()) {
+        c->last_error = "op deadline exhausted";
+      }
+      // Some replica was unreachable / un-owned and none succeeded:
+      // the key's state is UNKNOWN, never "not found".
       return -2;
     }
-    if (transport_failed) {
-      // Some replica was unreachable and none succeeded: the key's
-      // state is UNKNOWN, never "not found".
-      return -2;
+    // Refresh the ring (stale ownership, or churn removed a node),
+    // then back off before the next round; both stay inside the
+    // remaining budget.  Best-effort: keep the last ring on failure.
+    (void)sync_metadata_deadline(c, deadline);
+    const uint64_t nowv = now_ms();
+    if (nowv < deadline) {  // guard the uint64 underflow past deadline
+      uint64_t pause = backoff_ms(c, attempt);
+      const uint64_t remaining = deadline - nowv;
+      if (pause > remaining) pause = remaining;
+      if (pause > 0) sleep_ms(pause);
     }
-    if (last_rc == -2 && c->last_error.empty()) {
-      c->last_error = "no replica reachable";
-    }
-    return last_rc;
   }
-  return -2;
 }
 
 }  // namespace
@@ -520,6 +617,14 @@ void* dbeel_cli_new(const char* seed_ip, uint16_t seed_port) {
   Client* c = new Client();
   c->seed_ip = seed_ip;
   c->seed_port = seed_port;
+  // Entropy-seed the jitter RNG (clock ^ address): a constant seed
+  // would phase-lock every client's backoff sequence and recreate
+  // the synchronized retry storm the jitter exists to break up.
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  unsigned seed = (unsigned)(ts.tv_nsec ^ (ts.tv_sec << 10) ^
+                             (uintptr_t)c);
+  c->rng_state = seed ? seed : 0x5eed5eed;
   if (sync_metadata(c) != 0) {
     delete c;
     return nullptr;
@@ -535,6 +640,17 @@ int dbeel_cli_sync(void* h) {
 
 uint64_t dbeel_cli_ring_size(void* h) {
   return static_cast<Client*>(h)->ring.size();
+}
+
+// Failure-aware walk knobs (0 = keep the current value): per-op
+// deadline budget and the backoff base/cap for retry rounds.
+void dbeel_cli_set_retry(void* h, uint32_t deadline_ms,
+                         uint32_t backoff_base_ms,
+                         uint32_t backoff_cap_ms) {
+  Client* c = static_cast<Client*>(h);
+  if (deadline_ms) c->op_deadline_ms = deadline_ms;
+  if (backoff_base_ms) c->backoff_base_ms = backoff_base_ms;
+  if (backoff_cap_ms) c->backoff_cap_ms = backoff_cap_ms;
 }
 
 const char* dbeel_cli_last_error(void* h) {

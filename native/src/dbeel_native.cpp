@@ -3078,6 +3078,8 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
 // ---------------------------------------------------------------------
 
 #include <linux/io_uring.h>
+#include <linux/time_types.h>  // __kernel_timespec (not pulled in
+                               // by io_uring.h on older header sets)
 #include <sys/eventfd.h>
 #include <sys/mman.h>
 #include <sys/syscall.h>
@@ -3675,6 +3677,11 @@ struct WalSyncHub {
 constexpr uint64_t kHubFsync = 1;
 constexpr uint64_t kHubTimer = 2;
 
+// Failed IORING_OP_FSYNC completions (ADVICE r5 low #3): counted
+// process-wide and readable from Python via dbeel_walsync_errors() —
+// a failed sync must never silently pass for durability.
+std::atomic<uint64_t> g_hub_fsync_errors{0};
+
 uint64_t hub_tag(int32_t slot, uint32_t gen, uint64_t kind) {
   return ((uint64_t)gen << 40) | ((uint64_t)(uint32_t)slot << 8) |
          kind;
@@ -3733,7 +3740,7 @@ void hub_arm(WalSyncHub* hb, int32_t si) {
     s.fsync_inflight = true;
 }
 
-void hub_process_cqe(WalSyncHub* hb, uint64_t tag) {
+void hub_process_cqe(WalSyncHub* hb, uint64_t tag, int32_t res) {
   const uint64_t kind = tag & 0xFF;
   const int32_t si = (int32_t)((tag >> 8) & 0xFFFFFFFFu);
   const uint32_t gen = (uint32_t)(tag >> 40);
@@ -3743,9 +3750,17 @@ void hub_process_cqe(WalSyncHub* hb, uint64_t tag) {
   NativeWal* w = s.wal;
   if (kind == kHubFsync) {
     s.fsync_inflight = false;
-    // Best-effort like thread mode: a failed fdatasync still
-    // publishes (::fdatasync's result was ignored there too).
-    w->synced.store(s.inflight_s, std::memory_order_release);
+    if (res < 0) {
+      // Failed fdatasync (ADVICE r5 low #3): count it and do NOT
+      // advance the synced watermark — parked durable acks stay
+      // parked, and the dirty-slot re-arm below retries the sync
+      // (seq is still ahead of the unpublished watermark).  The
+      // closing path keeps its release-all contract: by then the
+      // flushed sstable owns durability.
+      g_hub_fsync_errors.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      w->synced.store(s.inflight_s, std::memory_order_release);
+    }
   } else if (kind == kHubTimer) {
     s.timer_armed = false;
   }
@@ -3778,7 +3793,7 @@ void hub_reap(WalSyncHub* hb) {
   int n;
   do {
     n = dbeel_uring_reap(hb->u, tags, res, 64);
-    for (int i = 0; i < n; i++) hub_process_cqe(hb, tags[i]);
+    for (int i = 0; i < n; i++) hub_process_cqe(hb, tags[i], res[i]);
   } while (n == 64);
   dbeel_uring_flush(hb->u);
 }
@@ -3875,6 +3890,13 @@ int32_t dbeel_walsync_hub_eventfd(void* h) {
 // reading dbeel_wal_synced.
 void dbeel_walsync_hub_reap(void* h) {
   hub_reap(static_cast<WalSyncHub*>(h));
+}
+
+// Process-wide count of failed IORING_OP_FSYNC completions: a
+// non-zero value means durable acks were delayed/retried because the
+// device rejected a sync (Python surfaces it in get_stats).
+uint64_t dbeel_walsync_errors(void) {
+  return g_hub_fsync_errors.load(std::memory_order_relaxed);
 }
 
 // Attach a WAL to the hub (instead of dbeel_wal_sync_enable's

@@ -79,6 +79,14 @@ def pytest_runtest_teardown(item):
         return (yield)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-horizon harnesses (chaos soak smoke) excluded "
+        "from tier-1 by -m 'not slow'",
+    )
+
+
 @pytest.fixture
 def tmp_dir():
     d = tempfile.mkdtemp(prefix="dbeel_tpu_test_")

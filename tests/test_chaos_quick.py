@@ -1,0 +1,70 @@
+"""Soak-report schema smoke (slow-marked: excluded from tier-1).
+
+``chaos_soak.py --quick`` runs a ~60s reduced-churn cycle and must
+emit the same report schema as the full soak — in particular the
+per-class client error breakdown the failure-aware request plane
+added (ISSUE 1) — so schema drift is caught without burning the full
+soak horizon in CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REPORT_KEYS = {
+    "duration_s",
+    "quick",
+    "acked_sets",
+    "acked_gets",
+    "acked_deletes",
+    "op_errors_during_churn",
+    "op_errors_by_class",
+    "client_error_rate",
+    "error_rate_ok",
+    "kills",
+    "restart_failures",
+    "acked_keys_checked",
+    "acked_writes_lost",
+    "divergent_keys",
+    "resources",
+    "pass",
+}
+
+
+@pytest.mark.slow
+def test_chaos_soak_quick_schema(tmp_dir):
+    report_path = os.path.join(tmp_dir, "report.json")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "chaos_soak.py"),
+            "--quick",
+            "--report",
+            report_path,
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert os.path.exists(report_path), proc.stdout[-2000:]
+    with open(report_path) as f:
+        report = json.load(f)
+    missing = REPORT_KEYS - set(report)
+    assert not missing, missing
+    from dbeel_tpu.errors import ERROR_CLASSES
+
+    for cls in ERROR_CLASSES:
+        assert cls in report["op_errors_by_class"], cls
+    assert report["quick"] is True
+    # The quick mode must still uphold the hard invariants (loss /
+    # divergence), even though the error-rate gate is waived.
+    assert proc.returncode == 0, (
+        proc.stdout[-3000:],
+        json.dumps(report)[:2000],
+    )

@@ -1,0 +1,444 @@
+"""Failure-aware request plane (ISSUE 1 tentpole): error taxonomy,
+client replica-walk failover, dead-peer fast-fail in the quorum
+fan-out, and detector-bounded blind windows — all driven through the
+deterministic fault-injection seam in cluster.remote_comm (refuse /
+black-hole / delay per peer address), no real node kills needed.
+"""
+
+import asyncio
+import json
+import time
+
+import msgpack
+import pytest
+
+from dbeel_tpu import errors
+from dbeel_tpu.client import Consistency, DbeelClient
+from dbeel_tpu.cluster import remote_comm
+from dbeel_tpu.cluster.messages import ShardRequest
+from dbeel_tpu.errors import (
+    ConnectionError_,
+    DbeelError,
+    Timeout,
+    classify_error,
+)
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.server.shard import MyShard, Shard
+from dbeel_tpu.utils.murmur import hash_bytes
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+def _key_owned_by(client, node_name, prefix="ok"):
+    """A key whose FIRST ring replica (i.e. its coordinator when the
+    client walks in order) lives on ``node_name``."""
+    for i in range(512):
+        k = f"{prefix}{i}"
+        h = hash_bytes(msgpack.packb(k, use_bin_type=True))
+        if client._shards_for_key(h, 3)[0].node_name == node_name:
+            return k
+    raise AssertionError(f"no key routed to {node_name}")
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_fanout(monkeypatch):
+    """Force the asyncio fan-out (the native QuorumFan engine writes
+    to raw sockets underneath the fault seam) and clear any armed
+    faults between tests."""
+    monkeypatch.setenv("DBEEL_NO_QF", "1")
+    yield
+    remote_comm.clear_faults()
+
+
+async def _three_node_cluster(tmp_dir, **kw):
+    cfg = make_config(tmp_dir, **kw)
+    nodes = [await ClusterNode(cfg).start()]
+    for i in (1, 2):
+        c = next_node_config(cfg, i, tmp_dir).replace(
+            seed_nodes=[nodes[0].seed_address], **kw
+        )
+        alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        nodes.append(await ClusterNode(c).start())
+        await alive
+    client = await DbeelClient.from_seed_nodes([nodes[0].db_address])
+    created = [
+        n.flow_event(0, FlowEvent.COLLECTION_CREATED) for n in nodes
+    ]
+    col = await client.create_collection("fo", replication_factor=3)
+    await asyncio.wait_for(asyncio.gather(*created), 10)
+    return nodes, client, col
+
+
+# ----------------------------------------------------------------------
+# Fault seam
+# ----------------------------------------------------------------------
+
+
+def test_fault_seam_refuse_and_blackhole(arun):
+    async def main():
+        conn = remote_comm.RemoteShardConnection(
+            "127.0.0.1:1", read_timeout_ms=300
+        )
+        remote_comm.set_fault("127.0.0.1:1", remote_comm.FAULT_REFUSE)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError_):
+            await conn.ping()
+        assert time.monotonic() - t0 < 0.2  # refused instantly
+        remote_comm.set_fault(
+            "127.0.0.1:1", remote_comm.FAULT_BLACKHOLE
+        )
+        t0 = time.monotonic()
+        with pytest.raises(Timeout):
+            await conn.ping()
+        # Black-hole hangs for the read timeout, then Timeout.
+        assert 0.25 <= time.monotonic() - t0 < 2.0
+        remote_comm.set_fault("127.0.0.1:1", None)  # disarm
+
+    arun(main())
+
+
+# ----------------------------------------------------------------------
+# Client replica-walk failover
+# ----------------------------------------------------------------------
+
+
+def test_client_walks_past_dead_coordinator(tmp_dir):
+    """A SIGKILLed coordinator must cost the client one walk hop, not
+    an error: connection-class failures advance to the next ring
+    replica (reference walk, dbeel_client lib.rs:336-417)."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(tmp_dir)
+        try:
+            keys = [f"k{i}" for i in range(12)]
+            for k in keys:
+                await col.set(
+                    k, {"v": 1}, consistency=Consistency.fixed(2)
+                )
+            # Kill node 0 hard: no death gossip, listener sockets
+            # vanish, every connect is refused.
+            await nodes[0].crash()
+            for k in keys:
+                # Some of these keys' first replica WAS node 0: the
+                # client must fail over and still meet W=2 on the two
+                # survivors.
+                await col.set(
+                    k, {"v": 2}, consistency=Consistency.fixed(2)
+                )
+                got = await col.get(
+                    k, consistency=Consistency.fixed(2)
+                )
+                assert got == {"v": 2}, (k, got)
+        finally:
+            for n in nodes[1:]:
+                await n.stop()
+        client.close()
+
+    run(main(), timeout=60)
+
+
+def test_client_deadline_budget_bounds_total_retry_time(tmp_dir):
+    """With every replica refusing, the walk + backoff rounds stop at
+    the per-op deadline and surface a coordinator-dead class error."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=0.8
+        )
+        col = await client.create_collection("d")
+        await col.set("k", 1)
+        await node.crash()
+        t0 = time.monotonic()
+        with pytest.raises((DbeelError, OSError)) as ei:
+            await col.set("k", 2)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 4.0, elapsed  # bounded by the budget
+        assert classify_error(ei.value) in (
+            "coordinator-dead",
+            "quorum-timeout",
+        )
+        client.close()
+
+    run(main(), timeout=30)
+
+
+def test_backoff_jitter_bounded():
+    import random
+
+    rng = random.Random(7)
+    base = DbeelClient.BACKOFF_BASE_S
+    cap = DbeelClient.BACKOFF_CAP_S
+    prev_hi = 0.0
+    for attempt in range(12):
+        lo_bound = min(cap, base * (1 << attempt)) / 2
+        hi_bound = min(cap, base * (1 << attempt))
+        for _ in range(50):
+            d = DbeelClient._backoff_s(attempt, rng)
+            assert lo_bound <= d <= hi_bound, (attempt, d)
+            assert d <= cap
+        assert hi_bound >= prev_hi  # monotone up to the cap
+        prev_hi = hi_bound
+    assert hi_bound == cap  # the cap is actually reached
+
+
+# ----------------------------------------------------------------------
+# Coordinator-side graceful degradation
+# ----------------------------------------------------------------------
+
+
+def test_midflight_death_mark_unblocks_blackholed_quorum(tmp_dir):
+    """A write stalled on a black-holed replica completes the moment
+    the failure detector marks that node Dead — the blind window is
+    bounded by detection, not by the 15 s read timeout — and the
+    mutation is hinted for the dead peer."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(
+            tmp_dir,
+            # Keep the soak-default detector OFF the critical path:
+            # the test calls handle_dead_node itself.
+            failure_detection_interval_ms=60_000,
+        )
+        try:
+            a = nodes[0].shards[0]
+            c_cfg = nodes[2].config
+            remote_comm.set_fault(
+                f"{c_cfg.ip}:{c_cfg.remote_shard_port}",
+                remote_comm.FAULT_BLACKHOLE,
+            )
+
+            async def detect_later():
+                await asyncio.sleep(0.3)
+                # Deterministic "failure detector fired" on node A.
+                await a.handle_dead_node(c_cfg.name)
+
+            # The key must route to node A as coordinator, so ITS
+            # fan-out (not another node's) hits the black hole.
+            key = _key_owned_by(client, nodes[0].config.name)
+            t0 = time.monotonic()
+            detector = asyncio.ensure_future(detect_later())
+            # W=3 needs both remote acks: node B acks, node C hangs.
+            await col.set(
+                key, {"v": 1}, consistency=Consistency.ALL
+            )
+            elapsed = time.monotonic() - t0
+            await detector
+            # Unblocked by the death mark (~0.3 s), nowhere near the
+            # 5 s op timeout / 15 s read timeout.
+            assert elapsed < 3.0, elapsed
+            assert c_cfg.name in a.dead_nodes
+            assert a.hints.get(c_cfg.name), "mutation not hinted"
+        finally:
+            remote_comm.clear_faults()
+            for n in nodes:
+                await n.stop()
+        client.close()
+
+    run(main(), timeout=60)
+
+
+def test_quorum_timeout_vs_peer_dead_error_frames(tmp_dir):
+    """Deadline expiry surfaces `Timeout` when the quorum was merely
+    slow/blind, and `PeerDead` when a fan-out target is known-Dead —
+    and the per-class server counters record both."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(
+            tmp_dir, failure_detection_interval_ms=60_000
+        )
+        try:
+            a = nodes[0].shards[0]
+            for n in nodes[1:]:
+                remote_comm.set_fault(
+                    f"{n.config.ip}:{n.config.remote_shard_port}",
+                    remote_comm.FAULT_BLACKHOLE,
+                )
+            request = {
+                "type": "set",
+                "collection": "fo",
+                # Routed to node A at replica 0 (we dial A directly:
+                # any other key would bounce with KeyNotOwnedByShard).
+                "key": _key_owned_by(client, nodes[0].config.name),
+                "value": 1,
+                "consistency": 2,
+                "timeout": 400,
+            }
+            with pytest.raises(DbeelError) as ei:
+                await client._send_to(
+                    *nodes[0].db_address, dict(request)
+                )
+            assert ei.value.kind == "Timeout", ei.value.kind
+
+            # Same stall, but now one hung target is marked Dead
+            # while the op waits: the error frame must say PeerDead.
+            b_name = nodes[1].config.name
+
+            async def mark_dead():
+                await asyncio.sleep(0.15)
+                a.dead_nodes.add(b_name)
+
+            marker = asyncio.ensure_future(mark_dead())
+            with pytest.raises(DbeelError) as ei:
+                await client._send_to(
+                    *nodes[0].db_address, dict(request)
+                )
+            await marker
+            assert ei.value.kind == "PeerDead", ei.value.kind
+
+            stats = a.metrics.snapshot()
+            assert stats["errors"]["quorum-timeout"] >= 1
+            assert stats["errors"]["peer-dead"] >= 1
+            for cls in errors.ERROR_CLASSES:
+                assert cls in stats["errors"]
+        finally:
+            remote_comm.clear_faults()
+            for n in nodes:
+                await n.stop()
+        client.close()
+
+    run(main(), timeout=60)
+
+
+def test_dead_peer_prefilter_fast_fails_without_dialing(tmp_dir):
+    """A fan-out whose connection list still contains a Dead-marked
+    node must hint-and-skip it synchronously — no dial, no stall."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        try:
+            shard = node.shards[0]
+            # Phantom peer on an unused port, marked Dead.
+            shard.shards.append(
+                Shard(
+                    node_name="ghost",
+                    name="ghost-0",
+                    connection=remote_comm.RemoteShardConnection(
+                        "127.0.0.1:1"
+                    ),
+                )
+            )
+            shard.sort_consistent_hash_ring()
+            shard.dead_nodes.add("ghost")
+            op_status = {}
+            t0 = time.monotonic()
+            results = await shard.send_request_to_replicas(
+                ShardRequest.set("c", b"k", b"v", 1),
+                number_of_acks=1,
+                number_of_nodes=1,
+                expected_kind="set",
+                op_status=op_status,
+            )
+            assert time.monotonic() - t0 < 1.0
+            assert results == []
+            assert op_status["peer_dead"] is True
+            assert op_status["targets"] == ["ghost"]
+            assert len(shard.hints.get("ghost", ())) == 1
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Satellites: persist_peers serialization, apply_if_newer stale-abort
+# ----------------------------------------------------------------------
+
+
+def test_persist_peers_stale_write_cannot_clobber_newer(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        try:
+            shard = node.shards[0]
+            path = f"{cfg.dir}/peers.json"
+            new_wire = [["n2", "127.0.0.1", 1, [0], 2, 3]]
+            old_wire = [["n1", "127.0.0.1", 1, [0], 2, 3]]
+            # Startup may already have persisted a snapshot: build on
+            # top of whatever version is current.
+            base = max(
+                shard._peers_version, shard._peers_written_version
+            )
+            shard._peers_version = base + 2
+            # Newer snapshot (base+2) lands first...
+            shard._persist_peers_write(new_wire, base + 2)
+            # ...then the stale base+1 write arrives late (the
+            # out-of-order pool-thread schedule from ADVICE low #1):
+            # it must be a no-op.
+            shard._persist_peers_write(old_wire, base + 1)
+            with open(path) as f:
+                assert json.load(f) == new_wire
+            # And a genuinely newer one still goes through.
+            shard._peers_version = base + 3
+            shard._persist_peers_write(old_wire, base + 3)
+            with open(path) as f:
+                assert json.load(f) == old_wire
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_apply_if_newer_below_watermark_still_lands(tmp_dir, arun):
+    """The stale-abort loop must not starve a below-watermark entry
+    that IS the newest for its key (a hint replayed after unrelated
+    flushes advanced the watermark), while still refusing an entry
+    older than the key's flushed version."""
+
+    async def main():
+        from dbeel_tpu.storage.lsm_tree import LSMTree
+
+        tree = LSMTree.open_or_create(
+            f"{tmp_dir}/t", cache=None, capacity=16
+        )
+        try:
+            await tree.set_with_timestamp(b"hot", b"v1", 1000)
+            await tree.flush()
+            assert tree.max_flushed_ts >= 1000
+            # Unrelated key, ts below the global watermark but newest
+            # for ITS key: must land (the plain stale_abort flag
+            # would refuse it forever).
+            assert await MyShard.apply_if_newer(
+                tree, b"cold", b"x", 500
+            )
+            assert await tree.get_entry(b"cold") == (b"x", 500)
+            # Older than the key's own flushed version: refused.
+            assert not await MyShard.apply_if_newer(
+                tree, b"hot", b"stale", 999
+            )
+            assert await tree.get_entry(b"hot") == (b"v1", 1000)
+            # Newer than everything: lands.
+            assert await MyShard.apply_if_newer(
+                tree, b"hot", b"v2", 2000
+            )
+            assert await tree.get_entry(b"hot") == (b"v2", 2000)
+        finally:
+            tree.close()
+
+    arun(main())
+
+
+def test_wal_fsync_error_counter_readable(tmp_dir):
+    """Satellite: the hub fsync-failure counter must be reachable
+    from Python (None when the native hub ABI is absent, a
+    non-negative int otherwise) and surfaced in get_stats."""
+    from dbeel_tpu.storage.wal import hub_fsync_errors
+
+    count = hub_fsync_errors()
+    assert count is None or (isinstance(count, int) and count >= 0)
+
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            stats = node.shards[0].get_stats()
+            assert "wal_fsync_errors" in stats
+            assert stats["wal_fsync_errors"] == hub_fsync_errors()
+            assert "dead_nodes" in stats
+            assert "hints_queued" in stats
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)

@@ -66,3 +66,39 @@ def test_request_histograms_over_the_wire(tmp_dir):
             await node.stop()
 
     run(main())
+
+
+def test_error_class_counters_over_the_wire(tmp_dir):
+    """Failure-taxonomy counters (ISSUE 1): every client-visible
+    failure lands in exactly one ERROR_CLASSES bucket; benign
+    outcomes (KeyNotFound) are NOT failures and stay uncounted."""
+    from dbeel_tpu.errors import ERROR_CLASSES, DbeelError
+
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection("e")
+            # A benign miss, then a real failure (unknown op type).
+            with pytest.raises(DbeelError):
+                await col.get("absent")
+            with pytest.raises(DbeelError):
+                await client._send_to(
+                    *node.db_address, {"type": "bogus-op"}
+                )
+            raw = await client._send_to(
+                *node.db_address, {"type": "get_stats"}
+            )
+            stats = msgpack.unpackb(raw, raw=False)
+            counters = stats["metrics"]["errors"]
+            for cls in ERROR_CLASSES:
+                assert cls in counters, cls
+            assert counters["other"] == 1  # the bogus op only
+            assert sum(counters.values()) == 1  # KeyNotFound uncounted
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main())
