@@ -40,12 +40,31 @@ def percentiles(samples):
 
 
 async def run_phase(
-    client, collection, op, keys, n_clients, value, consistency=None
+    client, collection, op, keys, n_clients, value, consistency=None,
+    batch=0,
 ):
+    """``batch=N`` switches the workers to multi_set/multi_get frames
+    of N keys each (per-op latency then reports the whole batch's
+    round trip for each constituent key — the honest cost of riding a
+    batch)."""
     latencies = []
 
     async def worker(worker_keys):
         col = client.collection(collection)
+        if batch:
+            for i in range(0, len(worker_keys), batch):
+                group = worker_keys[i : i + batch]
+                t0 = time.perf_counter()
+                if op == "set":
+                    await col.multi_set(
+                        [(k, value) for k in group], consistency
+                    )
+                else:
+                    got = await col.multi_get(group, consistency)
+                    assert all(v is not None for v in got)
+                dt = time.perf_counter() - t0
+                latencies.extend([dt] * len(group))
+            return
         for k in worker_keys:
             t0 = time.perf_counter()
             if op == "set":
@@ -68,7 +87,8 @@ async def run_phase(
 
 async def main_async(args):
     client = await DbeelClient.from_seed_nodes(
-        [(args.host, args.port)]
+        [(args.host, args.port)],
+        pipeline_window=args.pipeline or None,
     )
     from dbeel_tpu.errors import CollectionAlreadyExists
 
@@ -92,7 +112,7 @@ async def main_async(args):
     }[args.consistency]
     total, lat = await run_phase(
         client, args.collection, "set", keys, args.clients, value,
-        consistency,
+        consistency, batch=args.batch,
     )
     print(
         f"set: total {total:.3f}s "
@@ -102,12 +122,13 @@ async def main_async(args):
     rng.shuffle(keys)
     total, lat = await run_phase(
         client, args.collection, "get", keys, args.clients, value,
-        consistency,
+        consistency, batch=args.batch,
     )
     print(
         f"get: total {total:.3f}s "
         f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
     )
+    client.close()
 
 
 def main_native(args):
@@ -152,15 +173,71 @@ def main_native(args):
                 errors.append(e)
                 return
             try:
-                for k in keys[wi * chunk : (wi + 1) * chunk]:
-                    t0 = time.perf_counter()
-                    if op == "set":
-                        cli.set(
-                            args.collection, k, value, consistency, rf
+                my_keys = keys[wi * chunk : (wi + 1) * chunk]
+                if args.pipeline:
+                    # Windowed pipelining, one C call per train of
+                    # 1000 ops (the call releases the GIL for the
+                    # whole train).  Per-op latency reports the
+                    # train's wall clock spread over its ops — the
+                    # honest cost of riding a train.
+                    train = 1000
+                    for i in range(0, len(my_keys), train):
+                        group = my_keys[i : i + train]
+                        t0 = time.perf_counter()
+                        fails = cli.pipe_run(
+                            args.collection,
+                            op,
+                            group,
+                            [value] * len(group)
+                            if op == "set"
+                            else None,
+                            consistency,
+                            rf,
+                            args.pipeline,
                         )
-                    else:
-                        cli.get(args.collection, k, consistency, rf)
-                    lats[wi].append(time.perf_counter() - t0)
+                        if fails:
+                            raise RuntimeError(
+                                f"{fails} pipelined ops failed"
+                            )
+                        dt = time.perf_counter() - t0
+                        lats[wi].extend(
+                            [dt / max(1, len(group))] * len(group)
+                        )
+                elif args.batch:
+                    for i in range(0, len(my_keys), args.batch):
+                        group = my_keys[i : i + args.batch]
+                        t0 = time.perf_counter()
+                        if op == "set":
+                            cli.multi_set(
+                                args.collection,
+                                [(k, value) for k in group],
+                                consistency,
+                                rf,
+                            )
+                        else:
+                            got = cli.multi_get(
+                                args.collection, group,
+                                consistency, rf,
+                            )
+                            if any(v is None for v in got):
+                                raise RuntimeError(
+                                    "multi_get missed a written key"
+                                )
+                        dt = time.perf_counter() - t0
+                        lats[wi].extend([dt] * len(group))
+                else:
+                    for k in my_keys:
+                        t0 = time.perf_counter()
+                        if op == "set":
+                            cli.set(
+                                args.collection, k, value,
+                                consistency, rf,
+                            )
+                        else:
+                            cli.get(
+                                args.collection, k, consistency, rf
+                            )
+                        lats[wi].append(time.perf_counter() - t0)
             except Exception as e:
                 errors.append(e)
             finally:
@@ -217,7 +294,25 @@ def main():
         help="drive the load through the compiled C++ client "
         "(native/src/dbeel_client.cpp) on OS threads",
     )
+    ap.add_argument(
+        "--pipeline",
+        type=int,
+        default=0,
+        metavar="WINDOW",
+        help="pipelined mode: keep WINDOW requests in flight per "
+        "connection instead of lockstep round trips",
+    )
+    ap.add_argument(
+        "--batch",
+        type=int,
+        default=0,
+        metavar="N",
+        help="batched mode: multi_set/multi_get frames of N keys "
+        "grouped by owning node",
+    )
     args = ap.parse_args()
+    if args.pipeline and args.batch:
+        ap.error("--pipeline and --batch are separate phases")
     if args.native_client:
         main_native(args)
     else:

@@ -14,14 +14,16 @@ import asyncio
 import random
 import struct
 from bisect import bisect_left
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import msgpack
 
 from ..errors import (
     ConnectionError_,
     DbeelError,
+    KeyNotFound,
     KeyNotOwnedByShard,
     ProtocolError,
     Timeout,
@@ -65,6 +67,119 @@ class _RingShard:
     db_port: int  # already shard-specific (base + id)
 
 
+class _PipelinedConnection:
+    """One keepalive connection multiplexing many in-flight requests.
+
+    The server answers pipelined frames strictly in arrival order
+    (db_server._DbProtocol), so response dispatch is a FIFO: the j-th
+    response frame resolves the j-th outstanding future.  A semaphore
+    caps the in-flight window; writes go out as one buffer append per
+    frame (atomic on the loop), and a single reader task fans
+    responses back out.  Any transport error fails EVERY outstanding
+    future with ConnectionError_ — callers treat that as the usual
+    replica-walk transport failure and retry elsewhere."""
+
+    def __init__(self, host: str, port: int, window: int) -> None:
+        self.host = host
+        self.port = port
+        self._window = max(1, window)
+        self._sem = asyncio.Semaphore(self._window)
+        self._fifo: deque = deque()  # futures awaiting responses
+        self._reader_task = None
+        self._reader = None
+        self._writer = None
+        self._connecting: Optional[asyncio.Future] = None
+        self._broken: Optional[Exception] = None
+
+    @property
+    def usable(self) -> bool:
+        return self._broken is None
+
+    async def _ensure_connected(self) -> None:
+        # Single-flight dial: concurrent first requests must share
+        # ONE connection — a second open_connection would overwrite
+        # the streams under the first reader task and split response
+        # frames between two readexactly loops.
+        while self._connecting is not None:
+            await asyncio.shield(self._connecting)
+        if self._writer is not None:
+            return
+        self._connecting = asyncio.get_event_loop().create_future()
+        try:
+            self._reader, self._writer = (
+                await asyncio.open_connection(self.host, self.port)
+            )
+            self._reader_task = asyncio.ensure_future(
+                self._read_loop()
+            )
+        finally:
+            fut, self._connecting = self._connecting, None
+            fut.set_result(None)
+
+    async def request(self, request_buf: bytes) -> bytes:
+        """One framed round trip through the pipeline; returns the
+        raw response payload (length prefix stripped)."""
+        # Frame BEFORE queueing the future: an oversized request's
+        # struct.error must not leave an orphan FIFO slot that would
+        # misalign every later response.
+        framed = struct.pack("<H", len(request_buf)) + request_buf
+        async with self._sem:
+            if self._broken is not None:
+                raise ConnectionError_(
+                    f"pipelined connection to "
+                    f"{self.host}:{self.port} broken: {self._broken!r}"
+                )
+            await self._ensure_connected()
+            fut = asyncio.get_event_loop().create_future()
+            self._fifo.append(fut)
+            self._writer.write(framed)
+            # Transport-buffer backpressure (the window bounds how
+            # many writes can be outstanding before this drain).
+            await self._writer.drain()
+            return await fut
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                header = await self._reader.readexactly(4)
+                (size,) = struct.unpack("<I", header)
+                payload = await self._reader.readexactly(size)
+                if not self._fifo:
+                    raise ProtocolError(
+                        "unsolicited pipelined response"
+                    )
+                fut = self._fifo.popleft()
+                if not fut.done():
+                    fut.set_result(payload)
+        except BaseException as e:  # noqa: BLE001 — fail everything
+            self._fail(e)
+
+    def _fail(self, exc: BaseException) -> None:
+        self._broken = exc if isinstance(
+            exc, Exception
+        ) else ConnectionError_(repr(exc))
+        while self._fifo:
+            fut = self._fifo.popleft()
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError_(
+                        f"pipelined connection to "
+                        f"{self.host}:{self.port} lost: {exc!r}"
+                    )
+                )
+        self.close()
+
+    def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self._broken is None:
+            self._broken = ConnectionError_("closed")
+
+
 class DbeelClient:
     """``pooled=True`` (default) reuses connections via the keepalive
     protocol extension; pass False for strict reference behavior
@@ -75,7 +190,14 @@ class DbeelClient:
     next ring replica; an exhausted walk resyncs the ring (churn moves
     ownership) and retries after capped exponential backoff with
     jitter, until the budget runs out.  Benign application outcomes
-    (KeyNotFound et al.) are final immediately."""
+    (KeyNotFound et al.) are final immediately.
+
+    ``pipeline_window=N`` (N >= 1) switches transport to PIPELINED
+    connections: one keepalive connection per target multiplexes up
+    to N concurrent requests (the server executes them concurrently
+    and answers in arrival order), so M coroutines hitting one shard
+    share one socket and overlap their round trips instead of
+    serializing on a per-request pool checkout."""
 
     MAX_POOL_PER_TARGET = 8
     OP_DEADLINE_S = 10.0
@@ -87,6 +209,7 @@ class DbeelClient:
         seed_addresses: Sequence[Tuple[str, int]],
         pooled: bool = True,
         op_deadline_s: Optional[float] = None,
+        pipeline_window: Optional[int] = None,
     ):
         self._seeds = list(seed_addresses)
         self._ring: List[_RingShard] = []
@@ -94,6 +217,8 @@ class DbeelClient:
         self._collections: dict = {}
         self._pooled = pooled
         self._pool: dict = {}  # (host, port) -> [(reader, writer)]
+        self._pipeline_window = pipeline_window
+        self._pipes: Dict[tuple, _PipelinedConnection] = {}
         self._op_deadline_s = (
             self.OP_DEADLINE_S if op_deadline_s is None else op_deadline_s
         )
@@ -179,10 +304,38 @@ class DbeelClient:
         (size,) = struct.unpack("<I", header)
         return await reader.readexactly(size)
 
+    def _pipe_for(self, host: str, port: int) -> _PipelinedConnection:
+        key = (host, port)
+        pipe = self._pipes.get(key)
+        if pipe is None or not pipe.usable:
+            pipe = _PipelinedConnection(
+                host, port, self._pipeline_window
+            )
+            self._pipes[key] = pipe
+        return pipe
+
     async def _send_to(self, host: str, port: int, request: dict) -> bytes:
         """One request/response round trip (u16-len request; u32-len
         response + trailing type byte), over a pooled keepalive
-        connection when enabled."""
+        connection (or the target's pipelined connection) when
+        enabled."""
+        if self._pipeline_window:
+            request = dict(request)
+            request["keepalive"] = True
+            try:
+                payload = await self._pipe_for(host, port).request(
+                    msgpack.packb(request, use_bin_type=True)
+                )
+            except (OSError, asyncio.IncompleteReadError) as e:
+                raise ConnectionError_(
+                    f"pipelined request to {host}:{port}: {e}"
+                ) from e
+            if not payload:
+                raise ProtocolError("empty response")
+            body, rtype = payload[:-1], payload[-1]
+            if rtype == RESPONSE_ERR:
+                raise from_wire(msgpack.unpackb(body, raw=False))
+            return body
         payload = None
         if self._pooled:
             request = dict(request)
@@ -233,6 +386,9 @@ class DbeelClient:
             for _r, w in conns:
                 w.close()
         self._pool.clear()
+        for pipe in self._pipes.values():
+            pipe.close()
+        self._pipes.clear()
 
     # -- routing (lib.rs:336-417) ---------------------------------------
 
@@ -375,6 +531,180 @@ class DbeelClient:
             "no replica reachable"
         )
 
+    # -- batched multi-ops --------------------------------------------
+
+    # Per-frame bounds: the request framing is u16-LE, so a batch
+    # frame must stay comfortably under 64 KiB; the op count cap
+    # bounds server-side allocation fan per frame.
+    MULTI_MAX_OPS_PER_FRAME = 256
+    MULTI_MAX_BYTES_PER_FRAME = 48 << 10
+
+    async def _multi_request(
+        self,
+        collection: str,
+        rf: int,
+        is_set: bool,
+        keys: list,
+        values: list,
+        consistency: Optional[int],
+    ) -> list:
+        """Group sub-ops by owning coordinator via the ring, send ONE
+        multi frame per node (chunked under the u16 frame bound), and
+        fail over per sub-op: any sub-op that comes back with a
+        retryable/ownership error — or whose whole frame failed —
+        re-runs through the single-op replica walk (full PR-1
+        failover: walk, resync, backoff, deadline).  Returns outcomes
+        aligned with ``keys``: ("ok", payload) or ("err", exc)."""
+        n = len(keys)
+        enc = [
+            msgpack.packb(k, use_bin_type=True) for k in keys
+        ]
+        hashes = [hash_bytes(e) for e in enc]
+        outcomes: list = [None] * n
+        groups: Dict[tuple, list] = {}
+        for i, h in enumerate(hashes):
+            shard = self._shards_for_key(h, max(1, rf))[0]
+            groups.setdefault((shard.ip, shard.db_port), []).append(i)
+
+        rtype = "multi_set" if is_set else "multi_get"
+
+        async def send_chunk(addr: tuple, idxs: list) -> None:
+            ops = [
+                [keys[i], hashes[i], values[i]]
+                if is_set
+                else [keys[i], hashes[i]]
+                for i in idxs
+            ]
+            request: dict = {
+                "type": rtype,
+                "collection": collection,
+                "ops": ops,
+                "replica_index": 0,
+                # Coordinator-side bound, mirroring _sharded_request:
+                # the batch's quorum wait must not outlive our own
+                # deadline budget.
+                "timeout": max(
+                    100, min(5000, int(self._op_deadline_s * 1000))
+                ),
+            }
+            if consistency is not None:
+                request["consistency"] = consistency
+            try:
+                try:
+                    # Deadline-bound like every single op (a black-
+                    # holed coordinator must fail the chunk over to
+                    # the per-sub-op walk, not hang the batch).
+                    raw = await asyncio.wait_for(
+                        self._send_to(addr[0], addr[1], request),
+                        self._op_deadline_s,
+                    )
+                except struct.error:
+                    # Frame overflowed the u16 bound (values are not
+                    # pre-measured — serializing them twice just to
+                    # size chunks would double client CPU on the hot
+                    # batch path): split and retry.
+                    if len(idxs) == 1:
+                        outcomes[idxs[0]] = (
+                            "err",
+                            ProtocolError(
+                                "sub-op exceeds the u16 frame bound"
+                            ),
+                        )
+                        return
+                    mid = len(idxs) // 2
+                    await send_chunk(addr, idxs[:mid])
+                    await send_chunk(addr, idxs[mid:])
+                    return
+                results = msgpack.unpackb(raw, raw=False)
+                if (
+                    not isinstance(results, list)
+                    or len(results) != len(idxs)
+                ):
+                    raise ProtocolError("bad multi response shape")
+            except (
+                DbeelError,
+                OSError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+            ) as e:
+                # Whole-frame failure (dead coordinator, stale ring
+                # collection, transport): every sub-op falls back to
+                # the single-op walk.
+                for i in idxs:
+                    outcomes[i] = ("retry", e)
+                return
+            for i, res in zip(idxs, results):
+                status, payload = res[0], res[1]
+                if status == 0:
+                    outcomes[i] = ("ok", payload)
+                    continue
+                e = from_wire(payload)
+                if isinstance(e, KeyNotOwnedByShard) or (
+                    is_retryable_class(classify_error(e))
+                ):
+                    outcomes[i] = ("retry", e)
+                else:
+                    outcomes[i] = ("err", e)
+
+        # Chunk by op count and KEY bytes only — value sizes are not
+        # pre-measured (that would serialize every value twice); a
+        # chunk whose packed frame still overflows the u16 bound is
+        # split on struct.error inside send_chunk.
+        chunks: List[tuple] = []
+        for addr, idxs in groups.items():
+            cur: list = []
+            cur_bytes = 0
+            for i in idxs:
+                op_bytes = len(enc[i]) + 16
+                if cur and (
+                    len(cur) >= self.MULTI_MAX_OPS_PER_FRAME
+                    or cur_bytes + op_bytes
+                    > self.MULTI_MAX_BYTES_PER_FRAME
+                ):
+                    chunks.append((addr, cur))
+                    cur, cur_bytes = [], 0
+                cur.append(i)
+                cur_bytes += op_bytes
+            if cur:
+                chunks.append((addr, cur))
+        await asyncio.gather(
+            *(send_chunk(addr, idxs) for addr, idxs in chunks)
+        )
+
+        retries = [
+            i for i in range(n) if outcomes[i][0] == "retry"
+        ]
+        if retries:
+            async def walk_one(i: int) -> None:
+                request: dict = {
+                    "type": "set" if is_set else "get",
+                    "collection": collection,
+                    "key": keys[i],
+                }
+                if is_set:
+                    request["value"] = values[i]
+                if consistency is not None:
+                    request["consistency"] = consistency
+                try:
+                    body = await self._sharded_request(
+                        keys[i], request, rf
+                    )
+                    outcomes[i] = ("ok", None if is_set else body)
+                except (
+                    DbeelError,
+                    OSError,
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,
+                ) as e:
+                    # _sharded_request re-raises its LAST transport
+                    # error raw (OSError et al.) when the walk
+                    # exhausts — one dead sub-op must become an
+                    # aligned outcome, not abort the whole batch.
+                    outcomes[i] = ("err", e)
+
+            await asyncio.gather(*(walk_one(i) for i in retries))
+        return outcomes
+
     # -- public API (lib.rs:482-619) -------------------------------------
 
     async def create_collection(
@@ -444,6 +774,71 @@ class DbeelCollection:
             key, request, self.replication_factor
         )
         return msgpack.unpackb(raw, raw=False)
+
+    async def multi_set(
+        self, items, consistency=None
+    ) -> None:
+        """Batched set: ``items`` is a dict or an iterable of
+        (key, value) pairs.  Keys are grouped by owning coordinator
+        and travel one frame per node (multi_set); failed sub-ops
+        fall back to the single-op replica walk.  Raises the first
+        sub-op error (all other sub-ops still complete)."""
+        pairs = (
+            list(items.items())
+            if isinstance(items, dict)
+            else list(items)
+        )
+        if not pairs:
+            return
+        resolved = (
+            Consistency.resolve(consistency, self.replication_factor)
+            if consistency is not None
+            else None
+        )
+        outcomes = await self.client._multi_request(
+            self.name,
+            self.replication_factor,
+            True,
+            [k for k, _v in pairs],
+            [v for _k, v in pairs],
+            resolved,
+        )
+        for kind, payload in outcomes:
+            if kind == "err":
+                raise payload
+
+    async def multi_get(
+        self, keys: Sequence[Any], consistency=None
+    ) -> list:
+        """Batched get: returns values aligned with ``keys`` (None
+        for missing keys).  One frame per owning node; failed sub-ops
+        fall back to the single-op replica walk.  Raises the first
+        non-KeyNotFound sub-op error."""
+        keys = list(keys)
+        if not keys:
+            return []
+        resolved = (
+            Consistency.resolve(consistency, self.replication_factor)
+            if consistency is not None
+            else None
+        )
+        outcomes = await self.client._multi_request(
+            self.name,
+            self.replication_factor,
+            False,
+            keys,
+            [None] * len(keys),
+            resolved,
+        )
+        out = []
+        for kind, payload in outcomes:
+            if kind == "ok":
+                out.append(msgpack.unpackb(payload, raw=False))
+            elif isinstance(payload, KeyNotFound):
+                out.append(None)
+            else:
+                raise payload
+        return out
 
     async def delete(self, key: Any, consistency=None) -> None:
         request = {

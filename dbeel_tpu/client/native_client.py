@@ -85,6 +85,69 @@ def _bind(lib) -> None:
         u8p,
         ctypes.c_uint64,
     ]
+    if hasattr(lib, "dbeel_cli_pipe_set"):  # stale .so tolerance
+        lib.dbeel_cli_pipe_set.restype = ctypes.c_int
+        lib.dbeel_cli_pipe_set.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint32,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.dbeel_cli_pipe_get.restype = ctypes.c_int
+        lib.dbeel_cli_pipe_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.dbeel_cli_pipe_drain.restype = ctypes.c_int64
+        lib.dbeel_cli_pipe_drain.argtypes = [ctypes.c_void_p]
+        lib.dbeel_cli_pipe_run.restype = ctypes.c_int64
+        lib.dbeel_cli_pipe_run.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            u8p,
+            ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+    if hasattr(lib, "dbeel_cli_multi_set"):
+        lib.dbeel_cli_multi_set.restype = ctypes.c_int64
+        lib.dbeel_cli_multi_set.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_uint32,
+            u8p,
+        ]
+        lib.dbeel_cli_multi_get.restype = ctypes.c_int64
+        lib.dbeel_cli_multi_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint64,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_uint32,
+            u8p,
+            ctypes.c_uint64,
+        ]
     lib._cli_bound = True
 
 
@@ -228,6 +291,220 @@ class NativeDbeelClient:
         if n < 0:
             raise DbeelError(self._err())
         return msgpack.unpackb(bytes(self._buf[: int(n)]), raw=False)
+
+    # -- pipelined mode (windowed in-flight train per connection) ------
+
+    def pipe_set(
+        self,
+        collection: str,
+        key: Any,
+        value: Any,
+        consistency: int = 0,
+        rf: int = 1,
+        window: int = 16,
+    ) -> None:
+        """Enqueue one set on the pipelined train (replica-0 routed);
+        at most ``window`` responses ride unread per connection.
+        Application errors surface at pipe_drain()."""
+        k, v = self._enc(key), self._enc(value)
+        rc = self._lib.dbeel_cli_pipe_set(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            (ctypes.c_uint8 * len(v)).from_buffer_copy(v),
+            len(v),
+            consistency,
+            rf,
+            window,
+        )
+        if rc != 0:
+            raise DbeelError(self._err())
+
+    def pipe_get(
+        self,
+        collection: str,
+        key: Any,
+        consistency: int = 0,
+        rf: int = 1,
+        window: int = 16,
+    ) -> None:
+        """Enqueue one get on the pipelined train (value discarded —
+        throughput-path API; correctness checks use get())."""
+        k = self._enc(key)
+        rc = self._lib.dbeel_cli_pipe_get(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            consistency,
+            rf,
+            window,
+        )
+        if rc != 0:
+            raise DbeelError(self._err())
+
+    def pipe_run(
+        self,
+        collection: str,
+        op: str,
+        keys,
+        values=None,
+        consistency: int = 0,
+        rf: int = 1,
+        window: int = 16,
+    ) -> int:
+        """Pipeline a whole train of ops in ONE C call (the ctypes
+        boundary releases the GIL for the entire train, so worker
+        threads overlap fully) and drain it; returns the application
+        failure count.  ``op`` is "set" (values required) or "get"."""
+        keys = list(keys)
+        if not keys:
+            return 0
+        is_set = op == "set"
+        kbuf = bytearray()
+        for key in keys:
+            k = self._enc(key)
+            kbuf += len(k).to_bytes(4, "little") + k
+        vbuf = bytearray()
+        if is_set:
+            for value in values:
+                v = self._enc(value)
+                vbuf += len(v).to_bytes(4, "little") + v
+        rc = int(
+            self._lib.dbeel_cli_pipe_run(
+                self._h,
+                collection.encode(),
+                1 if is_set else 0,
+                (ctypes.c_uint8 * len(kbuf)).from_buffer(kbuf),
+                len(kbuf),
+                (ctypes.c_uint8 * len(vbuf)).from_buffer(vbuf)
+                if vbuf
+                else None,
+                len(vbuf),
+                len(keys),
+                consistency,
+                rf,
+                window,
+            )
+        )
+        if rc < 0:
+            raise DbeelError(self._err())
+        return rc
+
+    def pipe_drain(self) -> int:
+        """Read every outstanding pipelined response; returns how
+        many were application errors (0 on a healthy run)."""
+        rc = int(self._lib.dbeel_cli_pipe_drain(self._h))
+        if rc < 0:
+            raise DbeelError(self._err())
+        return rc
+
+    # -- batched multi-ops ---------------------------------------------
+
+    def multi_set(
+        self,
+        collection: str,
+        items,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> None:
+        """Batched set: one multi_set frame per owning node (C-side
+        grouping/chunking); sub-ops the batch path could not land
+        retry through the single-op walk (full failover)."""
+        pairs = (
+            list(items.items())
+            if isinstance(items, dict)
+            else list(items)
+        )
+        if not pairs:
+            return
+        buf = bytearray()
+        for key, value in pairs:
+            k, v = self._enc(key), self._enc(value)
+            buf += len(k).to_bytes(4, "little") + k
+            buf += len(v).to_bytes(4, "little") + v
+        status = (ctypes.c_uint8 * len(pairs))()
+        rc = self._lib.dbeel_cli_multi_set(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(buf)).from_buffer(buf),
+            len(buf),
+            len(pairs),
+            consistency,
+            rf,
+            status,
+        )
+        if rc < 0:
+            raise DbeelError(self._err())
+        for i in range(len(pairs)):
+            if status[i]:
+                self.set(
+                    collection, pairs[i][0], pairs[i][1],
+                    consistency, rf,
+                )
+
+    def multi_get(
+        self,
+        collection: str,
+        keys,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> list:
+        """Batched get: returns values aligned with ``keys`` (None
+        for missing); retryable sub-ops fall back to the single-op
+        walk."""
+        keys = list(keys)
+        if not keys:
+            return []
+        buf = bytearray()
+        for key in keys:
+            k = self._enc(key)
+            buf += len(k).to_bytes(4, "little") + k
+        kb = (ctypes.c_uint8 * len(buf)).from_buffer(buf)
+        if self._buf is None:
+            self._buf = (ctypes.c_uint8 * _GET_BUF_INITIAL)()
+        for _ in range(2):
+            n = self._lib.dbeel_cli_multi_get(
+                self._h,
+                collection.encode(),
+                kb,
+                len(buf),
+                len(keys),
+                consistency,
+                rf,
+                self._buf,
+                len(self._buf),
+            )
+            if n <= -10:
+                needed = -int(n) - 10
+                if needed > _GET_BUF_MAX:
+                    raise DbeelError(self._err())
+                self._buf = (ctypes.c_uint8 * needed)()
+                continue
+            break
+        if n < 0:
+            raise DbeelError(self._err())
+        raw = bytes(self._buf[: int(n)])
+        out: list = []
+        off = 0
+        for i in range(len(keys)):
+            st = raw[off]
+            vn = int.from_bytes(raw[off + 1 : off + 5], "little")
+            payload = raw[off + 5 : off + 5 + vn]
+            off += 5 + vn
+            if st == 0:
+                out.append(msgpack.unpackb(payload, raw=False))
+            elif st == 1:
+                out.append(None)
+            else:
+                try:
+                    out.append(
+                        self.get(collection, keys[i], consistency, rf)
+                    )
+                except KeyNotFound:
+                    out.append(None)
+        return out
 
     def delete(
         self,

@@ -96,6 +96,8 @@ class ShardRequest:
     DELETE = "delete"
     GET = "get"
     GET_DIGEST = "get_digest"
+    MULTI_SET = "multi_set"
+    MULTI_GET = "multi_get"
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
@@ -139,6 +141,23 @@ class ShardRequest:
         answers (timestamp, murmur3_32(value)) instead of the value,
         so agreeing replicas cost a byte-compare, not a payload."""
         return ["request", ShardRequest.GET_DIGEST, collection, key]
+
+    @staticmethod
+    def multi_set(collection: str, entries: list) -> list:
+        """Batched replica mutation: ``entries`` is
+        [[key, value, ts], ...] (tombstone value = delete).  ONE
+        frame and ONE ack per peer per client batch, instead of one
+        round trip per sub-op — the replica applies each entry under
+        the same watermark guard as a single SET."""
+        return [
+            "request", ShardRequest.MULTI_SET, collection, entries
+        ]
+
+    @staticmethod
+    def multi_get(collection: str, keys: list) -> list:
+        """Batched replica read: the response carries one entry (or
+        nil) per key, aligned with ``keys``."""
+        return ["request", ShardRequest.MULTI_GET, collection, keys]
 
     @staticmethod
     def range_digest(
@@ -204,6 +223,8 @@ class ShardResponse:
     DELETE = "delete"
     GET = "get"
     GET_DIGEST = "get_digest"
+    MULTI_SET = "multi_set"
+    MULTI_GET = "multi_get"
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
@@ -258,6 +279,15 @@ class ShardResponse:
             "response",
             ShardResponse.GET_DIGEST,
             [ts, murmur3_32(bytes(value))],
+        ]
+
+    @staticmethod
+    def multi_get(entries: list) -> list:
+        # One [value, ts] (or None) per requested key, same order.
+        return [
+            "response",
+            ShardResponse.MULTI_GET,
+            [list(e) if e is not None else None for e in entries],
         ]
 
     @staticmethod

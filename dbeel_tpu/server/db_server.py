@@ -205,6 +205,9 @@ async def handle_request(
                 raise Timeout(rtype) from e
         return None
 
+    if rtype in ("multi_set", "multi_get"):
+        return await _handle_multi(my_shard, request, timestamp, rtype)
+
     if rtype == "get":
         collection_name = _extract(request, "collection")
         timeout_ms = request.get("timeout") or DEFAULT_GET_TIMEOUT_MS
@@ -306,6 +309,221 @@ async def handle_request(
     if isinstance(rtype, str):
         raise UnsupportedField(rtype)
     raise BadFieldType("type")
+
+
+# Batched multi-op bounds: ops per frame (the u16 request framing is
+# its own byte bound; this caps the per-frame allocation fan).
+MULTI_MAX_OPS = 4096
+
+
+async def _handle_multi(
+    my_shard: MyShard, request: dict, timestamp: int, rtype: str
+) -> bytes:
+    """One multi_set/multi_get frame: N sub-ops in, ONE response frame
+    with N aligned results out.  Each result is ``[0, payload]`` (ok —
+    payload is the value bytes for gets, nil for sets) or
+    ``[1, [kind, message]]`` (per-sub-op error in the standard wire
+    error shape), so a client can fail over individual keys without
+    losing the rest of the batch.
+
+    The batch shares everything a per-op loop would repeat: ownership
+    checks ride one ring lookup each but the storage work batches —
+    one memtable capacity check + one WAL append_batch + one wal-sync
+    ticket for sets (group commit), one sstable-list acquire for gets
+    (LSMTree.multi_get) — and RF>1 batches fan out ONE peer frame per
+    replica (ShardRequest.multi_set/multi_get) with a single quorum
+    wait for the whole batch, instead of a frame per sub-op."""
+    collection_name = _extract(request, "collection")
+    ops = _extract(request, "ops")
+    if not isinstance(ops, (list, tuple)):
+        raise BadFieldType("ops")
+    if len(ops) > MULTI_MAX_OPS:
+        raise BadFieldType(f"ops: batch above {MULTI_MAX_OPS}")
+    is_set = rtype == "multi_set"
+    timeout_ms = request.get("timeout") or (
+        DEFAULT_SET_TIMEOUT_MS if is_set else DEFAULT_GET_TIMEOUT_MS
+    )
+    replica_index = request.get("replica_index") or 0
+    col = my_shard.get_collection(collection_name)
+    rf = col.replication_factor
+    consistency = request.get("consistency")
+    if not isinstance(consistency, int):
+        consistency = rf
+    consistency = min(consistency, rf)
+    my_shard.metrics.record_batch_size(len(ops))
+
+    results: list = [None] * len(ops)
+    keyed: list = []  # (result_index, key_bytes[, value_bytes])
+    min_fields = 3 if is_set else 2
+    for i, op in enumerate(ops):
+        try:
+            if (
+                not isinstance(op, (list, tuple))
+                or len(op) < min_fields
+            ):
+                raise BadFieldType("ops")
+            key = _encode_field(op[0])
+            key_hash = op[1]
+            if not isinstance(key_hash, int):
+                key_hash = hash_bytes(key)
+            if not my_shard.owns_key(key_hash, replica_index):
+                raise KeyNotOwnedByShard(
+                    f"shard {my_shard.shard_name} does not own "
+                    f"hash {key_hash}"
+                )
+            if is_set:
+                keyed.append((i, key, _encode_field(op[2])))
+            else:
+                keyed.append((i, key))
+        except DbeelError as e:
+            my_shard.metrics.record_error(classify_error(e))
+            results[i] = [1, e.to_wire()]
+    if not keyed:
+        return msgpack.packb(results, use_bin_type=True)
+
+    if is_set:
+        await _multi_set_keyed(
+            my_shard,
+            collection_name,
+            col,
+            keyed,
+            results,
+            timestamp,
+            consistency,
+            rf,
+            replica_index,
+            timeout_ms,
+        )
+    else:
+        await _multi_get_keyed(
+            my_shard,
+            collection_name,
+            col,
+            keyed,
+            results,
+            consistency,
+            rf,
+            replica_index,
+            timeout_ms,
+        )
+    return msgpack.packb(results, use_bin_type=True)
+
+
+async def _multi_set_keyed(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    keyed: list,
+    results: list,
+    timestamp: int,
+    consistency: int,
+    rf: int,
+    replica_index: int,
+    timeout_ms: int,
+) -> None:
+    entries = [(key, value, timestamp) for _i, key, value in keyed]
+    op_status: dict = {}
+    try:
+        local = col.tree.set_batch_with_timestamp(entries)
+        if rf > 1:
+            remote = my_shard.send_request_to_replicas(
+                ShardRequest.multi_set(
+                    collection_name,
+                    [[k, v, ts] for k, v, ts in entries],
+                ),
+                consistency - 1,
+                rf - replica_index - 1,
+                ShardResponse.MULTI_SET,
+                op_status=op_status,
+            )
+            await asyncio.wait_for(
+                asyncio.gather(local, remote), timeout_ms / 1000
+            )
+        else:
+            await asyncio.wait_for(local, timeout_ms / 1000)
+    except asyncio.TimeoutError:
+        err = _quorum_error(my_shard, "multi_set", op_status)
+        my_shard.metrics.record_error(classify_error(err))
+        wire = err.to_wire()
+        for i, *_rest in keyed:
+            results[i] = [1, wire]
+        return
+    for i, *_rest in keyed:
+        results[i] = [0, None]
+
+
+async def _multi_get_keyed(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    keyed: list,
+    results: list,
+    consistency: int,
+    rf: int,
+    replica_index: int,
+    timeout_ms: int,
+) -> None:
+    keys = [key for _i, key in keyed]
+    op_status: dict = {}
+    number_of_nodes = rf - replica_index - 1
+    try:
+        local = col.tree.multi_get(keys)
+        if rf > 1:
+            # Full-entry round only: the digest prediction is a
+            # per-key byte-compare trick and does not compose with
+            # one-frame-per-peer batching (ARCHITECTURE.md).
+            remote = my_shard.send_request_to_replicas(
+                ShardRequest.multi_get(collection_name, keys),
+                consistency - 1,
+                number_of_nodes,
+                ShardResponse.MULTI_GET,
+                op_status=op_status,
+            )
+            local_map, replica_lists = await asyncio.wait_for(
+                asyncio.gather(local, remote), timeout_ms / 1000
+            )
+        else:
+            local_map = await asyncio.wait_for(
+                local, timeout_ms / 1000
+            )
+            replica_lists = []
+    except asyncio.TimeoutError:
+        err = _quorum_error(my_shard, "multi_get", op_status)
+        my_shard.metrics.record_error(classify_error(err))
+        wire = err.to_wire()
+        for i, _key in keyed:
+            results[i] = [1, wire]
+        return
+    aligned = [
+        r
+        for r in replica_lists
+        if isinstance(r, (list, tuple)) and len(r) == len(keys)
+    ]
+    for j, (i, key) in enumerate(keyed):
+        local_value = local_map.get(key)
+        if rf > 1:
+            try:
+                win = _merge_quorum_get(
+                    my_shard,
+                    collection_name,
+                    col,
+                    key,
+                    local_value,
+                    [r[j] for r in aligned],
+                    number_of_nodes,
+                )
+                results[i] = [0, win]
+                continue
+            except KeyNotFound as e:
+                results[i] = [1, e.to_wire()]
+                continue
+        if (
+            local_value is None
+            or bytes(local_value[0]) == TOMBSTONE
+        ):
+            results[i] = [1, KeyNotFound(repr(key)).to_wire()]
+        else:
+            results[i] = [0, bytes(local_value[0])]
 
 
 def _digest_reads_enabled() -> bool:
@@ -469,6 +687,19 @@ async def _read_repair(
         my_shard.flow.notify(FlowEvent.READ_REPAIR)
     except Exception as e:
         log.warning("read repair for %r failed: %s", key, e)
+
+
+def _frame_response(buf: bytes) -> bytes:
+    """Wire envelope: u32-LE length + payload (incl. type byte)."""
+    return struct.pack("<I", len(buf)) + buf
+
+
+def _get_timeout_ms(req: dict) -> int:
+    """Per-op timeout field, defaulted/sanitized (wire input)."""
+    t = req.get("timeout")
+    if isinstance(t, int) and t > 0:
+        return t
+    return DEFAULT_GET_TIMEOUT_MS
 
 
 KEEPALIVE_IDLE_TIMEOUT_S = 300.0  # reap idle keepalive connections
@@ -643,17 +874,21 @@ async def _finish_coord_get(
     return win_value + bytes([RESPONSE_OK])
 
 
-async def _serve_frame(my_shard: MyShard, request_buf: bytes):
+async def _serve_frame(
+    my_shard: MyShard, request_buf: bytes, req: Optional[dict] = None
+):
     """One request frame → (response bytes incl. trailing type byte,
-    keepalive?)."""
+    keepalive?).  ``req`` may carry the already-unpacked request map
+    (the pipelined dispatcher parses frames once for batching)."""
     started = time.monotonic()
     op = "invalid"
     keepalive = False
     try:
-        try:
-            req = msgpack.unpackb(request_buf, raw=False)
-        except Exception as e:
-            raise BadFieldType(f"document: {e}") from e
+        if req is None:
+            try:
+                req = msgpack.unpackb(request_buf, raw=False)
+            except Exception as e:
+                raise BadFieldType(f"document: {e}") from e
         if not isinstance(req, dict):
             raise BadFieldType("document")
         op = str(req.get("type", "invalid"))
@@ -691,22 +926,51 @@ class _DbProtocol(framed.FramedServerProtocol):
     frame parsing happens in data_received with zero per-request
     timeout/stream machinery — the per-request `asyncio.wait_for` +
     two `readexactly` awaits of the stream version cost ~40µs/op on
-    this class of host.  Requests on one connection are answered in
-    arrival order; idle keepalive connections are reaped by one
+    this class of host.  Idle keepalive connections are reaped by one
     per-shard timer instead of a timeout per request.  Wire format
     unchanged: u16-LE request frames; u32-LE response length +
     payload + trailing type byte (db_server.rs:395-428).  Framing and
     backpressure live in FramedServerProtocol, shared with the peer
-    plane."""
+    plane.
+
+    Pipelined execution (ISSUE 2): up to PIPELINE_WINDOW queued
+    frames run CONCURRENTLY per connection — a head-of-line quorum
+    fan-out or parked WAL ack no longer serializes the frames behind
+    it — while responses are RELEASED strictly in arrival order
+    through the parked queue (the same mechanism that already ordered
+    wal-sync deferred acks), so the wire contract is unchanged: the
+    N-th response always answers the N-th request.  Native-fast
+    frames found behind a slow frame are answered synchronously at
+    dispatch and take an in-order parked slot instead of waiting for
+    the slow task."""
 
     HEADER = 2
     MAX_FRAME = None  # u16 length is its own bound
 
-    __slots__ = ("last_active",)
+    # Concurrent frames in flight per connection.  Beyond this the
+    # drain stops popping, `pending` grows, and the PENDING_HIGH
+    # read-pause applies the usual backpressure.
+    PIPELINE_WINDOW = 32
+    # Consecutive queued RF=1 gets coalesce into ONE internal
+    # multi_get task (shared memtable/sstable probe setup) — the
+    # drain-level mirror of the client's multi_get frames.
+    GET_BATCH_MAX = 64
+
+    __slots__ = (
+        "last_active",
+        "inflight",
+        "_slot_free",
+        "_get_batch",
+        "_get_batch_col",
+    )
 
     def __init__(self, my_shard: MyShard) -> None:
         super().__init__(my_shard)
         self.last_active = 0.0
+        self.inflight: set = set()
+        self._slot_free: "asyncio.Event | None" = None
+        self._get_batch: list = []  # (park entry, request map, t0)
+        self._get_batch_col: Optional[str] = None
 
     def _registry(self) -> set:
         return self.shard.db_connections
@@ -716,10 +980,16 @@ class _DbProtocol(framed.FramedServerProtocol):
 
     def _on_disconnect(self) -> None:
         # Client connections: nothing received is owed once the peer
-        # hangs up — stop serving and drop the backlog.
+        # hangs up — stop serving, drop the backlog, and cancel any
+        # in-flight pipelined work (a quorum fan-out for a client
+        # that left must not keep running detached).
         self.closing = True
         if self.task is not None:
             self.task.cancel()
+        for t in list(self.inflight):
+            t.cancel()
+        if self._slot_free is not None:
+            self._slot_free.set()
 
     def _on_data(self) -> None:
         self.last_active = asyncio.get_event_loop().time()
@@ -727,7 +997,9 @@ class _DbProtocol(framed.FramedServerProtocol):
 
     def _try_fast(self, frame: bytes) -> int:
         # A handled frame is answered synchronously right here — no
-        # task hop, no interpreter dispatch.
+        # task hop, no interpreter dispatch.  Only consulted by
+        # data_received when nothing is queued or in flight, so the
+        # direct transport.write cannot overtake a parked response.
         dp = self.shard.dataplane
         if dp is None:
             return framed.FAST_MISS
@@ -760,44 +1032,264 @@ class _DbProtocol(framed.FramedServerProtocol):
                 self.closing = True
                 return framed.FAST_CLOSE
             return framed.FAST_HANDLED
-        self.transport.write(resp)
         self.shard.metrics.record_request(op, started)
         if not keepalive:
             self.closing = True
-            self.transport.close()
+            self._write_out(resp, close=True)
             return framed.FAST_CLOSE
+        self._write_out(resp)
         return framed.FAST_HANDLED
 
-    async def _serve_one(self, frame: bytes) -> bool:
-        # Native coordinator assist for RF>1 writes: the C side
-        # parses + applies the local write and hands back the packed
-        # peer frame; only the fan-out/quorum brain stays here.
+    # -- pipelined drain --------------------------------------------
+
+    async def _drain(self) -> None:
+        try:
+            while self.pending and not self.closing:
+                if len(self.inflight) >= self.PIPELINE_WINDOW:
+                    # Window full: stop popping (pending grows and
+                    # the PENDING_HIGH read-pause backpressures the
+                    # socket) until a task completes.  Don't sit on
+                    # coalesced gets while waiting.
+                    self._flush_get_batch()
+                    if self._slot_free is None:
+                        self._slot_free = asyncio.Event()
+                    self._slot_free.clear()
+                    await self._slot_free.wait()
+                    continue
+                frame = self.pending.popleft()
+                if (
+                    self.paused_reading
+                    and len(self.pending) < self.PENDING_LOW
+                    and not self.transport.is_closing()
+                ):
+                    self.paused_reading = False
+                    self.transport.resume_reading()
+                if not self._dispatch(frame):
+                    return
+        except asyncio.CancelledError:
+            # Shard shutdown (or client disconnect) cancelled us:
+            # suppress the finally-respawn, or the orphan drain would
+            # outlive the cancellation snapshot and keep writing to
+            # trees the shard is about to close.
+            self.closing = True
+            raise
+        finally:
+            # Coalesced gets still owe their responses — even on the
+            # closing path (earlier in-order responses gate a parked
+            # non-keepalive close).
+            self._flush_get_batch()
+            self.task = None
+            # Frames may have arrived while we were finishing.
+            if self.pending and not self.closing:
+                self.task = self.shard.spawn(self._drain())
+
+    def _dispatch(self, frame: bytes) -> bool:
+        """Start serving one queued frame without awaiting its result:
+        natively-handled frames answer synchronously into an in-order
+        parked slot; consecutive RF=1 gets coalesce into one internal
+        multi_get task; everything else reserves its slot and runs as
+        a windowed concurrent task.  Returns False to stop draining
+        this connection."""
         dp = self.shard.dataplane
-        coord = (
-            dp.try_handle_coord(frame) if dp is not None else None
+        if (
+            dp is not None
+            and self.writable.is_set()
+            and len(self.parked) <= self.PENDING_HIGH
+        ):
+            # Queued-frame native fast path: a cheap memtable get
+            # behind a slow quorum op is answered NOW; the parked
+            # slot keeps its response in arrival order.
+            started = time.monotonic()
+            fast = dp.try_handle(frame)
+            if fast is not None:
+                resp, keepalive, flush_tree, op, defer = fast
+                if flush_tree is not None:
+                    self.shard.spawn(flush_tree.flush())
+                if defer is not None:
+                    syncer, ticket = defer
+                    entry = self.park_response(
+                        resp, keepalive, op, started
+                    )
+                    syncer.park(
+                        ticket, lambda e=entry: self.finish_park(e)
+                    )
+                else:
+                    self.park_response(
+                        resp, keepalive, op, started, done=True
+                    )
+                if not keepalive:
+                    self.closing = True
+                    return False
+                return True
+        # Coordinator assist runs AT DISPATCH (synchronous C call):
+        # the local write applies in frame-arrival order, so two
+        # pipelined writes to one key keep their server-timestamp
+        # order; only the fan-out/quorum wait runs concurrently.
+        coord = dp.try_handle_coord(frame) if dp is not None else None
+        req = None
+        keepalive = True
+        if coord is not None:
+            keepalive = bool(coord[2])
+        else:
+            try:
+                req = msgpack.unpackb(frame, raw=False)
+            except Exception:
+                req = None  # _serve_frame re-raises the wire error
+            keepalive = isinstance(req, dict) and bool(
+                req.get("keepalive")
+            )
+            if (
+                keepalive
+                and isinstance(req, dict)
+                and self._batchable_get(req)
+            ):
+                if (
+                    self._get_batch
+                    and self._get_batch_col != req.get("collection")
+                ):
+                    self._flush_get_batch()
+                self._get_batch_col = req.get("collection")
+                self._get_batch.append(
+                    (
+                        self.park_response(None, True),
+                        req,
+                        time.monotonic(),
+                    )
+                )
+                if len(self._get_batch) >= self.GET_BATCH_MAX:
+                    self._flush_get_batch()
+                return True
+        entry = self.park_response(None, True)
+        self.shard.metrics.record_pipeline_depth(
+            len(self.inflight) + 1
         )
+        task = self.shard.spawn(
+            self._serve_pipelined(frame, coord, entry, req)
+        )
+        self.inflight.add(task)
+        task.add_done_callback(self._pipelined_done)
+        if not keepalive:
+            # Reference semantics: one request per non-keepalive
+            # connection — frames already buffered behind it are
+            # DROPPED, never executed (the previous sequential drain
+            # guaranteed this; the concurrent drain must too).  The
+            # in-order parked release still closes the transport
+            # right after this frame's own response.
+            self.closing = True
+            return False
+        return True
+
+    def _batchable_get(self, req: dict) -> bool:
+        """Eligible for drain-level get coalescing: a keepalive get
+        on an RF=1 collection (quorum gets keep their per-frame
+        fan-out brain)."""
+        if req.get("type") != "get" or not req.get("keepalive"):
+            return False
+        col = self.shard.collections.get(req.get("collection"))
+        return col is not None and col.replication_factor == 1
+
+    def _flush_get_batch(self) -> None:
+        if not self._get_batch:
+            return
+        items, self._get_batch = self._get_batch, []
+        col_name, self._get_batch_col = self._get_batch_col, None
+        self.shard.metrics.record_pipeline_depth(
+            len(self.inflight) + 1
+        )
+        task = self.shard.spawn(
+            self._serve_get_batch(col_name, items)
+        )
+        self.inflight.add(task)
+        task.add_done_callback(self._pipelined_done)
+
+    async def _serve_get_batch(
+        self, col_name: str, items: list
+    ) -> None:
+        """Serve a run of coalesced pipelined gets with ONE
+        LSMTree.multi_get (shared probe setup); each frame still gets
+        its own in-order response and its own error surface
+        (ownership, absence)."""
+        my_shard = self.shard
+        my_shard.metrics.record_batch_size(len(items))
+        keyed: list = []
+        try:
+            col = my_shard.get_collection(col_name)
+        except DbeelError as e:
+            for entry, _req, started in items:
+                my_shard.metrics.record_error(classify_error(e))
+                my_shard.metrics.record_request("get", started)
+                self.finish_park(
+                    entry, _frame_response(_error_response(e))
+                )
+            return
+        # Conservative shared bound: the smallest per-op timeout in
+        # the batch — a frame must never wait LONGER because it
+        # happened to coalesce with others.
+        timeout_ms = min(
+            _get_timeout_ms(req) for _entry, req, _started in items
+        )
+        for entry, req, started in items:
+            try:
+                key = extract_key(
+                    my_shard, req, req.get("replica_index") or 0
+                )
+                keyed.append((entry, key, started))
+            except DbeelError as e:
+                my_shard.metrics.record_error(classify_error(e))
+                my_shard.metrics.record_request("get", started)
+                self.finish_park(
+                    entry, _frame_response(_error_response(e))
+                )
+        if not keyed:
+            return
+        err: Optional[DbeelError] = None
+        found: dict = {}
+        try:
+            found = await asyncio.wait_for(
+                col.tree.multi_get([k for _e, k, _s in keyed]),
+                timeout_ms / 1000,
+            )
+        except asyncio.TimeoutError:
+            err = Timeout("get")
+        except Exception as e:  # defensive: entries must resolve
+            err = DbeelError(f"Internal: {e}")
+        for entry, key, started in keyed:
+            hit = found.get(key)
+            if err is not None:
+                my_shard.metrics.record_error(classify_error(err))
+                buf = _error_response(err)
+            elif hit is None or bytes(hit[0]) == TOMBSTONE:
+                buf = _error_response(KeyNotFound(repr(key)))
+            else:
+                buf = bytes(hit[0]) + bytes([RESPONSE_OK])
+            my_shard.metrics.record_request("get", started)
+            self.finish_park(entry, _frame_response(buf))
+
+    def _pipelined_done(self, task) -> None:
+        self.inflight.discard(task)
+        if self._slot_free is not None:
+            self._slot_free.set()
+
+    async def _serve_pipelined(
+        self, frame: bytes, coord, entry, req: Optional[dict] = None
+    ) -> None:
         if coord is not None:
             buf, keepalive = await _serve_coord(self.shard, coord)
         else:
-            buf, keepalive = await _serve_frame(self.shard, frame)
-        if self.closing:
-            return False
-        # Responses leave in arrival order: queue behind any parked
-        # fast-path acks still awaiting their WAL sync.
-        await self._wait_parked_drained()
-        await self.writable.wait()
-        if self.closing:
-            return False
-        self.transport.write(struct.pack("<I", len(buf)) + buf)
+            buf, keepalive = await _serve_frame(
+                self.shard, frame, req
+            )
         if not keepalive:
             # Reference behavior: one request per connection unless
-            # the client opted into keepalive — any already-buffered
-            # extra frames are dropped, like the stream version
-            # dropped unread bytes.
+            # the client opted into keepalive — stop consuming
+            # buffered frames now; the in-order parked release
+            # closes the transport right after this response.
             self.closing = True
-            self.transport.close()
-            return False
-        return True
+        entry[2] = keepalive
+        self.finish_park(entry, _frame_response(buf))
+
+    async def _serve_one(self, frame: bytes) -> bool:
+        raise NotImplementedError  # _drain dispatches directly
 
 
 async def reap_idle_db_connections(my_shard: MyShard) -> None:
@@ -810,6 +1302,7 @@ async def reap_idle_db_connections(my_shard: MyShard) -> None:
             if (
                 now - conn.last_active > KEEPALIVE_IDLE_TIMEOUT_S
                 and conn.task is None
+                and not conn.inflight
                 and conn.transport is not None
             ):
                 conn.transport.close()

@@ -59,6 +59,9 @@ class FramedServerProtocol(asyncio.Protocol):
         "writable",
         "parked",
         "_parked_drained",
+        "_wbuf",
+        "_wclose",
+        "_wflush_scheduled",
     )
 
     def __init__(self, my_shard) -> None:
@@ -77,6 +80,18 @@ class FramedServerProtocol(asyncio.Protocol):
         # already-ready responses queue behind a pending head.
         self.parked: deque = deque()
         self._parked_drained = None
+        # Response-write coalescing: every response on this
+        # connection goes through _write_out, which batches the
+        # bytes and issues ONE transport.write per loop tick
+        # (call_soon).  A pipelined client draining a 16-deep train
+        # costs one send syscall instead of 16 — on this host the
+        # per-write syscall is a measurable slice of the serving
+        # loop (loopwatch stacks pointed at sock.send).  Ordering is
+        # preserved because every response path appends to the same
+        # buffer.
+        self._wbuf: list = []
+        self._wclose = False
+        self._wflush_scheduled = False
 
     # -- lifecycle --------------------------------------------------
 
@@ -104,6 +119,43 @@ class FramedServerProtocol(asyncio.Protocol):
         # write-paused deferred here (see _flush_parked).
         if self.parked:
             self._flush_parked()
+
+    # -- coalesced response writes ----------------------------------
+
+    def _write_out(self, data: bytes, close: bool = False) -> None:
+        """Queue response bytes; one transport.write per loop tick.
+        ``close=True`` closes the transport right after this chunk
+        reaches it (non-keepalive responses) — later appends are
+        dropped, like writes to a closed transport were."""
+        if (
+            self._wclose
+            or self.transport is None
+            or self.transport.is_closing()
+        ):
+            return
+        if data:
+            self._wbuf.append(data)
+        if close:
+            self._wclose = True
+        if not self._wflush_scheduled:
+            self._wflush_scheduled = True
+            asyncio.get_event_loop().call_soon(self._flush_wbuf)
+
+    def _flush_wbuf(self) -> None:
+        self._wflush_scheduled = False
+        if self.transport is None or self.transport.is_closing():
+            self._wbuf.clear()
+            return
+        if self._wbuf:
+            if len(self._wbuf) == 1:
+                data = self._wbuf[0]
+            else:
+                data = b"".join(self._wbuf)
+            self._wbuf.clear()
+            self.transport.write(data)
+        if self._wclose:
+            self.closing = True
+            self.transport.close()
 
     def _registry(self) -> set:
         raise NotImplementedError
@@ -143,6 +195,13 @@ class FramedServerProtocol(asyncio.Protocol):
         e[0] = True
         if resp is not None:
             e[1] = resp
+        if self.parked and self.parked[0] is not e:
+            # Ready, but an earlier response on this connection is
+            # still pending: the in-order release rule makes this one
+            # wait — the head-of-line pressure counter.
+            metrics = getattr(self.shard, "metrics", None)
+            if metrics is not None:
+                metrics.record_hol_wait()
         self._flush_parked()
 
     def _flush_parked(self) -> None:
@@ -169,11 +228,13 @@ class FramedServerProtocol(asyncio.Protocol):
             # is still owed; only a dead transport skips.
             if self.transport is None or self.transport.is_closing():
                 continue
-            if resp is not None:
-                self.transport.write(resp)
             if not keepalive:
                 self.closing = True
-                self.transport.close()
+                self._write_out(
+                    resp if resp is not None else b"", close=True
+                )
+            elif resp is not None:
+                self._write_out(resp)
         if not self.parked and self._parked_drained is not None:
             self._parked_drained.set()
 

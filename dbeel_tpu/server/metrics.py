@@ -84,6 +84,8 @@ class ShardMetrics:
             "set",
             "get",
             "delete",
+            "multi_set",
+            "multi_get",
             "create_collection",
             "drop_collection",
             "get_collection",
@@ -96,6 +98,18 @@ class ShardMetrics:
     def __init__(self) -> None:
         self.requests: Dict[str, LatencyHistogram] = {}
         self.slow_ops = 0
+        # Pipelined-plane shape counters.  The two histograms reuse
+        # the log-bucketed LatencyHistogram with a COUNT (not µs) as
+        # the recorded value — bucket b covers [2^b, 2^{b+1}) items:
+        #  * pipeline_depth: concurrent in-flight requests on a
+        #    connection at each pipelined dispatch;
+        #  * batch_sizes: sub-ops per multi_set/multi_get frame.
+        self.pipeline_depth = LatencyHistogram()
+        self.batch_sizes = LatencyHistogram()
+        # Responses that were ready but had to wait for an earlier
+        # (slower) response on the same connection before leaving —
+        # the head-of-line pressure the in-order release rule costs.
+        self.hol_waits = 0
         # Failure-taxonomy counters (errors.ERROR_CLASSES): every
         # client-visible failure this shard answered with an error
         # frame, by class — the server-side half of the soak report's
@@ -112,6 +126,15 @@ class ShardMetrics:
         if error_class not in self.errors:
             error_class = "other"
         self.errors[error_class] += 1
+
+    def record_pipeline_depth(self, depth: int) -> None:
+        self.pipeline_depth.record_us(max(1, depth))
+
+    def record_batch_size(self, n: int) -> None:
+        self.batch_sizes.record_us(max(1, n))
+
+    def record_hol_wait(self) -> None:
+        self.hol_waits += 1
 
     def record_request(self, op: str, started: float) -> None:
         """``started`` from time.monotonic() at frame receipt."""
@@ -133,5 +156,8 @@ class ShardMetrics:
                 for op, hist in self.requests.items()
             },
             "slow_ops": self.slow_ops,
+            "pipeline_depth": self.pipeline_depth.snapshot(),
+            "batch_sizes": self.batch_sizes.snapshot(),
+            "hol_waits": self.hol_waits,
             "errors": dict(self.errors),
         }

@@ -475,7 +475,7 @@ class MyShard:
                 "sstables": tree.sstable_indices_and_sizes(),
                 "replication_factor": col.replication_factor,
             }
-        from ..storage.wal import hub_fsync_errors
+        from ..storage.wal import group_commit_stats, hub_fsync_errors
 
         return {
             "shard": self.shard_name,
@@ -486,6 +486,10 @@ class MyShard:
                 n: len(q) for n, q in self.hints.items()
             },
             "wal_fsync_errors": hub_fsync_errors(),
+            # Group-commit shape: durable acks released per completed
+            # fdatasync (process-wide; the batching win of pipelined
+            # connections + multi-ops, observable in production).
+            "wal_group_commit": group_commit_stats(),
             "cache": {
                 "pages": len(self.cache),
                 "hits": self.cache.hits,
@@ -652,7 +656,11 @@ class MyShard:
         returns (bounded; oldest hints drop first — read repair then
         covers the remainder)."""
         kind = request[1] if len(request) > 1 else None
-        if kind not in (ShardRequest.SET, ShardRequest.DELETE):
+        if kind not in (
+            ShardRequest.SET,
+            ShardRequest.DELETE,
+            ShardRequest.MULTI_SET,
+        ):
             return
         self.hints.setdefault(
             node_name, deque(maxlen=self.MAX_HINTS_PER_NODE)
@@ -680,9 +688,12 @@ class MyShard:
                     try:
                         msgs.response_to_result(
                             await shard.connection.send_request(request),
-                            ShardResponse.SET
-                            if request[1] == ShardRequest.SET
-                            else ShardResponse.DELETE,
+                            {
+                                ShardRequest.SET: ShardResponse.SET,
+                                ShardRequest.MULTI_SET: (
+                                    ShardResponse.MULTI_SET
+                                ),
+                            }.get(request[1], ShardResponse.DELETE),
                         )
                         pending.pop(0)
                         replayed += 1
@@ -1019,6 +1030,40 @@ class MyShard:
                         col.tree, bytes(request[3]), TOMBSTONE, ts
                     )
             return ShardResponse.empty(ShardResponse.DELETE)
+        if kind == ShardRequest.MULTI_SET:
+            # Batched replica mutations (one peer frame per client
+            # batch): apply under the same watermark discipline as
+            # single SETs — fresh entries batch-insert (one WAL
+            # append_batch / one sync ticket), stale or race-rejected
+            # ones fall back to the read-guarded apply.
+            col = self.get_collection(request[2])
+            entries = [
+                (bytes(k), bytes(v), int(ts))
+                for k, v, ts in request[3]
+            ]
+            wm = col.tree.max_flushed_ts
+            fresh = [e for e in entries if e[2] > wm]
+            stale = [e for e in entries if e[2] <= wm]
+            if fresh:
+                stale.extend(
+                    await col.tree.set_batch_with_timestamp(
+                        fresh, stale_abort=True
+                    )
+                )
+            for k, v, ts in stale:
+                await self.apply_if_newer(col.tree, k, v, ts)
+            if entries:
+                self.flow.notify(FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
+            return ShardResponse.empty(ShardResponse.MULTI_SET)
+        if kind == ShardRequest.MULTI_GET:
+            col = self.collections.get(request[2])
+            keys = [bytes(k) for k in request[3]]
+            if col is None:
+                return ShardResponse.multi_get([None] * len(keys))
+            found = await col.tree.multi_get(keys)
+            return ShardResponse.multi_get(
+                [found.get(k) for k in keys]
+            )
         if kind == ShardRequest.GET:
             col = self.collections.get(request[2])
             entry = None

@@ -136,7 +136,7 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             if self.parked:
                 self.park_response(resp, done=True)
             else:
-                self.transport.write(resp)
+                self._write_out(resp)
         if notify_set:
             self.shard.flow.notify(
                 FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
@@ -195,7 +195,7 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             if self.closing or self.transport.is_closing():
                 return True  # keep applying buffered frames
             payload = pack_message(response)
-            self.transport.write(
+            self._write_out(
                 len(payload).to_bytes(4, "little") + payload
             )
         return True
@@ -394,6 +394,12 @@ async def _sync_range_with_peer(
     pushed = 0
     for off in range(0, len(mine), ANTI_ENTROPY_PAGE):
         page = mine[off : off + ANTI_ENTROPY_PAGE]
+        # Counter stamped at SEND: the peer applies the page before
+        # its ack travels back, so an observer who sees the data
+        # converge must also see the transfer counted — stamping
+        # after the await left a window where convergence was
+        # visible with ae_entries_pushed still 0.
+        my_shard.ae_entries_pushed += len(page)
         async with my_shard.scheduler.bg_slice():
             msgs.response_to_result(
                 await peer.connection.send_request(
@@ -402,7 +408,6 @@ async def _sync_range_with_peer(
                 ShardResponse.RANGE_PUSH,
             )
         pushed += len(page)
-        my_shard.ae_entries_pushed += len(page)
     # ...and pull theirs (same diverged buckets), applying only
     # strictly-newer entries.
     pulled = 0

@@ -412,6 +412,43 @@ class LSMTree:
             return None
         return hit[0]
 
+    async def multi_get(
+        self, keys: Sequence[bytes]
+    ) -> "dict[bytes, Optional[Tuple[bytes, int]]]":
+        """Batched point reads: one entry per DISTINCT key (None =
+        absent).  Shares the probe setup a per-key loop would pay N
+        times: the memtable probes run synchronously up front, then
+        ONE sstable-list acquire/release covers every remaining key,
+        probed in sorted key order so adjacent keys revisit the same
+        index/data pages while they are hot in the page cache."""
+        out: dict = {}
+        missing: List[bytes] = []
+        for key in keys:
+            if key in out:
+                continue
+            hit = self._active.get(key)
+            if hit is None and self._flushing is not None:
+                hit = self._flushing.get(key)
+            out[key] = hit
+            if hit is None:
+                missing.append(key)
+        if not missing:
+            return out
+        tables_list = self._sstables
+        tables_list.acquire()
+        try:
+            for key in sorted(missing):
+                for table in reversed(tables_list.tables):
+                    if not table.maybe_contains(key):
+                        continue
+                    hit = await table.get_async(key)
+                    if hit is not None:
+                        out[key] = hit
+                        break
+        finally:
+            tables_list.release()
+        return out
+
     # ------------------------------------------------------------------
     # Writes (lsm_tree.rs:731-837)
     # ------------------------------------------------------------------
@@ -483,6 +520,51 @@ class LSMTree:
         ):
             self._spawn_flush()
         return True
+
+    async def set_batch_with_timestamp(
+        self,
+        entries: Sequence[Tuple[bytes, bytes, int]],
+        stale_abort: bool = False,
+    ) -> List[Tuple[bytes, bytes, int]]:
+        """Insert a batch: memtable inserts under one capacity check
+        per chunk (Memtable.set_batch), then ONE WAL append_batch per
+        chunk — so a durable batch pays one fdatasync wait, not N
+        (group commit).  A capacity refusal mid-batch flush-waits and
+        continues with the remainder, like the single-set path.
+
+        With ``stale_abort``, entries whose timestamp is no newer
+        than the flush watermark AT INSERT TIME are skipped and
+        returned (the caller applies them read-guarded) — the same
+        race-closing contract as set_with_timestamp(stale_abort=True);
+        the watermark check and the memtable insert have no awaits
+        between them."""
+        rejected: List[Tuple[bytes, bytes, int]] = []
+        pending = list(entries)
+        while pending:
+            if stale_abort:
+                wm = self.max_flushed_ts
+                fresh = []
+                for e in pending:
+                    (rejected if e[2] <= wm else fresh).append(e)
+                pending = fresh
+                if not pending:
+                    break
+            applied = self._active.set_batch(pending)
+            if applied == 0:
+                waiter = self.flush_start_event.listen()
+                self._spawn_flush()
+                await waiter
+                continue
+            chunk, pending = pending[:applied], pending[applied:]
+            assert self._wal is not None
+            await self._wal.append_batch(chunk)
+            self._appends_since_swap += applied
+            if (
+                self._active.is_full()
+                or self._appends_since_swap >= self.capacity
+            ):
+                self._spawn_flush()
+        return rejected
 
     async def delete(self, key: bytes) -> None:
         await self.set_with_timestamp(key, TOMBSTONE, now_nanos())

@@ -70,6 +70,39 @@ class MemtableBase:
             self._map[key] = (value, timestamp)
             self.data_bytes += len(value) - len(prev[0])
 
+    def set_batch(
+        self, entries: List[Tuple[bytes, bytes, int]]
+    ) -> int:
+        """Insert entries in order until capacity; returns how many
+        were applied.  When the whole batch fits under the CURRENT
+        headroom the capacity predicate is evaluated ONCE up front
+        (len + batch <= capacity is sufficient even if every key is
+        new) and the per-entry insert skips it; otherwise entries
+        apply one by one and the count stops at the first capacity
+        refusal — the caller flush-waits and retries the remainder,
+        exactly like the single-set path."""
+        if len(self) + len(entries) > self.capacity:
+            done = 0
+            for key, value, ts in entries:
+                try:
+                    self.set(key, value, ts)
+                except MemtableCapacityReached:
+                    return done
+                done += 1
+            return done
+        m = self._map
+        for key, value, ts in entries:
+            if ts > self.max_ts:
+                self.max_ts = ts
+            prev = m.get(key)
+            if prev is None:
+                m[key] = (value, ts)
+                self.data_bytes += 16 + len(key) + len(value)
+            elif ts >= prev[1]:
+                m[key] = (value, ts)
+                self.data_bytes += len(value) - len(prev[0])
+        return len(entries)
+
     def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
         return self._map.get(key)
 
@@ -198,6 +231,21 @@ class ArenaMemtable(MemtableBase):
             int(ts.value),
         )
 
+    def set_batch(
+        self, entries: List[Tuple[bytes, bytes, int]]
+    ) -> int:
+        # The arena enforces capacity natively per insert (its node
+        # pool is the real bound), so the base class's single up-front
+        # check cannot be hoisted; stop-at-refusal semantics match.
+        done = 0
+        for key, value, ts in entries:
+            try:
+                self.set(key, value, ts)
+            except MemtableCapacityReached:
+                return done
+            done += 1
+        return done
+
     def sorted_items(self) -> List[Item]:
         ct = self._ctypes
         size = int(self._lib.dbeel_memtable_dump_size(self._handle))
@@ -267,6 +315,12 @@ class HashMemtable(MemtableBase):
     def set(self, key: bytes, value: bytes, timestamp: int) -> None:
         self._sorted_cache = None
         super().set(key, value, timestamp)
+
+    def set_batch(
+        self, entries: List[Tuple[bytes, bytes, int]]
+    ) -> int:
+        self._sorted_cache = None
+        return super().set_batch(entries)
 
     def sorted_items(self) -> List[Item]:
         if self._sorted_cache is None:

@@ -58,6 +58,14 @@ struct MpBuf {
       be16(n);
     }
   }
+  void array_header(uint32_t n) {
+    if (n <= 15) {
+      u8(0x90 | n);
+    } else {
+      u8(0xdc);
+      be16((uint16_t)n);
+    }
+  }
   void str(const std::string& s) {
     if (s.size() <= 31) {
       u8(0xa0 | (uint8_t)s.size());
@@ -163,6 +171,38 @@ struct MpRd {
     p += n;
     return s;
   }
+  // bin 8/16/32 — multi_get result payloads (raw msgpack value bytes).
+  bool bin(const uint8_t** out, uint64_t* out_len) {
+    if (!need(1)) return false;
+    uint8_t b = *p++;
+    uint64_t n;
+    if (b == 0xc4) {
+      if (!need(1)) return false;
+      n = be(1);
+    } else if (b == 0xc5) {
+      if (!need(2)) return false;
+      n = be(2);
+    } else if (b == 0xc6) {
+      if (!need(4)) return false;
+      n = be(4);
+    } else {
+      fail = true;
+      return false;
+    }
+    if (!need(n)) return false;
+    *out = p;
+    *out_len = n;
+    p += n;
+    return true;
+  }
+  bool nil() {
+    if (!need(1) || *p != 0xc0) {
+      fail = true;
+      return false;
+    }
+    p++;
+    return true;
+  }
 };
 
 // ------------------------------ client -------------------------------
@@ -179,6 +219,12 @@ struct Client {
   uint16_t seed_port;
   std::vector<RingShard> ring;  // sorted by hash
   std::map<std::pair<std::string, uint16_t>, int> conns;
+  // Pipelined mode: responses still owed per connection (requests
+  // written, responses unread).  Application-level error responses
+  // drained along the way accumulate in pipe_failures; the caller
+  // collects them at dbeel_cli_pipe_drain.
+  std::map<std::pair<std::string, uint16_t>, uint32_t> pipe_pending;
+  int64_t pipe_failures = 0;
   std::string last_error;
   // Failure-aware walk budget (mirrors the Python client): per-op
   // deadline, capped exponential backoff with jitter between walk
@@ -283,6 +329,28 @@ bool read_all(int fd, uint8_t* p, size_t n) {
     ssize_t r = ::recv(fd, p, n, 0);
     if (r <= 0) {
       if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// read_all that rides out SO_RCVTIMEO expiries (EAGAIN) until
+// `deadline_ms`: a pipelined train's head response can legitimately
+// queue behind a long quorum/flush wait under load — that is
+// latency, not a dead connection.
+bool read_all_deadline(int fd, uint8_t* p, size_t n,
+                       uint64_t deadline_ms) {
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+          now_ms() < deadline_ms) {
+        continue;
+      }
       return false;
     }
     p += r;
@@ -609,6 +677,309 @@ int keyed_request(Client* c, const char* type,
   }
 }
 
+// ------------------------- pipelined mode ----------------------------
+// Windowed request pipelining on the persistent keepalive connection:
+// up to `window` frames per target are written before the oldest
+// response is read back, so the wire carries a train of requests
+// instead of one lockstep round trip each.  The server executes the
+// train concurrently and answers strictly in arrival order, so
+// reading responses FIFO is correct.  Pipelined ops route to replica
+// 0 only (no mid-train walk — the train would desync); application
+// errors drained along the way accumulate and surface at drain time.
+
+// Read ONE pending response on the target's connection.  Returns 0
+// (ok, app errors counted into pipe_failures), or -2 on transport
+// failure (the connection and its unread responses are gone).
+int drain_one_response(Client* c, const std::pair<std::string, uint16_t>& key) {
+  auto it = c->conns.find(key);
+  uint32_t& pending = c->pipe_pending[key];
+  if (it == c->conns.end() || it->second < 0 || pending == 0) {
+    pending = 0;
+    c->last_error = "pipelined connection lost";
+    return -2;
+  }
+  int fd = it->second;
+  uint64_t deadline = now_ms() + c->op_deadline_ms;
+  uint8_t len4[4];
+  if (!read_all_deadline(fd, len4, 4, deadline)) {
+    pending = 0;
+    drop_conn(c, key.first, key.second);
+    c->last_error = "pipelined read failed: " +
+                    std::string(strerror(errno));
+    return -2;
+  }
+  uint32_t n = (uint32_t)len4[0] | ((uint32_t)len4[1] << 8) |
+               ((uint32_t)len4[2] << 16) | ((uint32_t)len4[3] << 24);
+  if (n == 0 || n > (64u << 20)) {
+    pending = 0;
+    drop_conn(c, key.first, key.second);
+    c->last_error = "bad pipelined response length";
+    return -2;
+  }
+  std::vector<uint8_t> body(n);
+  if (!read_all_deadline(fd, body.data(), n, deadline)) {
+    pending = 0;
+    drop_conn(c, key.first, key.second);
+    c->last_error = "pipelined read failed: " +
+                    std::string(strerror(errno));
+    return -2;
+  }
+  pending--;
+  uint8_t rtype = body.back();
+  body.pop_back();
+  if (rtype == 0) {
+    std::string msg;
+    c->pipe_failures++;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+  }
+  return 0;
+}
+
+int pipe_op(Client* c, const char* type, const std::string& collection,
+            const uint8_t* key, uint32_t klen, const uint8_t* value,
+            uint32_t vlen, int consistency, uint32_t rf,
+            uint32_t window) {
+  if (window == 0) window = 1;
+  uint32_t key_hash = dbeel_murmur3_32(key, klen, 0);
+  auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
+  if (replicas.empty()) {
+    c->last_error = "empty ring";
+    return -2;
+  }
+  const RingShard* s = replicas[0];
+  bool is_set = std::strcmp(type, "set") == 0;
+  MpBuf m;
+  uint32_t fields = 6 + (is_set ? 1 : 0) + (consistency > 0 ? 1 : 0);
+  m.map_header(fields);
+  common_fields(&m, type, collection, true);
+  m.str("key");
+  m.raw(key, klen);
+  if (is_set) {
+    m.str("value");
+    m.raw(value, vlen);
+  }
+  if (consistency > 0) {
+    m.str("consistency");
+    m.uint((uint64_t)consistency);
+  }
+  m.str("hash");
+  m.uint(key_hash);
+  m.str("replica_index");
+  m.uint(0);
+  if (m.b.size() > 0xFFFF) {
+    c->last_error = "request frame too large";
+    return -2;
+  }
+  auto conn_key = std::make_pair(s->ip, s->db_port);
+  // Window control BEFORE the write: never more than `window`
+  // responses outstanding per connection.
+  while (c->pipe_pending[conn_key] >= window) {
+    int rc = drain_one_response(c, conn_key);
+    if (rc != 0) return rc;
+  }
+  int fd = connect_to(c, s->ip, s->db_port);
+  if (fd < 0) return -2;
+  uint8_t hdr[2] = {(uint8_t)(m.b.size() & 0xff),
+                    (uint8_t)(m.b.size() >> 8)};
+  if (!write_all(fd, hdr, 2) ||
+      !write_all(fd, m.b.data(), m.b.size())) {
+    c->pipe_pending[conn_key] = 0;
+    drop_conn(c, s->ip, s->db_port);
+    c->last_error = "pipelined write failed: " +
+                    std::string(strerror(errno));
+    return -2;
+  }
+  c->pipe_pending[conn_key]++;
+  return 0;
+}
+
+// ------------------------- batched multi-ops -------------------------
+
+struct MultiOp {
+  const uint8_t* key;
+  uint32_t klen;
+  const uint8_t* value;  // null for gets
+  uint32_t vlen;
+  uint32_t hash;
+};
+
+// Parse the flat ops buffer: n × ([u32 klen][key][u32 vlen][value]);
+// gets pass vlen == 0 with no value bytes permitted too.
+bool parse_multi_ops(const uint8_t* buf, uint64_t len, uint32_t n,
+                     bool with_values, std::vector<MultiOp>* out) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  out->reserve(n);
+  for (uint32_t i = 0; i < n; i++) {
+    MultiOp op{};
+    if (end - p < 4) return false;
+    std::memcpy(&op.klen, p, 4);
+    p += 4;
+    if ((uint64_t)(end - p) < op.klen) return false;
+    op.key = p;
+    p += op.klen;
+    if (with_values) {
+      if (end - p < 4) return false;
+      std::memcpy(&op.vlen, p, 4);
+      p += 4;
+      if ((uint64_t)(end - p) < op.vlen) return false;
+      op.value = p;
+      p += op.vlen;
+    }
+    op.hash = dbeel_murmur3_32(op.key, op.klen, 0);
+    out->push_back(op);
+  }
+  return true;
+}
+
+constexpr uint32_t kMultiMaxOpsPerFrame = 256;
+constexpr uint32_t kMultiMaxBytesPerFrame = 48u << 10;
+
+// One multi frame for the sub-ops in `idxs`; parses per-op results.
+// status slots: 0 ok, 1 not-found (gets), 2 retry-with-single-op.
+// `values_out` (gets only) receives each ok payload.  Returns 0, or
+// -2 on a frame-level failure (caller marks the chunk retryable).
+int multi_round_trip(Client* c, const char* type,
+                     const std::string& collection,
+                     const std::vector<MultiOp>& ops,
+                     const std::vector<uint32_t>& idxs, bool is_set,
+                     int consistency, const RingShard* target,
+                     uint8_t* status,
+                     std::vector<std::vector<uint8_t>>* values_out) {
+  MpBuf m;
+  uint32_t fields = 5 + (consistency > 0 ? 1 : 0);
+  m.map_header(fields);
+  common_fields(&m, type, collection, true);
+  m.str("ops");
+  m.array_header((uint32_t)idxs.size());
+  for (uint32_t i : idxs) {
+    const MultiOp& op = ops[i];
+    m.array_header(is_set ? 3 : 2);
+    m.raw(op.key, op.klen);
+    m.uint(op.hash);
+    if (is_set) m.raw(op.value, op.vlen);
+  }
+  m.str("replica_index");
+  m.uint(0);
+  if (consistency > 0) {
+    m.str("consistency");
+    m.uint((uint64_t)consistency);
+  }
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, target->ip, target->db_port, m, &body, &rtype)) {
+    return -2;
+  }
+  if (rtype == 0) {
+    std::string msg;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+    return -2;
+  }
+  MpRd r{body.data(), body.data() + body.size()};
+  uint32_t count = r.array_header();
+  if (r.fail || count != idxs.size()) {
+    c->last_error = "bad multi response shape";
+    return -2;
+  }
+  for (uint32_t j = 0; j < count; j++) {
+    uint32_t pair = r.array_header();
+    if (r.fail || pair < 2) {
+      c->last_error = "bad multi result shape";
+      return -2;
+    }
+    int64_t st = r.integer();
+    if (st == 0) {
+      if (is_set) {
+        r.nil();
+      } else {
+        const uint8_t* vp = nullptr;
+        uint64_t vn = 0;
+        if (!r.bin(&vp, &vn)) {
+          c->last_error = "bad multi get payload";
+          return -2;
+        }
+        (*values_out)[idxs[j]].assign(vp, vp + vn);
+      }
+      status[idxs[j]] = 0;
+    } else {
+      std::string msg;
+      uint32_t earr = r.array_header();
+      std::string kind = earr >= 1 ? r.str() : "";
+      if (earr >= 2) msg = r.str();
+      for (uint32_t extra = 2; extra < earr; extra++) (void)r.str();
+      if (!is_set && kind == "KeyNotFound") {
+        status[idxs[j]] = 1;
+      } else {
+        status[idxs[j]] = 2;  // single-op walk resolves it
+        c->last_error = kind + ": " + msg;
+      }
+    }
+    if (r.fail) {
+      c->last_error = "bad multi result encoding";
+      return -2;
+    }
+  }
+  return 0;
+}
+
+// Shared driver for multi_set / multi_get: group by owning
+// coordinator, chunk under the u16 frame bound, one frame per chunk.
+// Frame-level failures mark their chunk's ops status=2 (the caller
+// retries those through the single-op walk, preserving the PR-1
+// failover semantics per sub-op).  Returns the number of non-ok ops.
+int64_t multi_driver(Client* c, const char* type, bool is_set,
+                     const std::string& collection,
+                     const std::vector<MultiOp>& ops, int consistency,
+                     uint32_t rf, uint8_t* status,
+                     std::vector<std::vector<uint8_t>>* values_out) {
+  std::map<std::pair<std::string, uint16_t>,
+           std::pair<const RingShard*, std::vector<uint32_t>>>
+      groups;
+  for (uint32_t i = 0; i < ops.size(); i++) {
+    auto replicas = shards_for_key(c, ops[i].hash, rf ? rf : 1);
+    if (replicas.empty()) {
+      status[i] = 2;
+      continue;
+    }
+    const RingShard* s = replicas[0];
+    auto& slot = groups[std::make_pair(s->ip, s->db_port)];
+    slot.first = s;
+    slot.second.push_back(i);
+  }
+  for (auto& kv : groups) {
+    const RingShard* target = kv.second.first;
+    std::vector<uint32_t>& idxs = kv.second.second;
+    std::vector<uint32_t> chunk;
+    uint64_t chunk_bytes = 0;
+    auto flush_chunk = [&]() {
+      if (chunk.empty()) return;
+      if (multi_round_trip(c, type, collection, ops, chunk, is_set,
+                           consistency, target, status,
+                           values_out) != 0) {
+        for (uint32_t i : chunk) status[i] = 2;
+      }
+      chunk.clear();
+      chunk_bytes = 0;
+    };
+    for (uint32_t i : idxs) {
+      uint64_t op_bytes = 16 + ops[i].klen + ops[i].vlen;
+      if (!chunk.empty() &&
+          (chunk.size() >= kMultiMaxOpsPerFrame ||
+           chunk_bytes + op_bytes > kMultiMaxBytesPerFrame)) {
+        flush_chunk();
+      }
+      chunk.push_back(i);
+      chunk_bytes += op_bytes;
+    }
+    flush_chunk();
+  }
+  int64_t failed = 0;
+  for (uint32_t i = 0; i < ops.size(); i++) {
+    if (status[i] != 0) failed++;
+  }
+  return failed;
+}
+
 }  // namespace
 
 extern "C" {
@@ -678,6 +1049,155 @@ int dbeel_cli_create_collection(void* h, const char* name,
     return -2;
   }
   return 0;
+}
+
+// ---- pipelined single-ops (windowed; responses drain lazily) ----
+
+int dbeel_cli_pipe_set(void* h, const char* collection,
+                       const uint8_t* key, uint32_t klen,
+                       const uint8_t* value, uint32_t vlen,
+                       int consistency, uint32_t rf, uint32_t window) {
+  return pipe_op(static_cast<Client*>(h), "set", collection, key, klen,
+                 value, vlen, consistency, rf, window);
+}
+
+int dbeel_cli_pipe_get(void* h, const char* collection,
+                       const uint8_t* key, uint32_t klen,
+                       int consistency, uint32_t rf, uint32_t window) {
+  return pipe_op(static_cast<Client*>(h), "get", collection, key, klen,
+                 nullptr, 0, consistency, rf, window);
+}
+
+// Whole-train driver: pipeline n ops (keys_buf: n × [u32 klen][key];
+// vals_buf: n × [u32 vlen][value], null for gets) with `window`
+// in-flight per connection, then drain everything.  One C call per
+// train — the per-op interpreter cost of a Python pipe loop is the
+// client-side bottleneck this exists to remove.  Returns the
+// application-failure count, or -2 on transport failure.
+int64_t dbeel_cli_pipe_run(void* h, const char* collection, int is_set,
+                           const uint8_t* keys_buf, uint64_t keys_len,
+                           const uint8_t* vals_buf, uint64_t vals_len,
+                           uint32_t n, int consistency, uint32_t rf,
+                           uint32_t window) {
+  Client* c = static_cast<Client*>(h);
+  const uint8_t* kp = keys_buf;
+  const uint8_t* kend = keys_buf + keys_len;
+  const uint8_t* vp = vals_buf;
+  const uint8_t* vend = vals_buf ? vals_buf + vals_len : nullptr;
+  for (uint32_t i = 0; i < n; i++) {
+    if (kend - kp < 4) {
+      c->last_error = "malformed pipe keys buffer";
+      return -2;
+    }
+    uint32_t klen;
+    std::memcpy(&klen, kp, 4);
+    kp += 4;
+    if ((uint64_t)(kend - kp) < klen) {
+      c->last_error = "malformed pipe keys buffer";
+      return -2;
+    }
+    const uint8_t* key = kp;
+    kp += klen;
+    const uint8_t* value = nullptr;
+    uint32_t vlen = 0;
+    if (is_set) {
+      if (!vals_buf || vend - vp < 4) {
+        c->last_error = "malformed pipe values buffer";
+        return -2;
+      }
+      std::memcpy(&vlen, vp, 4);
+      vp += 4;
+      if ((uint64_t)(vend - vp) < vlen) {
+        c->last_error = "malformed pipe values buffer";
+        return -2;
+      }
+      value = vp;
+      vp += vlen;
+    }
+    int rc = pipe_op(c, is_set ? "set" : "get", collection, key, klen,
+                     value, vlen, consistency, rf, window);
+    if (rc != 0) return rc;
+  }
+  for (auto& kv : c->pipe_pending) {
+    while (kv.second > 0) {
+      if (drain_one_response(c, kv.first) != 0) return -2;
+    }
+  }
+  int64_t failures = c->pipe_failures;
+  c->pipe_failures = 0;
+  return failures;
+}
+
+// Drain every outstanding pipelined response; returns the total
+// application-level failures accumulated since the last drain (and
+// resets the counter), or -2 on transport failure.
+int64_t dbeel_cli_pipe_drain(void* h) {
+  Client* c = static_cast<Client*>(h);
+  for (auto& kv : c->pipe_pending) {
+    while (kv.second > 0) {
+      if (drain_one_response(c, kv.first) != 0) return -2;
+    }
+  }
+  int64_t failures = c->pipe_failures;
+  c->pipe_failures = 0;
+  return failures;
+}
+
+// ---- batched multi-ops (one frame per owning node per chunk) ----
+
+// ops buffer: n × ([u32 klen][key][u32 vlen][value]), raw msgpack
+// blobs.  status_out[n]: 0 ok, non-zero = retry via the single-op
+// walk.  Returns the non-ok count, or -2 on malformed input.
+int64_t dbeel_cli_multi_set(void* h, const char* collection,
+                            const uint8_t* ops_buf, uint64_t ops_len,
+                            uint32_t n, int consistency, uint32_t rf,
+                            uint8_t* status_out) {
+  Client* c = static_cast<Client*>(h);
+  std::vector<MultiOp> ops;
+  if (!parse_multi_ops(ops_buf, ops_len, n, true, &ops)) {
+    c->last_error = "malformed multi ops buffer";
+    return -2;
+  }
+  std::memset(status_out, 2, n);
+  return multi_driver(c, "multi_set", true, collection, ops,
+                      consistency, rf, status_out, nullptr);
+}
+
+// ops buffer: n × ([u32 klen][key]).  out (cap bytes) receives, in
+// input order, n records of [u8 status][u32 len][payload] — status
+// 0 ok (payload = raw msgpack value), 1 not found, 2 retry via the
+// single-op walk.  Returns bytes written, -2 on malformed input, or
+// <= -10 encoding the needed buffer size as -(rc) - 10.
+int64_t dbeel_cli_multi_get(void* h, const char* collection,
+                            const uint8_t* ops_buf, uint64_t ops_len,
+                            uint32_t n, int consistency, uint32_t rf,
+                            uint8_t* out, uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::vector<MultiOp> ops;
+  if (!parse_multi_ops(ops_buf, ops_len, n, false, &ops)) {
+    c->last_error = "malformed multi ops buffer";
+    return -2;
+  }
+  std::vector<uint8_t> status(n, 2);
+  std::vector<std::vector<uint8_t>> values(n);
+  multi_driver(c, "multi_get", false, collection, ops, consistency, rf,
+               status.data(), &values);
+  uint64_t needed = 0;
+  for (uint32_t i = 0; i < n; i++) needed += 5 + values[i].size();
+  if (needed > cap) {
+    c->last_error = "multi_get results exceed caller buffer";
+    return -((int64_t)needed) - 10;
+  }
+  uint8_t* p = out;
+  for (uint32_t i = 0; i < n; i++) {
+    *p++ = status[i];
+    uint32_t vn = (uint32_t)values[i].size();
+    std::memcpy(p, &vn, 4);
+    p += 4;
+    if (vn) std::memcpy(p, values[i].data(), vn);
+    p += vn;
+  }
+  return (int64_t)(p - out);
 }
 
 // key/value: raw msgpack-encoded blobs.  rf: the collection's

@@ -1,0 +1,404 @@
+"""Pipelined connections + batched multi-ops (ISSUE 2 tentpole).
+
+Ordering invariants of the pipelined serving plane: responses leave in
+arrival order across interleaved fast-path parked WAL acks, coalesced
+get batches, and slow Python-path ops; a mid-pipeline disconnect
+cancels in-flight work without leaking tasks (py3.10 bpo-37658
+discipline: shard teardown re-cancels, so protocol tasks must resolve
+promptly on their own).  Plus the multi_set/multi_get surface — wire
+shape, per-sub-op errors, client grouping/failover — and the storage
+batch primitives underneath (WAL append_batch, memtable set_batch,
+LSMTree.multi_get).
+"""
+
+import asyncio
+import struct
+
+import msgpack
+import pytest
+
+from dbeel_tpu import errors
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu.cluster import remote_comm
+from dbeel_tpu.flow_events import FlowEvent
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+async def _open_raw(host, port):
+    return await asyncio.open_connection(host, port)
+
+
+def _frame(request: dict) -> bytes:
+    buf = msgpack.packb(request, use_bin_type=True)
+    return struct.pack("<H", len(buf)) + buf
+
+
+async def _read_response(reader):
+    header = await reader.readexactly(4)
+    (size,) = struct.unpack("<I", header)
+    payload = await reader.readexactly(size)
+    return payload[:-1], payload[-1]
+
+
+# ----------------------------------------------------------------------
+# Ordering invariant
+# ----------------------------------------------------------------------
+
+
+def test_pipelined_responses_stay_in_arrival_order(tmp_dir):
+    """One connection, a train mixing native fast-path sets (parked
+    on wal-sync tickets), gets of flushed keys (coalesced multi_get
+    batches), and interpreter-path ops (get_collection): the N-th
+    response must answer the N-th request even though execution
+    overlaps."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(
+                tmp_dir, wal_sync=True, memtable_capacity=64
+            )
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection("ord")
+            # Pre-write (and flush past) the keys the train will read
+            # so pipelined gets never race their own writes.
+            for i in range(80):
+                await col.set(f"g{i}", {"n": i})
+            reader, writer = await _open_raw(*node.db_address)
+            expected = []  # ("ok"|"value"|"col", payload check)
+            train = []
+            for i in range(40):
+                train.append(
+                    _frame(
+                        {
+                            "type": "set",
+                            "collection": "ord",
+                            "key": f"s{i}",
+                            "value": i,
+                            "keepalive": True,
+                        }
+                    )
+                )
+                expected.append(("set", None))
+                train.append(
+                    _frame(
+                        {
+                            "type": "get",
+                            "collection": "ord",
+                            "key": f"g{i}",
+                            "keepalive": True,
+                        }
+                    )
+                )
+                expected.append(("get", {"n": i}))
+                if i % 8 == 0:
+                    train.append(
+                        _frame(
+                            {
+                                "type": "get_collection",
+                                "name": "ord",
+                                "keepalive": True,
+                            }
+                        )
+                    )
+                    expected.append(
+                        ("col", {"replication_factor": 1})
+                    )
+            writer.write(b"".join(train))
+            await writer.drain()
+            for kind, want in expected:
+                body, rtype = await asyncio.wait_for(
+                    _read_response(reader), 10
+                )
+                if kind == "set":
+                    assert rtype == 2, (kind, rtype, body)
+                    assert msgpack.unpackb(body, raw=False) == "OK"
+                else:
+                    assert rtype == 1, (kind, rtype, body)
+                    assert (
+                        msgpack.unpackb(body, raw=False) == want
+                    ), kind
+            writer.close()
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_mid_pipeline_disconnect_cancels_inflight(tmp_dir):
+    """Disconnecting with slow quorum ops still in flight must cancel
+    the connection's pipelined tasks promptly — no protocol-level
+    leaks for shard teardown's re-cancel loop to mop up."""
+
+    async def main():
+        cfg = make_config(
+            tmp_dir, remote_shard_read_timeout_ms=1000
+        )
+        node1 = await ClusterNode(cfg).start()
+        node2 = None
+        try:
+            c2 = next_node_config(cfg, 1, tmp_dir).replace(
+                seed_nodes=[node1.seed_address],
+                remote_shard_read_timeout_ms=1000,
+            )
+            alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            node2 = await ClusterNode(c2).start()
+            await alive
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            created = node2.flow_event(
+                0, FlowEvent.COLLECTION_CREATED
+            )
+            await client.create_collection(
+                "dc", replication_factor=2
+            )
+            await asyncio.wait_for(created, 10)
+            # Black-hole the replica plane: RF=2 sets now park in
+            # their quorum wait.
+            remote_comm.set_fault(
+                node2.seed_address, remote_comm.FAULT_BLACKHOLE
+            )
+            shard = node1.shards[0]
+            reader, writer = await _open_raw(*node1.db_address)
+            for i in range(5):
+                writer.write(
+                    _frame(
+                        {
+                            "type": "set",
+                            "collection": "dc",
+                            "key": f"k{i}",
+                            "value": i,
+                            "keepalive": True,
+                            "consistency": 2,
+                        }
+                    )
+                )
+            await writer.drain()
+            # Wait until the connection has in-flight pipelined work.
+            conn = None
+            for _ in range(200):
+                conns = [
+                    c
+                    for c in shard.db_connections
+                    if c.inflight or c.task is not None
+                ]
+                if conns:
+                    conn = conns[0]
+                    break
+                await asyncio.sleep(0.01)
+            assert conn is not None, "pipeline never went in-flight"
+            # Mid-pipeline disconnect.
+            writer.close()
+            for _ in range(300):
+                if (
+                    conn not in shard.db_connections
+                    and not conn.inflight
+                    and conn.task is None
+                ):
+                    break
+                await asyncio.sleep(0.01)
+            assert conn not in shard.db_connections
+            assert not conn.inflight, "in-flight tasks leaked"
+            assert conn.task is None, "drain task leaked"
+            client.close()
+        finally:
+            remote_comm.clear_faults()
+            if node2 is not None:
+                await node2.stop()
+            await node1.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Multi-op surface
+# ----------------------------------------------------------------------
+
+
+def test_multi_set_multi_get_roundtrip(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, memtable_capacity=512),
+            num_shards=2,
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection("m")
+            items = [(f"k{i}", {"i": i}) for i in range(100)]
+            await col.multi_set(items)
+            vals = await col.multi_get(
+                [k for k, _ in items] + ["missing"]
+            )
+            assert vals[:100] == [{"i": i} for i in range(100)]
+            assert vals[100] is None
+            # Single-op reads observe batched writes.
+            assert await col.get("k7") == {"i": 7}
+            # Batch sizes are recorded for observability.
+            raw = await client._send_to(
+                *node.db_address, {"type": "get_stats"}
+            )
+            stats = msgpack.unpackb(raw, raw=False)
+            assert stats["metrics"]["batch_sizes"]["count"] > 0
+            assert "wal_group_commit" in stats
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_multi_ops_replicate_at_rf2(tmp_dir):
+    """RF>1 batches: one MULTI_SET peer frame per replica applies
+    every sub-op; batched quorum gets merge per key."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node1 = await ClusterNode(cfg).start()
+        node2 = None
+        try:
+            c2 = next_node_config(cfg, 1, tmp_dir).replace(
+                seed_nodes=[node1.seed_address]
+            )
+            alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+            node2 = await ClusterNode(c2).start()
+            await alive
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            created = node2.flow_event(
+                0, FlowEvent.COLLECTION_CREATED
+            )
+            col = await client.create_collection(
+                "r", replication_factor=2
+            )
+            await asyncio.wait_for(created, 10)
+            items = [(f"k{i}", i) for i in range(50)]
+            await col.multi_set(items)
+            vals = await col.multi_get([k for k, _ in items])
+            assert vals == list(range(50))
+            # Every replica holds every batched write.
+            tree2 = node2.shards[0].collections["r"].tree
+            for i in range(50):
+                k = msgpack.packb(f"k{i}", use_bin_type=True)
+                assert await tree2.get(k) is not None, i
+            client.close()
+        finally:
+            if node2 is not None:
+                await node2.stop()
+            await node1.stop()
+
+    run(main(), timeout=60)
+
+
+def test_pipelined_client_window(tmp_dir):
+    """The pipelined Python client multiplexes concurrent ops on one
+    connection per target and stays correct under gather-storms."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, memtable_capacity=512)
+        ).start()
+        try:
+            boot = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            await boot.create_collection("p")
+            boot.close()
+            pc = await DbeelClient.from_seed_nodes(
+                [node.db_address], pipeline_window=8
+            )
+            col = pc.collection("p")
+            await asyncio.gather(
+                *(col.set(f"k{i}", i) for i in range(120))
+            )
+            got = await asyncio.gather(
+                *(col.get(f"k{i}") for i in range(120))
+            )
+            assert got == list(range(120))
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("absent")
+            # One pipelined connection per target, not one per op.
+            assert len(pc._pipes) == 1
+            pc.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Storage batch primitives
+# ----------------------------------------------------------------------
+
+
+def test_wal_append_batch_replay_equivalence(tmp_dir, arun):
+    from dbeel_tpu.storage import wal as wal_mod
+
+    async def main():
+        single = f"{tmp_dir}/single.memtable"
+        batched = f"{tmp_dir}/batched.memtable"
+        entries = [
+            (f"k{i}".encode(), f"v{i}".encode() * (i % 7 + 1), i + 1)
+            for i in range(50)
+        ]
+        w1 = wal_mod.Wal(single)
+        for k, v, ts in entries:
+            await w1.append(k, v, ts)
+        w1.close()
+        w2 = wal_mod.Wal(batched)
+        await w2.append_batch(entries)
+        w2.close()
+        assert list(wal_mod.replay(single)) == list(
+            wal_mod.replay(batched)
+        ), "append_batch must be record-identical to N appends"
+
+    arun(main())
+
+
+def test_memtable_set_batch_capacity(tmp_dir):
+    from dbeel_tpu.storage.memtable import Memtable
+
+    m = Memtable(10)
+    entries = [(f"k{i}".encode(), b"v", i) for i in range(8)]
+    assert m.set_batch(entries) == 8
+    # Overwrites don't consume capacity; new keys stop at the cap.
+    assert m.set_batch([(b"k1", b"w", 100)]) == 1
+    assert m.get(b"k1") == (b"w", 100)
+    more = [(f"n{i}".encode(), b"v", i) for i in range(5)]
+    assert m.set_batch(more) == 2  # 8 distinct + 2 = capacity 10
+    assert len(m) == 10
+
+
+def test_lsm_multi_get_and_set_batch(tmp_dir, arun):
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            f"{tmp_dir}/t", capacity=64
+        )
+        entries = [
+            (f"k{i:03}".encode(), f"v{i}".encode(), i + 1)
+            for i in range(200)  # spans several flushes
+        ]
+        await tree.set_batch_with_timestamp(entries)
+        # Batched reads match per-key reads, including sstable-
+        # resident keys and absent ones.
+        keys = [k for k, _v, _t in entries] + [b"absent"]
+        got = await tree.multi_get(keys)
+        for k, v, _ts in entries:
+            single = await tree.get_entry(k)
+            assert got[k] == single, k
+            assert bytes(got[k][0]) == v
+        assert got[b"absent"] is None
+        tree.close()
+
+    arun(main())
