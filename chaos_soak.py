@@ -74,7 +74,7 @@ class Node:
             tempfile.gettempdir(), f"chaos_n{i}.log"
         )
 
-    def start(self, seeds):
+    def start(self, seeds, extra_env=None):
         env = {
             **os.environ,
             "PYTHONPATH": REPO
@@ -83,6 +83,10 @@ class Node:
                 if os.environ.get("PYTHONPATH")
                 else ""
             ),
+            # A clean restart must not inherit a fault armed for a
+            # previous incarnation of this node.
+            "DBEEL_DISK_FAULTS": "",
+            **(extra_env or {}),
         }
         argv = [
             sys.executable, "-m", "dbeel_tpu.server.run",
@@ -447,6 +451,128 @@ async def final_checks(nodes, acks, report):
     return not lost and not divergent
 
 
+async def disk_fault_phase(nodes, acks, seeds, report):
+    """--disk-faults: (a) flip one bit in a random on-disk sstable of
+    a running node and read back every acked key at R=2 asserting ZERO
+    client-visible corrupt payloads (the checksum plane quarantines,
+    quorum merges clean replicas); (b) restart one node with an
+    ENOSPC fault armed on its whole store (DBEEL_DISK_FAULTS env →
+    storage/file_io seam) and drive reads+writes through the window
+    asserting the node SERVES instead of crashing and the cluster
+    keeps taking W=2 writes."""
+    import glob
+
+    phase = {"bitflip": None, "enospc": None}
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)]
+    )
+    col = client.collection(COLLECTION)
+    rng = random.Random(99)
+
+    # ---- (a) bit flip on a live node's sstable -----------------------
+    candidates = []
+    for n in nodes:
+        for sid in range(SHARDS):
+            d = os.path.join(n.dir, f"{COLLECTION}-{sid}")
+            candidates += [
+                (n, p) for p in glob.glob(os.path.join(d, "*.data"))
+            ]
+    if candidates:
+        victim, path = rng.choice(candidates)
+        offset = max(0, os.path.getsize(path) // 2)
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            b = f.read(1) or b"\x00"
+            f.seek(offset)
+            f.write(bytes([b[0] ^ 0x01]))
+        log(f"DISK-FAULTS: flipped a bit in {victim.name}:{path}")
+        checked = corrupt = op_errors = 0
+        for key, (op, version) in sorted(acks.last.items()):
+            if op != "set":
+                continue
+            checked += 1
+            try:
+                got = await asyncio.wait_for(
+                    col.get(key, consistency=Consistency.fixed(2)), 20
+                )
+                if (
+                    not isinstance(got, dict)
+                    or got.get("v", -1) < version
+                ):
+                    corrupt += 1
+            except Exception as e:
+                if "KeyNotFound" not in repr(e):
+                    op_errors += 1
+        phase["bitflip"] = {
+            "victim": victim.name,
+            "file": os.path.basename(path),
+            "keys_checked": checked,
+            "corrupt_payloads": corrupt,
+            "op_errors": op_errors,
+        }
+        log(f"DISK-FAULTS bitflip: {phase['bitflip']}")
+    else:
+        log("DISK-FAULTS: no sstable on disk yet; bitflip skipped")
+
+    # ---- (b) ENOSPC window on one node's store -----------------------
+    victim = nodes[-1]
+    log(f"DISK-FAULTS: restarting {victim.name} with ENOSPC armed")
+    victim.kill()
+    victim.start(
+        seeds,
+        extra_env={"DBEEL_DISK_FAULTS": f"{victim.dir}={'enospc'}"},
+    )
+    await wait_port(victim.db_port)
+    await asyncio.sleep(2)
+    writes_ok = write_errors = reads_ok = read_errors = 0
+    for i in range(40):
+        key = f"dfk{i:03d}"
+        try:
+            await asyncio.wait_for(
+                col.set(
+                    key, {"v": i}, consistency=Consistency.fixed(2)
+                ),
+                20,
+            )
+            writes_ok += 1
+        except Exception:
+            write_errors += 1
+        try:
+            await asyncio.wait_for(
+                col.get(key, consistency=Consistency.fixed(2)), 20
+            )
+            reads_ok += 1
+        except Exception as e:
+            if "KeyNotFound" not in repr(e):
+                read_errors += 1
+    alive = victim.alive()
+    phase["enospc"] = {
+        "victim": victim.name,
+        "writes_ok": writes_ok,
+        "write_errors": write_errors,
+        "reads_ok": reads_ok,
+        "read_errors": read_errors,
+        "victim_alive": alive,
+    }
+    log(f"DISK-FAULTS enospc: {phase['enospc']}")
+    # Clean restart for the final convergence checks.
+    victim.kill()
+    victim.start(seeds)
+    await wait_port(victim.db_port)
+    client.close()
+    report["disk_faults"] = phase
+    ok = alive
+    if phase["bitflip"] is not None:
+        b = phase["bitflip"]
+        ok = ok and b["corrupt_payloads"] == 0
+        # Bounded error rate: the replica walk must absorb the
+        # quarantined replica (generous bound — host weather).
+        ok = ok and b["op_errors"] <= max(3, b["keys_checked"] // 4)
+    e = phase["enospc"]
+    ok = ok and e["writes_ok"] >= 20 and e["reads_ok"] >= 20
+    return ok
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -464,6 +590,13 @@ async def main():
         "--scale-churn", action="store_true",
         help="every other churn cycle adds a brand-new node under "
         "load (addition migration), then SIGKILLs it (removal)",
+    )
+    ap.add_argument(
+        "--disk-faults", action="store_true",
+        help="after churn: flip a bit in a live node's sstable "
+        "(asserting zero corrupt client payloads) and run an ENOSPC "
+        "window on one node's store (asserting it degrades to "
+        "read-only instead of crashing)",
     )
     ap.add_argument(
         "--quick", action="store_true",
@@ -566,7 +699,13 @@ async def main():
         "scale_outs": stats["scale_outs"],
         "restart_failures": stats["restart_failures"],
     }
-    ok = await final_checks(nodes, acks, report)
+    ok = True
+    if args.disk_faults:
+        ok = await disk_fault_phase(nodes, acks, seeds, report)
+        # Let quarantine repair + anti-entropy re-converge the
+        # bit-flipped replica before the divergence scan.
+        await asyncio.sleep(min(args.quiet_window, 15.0))
+    ok = (await final_checks(nodes, acks, report)) and ok
     if not args.quick:
         # Quick mode waives the rate gate: one unlucky op in a tiny
         # sample would dominate the percentage.

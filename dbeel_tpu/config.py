@@ -48,6 +48,11 @@ class Config:
     # Hash sub-range buckets per digest scan (flat merkle layer): one
     # diverged key syncs ~range/buckets entries, not the whole range.
     anti_entropy_buckets: int = 64
+    # Background checksum scrub (durability plane): cold sstable
+    # blocks re-verify against the .sums sidecar every interval, at a
+    # bounded byte rate under the share scheduler.  0 disables.
+    scrub_interval_ms: int = 600_000
+    scrub_bytes_per_sec: int = 8 << 20
 
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
@@ -143,6 +148,19 @@ def build_parser() -> argparse.ArgumentParser:
         default=d.anti_entropy_buckets,
         help="hash sub-range buckets per anti-entropy digest scan",
     )
+    p.add_argument(
+        "--scrub-interval",
+        type=int,
+        dest="scrub_interval_ms",
+        default=d.scrub_interval_ms,
+        help="background checksum-scrub interval in ms (0 disables)",
+    )
+    p.add_argument(
+        "--scrub-bytes-per-sec",
+        type=int,
+        default=d.scrub_bytes_per_sec,
+        help="scrub read-rate ceiling in bytes/sec",
+    )
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
@@ -209,6 +227,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         background_tasks_shares=ns.background_tasks_shares,
         anti_entropy_interval_ms=ns.anti_entropy_interval_ms,
         anti_entropy_buckets=ns.anti_entropy_buckets,
+        scrub_interval_ms=ns.scrub_interval_ms,
+        scrub_bytes_per_sec=ns.scrub_bytes_per_sec,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,

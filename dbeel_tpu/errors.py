@@ -59,6 +59,11 @@ PeerDead = _mk(
     "A replica needed for this op is marked Dead by the failure "
     "detector.",
 )
+ShardDegraded = _mk(
+    "ShardDegraded",
+    "The shard's disk failed (EIO/ENOSPC on the WAL); it is serving "
+    "reads only — retry the write on another replica.",
+)
 
 _BY_KIND = {
     cls.kind: cls
@@ -78,12 +83,20 @@ ERROR_CLASS_COORDINATOR_DEAD = "coordinator-dead"
 ERROR_CLASS_QUORUM_TIMEOUT = "quorum-timeout"
 ERROR_CLASS_PEER_DEAD = "peer-dead"
 ERROR_CLASS_NOT_OWNED = "not-owned"
+# Disk plane (PR 3): a read hit a checksum failure / quarantined range
+# on this replica, or the shard is in read-only degraded mode after a
+# WAL EIO/ENOSPC — both retryable, the client walks to a healthy
+# replica.
+ERROR_CLASS_CORRUPTION = "data-corruption"
+ERROR_CLASS_DEGRADED = "degraded"
 ERROR_CLASS_OTHER = "other"
 ERROR_CLASSES = (
     ERROR_CLASS_COORDINATOR_DEAD,
     ERROR_CLASS_QUORUM_TIMEOUT,
     ERROR_CLASS_PEER_DEAD,
     ERROR_CLASS_NOT_OWNED,
+    ERROR_CLASS_CORRUPTION,
+    ERROR_CLASS_DEGRADED,
     ERROR_CLASS_OTHER,
 )
 
@@ -117,6 +130,10 @@ def classify_error(exc: BaseException) -> "str | None":
             return ERROR_CLASS_QUORUM_TIMEOUT
         if kind == "PeerDead":
             return ERROR_CLASS_PEER_DEAD
+        if kind == "CorruptedFile":
+            return ERROR_CLASS_CORRUPTION
+        if kind == "ShardDegraded":
+            return ERROR_CLASS_DEGRADED
         if kind in _CONNECTION_KINDS:
             return ERROR_CLASS_COORDINATOR_DEAD
         return ERROR_CLASS_OTHER
@@ -140,6 +157,10 @@ def is_retryable_class(error_class: "str | None") -> bool:
         ERROR_CLASS_QUORUM_TIMEOUT,
         ERROR_CLASS_PEER_DEAD,
         ERROR_CLASS_NOT_OWNED,
+        # Another replica may hold a clean copy (corruption) or a
+        # writable WAL (degraded): always worth the walk.
+        ERROR_CLASS_CORRUPTION,
+        ERROR_CLASS_DEGRADED,
     )
 
 

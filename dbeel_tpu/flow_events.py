@@ -33,6 +33,11 @@ class FlowEvent(enum.Enum):
     HINTS_REPLAYED = "HintsReplayed"
     ANTI_ENTROPY_DONE = "AntiEntropyDone"
     ANTI_ENTROPY_SYNCED = "AntiEntropySynced"  # a mismatch was repaired
+    # Durability plane (PR 3).
+    TABLE_QUARANTINED = "TableQuarantined"
+    REPAIR_DONE = "RepairDone"  # quarantine repair pull completed
+    SCRUB_PASS_DONE = "ScrubPassDone"  # one full scrub cycle finished
+    SHARD_DEGRADED = "ShardDegraded"  # WAL EIO/ENOSPC: now read-only
 
 
 _enabled = False

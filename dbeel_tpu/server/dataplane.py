@@ -139,6 +139,15 @@ class DataPlane:
             # Durable mode without the native group-commit thread:
             # writes must punt to the Python coalescer.
             return None
+        from ..storage import file_io
+
+        if file_io._faults:
+            # Disk-fault seam armed (tests / chaos drills): the C
+            # appender would bypass the Python-side injection AND the
+            # degraded-mode escalation it must trigger — punt writes
+            # to the guarded Python path.  Production never pays this
+            # (the dict is empty; one truthiness check).
+            return None
         return wal._native
 
     def register_tree(

@@ -22,6 +22,7 @@ import msgpack
 
 from ..errors import (
     BadFieldType,
+    CorruptedFile,
     DbeelError,
     KeyNotFound,
     KeyNotOwnedByShard,
@@ -232,8 +233,15 @@ async def handle_request(
                 # predicted digest bytes), then (ts, hash) fan-out —
                 # full entries move only when a replica is newer.
                 try:
+                    # consistency=1 means this local read may be the
+                    # ONLY evidence: shadow-suspect hits must demote
+                    # to (guarded) misses.  With consistency>1 the
+                    # quorum merge outvotes staleness by timestamp.
                     local_value = await asyncio.wait_for(
-                        col.tree.get_entry(key), timeout_ms / 1000
+                        col.tree.get_entry(
+                            key, suspect_guard=consistency == 1
+                        ),
+                        timeout_ms / 1000,
                     )
                 except asyncio.TimeoutError as e:
                     raise Timeout("get") from e
@@ -255,6 +263,19 @@ async def handle_request(
                         local_value is None
                         or bytes(local_value[0]) == TOMBSTONE
                     ):
+                        if (
+                            local_value is None
+                            and consistency == 1
+                            and col.tree.reads_suspect
+                        ):
+                            # No replica corroborated this absence
+                            # (consistency=1 ends the digest round
+                            # immediately): unproven during a pending
+                            # repair — error retryably.
+                            raise CorruptedFile(
+                                "local miss is suspect: quarantined "
+                                "table pending repair"
+                            )
                         raise KeyNotFound(repr(key))
                     return bytes(local_value[0])
             remote = my_shard.send_request_to_replicas(
@@ -267,7 +288,12 @@ async def handle_request(
             try:
                 if local_value is _NO_LOCAL_READ:
                     local_value, values = await asyncio.wait_for(
-                        asyncio.gather(col.tree.get_entry(key), remote),
+                        asyncio.gather(
+                            col.tree.get_entry(
+                                key, suspect_guard=consistency == 1
+                            ),
+                            remote,
+                        ),
                         max(
                             0.001,
                             deadline
@@ -297,14 +323,26 @@ async def handle_request(
                 rf - replica_index - 1,
             )
         try:
-            value = await asyncio.wait_for(
-                col.tree.get(key), timeout_ms / 1000
+            entry = await asyncio.wait_for(
+                col.tree.get_entry(key, suspect_guard=True),
+                timeout_ms / 1000,
             )
         except asyncio.TimeoutError as e:
             raise Timeout("get") from e
-        if value is None:
-            raise KeyNotFound(repr(key))
-        return value
+        if entry is not None and bytes(entry[0]) != TOMBSTONE:
+            return bytes(entry[0])
+        if entry is None and col.tree.reads_suspect:
+            # RF=1 read on a tree with a quarantine pending repair:
+            # absence is unproven (the key may have lived in the
+            # dropped table) — surface the retryable corruption
+            # error, not a confident KeyNotFound.  A TOMBSTONE hit
+            # that survived the suspect guard is newest evidence and
+            # stays a confident KeyNotFound.
+            raise CorruptedFile(
+                "local miss is suspect: quarantined table "
+                "pending repair"
+            )
+        raise KeyNotFound(repr(key))
 
     if isinstance(rtype, str):
         raise UnsupportedField(rtype)
@@ -467,7 +505,13 @@ async def _multi_get_keyed(
     op_status: dict = {}
     number_of_nodes = rf - replica_index - 1
     try:
-        local = col.tree.multi_get(keys)
+        # suspect_guard whenever the local read may be the ONLY
+        # evidence (consistency=1 — including RF>1 with 0 remote acks
+        # awaited): a quorum merge outvotes shadow-suspect staleness
+        # by timestamp, an evidence-free merge cannot.
+        local = col.tree.multi_get(
+            keys, suspect_guard=consistency == 1
+        )
         if rf > 1:
             # Full-entry round only: the digest prediction is a
             # per-key byte-compare trick and does not compose with
@@ -517,7 +561,21 @@ async def _multi_get_keyed(
             except KeyNotFound as e:
                 results[i] = [1, e.to_wire()]
                 continue
-        if (
+            except CorruptedFile as e:
+                # Suspect miss (quarantine pending repair): retryable
+                # per-sub-op error; the client re-runs it through the
+                # single-op replica walk.
+                my_shard.metrics.record_error(classify_error(e))
+                results[i] = [1, e.to_wire()]
+                continue
+        if local_value is None and col.tree.reads_suspect:
+            e = CorruptedFile(
+                "local miss is suspect: quarantined table pending "
+                "repair"
+            )
+            my_shard.metrics.record_error(classify_error(e))
+            results[i] = [1, e.to_wire()]
+        elif (
             local_value is None
             or bytes(local_value[0]) == TOMBSTONE
         ):
@@ -656,6 +714,15 @@ def _merge_quorum_get(
             )
         if win_value != TOMBSTONE:
             return win_value
+    if not values and local_value is None and col.tree.reads_suspect:
+        # Local-only evidence (consistency=1) on a tree with a
+        # quarantine pending repair: the key may have lived in the
+        # dropped table, so absence is unproven — answer with a
+        # RETRYABLE error and let the client walk to a clean replica
+        # instead of asserting KeyNotFound.
+        raise CorruptedFile(
+            "local miss is suspect: quarantined table pending repair"
+        )
     raise KeyNotFound(repr(key))
 
 
@@ -1246,7 +1313,9 @@ class _DbProtocol(framed.FramedServerProtocol):
         found: dict = {}
         try:
             found = await asyncio.wait_for(
-                col.tree.multi_get([k for _e, k, _s in keyed]),
+                col.tree.multi_get(
+                    [k for _e, k, _s in keyed], suspect_guard=True
+                ),
                 timeout_ms / 1000,
             )
         except asyncio.TimeoutError:
@@ -1258,6 +1327,15 @@ class _DbProtocol(framed.FramedServerProtocol):
             if err is not None:
                 my_shard.metrics.record_error(classify_error(err))
                 buf = _error_response(err)
+            elif hit is None and col.tree.reads_suspect:
+                # Quarantine pending repair: a miss is unproven —
+                # answer retryably so the client walks replicas.
+                bad = CorruptedFile(
+                    "local miss is suspect: quarantined table "
+                    "pending repair"
+                )
+                my_shard.metrics.record_error(classify_error(bad))
+                buf = _error_response(bad)
             elif hit is None or bytes(hit[0]) == TOMBSTONE:
                 buf = _error_response(KeyNotFound(repr(key)))
             else:

@@ -189,6 +189,22 @@ class MyShard:
         # Set by crash-simulating harnesses: suppresses graceful-stop
         # side effects (death gossip) so a "crash" really is silent.
         self.crashed = False
+        # Durability plane (PR 3): WAL EIO/ENOSPC flips the shard into
+        # explicit read-only degraded mode — writes answer
+        # ShardDegraded (clients walk to healthy replicas), reads keep
+        # serving.  Sticky until restart: a disk that errored once is
+        # not trusted again on a timer.
+        self.degraded = False
+        self.degraded_reason: Optional[str] = None
+        # Collections with a replica repair pull in flight (quarantine
+        # recovery) — dedup so a burst of checksum failures on one
+        # table spawns one repair; a quarantine arriving MID-repair
+        # marks a rerun instead of being dropped.
+        self._repairs_running: set = set()
+        self._repairs_rerun: set = set()
+        # Background scrub counters (tasks.run_scrub_loop).
+        self.scrub_bytes_verified = 0
+        self.scrub_cycles = 0
         # Per-boot nonce salted into the gossip source: a restarted
         # node's announcements are a FRESH epidemic, so the seen-count
         # dedup can never suppress a rejoin (the reference's
@@ -453,7 +469,7 @@ class MyShard:
         # Intra-merge latency class: the merge worker thread yields CPU
         # to serving between bounded quanta (scheduler.BgThrottle).
         strategy.throttle = self.scheduler.thread_throttle()
-        return LSMTree.open_or_create(
+        tree = LSMTree.open_or_create(
             self._collection_dir(name),
             cache=PartitionPageCache(name, self.cache),
             capacity=capacity,
@@ -463,6 +479,108 @@ class MyShard:
             strategy=strategy,
             memtable_kind=self.config.memtable_kind,
         )
+        # Durability-plane escalation hooks: disk errors degrade the
+        # whole shard; a corruption quarantine pulls the lost range
+        # back from replicas.
+        tree.on_disk_error = self._on_tree_disk_error
+        tree.on_quarantine = (
+            lambda _tree, n=name: self._on_tree_quarantine(n)
+        )
+        if self.degraded:
+            tree.read_only = True
+        return tree
+
+    # -- degraded mode + quarantine repair (durability plane) ----------
+
+    def _on_tree_disk_error(self, exc: BaseException) -> None:
+        self.enter_degraded(exc)
+
+    def enter_degraded(self, reason) -> None:
+        """Flip this shard read-only after a disk failure: every
+        tree rejects writes with ShardDegraded (a retryable class —
+        coordinators keep quorum via the other replicas, smart clients
+        walk), the native write fast path is suspended so the guard
+        cannot be bypassed in C, and reads keep serving.  Sticky until
+        operator restart."""
+        for col in self.collections.values():
+            col.tree.read_only = True
+        if self.degraded:
+            return
+        self.degraded = True
+        self.degraded_reason = str(reason)
+        log.error(
+            "shard %s entering DEGRADED read-only mode: %s",
+            self.shard_name,
+            reason,
+        )
+        if self.dataplane is not None:
+            # The C client/replica planes answer writes without Python
+            # in the loop: unhook them (listener first, or the next
+            # write-state notify would re-register) so every request
+            # funnels through the guarded Python path.
+            for name, col in list(self.collections.items()):
+                col.tree.write_state_listener = None
+                try:
+                    self.dataplane.unregister(name)
+                except Exception:
+                    log.exception(
+                        "dataplane unregister(%s) failed", name
+                    )
+        self.flow.notify(FlowEvent.SHARD_DEGRADED)
+
+    def _on_tree_quarantine(self, name: str) -> None:
+        """A table was quarantined: suspend the collection's native
+        fast path (a C miss during the suspect window would read as a
+        confident absence) and spawn one replica repair pull."""
+        col = self.collections.get(name)
+        if col is not None and self.dataplane is not None:
+            col.tree.write_state_listener = None
+            try:
+                self.dataplane.unregister(name)
+            except Exception:
+                log.exception("dataplane unregister(%s) failed", name)
+        if name in self._repairs_running:
+            # A repair is mid-pull: its `covered` snapshot doesn't
+            # include THIS quarantine — request a follow-up run, or
+            # the new quarantine would stay suspect forever.
+            self._repairs_rerun.add(name)
+            return
+        self._repairs_running.add(name)
+
+        async def run(n=name):
+            from .tasks import repair_collection
+
+            try:
+                while True:
+                    self._repairs_rerun.discard(n)
+                    await repair_collection(self, n)
+                    if n not in self._repairs_rerun:
+                        break
+            except Exception:
+                log.exception("replica repair for %s failed", n)
+            finally:
+                self._repairs_running.discard(n)
+                self._resume_dataplane(n)
+
+        self.spawn(run())
+
+    def _resume_dataplane(self, name: str) -> None:
+        col = self.collections.get(name)
+        if (
+            col is None
+            or self.dataplane is None
+            or self.degraded
+            or col.tree.reads_suspect
+        ):
+            return
+        try:
+            self.dataplane.register_tree(
+                name,
+                col.tree,
+                client_plane=col.replication_factor == 1,
+            )
+        except Exception:
+            log.exception("dataplane re-register(%s) failed", name)
 
     def get_stats(self) -> dict:
         """Per-shard observability snapshot (no reference analog —
@@ -477,8 +595,27 @@ class MyShard:
             }
         from ..storage.wal import group_commit_stats, hub_fsync_errors
 
+        durability = {
+            "checksum_failures": 0,
+            "quarantined_tables": 0,
+            "repairs_completed": 0,
+        }
+        repairs_pending = 0
+        for col in self.collections.values():
+            for k in durability:
+                durability[k] += col.tree.durability.get(k, 0)
+            repairs_pending += col.tree._quarantine_pending
+        durability.update(
+            repairs_pending=repairs_pending,
+            scrub_bytes_verified=self.scrub_bytes_verified,
+            scrub_cycles=self.scrub_cycles,
+            degraded_mode=int(self.degraded),
+            degraded_reason=self.degraded_reason,
+        )
+
         return {
             "shard": self.shard_name,
+            "durability": durability,
             "nodes_known": len(self.nodes),
             "ring_size": len(self.shards),
             "dead_nodes": sorted(self.dead_nodes),
@@ -1061,6 +1198,10 @@ class MyShard:
             if col is None:
                 return ShardResponse.multi_get([None] * len(keys))
             found = await col.tree.multi_get(keys)
+            if col.tree.reads_suspect and any(
+                found.get(k) is None for k in keys
+            ):
+                self._raise_suspect_miss()
             return ShardResponse.multi_get(
                 [found.get(k) for k in keys]
             )
@@ -1069,6 +1210,8 @@ class MyShard:
             entry = None
             if col is not None:
                 entry = await col.tree.get_entry(bytes(request[3]))
+                if entry is None and col.tree.reads_suspect:
+                    self._raise_suspect_miss()
             return ShardResponse.get(entry)
         if kind == ShardRequest.GET_DIGEST:
             # Digest read (quorum-get fast path): answer (ts, value
@@ -1079,6 +1222,8 @@ class MyShard:
             entry = None
             if col is not None:
                 entry = await col.tree.get_entry(bytes(request[3]))
+                if entry is None and col.tree.reads_suspect:
+                    self._raise_suspect_miss()
             return ShardResponse.get_digest(entry)
         if kind == ShardRequest.RANGE_DIGEST:
             col = self.collections.get(request[2])
@@ -1129,6 +1274,18 @@ class MyShard:
                     )
             return ShardResponse.empty(ShardResponse.RANGE_PUSH)
         raise DbeelError(f"unknown shard request {kind!r}")
+
+    @staticmethod
+    def _raise_suspect_miss() -> None:
+        """A replica-plane miss on a tree with a quarantine pending
+        repair is unproven (the key may have lived in the dropped
+        table): answer the coordinator with a retryable error frame
+        instead of a confident absence it would merge as truth."""
+        from ..errors import CorruptedFile
+
+        raise CorruptedFile(
+            "replica miss is suspect: quarantined table pending repair"
+        )
 
     # ------------------------------------------------------------------
     # Anti-entropy primitives (no reference analog — SURVEY §5 lists

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 
 from ..errors import DbeelError, ShardStopped
@@ -365,22 +366,7 @@ async def _sync_range_with_peer(
         ShardRequest.range_digest(name, start, end, nb)
     )
     msgs.response_to_result(resp, ShardResponse.RANGE_DIGEST)
-    try:
-        p_counts, p_digests = list(resp[2]), list(resp[3])
-    except TypeError:  # scalar (pre-bucket dialect) or junk
-        p_counts = []
-        p_digests = []
-    if len(p_counts) != nb or len(p_digests) != nb:
-        # Defensive: peer answered a weird/old shape — treat every
-        # bucket as diverged and fall back to a whole-range sync
-        # rather than crashing this shard's anti-entropy loop.
-        p_counts = [-1] * nb
-        p_digests = [0] * nb
-    diverged = [
-        b
-        for b in range(nb)
-        if (counts[b], digests[b]) != (p_counts[b], p_digests[b])
-    ]
+    diverged = _diverged_buckets(counts, digests, resp, nb)
     if not diverged:
         return False
     bucket_set = set(diverged)
@@ -410,37 +396,9 @@ async def _sync_range_with_peer(
         pushed += len(page)
     # ...and pull theirs (same diverged buckets), applying only
     # strictly-newer entries.
-    pulled = 0
-    fetched = 0
-    page_after = None
-    while True:
-        resp = await peer.connection.send_request(
-            ShardRequest.range_pull(
-                name,
-                start,
-                end,
-                page_after,
-                ANTI_ENTROPY_PAGE,
-                diverged,
-                nb,
-            )
-        )
-        entries = msgs.response_to_result(
-            resp, ShardResponse.RANGE_PULL
-        )
-        if not entries:
-            break
-        fetched += len(entries)
-        my_shard.ae_entries_fetched += len(entries)
-        async with my_shard.scheduler.bg_slice():
-            for key, value, ts in entries:
-                if await my_shard.apply_if_newer(
-                    tree, bytes(key), bytes(value), int(ts)
-                ):
-                    pulled += 1
-        if len(entries) < ANTI_ENTROPY_PAGE:
-            break
-        page_after = bytes(entries[-1][0])
+    fetched, pulled = await _pull_buckets_from_peer(
+        my_shard, name, tree, peer, start, end, diverged, nb
+    )
     if pushed or pulled:
         log.info(
             "anti-entropy %s with %s: %d/%d buckets diverged, "
@@ -531,6 +489,310 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
                         e,
                     )
         my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_DONE)
+
+
+# ----------------------------------------------------------------------
+# Quarantine repair + background scrub (durability plane, PR 3 — no
+# reference analog: the reference trusts every byte it reads back).
+#
+# Repair: when a checksum failure quarantines an sstable, the shard
+# pulls the lost range back from its replicas THROUGH the existing
+# anti-entropy machinery — per-bucket range digests gate the transfer,
+# so only the buckets the quarantine actually diverged move, and
+# apply_if_newer keeps the pulls LWW-safe.  The pull covers the arc
+# this shard can store — its primary range plus the rf-1 predecessor
+# primaries it replicates — from peers in BOTH walk directions, and
+# buckets that agree cost one digest frame.  Only after the pull
+# completes are the quarantined files retired (tree.finish_repair)
+# and suspect-miss reads re-enabled.
+#
+# Scrub: a background pass re-reads cold blocks directly (no page-
+# cache pollution) at a bounded byte rate under the share scheduler,
+# verifying them against the checksum sidecar — bit rot is found in
+# weeks-old tables BEFORE a client read trips over it; a mismatch
+# funnels into the exact same quarantine → repair path.
+# ----------------------------------------------------------------------
+
+
+async def _pull_buckets_from_peer(
+    my_shard, name, tree, peer, start, end, buckets, nb
+) -> "tuple[int, int]":
+    """Paged RANGE_PULL of ``buckets`` from one peer, applying each
+    entry strictly-newer — the pull half shared by the anti-entropy
+    exchange and the quarantine repair (one implementation, so paging
+    or dialect fixes can never diverge between them).  Returns
+    (entries fetched, entries applied)."""
+    from ..cluster.messages import ShardRequest, ShardResponse
+
+    fetched = applied = 0
+    page_after = None
+    while True:
+        resp = await peer.connection.send_request(
+            ShardRequest.range_pull(
+                name,
+                start,
+                end,
+                page_after,
+                ANTI_ENTROPY_PAGE,
+                buckets,
+                nb,
+            )
+        )
+        entries = msgs.response_to_result(
+            resp, ShardResponse.RANGE_PULL
+        )
+        if not entries:
+            break
+        fetched += len(entries)
+        my_shard.ae_entries_fetched += len(entries)
+        async with my_shard.scheduler.bg_slice():
+            for key, value, ts in entries:
+                if await my_shard.apply_if_newer(
+                    tree, bytes(key), bytes(value), int(ts)
+                ):
+                    applied += 1
+        if len(entries) < ANTI_ENTROPY_PAGE:
+            break
+        page_after = bytes(entries[-1][0])
+    return fetched, applied
+
+
+def _diverged_buckets(counts, digests, resp, nb) -> list:
+    """Bucket indices where our (count, digest) disagrees with a
+    peer's RANGE_DIGEST response; defensive about old-dialect/junk
+    shapes (everything diverged → whole-range sync, never a crash)."""
+    try:
+        p_counts, p_digests = list(resp[2]), list(resp[3])
+    except TypeError:
+        p_counts, p_digests = [], []
+    if len(p_counts) != nb or len(p_digests) != nb:
+        p_counts = [-1] * nb
+        p_digests = [0] * nb
+    return [
+        b
+        for b in range(nb)
+        if (counts[b], digests[b]) != (p_counts[b], p_digests[b])
+    ]
+
+
+async def _pull_diverged_from_peer(
+    my_shard, name, tree, peer, start, end, nb
+) -> int:
+    """Pull-only half of the anti-entropy exchange: compare per-bucket
+    digests with one peer and apply (strictly-newer) everything in the
+    diverged buckets.  Returns entries applied."""
+    from ..cluster.messages import ShardRequest, ShardResponse
+
+    async with my_shard.scheduler.bg_slice():
+        counts, digests = await my_shard.compute_range_digests(
+            tree, start, end, nb
+        )
+    resp = await peer.connection.send_request(
+        ShardRequest.range_digest(name, start, end, nb)
+    )
+    msgs.response_to_result(resp, ShardResponse.RANGE_DIGEST)
+    diverged = _diverged_buckets(counts, digests, resp, nb)
+    if not diverged:
+        return 0
+    _fetched, applied = await _pull_buckets_from_peer(
+        my_shard, name, tree, peer, start, end, diverged, nb
+    )
+    return applied
+
+
+async def repair_collection(my_shard: MyShard, name: str) -> None:
+    """Re-fetch whatever a quarantined table lost from this
+    collection's replicas, then retire the quarantined files."""
+    col = my_shard.collections.get(name)
+    if col is None:
+        return
+    tree = col.tree
+    covered = tree._quarantine_pending
+    rf = col.replication_factor
+    nb = max(1, my_shard.config.anti_entropy_buckets)
+    # Scope the pull to the arc this shard can actually STORE — the
+    # union of its primary range and the rf-1 predecessor primaries
+    # it replicates, i.e. (rf-th-distinct-node-predecessor, self].
+    # An unscoped whole-ring compare would import every peer-only
+    # range wholesale (unbounded store bloat in clusters with
+    # nodes > rf).  With fewer distinct nodes than rf the arc IS the
+    # whole ring (start == end).  Over-approximation under ring churn
+    # is safe: apply_if_newer is LWW and migration cleanup owns
+    # unowned-range hygiene.
+    seen_pred: set = set()
+    start_hash = my_shard.hash  # start == end ⇒ whole ring
+    for s in reversed(my_shard.shards):  # rotated: [-1] = predecessor
+        if s.node_name == my_shard.config.name:
+            continue
+        if s.node_name not in seen_pred:
+            seen_pred.add(s.node_name)
+        start_hash = s.hash
+        if len(seen_pred) >= rf:
+            break
+    if len(seen_pred) < rf:
+        start_hash = my_shard.hash
+    start = (start_hash + 1) & 0xFFFFFFFF
+    end = (my_shard.hash + 1) & 0xFFFFFFFF
+    # The peers that can hold data this shard stores are BOTH walk
+    # directions: the rf-1 distinct-node SUCCESSORS replicate our
+    # primary range, and the rf-1 distinct-node PREDECESSORS own the
+    # ranges we hold as a replica — pulling from successors alone
+    # would silently never recover a quarantined replica range.
+    # RF=1 has NO peer holding our data: the honest outcome is the
+    # lost-data branch below, never a pull from a non-replica.
+    nodes: set = set()
+    peers = []
+
+    def _collect(walk):
+        found = 0
+        for s in walk:
+            if (
+                s.node_name == my_shard.config.name
+                or s.node_name in nodes
+            ):
+                continue
+            nodes.add(s.node_name)
+            peers.append(s)
+            found += 1
+            if found >= rf - 1:
+                return
+
+    if rf > 1:
+        _collect(my_shard.shards)  # forward: successors
+        _collect(reversed(my_shard.shards))  # backward: predecessors
+    if not peers:
+        log.warning(
+            "repair of %s: no replica holds this shard's data — "
+            "whatever only the quarantined table held is LOST; "
+            "clearing the suspect state so reads answer again",
+            name,
+        )
+        tree.finish_repair(covered, recovered=False)
+        my_shard.flow.notify(FlowEvent.REPAIR_DONE)
+        return
+    applied = 0
+    ok = 0
+    for peer in peers:
+        try:
+            applied += await _pull_diverged_from_peer(
+                my_shard, name, tree, peer, start, end, nb
+            )
+            ok += 1
+        except (DbeelError, OSError) as e:
+            log.warning(
+                "repair pull of %s from %s failed: %s",
+                name,
+                peer.name,
+                e,
+            )
+    if ok == 0:
+        # Every peer failed: keep the suspect state (reads keep
+        # walking to replicas) and retry on a later quarantine/scrub
+        # trigger rather than declaring a repair that never ran.
+        log.error("repair of %s: no peer reachable; will retry", name)
+        return
+    log.info(
+        "repair of %s complete: %d entries re-applied from %d peers",
+        name,
+        applied,
+        ok,
+    )
+    tree.finish_repair(covered)
+    my_shard.flow.notify(FlowEvent.REPAIR_DONE)
+
+
+SCRUB_CHUNK_PAGES = 64
+
+
+def _scrub_read_chunk(fd: int, first_page: int, n: int, page_size: int):
+    out = []
+    for i in range(n):
+        raw = os.pread(fd, page_size, (first_page + i) * page_size)
+        if len(raw) < page_size:
+            raw = raw + b"\x00" * (page_size - len(raw))
+        out.append(raw)
+    return out
+
+
+async def _scrub_table(my_shard, tree, table, rate: int) -> None:
+    import zlib
+
+    from ..errors import CorruptedFile
+    from ..storage.entry import PAGE_SIZE
+
+    for reader, crcs in (
+        (table._data, table.sums.data_crcs),
+        (table._index, table.sums.index_crcs),
+    ):
+        page = 0
+        npages = len(crcs)
+        while page < npages:
+            chunk = min(SCRUB_CHUNK_PAGES, npages - page)
+            # Short acquire windows per chunk: holding the list
+            # refcount for a whole rate-limited table would stall
+            # compaction's reader-drain for minutes.
+            lst = tree._sstables
+            if (
+                table not in lst.tables
+                or table.index in tree._quarantined_indices
+                or reader._fd < 0
+            ):
+                return  # compacted away / quarantined mid-scrub
+            lst.acquire()
+            try:
+                async with my_shard.scheduler.bg_slice():
+                    try:
+                        raws = await asyncio.get_event_loop().run_in_executor(
+                            None,
+                            _scrub_read_chunk,
+                            reader._fd,
+                            page,
+                            chunk,
+                            PAGE_SIZE,
+                        )
+                    except OSError:
+                        return  # fd closed under us: table retired
+                for j, raw in enumerate(raws):
+                    if zlib.crc32(raw) != crcs[page + j]:
+                        exc = CorruptedFile(
+                            f"{reader.path}: scrub found page "
+                            f"{page + j} failing its CRC"
+                        )
+                        exc.path = reader.path
+                        tree._handle_table_corruption(table, exc)
+                        return
+            finally:
+                lst.release()
+            my_shard.scrub_bytes_verified += chunk * PAGE_SIZE
+            page += chunk
+            # Bounded byte rate: cold-block verification must never
+            # compete with foreground I/O (Pome's lesson: overlap is
+            # where LSM throughput lives).
+            await asyncio.sleep(chunk * PAGE_SIZE / rate)
+
+
+async def run_scrub_loop(my_shard: MyShard) -> None:
+    interval = my_shard.config.scrub_interval_ms / 1000.0
+    if interval <= 0:
+        return
+    rate = max(1, my_shard.config.scrub_bytes_per_sec)
+    while True:
+        await asyncio.sleep(interval)
+        from ..storage import checksums
+
+        if not checksums.verification_enabled():
+            # DBEEL_NO_CHECKSUMS=1 is the whole-plane kill switch
+            # (distrusted sidecars / emergency): the scrub must not
+            # keep quarantining behind the operator's back.
+            continue
+        for _name, col in list(my_shard.collections.items()):
+            tables = list(col.tree._sstables.tables)
+            for table in tables:
+                if table.sums is None:
+                    continue  # legacy table: nothing to verify against
+                await _scrub_table(my_shard, col.tree, table, rate)
+        my_shard.scrub_cycles += 1
+        my_shard.flow.notify(FlowEvent.SCRUB_PASS_DONE)
 
 
 # ----------------------------------------------------------------------

@@ -27,12 +27,13 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import columnar
+from . import checksums, columnar
 from .bloom import BloomFilter
 from .entry import (
     COMPACT_BLOOM_FILE_EXT,
     COMPACT_DATA_FILE_EXT,
     COMPACT_INDEX_FILE_EXT,
+    COMPACT_SUMS_FILE_EXT,
     ENTRY_HEADER_SIZE,
     INDEX_ENTRY,
     file_name,
@@ -131,11 +132,22 @@ class HeapMergeStrategy(CompactionStrategy):
             keys.append(key)
         data_size = writer.close()
         wrote_bloom = False
+        bloom_bytes = None
         if data_size >= bloom_min_size:
             bloom = BloomFilter.with_capacity(max(1, len(keys)))
             bloom.add_batch(keys)
-            _write_bloom(dir_path, output_index, bloom)
+            bloom_bytes = _write_bloom(dir_path, output_index, bloom)
             wrote_bloom = True
+        data_crcs, index_crcs = writer.page_crcs()
+        checksums.write(
+            dir_path,
+            output_index,
+            data_crcs,
+            index_crcs,
+            data_size,
+            bloom_bytes,
+            ext=COMPACT_SUMS_FILE_EXT,
+        )
         return MergeResult(writer.entries_written, data_size, wrote_bloom)
 
 
@@ -229,6 +241,7 @@ def write_output_columnar(
     index_w.close()
 
     wrote_bloom = False
+    bloom_bytes = None
     if data_size >= bloom_min_size:
         key_pos = columnar.ranges_to_positions(
             cols.start[order] + np.uint64(ENTRY_HEADER_SIZE),
@@ -243,19 +256,32 @@ def write_output_columnar(
         ]
         bloom = BloomFilter.with_capacity(max(1, n))
         bloom.add_batch(keys)
-        _write_bloom(dir_path, output_index, bloom)
+        bloom_bytes = _write_bloom(dir_path, output_index, bloom)
         wrote_bloom = True
+    checksums.write(
+        dir_path,
+        output_index,
+        data_w.page_crcs,
+        index_w.page_crcs,
+        data_size,
+        bloom_bytes,
+        ext=COMPACT_SUMS_FILE_EXT,
+    )
     return MergeResult(n, data_size, wrote_bloom)
 
 
-def _write_bloom(dir_path: str, output_index: int, bloom: BloomFilter):
+def _write_bloom(
+    dir_path: str, output_index: int, bloom: BloomFilter
+) -> bytes:
     path = f"{dir_path}/{file_name(output_index, COMPACT_BLOOM_FILE_EXT)}"
     import os
 
+    blob = bloom.serialize()
     with open(path, "wb") as f:
-        f.write(bloom.serialize())
+        f.write(blob)
         f.flush()
         os.fsync(f.fileno())
+    return blob
 
 
 def _jax_marked_dead(backend: str) -> bool:

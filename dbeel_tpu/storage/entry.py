@@ -42,6 +42,9 @@ COMPACT_DATA_FILE_EXT = "compact_data"
 COMPACT_INDEX_FILE_EXT = "compact_index"
 COMPACT_BLOOM_FILE_EXT = "compact_bloom"
 COMPACT_ACTION_FILE_EXT = "compact_action"
+# Per-block CRC32 sidecar (storage/checksums.py) — no reference analog.
+SUMS_FILE_EXT = "sums"
+COMPACT_SUMS_FILE_EXT = "compact_sums"
 
 # Zero-padded index in file names so lexicographic order == numeric order
 # (reference INDEX_PADDING = 20, mod.rs:21).

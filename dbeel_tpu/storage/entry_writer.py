@@ -68,6 +68,16 @@ class EntryWriter:
         self._index.close(sync=sync)
         return size
 
+    def page_crcs(self):
+        """(data page CRCs, index page CRCs) accumulated by the
+        mirroring writers — the inputs for the table's sums sidecar
+        (storage/checksums.py); valid after close()."""
+        return self._data.page_crcs, self._index.page_crcs
+
+    @property
+    def index_size(self) -> int:
+        return self._index.written
+
     def abort(self) -> None:
         self._data.abort()
         self._index.abort()

@@ -15,27 +15,146 @@ without changing callers.
 from __future__ import annotations
 
 import asyncio
+import errno
 import os
-from typing import Optional, Tuple
+import shutil
+import zlib
+from typing import Optional, Sequence, Tuple
 
+from ..errors import CorruptedFile
 from .entry import PAGE_SIZE
 from .page_cache import PartitionPageCache, align_down
 
+# ---------------------------------------------------------------------
+# Disk-fault injection seam — the storage-plane twin of
+# remote_comm.set_fault: tests arm a fault for every file whose path
+# starts with a prefix, and the affected I/O paths (page preads,
+# page-mirroring writes, WAL appends/fsyncs, free-space probes)
+# misbehave deterministically — bit rot, short reads, EIO, ENOSPC and
+# torn closes with no real hardware tricks.  Production never touches
+# this: the dict stays empty and the per-call check is one truthiness
+# test.  ``DBEEL_DISK_FAULTS="<prefix>=<mode>[,...]"`` pre-arms faults
+# at import for subprocess harnesses (chaos_soak --disk-faults).
+# ---------------------------------------------------------------------
+
+FAULT_BITFLIP = "bitflip"  # flip one bit in every page read
+FAULT_SHORT_READ = "short_read"  # preads return half the bytes
+FAULT_EIO_READ = "eio_read"  # preads raise EIO
+FAULT_EIO_WRITE = "eio_write"  # writes raise EIO
+FAULT_ENOSPC = "enospc"  # writes raise ENOSPC
+FAULT_TORN_CLOSE = "torn_close"  # writer close drops the tail page
+FAULT_NO_SPACE = "no_space"  # free-space probe reports 0 bytes
+
+_faults: dict = {}  # path prefix -> mode
+
+
+def set_fault(path_prefix: str, mode: Optional[str]) -> None:
+    """Arm ``mode`` for every path under ``path_prefix`` (None
+    disarms)."""
+    if mode is None:
+        _faults.pop(path_prefix, None)
+    else:
+        _faults[path_prefix] = mode
+
+
+def clear_faults() -> None:
+    _faults.clear()
+
+
+def fault_for(path: str) -> Optional[str]:
+    if not _faults:
+        return None
+    for prefix, mode in _faults.items():
+        if path.startswith(prefix):
+            return mode
+    return None
+
+
+def _arm_from_env() -> None:
+    spec = os.environ.get("DBEEL_DISK_FAULTS", "")
+    for part in spec.split(","):
+        if "=" in part:
+            prefix, mode = part.rsplit("=", 1)
+            if prefix and mode:
+                set_fault(prefix, mode)
+
+
+_arm_from_env()
+
+
+def check_write_fault(path: str) -> None:
+    """Raise the armed write-side fault for ``path``, if any — called
+    by the WAL append path and the page-mirroring writer so EIO/ENOSPC
+    scenarios inject identically across the Python and native write
+    backends."""
+    mode = fault_for(path)
+    if mode == FAULT_EIO_WRITE:
+        raise OSError(errno.EIO, f"[fault] write EIO: {path}")
+    if mode == FAULT_ENOSPC:
+        raise OSError(
+            errno.ENOSPC, f"[fault] no space left on device: {path}"
+        )
+
+
+def free_disk_space(path: str) -> int:
+    """Free bytes on the filesystem holding ``path`` (seam-aware:
+    FAULT_NO_SPACE reports zero so ENOSPC back-off paths are testable
+    without filling a disk)."""
+    if fault_for(path) == FAULT_NO_SPACE:
+        return 0
+    try:
+        return shutil.disk_usage(os.path.dirname(path) or ".").free
+    except OSError:
+        return 1 << 62  # unknown filesystem: never back off on it
+
+
+def _apply_read_fault(path: str, raw: bytes) -> bytes:
+    mode = fault_for(path)
+    if mode is None:
+        return raw
+    if mode == FAULT_EIO_READ:
+        raise OSError(errno.EIO, f"[fault] read EIO: {path}")
+    if mode == FAULT_SHORT_READ:
+        return raw[: len(raw) // 2]
+    if mode == FAULT_BITFLIP and raw:
+        i = min(len(raw) - 1, PAGE_SIZE // 2)
+        flipped = bytearray(raw)
+        flipped[i] ^= 0x01
+        return bytes(flipped)
+    return raw
+
 
 class CachedFileReader:
-    """Read-through page cache over one immutable file."""
+    """Read-through page cache over one immutable file.
+
+    ``crcs`` (one CRC32 per 4 KiB page, storage/checksums.py) arms
+    verification: every page is checked right after the pread — BEFORE
+    it can enter the page cache or reach a caller — and a mismatch
+    raises ``CorruptedFile`` (with ``.path`` set for quarantine
+    attribution).  Without crcs the reader serves legacy-unverified,
+    exactly as before."""
 
     def __init__(
         self,
         path: str,
         file_id: Tuple[str, int],
         cache: Optional[PartitionPageCache],
+        crcs: Optional[Sequence[int]] = None,
     ) -> None:
         self.path = path
         self.file_id = file_id
         self._cache = cache
         self._fd = os.open(path, os.O_RDONLY)
         self.size = os.fstat(self._fd).st_size
+        from . import checksums as _ck
+
+        # Held by reference (TableSums owns the array('I')): a large
+        # table's CRC arrays must not be duplicated per reader.
+        self._crcs = (
+            crcs
+            if crcs is not None and _ck.verification_enabled()
+            else None
+        )
 
     def close(self) -> None:
         if self._fd >= 0:
@@ -47,6 +166,18 @@ class CachedFileReader:
             self.close()
         except Exception:
             pass
+
+    def _verify_page(self, address: int, raw: bytes) -> None:
+        crcs = self._crcs
+        if crcs is None:
+            return
+        i = address // PAGE_SIZE
+        if i >= len(crcs) or zlib.crc32(raw) != crcs[i]:
+            exc = CorruptedFile(
+                f"{self.path}: page at {address} failed its CRC"
+            )
+            exc.path = self.path
+            raise exc
 
     def read_at(self, pos: int, size: int) -> bytes:
         """cached_file_reader.rs:28-79: walk the range page by page, cache
@@ -79,8 +210,11 @@ class CachedFileReader:
 
     def _pread_page(self, address: int) -> bytes:
         raw = os.pread(self._fd, PAGE_SIZE, address)
+        if _faults:
+            raw = _apply_read_fault(self.path, raw)
         if len(raw) < PAGE_SIZE:
             raw = raw + b"\x00" * (PAGE_SIZE - len(raw))
+        self._verify_page(address, raw)
         return raw
 
     def _pread_pages(self, addresses) -> list:
@@ -122,14 +256,18 @@ class CachedFileReader:
                     ),
                 ):
                     by_addr[a] = r
-            return [
-                (
-                    r + b"\x00" * (PAGE_SIZE - len(r))
-                    if len(r) < PAGE_SIZE
-                    else r
-                )
-                for r in (by_addr[a] for a in addresses)
-            ]
+            out = []
+            for a in addresses:
+                r = by_addr[a]
+                if _faults:
+                    r = _apply_read_fault(self.path, r)
+                if len(r) < PAGE_SIZE:
+                    r = r + b"\x00" * (PAGE_SIZE - len(r))
+                # Verify BEFORE the caller can cache or decode it —
+                # uring completions bypass _pread_page.
+                self._verify_page(a, r)
+                out.append(r)
+            return out
         return await asyncio.get_event_loop().run_in_executor(
             None, self._pread_pages, addresses
         )
@@ -219,6 +357,11 @@ class PageMirroringWriter:
         self._buf = bytearray()
         self._flushed = 0  # bytes written to the OS so far (page multiple)
         self.written = 0  # logical bytes appended
+        # CRC32 per completed page, accumulated as pages are emitted —
+        # the write-side half of the checksum plane (zero extra I/O;
+        # the sums sidecar is assembled from these at close by the
+        # sstable-writing call sites).
+        self.page_crcs: list = []
 
     def write(self, data: bytes) -> None:
         self._buf += data
@@ -229,7 +372,13 @@ class PageMirroringWriter:
             del self._buf[:whole]
 
     def _emit(self, chunk: bytes) -> None:
+        if _faults:
+            check_write_fault(self.path)
         os.pwrite(self._fd, chunk, self._flushed)
+        for off in range(0, len(chunk), PAGE_SIZE):
+            self.page_crcs.append(
+                zlib.crc32(chunk[off : off + PAGE_SIZE])
+            )
         if self._cache is not None:
             for off in range(0, len(chunk), PAGE_SIZE):
                 self._cache.set(
@@ -253,6 +402,12 @@ class PageMirroringWriter:
         # Pages are written whole (cache mirroring needs that), but the
         # file's logical length is exact so entry counts derive from size.
         os.ftruncate(self._fd, self.written)
+        if _faults and fault_for(self.path) == FAULT_TORN_CLOSE:
+            # Torn write: the final page vanishes, as if power died
+            # between the tail write and the fsync below.
+            os.ftruncate(
+                self._fd, align_down(max(0, self.written - 1))
+            )
         if sync:
             os.fsync(self._fd)
         os.close(self._fd)

@@ -19,6 +19,7 @@ older and newer tables.
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 import os
 import re
@@ -28,9 +29,16 @@ from typing import AsyncIterator, Callable, List, Optional, Sequence, Tuple
 import msgpack
 
 from .. import flow_events
-from ..errors import CorruptedFile, MemtableCapacityReached, TooManyWalFiles
+from ..errors import (
+    CorruptedFile,
+    MemtableCapacityReached,
+    ShardDegraded,
+    TooManyWalFiles,
+)
 from ..utils.event import LocalEvent
 from ..utils.timestamps import now_nanos
+from . import checksums
+from . import file_io
 from . import wal as wal_mod
 from .bloom import BloomFilter
 from .compaction import CompactionStrategy, HeapMergeStrategy
@@ -40,9 +48,11 @@ from .entry import (
     COMPACT_BLOOM_FILE_EXT,
     COMPACT_DATA_FILE_EXT,
     COMPACT_INDEX_FILE_EXT,
+    COMPACT_SUMS_FILE_EXT,
     DATA_FILE_EXT,
     INDEX_FILE_EXT,
     MEMTABLE_FILE_EXT,
+    SUMS_FILE_EXT,
     TOMBSTONE,
     file_name,
 )
@@ -57,6 +67,20 @@ DEFAULT_TREE_CAPACITY = 8192  # reference mod.rs:18
 DEFAULT_BLOOM_MIN_SIZE = 1 << 20
 
 _FILE_RE = re.compile(r"^(\d{20})\.(\w+)$")
+
+# Free-space floors (overridable for tests / tiny hosts): a flush or
+# compaction that would fill the disk backs off instead of half-writing
+# a triplet and cascading into ENOSPC quarantines.
+MIN_FREE_BYTES = int(
+    os.environ.get("DBEEL_MIN_FREE_BYTES", str(32 << 20))
+)
+QUARANTINE_DIR = "quarantine"
+
+# Errnos that mean the DISK (not the caller) failed — the degraded-mode
+# escalation set.
+_DISK_ERRNOS = frozenset(
+    {errno.EIO, errno.ENOSPC, errno.EROFS, errno.EDQUOT}
+)
 
 
 class SSTableList:
@@ -176,6 +200,37 @@ class LSMTree:
         self._pending_flush: Optional[Tuple[int, wal_mod.Wal]] = None
         self._disposing_wal: Optional[wal_mod.Wal] = None
 
+        # ---- durability plane (PR 3) ------------------------------
+        # Degraded mode: WAL EIO/ENOSPC flips the tree read-only —
+        # writes raise ShardDegraded (clients walk to healthy
+        # replicas) while reads keep serving.
+        self.read_only = False
+        # Escalation hooks wired by the owning shard: disk errors flip
+        # the whole shard degraded; a quarantine spawns a replica
+        # repair pull.
+        self.on_disk_error: Optional[Callable] = None
+        self.on_quarantine: Optional[Callable] = None
+        self.durability = {
+            "checksum_failures": 0,
+            "quarantined_tables": 0,
+            "repairs_completed": 0,
+        }
+        self._quarantined_indices: set = set()
+        # Quarantines not yet covered by a completed repair: while
+        # non-zero, a local miss is SUSPECT (the key may have lived in
+        # the dropped table) and read paths surface CorruptedFile
+        # instead of a confident absence.
+        self._quarantine_pending = 0
+        # Highest PENDING quarantined index: any surviving-table hit
+        # from a LOWER index is equally suspect under single-evidence
+        # reads — the quarantined newer table may have held a newer
+        # value or a tombstone that would shadow it (resurrection
+        # hazard).  Reset when repairs cover every pending quarantine.
+        self._suspect_max_index = -1
+        # In-flight quarantine file moves (reader-drain + os.replace):
+        # finish_repair must not race them when deleting quarantine/.
+        self._retire_tasks: set = set()
+
         self.flush_start_event = LocalEvent()
         self.flush_done_event = LocalEvent()
         self.flow = flow_events.FlowEventNotifier()
@@ -220,6 +275,7 @@ class LSMTree:
                 COMPACT_DATA_FILE_EXT,
                 COMPACT_INDEX_FILE_EXT,
                 COMPACT_BLOOM_FILE_EXT,
+                COMPACT_SUMS_FILE_EXT,
             ):
                 os.unlink(os.path.join(self.dir_path, name))
 
@@ -275,7 +331,10 @@ class LSMTree:
         # (3) Load sstables.
         self._sstables = SSTableList(
             [
-                SSTable(self.dir_path, i, self.cache)
+                SSTable(
+                    self.dir_path, i, self.cache,
+                    counters=self.durability,
+                )
                 for i in data_indices
             ]
         )
@@ -308,6 +367,7 @@ class LSMTree:
             self._wal_path(self._index),
             sync=self.wal_sync,
             sync_delay_us=self.wal_sync_delay_us,
+            on_error=self._report_disk_error,
         )
         if data_indices or wal_indices:
             # Anything recovered from disk may hold entries up to
@@ -347,6 +407,158 @@ class LSMTree:
                 os.unlink(victim)
         os.unlink(path)
 
+    # ------------------------------------------------------------------
+    # Durability plane: disk-error escalation + corruption quarantine
+    # (no reference analog — the reference trusts every byte it reads
+    # back and dies on WAL I/O errors).
+    # ------------------------------------------------------------------
+
+    def _report_disk_error(self, e: BaseException) -> None:
+        """Escalate a disk-level failure (WAL append/fsync EIO/ENOSPC,
+        flush/compaction out of space): flip this tree read-only and
+        tell the shard so it degrades the whole serving plane instead
+        of dying mid-pipeline.  Always called on the loop thread."""
+        if isinstance(e, OSError) and (
+            e.errno is not None and e.errno not in _DISK_ERRNOS
+        ):
+            return  # EBADF during a close race etc. — not the disk
+        first = not self.read_only
+        self.read_only = True
+        if first:
+            log.error(
+                "disk failure on %s: entering read-only degraded "
+                "mode (%s)",
+                self.dir_path,
+                e,
+            )
+            self.flow.notify(flow_events.FlowEvent.SHARD_DEGRADED)
+        if self.on_disk_error is not None:
+            try:
+                self.on_disk_error(e)
+            except Exception:
+                log.exception("on_disk_error callback failed")
+
+    @property
+    def reads_suspect(self) -> bool:
+        """True while a quarantine awaits repair: a local miss may be
+        LOST data, not a genuine absence — callers answering clients
+        from this tree alone must error (retryable) instead."""
+        return self._quarantine_pending > 0
+
+    def quarantine_table(self, table: SSTable, reason: str) -> None:
+        """Contain a corrupt table: drop it from the read set NOW
+        (synchronously — the very next probe must not touch it), purge
+        its page-cache entries, and move its files aside off-loop once
+        in-flight readers drain.  Never unlinks: the quarantined
+        triplet is retired only after a completed replica repair
+        (finish_repair) — extending the torn-journal containment at
+        _replay_compact_action to read-path corruption."""
+        if table.index in self._quarantined_indices:
+            return
+        self._quarantined_indices.add(table.index)
+        self.durability["quarantined_tables"] += 1
+        self._quarantine_pending += 1
+        self._suspect_max_index = max(
+            self._suspect_max_index, table.index
+        )
+        log.error(
+            "quarantining sstable %d of %s: %s",
+            table.index,
+            self.dir_path,
+            reason,
+        )
+        old_list = self._sstables
+        self._sstables = SSTableList(
+            [t for t in old_list.tables if t.index != table.index]
+        )
+        if self.cache is not None:
+            # A recycled (ext, index) file id must never serve the
+            # corrupt (or merely stale) pages.
+            self.cache.invalidate_file((DATA_FILE_EXT, table.index))
+            self.cache.invalidate_file((INDEX_FILE_EXT, table.index))
+        self._notify_write_state()
+        retire = asyncio.ensure_future(
+            self._retire_quarantined_files(old_list, table)
+        )
+        self._retire_tasks.add(retire)
+        retire.add_done_callback(self._retire_tasks.discard)
+        if self.on_quarantine is not None:
+            try:
+                self.on_quarantine(self)
+            except Exception:
+                log.exception("on_quarantine callback failed")
+        self.flow.notify(flow_events.FlowEvent.TABLE_QUARANTINED)
+
+    def _handle_table_corruption(
+        self, table: SSTable, exc: BaseException
+    ) -> None:
+        self.durability["checksum_failures"] += 1
+        self.quarantine_table(table, str(exc))
+
+    async def _retire_quarantined_files(self, old_list, table) -> None:
+        # Reader drain first (same contract as compaction input
+        # deletion): probes already inside the old snapshot may still
+        # hold offsets into these files.
+        while old_list.readers > 0:
+            await old_list.drained.listen()
+        table.close()
+        qdir = os.path.join(self.dir_path, QUARANTINE_DIR)
+
+        def _move():
+            os.makedirs(qdir, exist_ok=True)
+            for p in table.paths():
+                try:
+                    if os.path.exists(p):
+                        os.replace(
+                            p, os.path.join(qdir, os.path.basename(p))
+                        )
+                except OSError:
+                    log.warning("quarantine move failed for %s", p)
+
+        await asyncio.get_event_loop().run_in_executor(None, _move)
+
+    def finish_repair(self, covered: int, recovered: bool = True) -> None:
+        """A replica repair pull completed, covering ``covered``
+        quarantines observed when it started: retire the quarantined
+        files for good and clear the suspect-miss state.
+        ``recovered=False`` (no replica existed to pull from — the
+        quarantined data is lost) clears the state without counting a
+        completed repair in the stats."""
+        self._quarantine_pending = max(
+            0, self._quarantine_pending - max(0, covered)
+        )
+        if self._quarantine_pending == 0:
+            self._suspect_max_index = -1
+        if recovered:
+            self.durability["repairs_completed"] += 1
+        qdir = os.path.join(self.dir_path, QUARANTINE_DIR)
+
+        def _rm():
+            try:
+                for name in os.listdir(qdir):
+                    os.unlink(os.path.join(qdir, name))
+                os.rmdir(qdir)
+            except OSError:
+                pass
+
+        # A fast repair can beat the reader-drained file move
+        # (_retire_quarantined_files): deleting first would leave the
+        # late-moved triplet leaking in quarantine/ forever — wait for
+        # every in-flight retire before removing the dir.
+        pending = [t for t in self._retire_tasks if not t.done()]
+
+        async def _rm_after_retires():
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+            await asyncio.get_event_loop().run_in_executor(None, _rm)
+
+        try:
+            asyncio.get_running_loop()
+            asyncio.ensure_future(_rm_after_retires())
+        except RuntimeError:
+            _rm()
+        self.flow.notify(flow_events.FlowEvent.REPAIR_DONE)
+
     def close(self) -> None:
         if self._wal is not None:
             self._wal.close()
@@ -377,14 +589,25 @@ class LSMTree:
                 newest = hit[1]
         return newest
 
-    async def get_entry(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+    async def get_entry(
+        self, key: bytes, suspect_guard: bool = False
+    ) -> Optional[Tuple[bytes, int]]:
         """Async point read: memtable hits return inline; sstable
         probes go through the executor-backed async read path so a
         cache-miss binary search never stalls the shard loop (VERDICT
         round 1 weak #2/#5; reference analog: io_uring DMA reads).  The
         sstable list is refcounted across awaits so a concurrent
         compaction cannot delete tables under us (lsm_tree.rs:
-        1141-1145 reader-drain semantics)."""
+        1141-1145 reader-drain semantics).
+
+        ``suspect_guard`` (single-evidence callers: RF=1 /
+        consistency=1 — quorum reads must NOT set it, their merge
+        outvotes staleness by timestamp): while a quarantine awaits
+        repair, a hit from a table OLDER than the quarantined one is
+        reported as a miss — the dropped table may have held a newer
+        value or a tombstone that would shadow it (resurrection
+        hazard), and the caller's suspect-miss handling turns the
+        miss into a retryable error."""
         hit = self._active.get(key)
         if hit is not None:
             return hit
@@ -396,24 +619,42 @@ class LSMTree:
         tables_list.acquire()
         try:
             for table in reversed(tables_list.tables):
+                if table.index in self._quarantined_indices:
+                    continue  # snapshot taken before a quarantine
                 if not table.maybe_contains(key):
                     continue
-                hit = await table.get_async(key)
+                try:
+                    hit = await table.get_async(key)
+                except CorruptedFile as e:
+                    # Detect → contain → fall back: quarantine the
+                    # table and keep probing the surviving (older)
+                    # tables; the caller's replica walk covers the
+                    # rest.
+                    self._handle_table_corruption(table, e)
+                    continue
                 if hit is not None:
+                    if (
+                        suspect_guard
+                        and self._quarantine_pending
+                        and table.index < self._suspect_max_index
+                    ):
+                        return None  # shadow-suspect: treat as miss
                     return hit
         finally:
             tables_list.release()
         return None
 
-    async def get(self, key: bytes) -> Optional[bytes]:
+    async def get(
+        self, key: bytes, suspect_guard: bool = False
+    ) -> Optional[bytes]:
         """Live value or None (tombstone = None)."""
-        hit = await self.get_entry(key)
+        hit = await self.get_entry(key, suspect_guard=suspect_guard)
         if hit is None or hit[0] == TOMBSTONE:
             return None
         return hit[0]
 
     async def multi_get(
-        self, keys: Sequence[bytes]
+        self, keys: Sequence[bytes], suspect_guard: bool = False
     ) -> "dict[bytes, Optional[Tuple[bytes, int]]]":
         """Batched point reads: one entry per DISTINCT key (None =
         absent).  Shares the probe setup a per-key loop would pay N
@@ -439,10 +680,22 @@ class LSMTree:
         try:
             for key in sorted(missing):
                 for table in reversed(tables_list.tables):
+                    if table.index in self._quarantined_indices:
+                        continue
                     if not table.maybe_contains(key):
                         continue
-                    hit = await table.get_async(key)
+                    try:
+                        hit = await table.get_async(key)
+                    except CorruptedFile as e:
+                        self._handle_table_corruption(table, e)
+                        continue
                     if hit is not None:
+                        if (
+                            suspect_guard
+                            and self._quarantine_pending
+                            and table.index < self._suspect_max_index
+                        ):
+                            break  # shadow-suspect: report a miss
                         out[key] = hit
                         break
         finally:
@@ -477,6 +730,10 @@ class LSMTree:
         ts whose probe proved it newest for its key must still land
         (the plain flag would starve it forever), while a swap that
         raced the probe forces a re-probe against the new layers."""
+        if self.read_only:
+            raise ShardDegraded(
+                f"{self.dir_path}: read-only (disk failure)"
+            )
         while True:
             try:
                 if (
@@ -498,8 +755,24 @@ class LSMTree:
                 waiter = self.flush_start_event.listen()
                 self._spawn_flush()
                 await waiter
+                if self.read_only:
+                    # The flush we waited on backed off (out of disk):
+                    # escape instead of spinning on a full memtable.
+                    raise ShardDegraded(
+                        f"{self.dir_path}: read-only (disk failure)"
+                    )
         assert self._wal is not None
-        await self._wal.append(key, value, timestamp)
+        try:
+            await self._wal.append(key, value, timestamp)
+        except OSError as e:
+            # The memtable holds the entry but durability failed: the
+            # WAL's on_error hook already flipped degraded mode —
+            # surface a retryable, typed error so the client walks to
+            # a replica with a working disk (timestamps make the
+            # retry idempotent under LWW).
+            raise ShardDegraded(
+                f"WAL append failed: {e}"
+            ) from e
         self._appends_since_swap += 1
         # Flush on capacity DISTINCT keys (reference semantics,
         # lsm_tree.rs:747-755) — or on capacity APPENDS: an
@@ -538,6 +811,10 @@ class LSMTree:
         race-closing contract as set_with_timestamp(stale_abort=True);
         the watermark check and the memtable insert have no awaits
         between them."""
+        if self.read_only:
+            raise ShardDegraded(
+                f"{self.dir_path}: read-only (disk failure)"
+            )
         rejected: List[Tuple[bytes, bytes, int]] = []
         pending = list(entries)
         while pending:
@@ -557,7 +834,12 @@ class LSMTree:
                 continue
             chunk, pending = pending[:applied], pending[applied:]
             assert self._wal is not None
-            await self._wal.append_batch(chunk)
+            try:
+                await self._wal.append_batch(chunk)
+            except OSError as e:
+                raise ShardDegraded(
+                    f"WAL batch append failed: {e}"
+                ) from e
             self._appends_since_swap += applied
             if (
                 self._active.is_full()
@@ -597,13 +879,38 @@ class LSMTree:
                     self._disposing_wal = None
                 flush_index = self._index
                 next_index = flush_index + 2
+                # ENOSPC back-off: a flush that would fill the disk is
+                # refused up front (degraded mode takes over) rather
+                # than half-writing a triplet and cascading into
+                # checksum quarantines of its own torn output.
+                if (
+                    file_io.free_disk_space(
+                        self._wal_path(next_index)
+                    )
+                    < MIN_FREE_BYTES
+                ):
+                    self._report_disk_error(
+                        OSError(
+                            errno.ENOSPC,
+                            f"flush of {self.dir_path}: below the "
+                            f"free-space floor",
+                        )
+                    )
+                    self.flush_start_event.notify()  # release waiters
+                    return
                 # Two-WAL protocol: the next WAL must exist before the
                 # sstable write starts (lsm_tree.rs:854-873).
-                new_wal = wal_mod.Wal(
-                    self._wal_path(next_index),
-                    sync=self.wal_sync,
-                    sync_delay_us=self.wal_sync_delay_us,
-                )
+                try:
+                    new_wal = wal_mod.Wal(
+                        self._wal_path(next_index),
+                        sync=self.wal_sync,
+                        sync_delay_us=self.wal_sync_delay_us,
+                        on_error=self._report_disk_error,
+                    )
+                except OSError as e:
+                    self._report_disk_error(e)
+                    self.flush_start_event.notify()
+                    return
                 assert self._wal is not None
                 self._pending_flush = (flush_index, self._wal)
                 self._flushing = self._active
@@ -631,22 +938,57 @@ class LSMTree:
             # native call (byte-identical, golden-tested) — the Python
             # per-entry writer held the GIL for tens of ms per flush,
             # which surfaced as the serving Set p999 tail.
-            if getattr(flushing, "has_native_flush", False):
-                await asyncio.get_event_loop().run_in_executor(
-                    None,
-                    flushing.flush_to_sstable,
-                    self.dir_path,
-                    flush_index,
-                    self.bloom_min_size,
-                )
-            else:
-                await asyncio.get_event_loop().run_in_executor(
-                    None,
-                    lambda: self._write_sstable_from_items(
-                        flush_index, flushing.sorted_items()
-                    ),
-                )
-            table = SSTable(self.dir_path, flush_index, self.cache)
+            try:
+                if getattr(flushing, "has_native_flush", False):
+
+                    def _native_flush():
+                        flushing.flush_to_sstable(
+                            self.dir_path,
+                            flush_index,
+                            self.bloom_min_size,
+                        )
+                        # The C writer doesn't know the checksum
+                        # sidecar: sum the triplet it just wrote
+                        # (OS-cache-hot) in the same executor job so
+                        # the table opens verified.
+                        checksums.compute_and_write(
+                            self.dir_path,
+                            flush_index,
+                            os.path.join(
+                                self.dir_path,
+                                file_name(flush_index, DATA_FILE_EXT),
+                            ),
+                            os.path.join(
+                                self.dir_path,
+                                file_name(flush_index, INDEX_FILE_EXT),
+                            ),
+                            os.path.join(
+                                self.dir_path,
+                                file_name(flush_index, BLOOM_FILE_EXT),
+                            ),
+                        )
+
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, _native_flush
+                    )
+                else:
+                    await asyncio.get_event_loop().run_in_executor(
+                        None,
+                        lambda: self._write_sstable_from_items(
+                            flush_index, flushing.sorted_items()
+                        ),
+                    )
+            except OSError as e:
+                # Sstable write failed on the disk: keep the flushing
+                # memtable + old WAL (_pending_flush retries once the
+                # operator frees space / replaces the disk) and
+                # degrade instead of crashing the flush task.
+                self._report_disk_error(e)
+                return
+            table = SSTable(
+                self.dir_path, flush_index, self.cache,
+                counters=self.durability,
+            )
             # Pre-warm the in-memory read index off-loop so the first
             # point lookup doesn't pay the bulk read; when it lands,
             # re-notify so the native data plane picks up the built
@@ -685,18 +1027,30 @@ class LSMTree:
         )
         for key, (value, ts) in items:
             writer.write(key, value, ts)
-        writer.close()
+        written = writer.close()
+        bloom_bytes = None
         if bloom is not None:
             bloom.add_batch([k for k, _ in items])
+            bloom_bytes = bloom.serialize()
             with open(
                 os.path.join(
                     self.dir_path, file_name(index, BLOOM_FILE_EXT)
                 ),
                 "wb",
             ) as f:
-                f.write(bloom.serialize())
+                f.write(bloom_bytes)
                 f.flush()
                 os.fsync(f.fileno())
+        data_crcs, index_crcs = writer.page_crcs()
+        checksums.write(
+            self.dir_path,
+            index,
+            data_crcs,
+            index_crcs,
+            written,
+            bloom_bytes,
+            ext=SUMS_FILE_EXT,
+        )
 
     # ------------------------------------------------------------------
     # Compaction (lsm_tree.rs:950-1156)
@@ -736,6 +1090,19 @@ class LSMTree:
         if not inputs:
             return
 
+        # ENOSPC back-off: the merge output peaks at roughly the sum
+        # of its inputs before the old files are deleted — refuse up
+        # front and retry on a later cycle rather than tearing a
+        # half-written compact_* triplet on a full disk.
+        needed = sum(t.data_size for t in inputs) + MIN_FREE_BYTES
+        if file_io.free_disk_space(self.dir_path) < needed:
+            log.warning(
+                "compaction of %s backing off: need ~%d free bytes",
+                self.dir_path,
+                needed,
+            )
+            return
+
         # Merge runs off-loop so reads/writes stay responsive; it gets
         # cache-free sstable handles (the page cache is loop-owned).
         # Strategies exposing merge_async (the coalescer) coordinate on
@@ -770,6 +1137,19 @@ class LSMTree:
                     keep_tombstones,
                     self.bloom_min_size,
                 )
+        except CorruptedFile as e:
+            # The merge read a corrupt input block (compaction rewrites
+            # every byte of the store, so it is also a scrubber):
+            # quarantine the offending input so the next cycle never
+            # re-feeds it, then surface to the compaction loop's
+            # error handling.
+            bad = self._table_index_from_path(getattr(e, "path", None))
+            victim = next(
+                (t for t in inputs if t.index == bad), None
+            )
+            if victim is not None:
+                self._handle_table_corruption(victim, e)
+            raise
         finally:
             for t in inputs_nocache:
                 t.close()
@@ -808,6 +1188,38 @@ class LSMTree:
                     ),
                 ]
             )
+        # Checksum sidecar rides the same journaled rename.  Python
+        # strategies write compact_sums inline; native (C) merges
+        # don't know the sidecar — sum their freshly-written triplet
+        # post-hoc (off-loop, OS-cache-hot) so compaction outputs are
+        # always verified tables.
+        compact_sums = os.path.join(
+            self.dir_path,
+            file_name(output_index, COMPACT_SUMS_FILE_EXT),
+        )
+        if not os.path.exists(compact_sums):
+            await asyncio.get_event_loop().run_in_executor(
+                None,
+                checksums.compute_and_write,
+                self.dir_path,
+                output_index,
+                renames[0][0],
+                renames[1][0],
+                os.path.join(
+                    self.dir_path,
+                    file_name(output_index, COMPACT_BLOOM_FILE_EXT),
+                ),
+                COMPACT_SUMS_FILE_EXT,
+            )
+        renames.append(
+            [
+                compact_sums,
+                os.path.join(
+                    self.dir_path,
+                    file_name(output_index, SUMS_FILE_EXT),
+                ),
+            ]
+        )
         deletes = [p for t in inputs for p in t.paths()]
         action_path = os.path.join(
             self.dir_path, file_name(output_index, COMPACT_ACTION_FILE_EXT)
@@ -839,7 +1251,10 @@ class LSMTree:
         survivors = [
             t for t in self._sstables.tables if t.index not in index_set
         ]
-        output_table = SSTable(self.dir_path, output_index, self.cache)
+        output_table = SSTable(
+            self.dir_path, output_index, self.cache,
+            counters=self.durability,
+        )
         warm_fut = asyncio.get_event_loop().run_in_executor(
             None, output_table.warm
         )
@@ -945,7 +1360,26 @@ class LSMTree:
 
     # ------------------------------------------------------------------
 
+    def _table_index_from_path(self, path) -> Optional[int]:
+        """Sstable index encoded in a triplet file path (CorruptedFile
+        attribution from merge workers), or None."""
+        if not path:
+            return None
+        m = _FILE_RE.match(os.path.basename(path))
+        return int(m.group(1)) if m else None
+
     async def purge(self) -> None:
-        """Delete the tree from disk (drop collection, shards.rs:369-381)."""
+        """Delete the tree from disk (drop collection, shards.rs:369-381).
+
+        Every table's cached pages are invalidated BEFORE the files
+        go: page-cache keys are (collection-name-hash, (ext, index),
+        address), all of which a re-created same-name collection
+        recycles from 0 — without the invalidation its reads would
+        serve the DROPPED collection's pages (satellite fix, PR 3;
+        regression-tested in tests/test_disk_faults.py)."""
         self.close()
+        if self.cache is not None:
+            for t in self._sstables.tables:
+                self.cache.invalidate_file((DATA_FILE_EXT, t.index))
+                self.cache.invalidate_file((INDEX_FILE_EXT, t.index))
         shutil.rmtree(self.dir_path, ignore_errors=True)

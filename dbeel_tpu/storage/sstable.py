@@ -25,6 +25,7 @@ from .entry import (
     INDEX_ENTRY,
     INDEX_ENTRY_SIZE,
     INDEX_FILE_EXT,
+    PAGE_SIZE,
     decode_entry,
     file_name,
 )
@@ -38,7 +39,10 @@ class SSTable:
         dir_path: str,
         index: int,
         cache: Optional[PartitionPageCache],
+        counters: Optional[dict] = None,
     ) -> None:
+        from . import checksums
+
         self.dir_path = dir_path
         self.index = index
         self.data_path = os.path.join(
@@ -50,20 +54,63 @@ class SSTable:
         self.bloom_path = os.path.join(
             dir_path, file_name(index, BLOOM_FILE_EXT)
         )
+        self.sums_path = checksums.sums_path(dir_path, index)
+        # CRC sidecar (checksums.py): None = legacy/unverified table
+        # (pre-checksum store, or a sidecar that failed its own
+        # trailer CRC) — it opens read-only as ever, just without
+        # per-page verification.
+        self.sums = checksums.load(dir_path, index)
+        self._counters = counters  # tree durability counters (or None)
         self._data = CachedFileReader(
-            self.data_path, (DATA_FILE_EXT, index), cache
+            self.data_path,
+            (DATA_FILE_EXT, index),
+            cache,
+            crcs=self.sums.data_crcs if self.sums else None,
         )
         self._index = CachedFileReader(
-            self.index_path, (INDEX_FILE_EXT, index), cache
+            self.index_path,
+            (INDEX_FILE_EXT, index),
+            cache,
+            crcs=self.sums.index_crcs if self.sums else None,
         )
         self.entry_count = self._index.size // INDEX_ENTRY_SIZE
         self.data_size = self._data.size
         self.bloom: Optional[BloomFilter] = None
         try:
             with open(self.bloom_path, "rb") as f:
-                self.bloom = BloomFilter.deserialize(f.read())
+                raw_bloom = f.read()
         except FileNotFoundError:
-            pass
+            raw_bloom = None
+        if raw_bloom is not None:
+            # The bloom is read once, here: verify the whole file.  A
+            # corrupt bloom is NOT a quarantine case — it is a pure
+            # optimization, so degrade to bloomless probing (every get
+            # pays the binary search) instead of dropping good data.
+            import zlib as _zlib
+
+            ok = not (
+                self.sums is not None
+                and self.sums.has_bloom
+                and checksums.verification_enabled()
+                and _zlib.crc32(raw_bloom) != self.sums.bloom_crc
+            )
+            if ok:
+                try:
+                    self.bloom = BloomFilter.deserialize(raw_bloom)
+                except Exception:
+                    ok = False
+            if not ok:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "sstable %s: bloom failed validation; probing "
+                    "without it",
+                    self.bloom_path,
+                )
+                if counters is not None:
+                    counters["checksum_failures"] = (
+                        counters.get("checksum_failures", 0) + 1
+                    )
         # Lazily-built in-memory read index (see _build_read_index):
         # dense below the caps, sparse above them — no table-size cliff.
         self._fast: Optional[tuple] = None
@@ -77,7 +124,24 @@ class SSTable:
         self._index.close()
 
     def paths(self) -> Tuple[str, ...]:
-        return (self.data_path, self.index_path, self.bloom_path)
+        return (
+            self.data_path,
+            self.index_path,
+            self.bloom_path,
+            self.sums_path,
+        )
+
+    @property
+    def verified(self) -> bool:
+        """True when this table carries a CRC sidecar (reads verify)."""
+        return self.sums is not None
+
+    def _corrupt(self, path: str, what: str):
+        from ..errors import CorruptedFile
+
+        exc = CorruptedFile(f"{path}: {what}")
+        exc.path = path
+        return exc
 
     # -- point lookup ---------------------------------------------------
 
@@ -125,9 +189,29 @@ class SSTable:
                 self._fast = (p1, p2, offs, ks, fs)
             else:
                 stride = self.SPARSE_STRIDE
+                from . import checksums as _ck
+
+                verify = (
+                    self.sums is not None
+                    and _ck.verification_enabled()
+                )
                 # memmap both files: only the touched pages are read
                 # and no whole-index RAM copy is made (~160MB for a
                 # 10M-key table).
+                if verify:
+                    # The strided walk touches every index page anyway
+                    # (stride 16 × 16 B = one sample per 256 B), so a
+                    # full index verification costs the same I/O.
+                    mm = np.memmap(
+                        self.index_path, dtype=np.uint8, mode="r"
+                    )
+                    self._verify_pages_mm(
+                        mm,
+                        self.sums.index_crcs,
+                        range(len(self.sums.index_crcs)),
+                        self.index_path,
+                    )
+                    del mm
                 idx = np.memmap(
                     self.index_path,
                     dtype=np.dtype(
@@ -145,11 +229,62 @@ class SSTable:
                 data = np.memmap(
                     self.data_path, dtype=np.uint8, mode="r"
                 )
+                if verify:
+                    # Verify exactly the data pages the sampled key
+                    # prefixes will be gathered from — those pages
+                    # fault in for the gather regardless; a flipped
+                    # bit in a sample would otherwise silently skew
+                    # the candidate range into a false miss.
+                    lo = (
+                        s_offs + np.uint64(ENTRY_HEADER_SIZE)
+                    ) // np.uint64(PAGE_SIZE)
+                    hi = (
+                        s_offs
+                        + np.uint64(ENTRY_HEADER_SIZE + 16 - 1)
+                    ) // np.uint64(PAGE_SIZE)
+                    pages = np.unique(np.concatenate([lo, hi]))
+                    self._verify_pages_mm(
+                        data,
+                        self.sums.data_crcs,
+                        pages.tolist(),
+                        self.data_path,
+                    )
                 words = columnar.prefix_words(data, s_offs, s_ks)
                 del data
                 p1, p2 = self._prefix_pair(words)
                 self._sparse = (p1, p2, stride)
             self._fast_tried = True
+
+    def _verify_pages_mm(self, mm_u8, crcs, pages, path) -> None:
+        """CRC-check specific 4 KiB pages of a uint8 memmap (sparse
+        read-index build — runs off-loop)."""
+        import zlib as _zlib
+
+        n = len(mm_u8)
+        for p in pages:
+            lo = int(p) * PAGE_SIZE
+            if lo >= n:
+                continue
+            page = bytes(mm_u8[lo : lo + PAGE_SIZE])
+            if len(page) < PAGE_SIZE:
+                page = page + b"\x00" * (PAGE_SIZE - len(page))
+            if int(p) >= len(crcs) or _zlib.crc32(page) != crcs[int(p)]:
+                raise self._corrupt(
+                    path, f"page {int(p)} failed its CRC"
+                )
+
+    def _verify_whole(self, raw, kind: str) -> None:
+        """Bulk-read verification (dense read-index build, compaction
+        columnarize): one sequential CRC pass over the whole buffer."""
+        from . import checksums as _ck
+
+        if self.sums is None or not _ck.verification_enabled():
+            return
+        if not self.sums.verify_buffer(kind, raw, len(raw)):
+            raise self._corrupt(
+                self.data_path if kind == "data" else self.index_path,
+                "bulk read failed CRC verification",
+            )
 
     @staticmethod
     def _prefix_pair(words: "np.ndarray"):
@@ -181,8 +316,19 @@ class SSTable:
 
     def warm(self) -> None:
         """Executor hook: build the read index off-loop so first reads
-        don't pay the bulk scan."""
-        self._build_read_index()
+        don't pay the bulk scan.  Swallows failures (including CRC
+        mismatches): the serving read path re-detects them through the
+        verified page reads and drives quarantine from there — a warm
+        must never crash a flush/compaction commit."""
+        try:
+            self._build_read_index()
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sstable %d read-index warm failed", self.index,
+                exc_info=True,
+            )
 
     def _sparse_range(self, key: bytes) -> Tuple[int, int]:
         """Candidate [lo, hi) entry range for ``key`` from the sparse
@@ -321,11 +467,18 @@ class SSTable:
                 )
             try:
                 await self._build_future
-            except Exception:
+            except Exception as e:
                 # Transient build failure (fd/memory pressure): don't
                 # poison the table — retry on the next get; the disk
                 # binary-search fallback below works meanwhile.
+                # CORRUPTION is not transient: re-raise so the LSM
+                # read path quarantines the table instead of paying a
+                # doomed whole-file build on every get.
                 self._build_future = None
+                from ..errors import CorruptedFile
+
+                if isinstance(e, CorruptedFile):
+                    raise
         hit = self._get_cached(key)
         if hit is not self._CACHE_MISS:
             return hit
@@ -379,6 +532,7 @@ class SSTable:
         column arrays in one read — the host→device staging format."""
         with open(self.index_path, "rb") as f:
             raw = f.read(self.entry_count * INDEX_ENTRY_SIZE)
+        self._verify_whole(raw, "index")
         rec = np.frombuffer(
             raw,
             dtype=np.dtype(
@@ -395,4 +549,6 @@ class SSTable:
         """Whole data file in one bulk read (bypasses the page cache on
         purpose — compaction inputs are about to be deleted)."""
         with open(self.data_path, "rb") as f:
-            return f.read(self.data_size)
+            raw = f.read(self.data_size)
+        self._verify_whole(raw, "data")
+        return raw

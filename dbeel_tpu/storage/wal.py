@@ -339,10 +339,16 @@ class Wal:
         path: str,
         sync: bool = False,
         sync_delay_us: int = 0,
+        on_error=None,
     ) -> None:
         self.path = path
         self._sync = sync
         self._sync_delay_us = sync_delay_us
+        # Disk-fault escalation hook (degraded mode): called with the
+        # OSError when an append or fdatasync hits EIO/ENOSPC — the
+        # LSM tree threads it up to the shard, which flips read-only
+        # instead of dying mid-pipeline.
+        self._on_error = on_error
         self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
         # Resume appending after the last *valid* record: a torn tail from
         # a crash must be overwritten, not skipped, or post-recovery
@@ -415,23 +421,47 @@ class Wal:
                     log.exception("native wal syncer unavailable")
                     self._syncer = None
 
+    def _report_io_error(self, e: BaseException) -> None:
+        if self._on_error is not None:
+            try:
+                self._on_error(e)
+            except Exception:
+                log.exception("wal on_error callback failed")
+
     def _append_record_sync(
         self, key: bytes, value: bytes, timestamp: int
     ) -> None:
         """One record appended, no sync (shared by append and
         append_batch; the native appender owns the offset when
-        present)."""
-        if self._native is not None:
-            new_off = self._lib.dbeel_wal_append(
-                self._native, key, len(key), value, len(value), timestamp
-            )
-            if new_off == 0:
-                raise OSError(f"WAL append failed for {self.path}")
-            self._offset = new_off
-        else:
-            record = _encode_record(key, value, timestamp)
-            os.pwrite(self._fd, record, self._offset)
-            self._offset += len(record)
+        present).  EIO/ENOSPC surfaces as OSError AND fires the
+        on_error escalation hook — both write backends inject
+        identically through the file_io fault seam."""
+        from . import file_io as _fio
+
+        try:
+            if _fio._faults:
+                _fio.check_write_fault(self.path)
+            if self._native is not None:
+                new_off = self._lib.dbeel_wal_append(
+                    self._native,
+                    key,
+                    len(key),
+                    value,
+                    len(value),
+                    timestamp,
+                )
+                if new_off == 0:
+                    raise OSError(
+                        f"WAL append failed for {self.path}"
+                    )
+                self._offset = new_off
+            else:
+                record = _encode_record(key, value, timestamp)
+                os.pwrite(self._fd, record, self._offset)
+                self._offset += len(record)
+        except OSError as e:
+            self._report_io_error(e)
+            raise
         self._seq += 1
 
     async def append(self, key: bytes, value: bytes, timestamp: int) -> None:
@@ -458,7 +488,15 @@ class Wal:
                 _encode_record(key, value, ts)
                 for key, value, ts in entries
             )
-            os.pwrite(self._fd, blob, self._offset)
+            try:
+                from . import file_io as _fio
+
+                if _fio._faults:
+                    _fio.check_write_fault(self.path)
+                os.pwrite(self._fd, blob, self._offset)
+            except OSError as e:
+                self._report_io_error(e)
+                raise
             self._offset += len(blob)
             self._seq += len(entries)
         await self._maybe_sync()
@@ -470,12 +508,24 @@ class Wal:
         if self._closing or self._fd < 0:
             return
         self._inflight_syncs += 1
+
+        def _sync_fd(fd=self._fd, path=self.path):
+            from . import file_io as _fio
+
+            if _fio._faults:
+                _fio.check_write_fault(path)
+            os.fdatasync(fd)
+
         try:
             await asyncio.get_event_loop().run_in_executor(
-                None, os.fdatasync, self._fd
+                None, _sync_fd
             )
-        except OSError:
-            pass
+        except OSError as e:
+            # Riders are still released (the flush path makes the
+            # contents durable via the sstable), but the failure
+            # escalates: a device that rejects fsync is exactly the
+            # degraded-mode trigger.
+            self._report_io_error(e)
         finally:
             self._inflight_syncs -= 1
             if self._closing and self._inflight_syncs == 0:

@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Flip bits/bytes in an SSTable (or any) file — the manual / CI
+counterpart of the in-process disk-fault seam (storage/file_io
+.set_fault).  Used by the kill-and-corrupt drill, chaos_soak.py
+--disk-faults, and by hand:
+
+    # flip one bit at 40% through the file
+    python scripts/corrupt.py /path/to/00000000000000000000.data --percent 40
+
+    # flip 3 bytes starting at byte 8192
+    python scripts/corrupt.py FILE --offset 8192 --bytes 3
+
+    # pick a random .data file of a store dir and flip one bit in it
+    python scripts/corrupt.py --store /var/lib/dbeel/mycol-0 --seed 7
+
+Prints exactly what it flipped (file, offset, before/after) so a drill
+log records the injected fault.  The write is in place: run it against
+a COPY or a store you are prepared to repair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+
+def flip_bytes(
+    path: str,
+    offset: int,
+    n_bytes: int = 1,
+    bit: int = 0,
+) -> list:
+    """Flip ``bit`` in each of ``n_bytes`` bytes at ``offset``;
+    returns [(offset, before, after), ...]."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise SystemExit(f"{path}: empty file, nothing to corrupt")
+    offset = max(0, min(offset, size - 1))
+    n_bytes = max(1, min(n_bytes, size - offset))
+    out = []
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        before = bytearray(f.read(n_bytes))
+        after = bytearray(b ^ (1 << bit) for b in before)
+        f.seek(offset)
+        f.write(after)
+        f.flush()
+        os.fsync(f.fileno())
+    for i in range(n_bytes):
+        out.append((offset + i, before[i], after[i]))
+    return out
+
+
+def pick_sstable(store_dir: str, rng: random.Random) -> str:
+    """A random .data file in a collection-shard directory."""
+    candidates = [
+        os.path.join(store_dir, n)
+        for n in sorted(os.listdir(store_dir))
+        if n.endswith(".data")
+    ]
+    if not candidates:
+        raise SystemExit(f"no .data files under {store_dir}")
+    return rng.choice(candidates)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Flip bits in an SSTable file (corruption drill)."
+    )
+    ap.add_argument("path", nargs="?", help="file to corrupt")
+    ap.add_argument(
+        "--store",
+        help="pick a random .data file from this store directory "
+        "instead of naming one",
+    )
+    ap.add_argument(
+        "--offset", type=int, default=None,
+        help="byte offset to corrupt (default: --percent)",
+    )
+    ap.add_argument(
+        "--percent", type=float, default=50.0,
+        help="position as %% of file size when --offset is not given",
+    )
+    ap.add_argument("--bytes", type=int, default=1, dest="n_bytes")
+    ap.add_argument("--bit", type=int, default=0, choices=range(8))
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    path = args.path
+    if path is None:
+        if not args.store:
+            ap.error("either PATH or --store is required")
+        path = pick_sstable(args.store, rng)
+    size = os.path.getsize(path)
+    offset = (
+        args.offset
+        if args.offset is not None
+        else int(size * args.percent / 100.0)
+    )
+    for off, before, after in flip_bytes(
+        path, offset, args.n_bytes, args.bit
+    ):
+        print(
+            f"corrupted {path} @{off}: "
+            f"0x{before:02x} -> 0x{after:02x}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

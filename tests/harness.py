@@ -22,14 +22,24 @@ _port_block = itertools.count(0)
 
 
 def make_config(tmp_dir: str, **kw) -> Config:
-    """Fresh config with a unique port block (peace between tests)."""
-    block = next(_port_block) * 64 + 11000
+    """Fresh config with a unique port block (peace between tests).
+
+    Every listen port stays BELOW the container's ephemeral range
+    (/proc/sys/net/ipv4/ip_local_port_range starts at 16000 here):
+    the old +20000/+40000 scheme put the remote and gossip listeners
+    right inside it, so any outgoing connection's kernel-chosen
+    source port could squat a later test's listener — observed as a
+    mid-suite EADDRINUSE "shard task died during startup" flake.
+    26 blocks of 192 ports (db / remote / gossip sub-blocks of 64)
+    cycle; tier-1 runs tests sequentially (-p no:xdist), so reuse 26
+    tests later only ever meets closed listeners."""
+    block = 11000 + (next(_port_block) % 26) * 192
     defaults = dict(
         name="dbeel-test",
         dir=f"{tmp_dir}/db",
         port=block,
-        remote_shard_port=block + 20000,
-        gossip_port=block + 40000,
+        remote_shard_port=block + 64,
+        gossip_port=block + 128,
         failure_detection_interval_ms=50,
         memtable_capacity=64,
     )

@@ -38,12 +38,20 @@ REPORT_KEYS = {
 
 @pytest.mark.slow
 def test_chaos_soak_quick_schema(tmp_dir):
+    # The quick soak plus the --disk-faults phase runs ~2-3 min —
+    # past the conftest 110s per-test watchdog; re-arm the alarm
+    # (same handler) for this test's real horizon.
+    import signal
+
+    if hasattr(signal, "SIGALRM"):
+        signal.alarm(590)
     report_path = os.path.join(tmp_dir, "report.json")
     proc = subprocess.run(
         [
             sys.executable,
             os.path.join(REPO, "chaos_soak.py"),
             "--quick",
+            "--disk-faults",
             "--report",
             report_path,
         ],
@@ -61,6 +69,16 @@ def test_chaos_soak_quick_schema(tmp_dir):
 
     for cls in ERROR_CLASSES:
         assert cls in report["op_errors_by_class"], cls
+    # PR 3 durability classes must be first-class in the breakdown.
+    assert "data-corruption" in report["op_errors_by_class"]
+    assert "degraded" in report["op_errors_by_class"]
+    # --disk-faults phase schema: the ENOSPC window must leave the
+    # faulted node ALIVE (degraded read-only, not crashed) and the
+    # bit-flip (when an sstable existed) zero corrupt payloads.
+    df = report["disk_faults"]
+    assert df["enospc"]["victim_alive"] is True
+    if df["bitflip"] is not None:
+        assert df["bitflip"]["corrupt_payloads"] == 0
     assert report["quick"] is True
     # The quick mode must still uphold the hard invariants (loss /
     # divergence), even though the error-rate gate is waived.

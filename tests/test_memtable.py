@@ -206,10 +206,31 @@ def test_native_flush_byte_identical(tmp_dir):
         tree.bloom_min_size = bloom_min
         tree._write_sstable_from_items(0, py.sorted_items())
 
+        # Primary triplet stays byte-identical.  The Python writer
+        # additionally leaves a .sums checksum sidecar (PR 3); the
+        # native path gains its sidecar post-hoc in LSMTree.flush, so
+        # a direct flush_to_sstable call legitimately has none.
         nat_files = sorted(os.listdir(nat_dir))
-        py_files = sorted(os.listdir(py_dir))
+        py_files = sorted(
+            f for f in os.listdir(py_dir) if not f.endswith(".sums")
+        )
         assert nat_files == py_files, (case, nat_files, py_files)
         for fn in nat_files:
             assert sha(os.path.join(nat_dir, fn)) == sha(
                 os.path.join(py_dir, fn)
             ), (case, fn)
+        # And the inline-accumulated sums must equal a post-hoc
+        # compute over the (identical) native files — the two sidecar
+        # production paths can never diverge.
+        from dbeel_tpu.storage import checksums
+
+        checksums.compute_and_write(
+            nat_dir,
+            0,
+            os.path.join(nat_dir, "00000000000000000000.data"),
+            os.path.join(nat_dir, "00000000000000000000.index"),
+            os.path.join(nat_dir, "00000000000000000000.bloom"),
+        )
+        assert sha(checksums.sums_path(nat_dir, 0)) == sha(
+            checksums.sums_path(py_dir, 0)
+        ), case

@@ -1,0 +1,134 @@
+"""Migration under sustained foreground load (ROADMAP open item).
+
+A hash-range migration (node addition) streams thousands of entries
+while foreground sets/gets keep arriving: the share scheduler's
+bg_slice must keep foreground p99 within an SLO multiple of the
+unloaded same-session baseline.  Slow-marked (nightly): the p99 bound
+is generous because this container's CPU budget swings ~10× between
+sessions (ROADMAP "host weather") — the SAME-SESSION baseline is the
+whole point of the test shape.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import pytest
+
+from dbeel_tpu.client import DbeelClient, Consistency
+from dbeel_tpu.flow_events import FlowEvent
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+# Loaded p99 must stay under max(SLO_MULT × baseline p99, FLOOR_S):
+# the multiple is the real assertion, the floor absorbs timer noise
+# when the unloaded baseline is sub-millisecond.
+SLO_MULT = 20.0
+FLOOR_S = 0.25
+
+N_KEYS = 2500
+BASELINE_GETS = 200
+LOADED_WINDOW_S = 12.0
+
+
+def _p99(samples):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+@pytest.mark.slow
+def test_foreground_p99_during_migration(tmp_dir):
+    async def main():
+        cfg = make_config(
+            tmp_dir,
+            memtable_capacity=512,
+            anti_entropy_interval_ms=0,
+            default_replication_factor=2,
+        )
+        node1 = await ClusterNode(cfg).start()
+        node2 = None
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            col = await client.create_collection(
+                "mig", replication_factor=2
+            )
+            keys = [f"mk{i:05d}" for i in range(N_KEYS)]
+            for i, k in enumerate(keys):
+                await col.set(
+                    k,
+                    {"v": i},
+                    consistency=Consistency.fixed(1),
+                )
+
+            # Same-session unloaded baseline.
+            rng = random.Random(11)
+            baseline = []
+            for _ in range(BASELINE_GETS):
+                k = rng.choice(keys)
+                t0 = time.monotonic()
+                await col.get(k, consistency=Consistency.fixed(1))
+                baseline.append(time.monotonic() - t0)
+            base_p99 = _p99(baseline)
+
+            # Node 2 joins → addition migration streams this shard's
+            # owned ranges while foreground keeps hammering.
+            done_migration = node1.flow_event(
+                0, FlowEvent.DONE_MIGRATION
+            )
+            cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+                seed_nodes=[node1.seed_address],
+                memtable_capacity=512,
+                anti_entropy_interval_ms=0,
+            )
+            node2 = await ClusterNode(cfg2).start()
+
+            loaded = []
+            sets = 0
+            t_start = time.monotonic()
+            while (
+                time.monotonic() - t_start < LOADED_WINDOW_S
+                and not done_migration.done()
+            ):
+                k = rng.choice(keys)
+                if rng.random() < 0.2:
+                    t0 = time.monotonic()
+                    await col.set(
+                        k,
+                        {"v": sets},
+                        consistency=Consistency.fixed(1),
+                    )
+                    loaded.append(time.monotonic() - t0)
+                    sets += 1
+                else:
+                    t0 = time.monotonic()
+                    await col.get(
+                        k, consistency=Consistency.fixed(1)
+                    )
+                    loaded.append(time.monotonic() - t0)
+            overlapped = len(loaded)
+
+            # The migration must finish (bounded) even under load.
+            await asyncio.wait_for(done_migration, 120)
+
+            assert overlapped >= 50, (
+                "migration finished before any meaningful foreground "
+                f"overlap ({overlapped} ops) — grow N_KEYS"
+            )
+            loaded_p99 = _p99(loaded)
+            slo = max(SLO_MULT * base_p99, FLOOR_S)
+            assert loaded_p99 <= slo, (
+                f"foreground p99 {loaded_p99*1e3:.1f}ms during "
+                f"migration blew the SLO {slo*1e3:.1f}ms "
+                f"(baseline p99 {base_p99*1e3:.1f}ms, "
+                f"{overlapped} ops overlapped migration)"
+            )
+        finally:
+            if node2 is not None:
+                await node2.stop()
+            await node1.stop()
+
+    run(main(), timeout=300)
