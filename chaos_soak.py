@@ -21,6 +21,11 @@ Invariants checked at the end (exit 1 on violation):
      count are bounded across the whole run (threads must stay flat:
      the io_uring sync hub adds none per WAL).
 
+Optional phases: ``--disk-faults`` (bit flip + ENOSPC window) and
+``--partition`` (asymmetric partition on one node during quorum
+writes → WAL-backed hints → heal by clean restart → all replicas
+byte-agree within the hint-drain SLO).
+
 Usage:  python chaos_soak.py [--duration 900] [--churn-period 75]
             [--down-time 18] [--report chaos_soak_report.json]
 """
@@ -74,7 +79,7 @@ class Node:
             tempfile.gettempdir(), f"chaos_n{i}.log"
         )
 
-    def start(self, seeds, extra_env=None):
+    def start(self, seeds, extra_env=None, extra_argv=None):
         env = {
             **os.environ,
             "PYTHONPATH": REPO
@@ -86,6 +91,8 @@ class Node:
             # A clean restart must not inherit a fault armed for a
             # previous incarnation of this node.
             "DBEEL_DISK_FAULTS": "",
+            "DBEEL_REMOTE_FAULTS": "",
+            "DBEEL_REMOTE_FAULTS_DELAY_S": "",
             **(extra_env or {}),
         }
         argv = [
@@ -103,6 +110,8 @@ class Node:
         ]
         if seeds:
             argv += ["--seed-nodes", *seeds]
+        if extra_argv:
+            argv += list(extra_argv)
         self.proc = subprocess.Popen(
             argv, env=env,
             stdout=open(self.log_path, "ab"),
@@ -327,80 +336,31 @@ async def final_checks(nodes, acks, report):
     if lost:
         log("ACKED-WRITE LOSS:", lost[:10])
 
-    # Convergence: all RF replicas byte-agree on every key's digest.
-    md = await client.get_cluster_metadata()
-    node_md = {m.name: m for m in md.nodes}
-    ring = []  # (hash, node_name, shard_id)
-    from dbeel_tpu.utils.murmur import hash_string
-
-    for m in md.nodes:
-        for sid in m.ids:
-            ring.append((hash_string(f"{m.name}-{sid}"), m.name, sid))
-    ring.sort()
-    conns = {}
-
-    async def digest_of(name, sid, key_b):
-        addr = (
-            f"{node_md[name].ip}:"
-            f"{node_md[name].remote_shard_base_port + sid}"
-        )
-        conn = conns.get(addr)
-        if conn is None:
-            conn = RemoteShardConnection(addr, pooled=True)
-            conns[addr] = conn
-        resp = await conn.send_request(
-            ShardRequest.get_digest(COLLECTION, key_b)
-        )
-        return resp[2]
-
-    async def divergence_scan():
-        import bisect
-
-        out = []
-        for key in sorted(acks.last):
-            key_b = msgpack.packb(key, use_bin_type=True)
-            h = hash_bytes(key_b)
-            start = bisect.bisect_left(
-                [r[0] for r in ring], h
-            ) % len(ring)
-            owners = []
-            seen = set()
-            for off in range(len(ring)):
-                _hh, name, sid = ring[(start + off) % len(ring)]
-                if name in seen:
-                    continue
-                seen.add(name)
-                owners.append((name, sid))
-                if len(owners) == RF:
-                    break
-            digests = []
-            for name, sid in owners:
-                try:
-                    digests.append(
-                        await digest_of(name, sid, key_b)
-                    )
-                except Exception as e:
-                    digests.append(f"ERR {repr(e)[:60]}")
-            if any(d != digests[0] for d in digests[1:]):
-                out.append((key, owners, digests))
-        return out
-
-    # Post-churn convergence is ASYMPTOTIC (hint replay + bucketed
-    # anti-entropy catch a just-restarted replica up over a few
-    # cycles): poll until every key's replicas byte-agree and report
-    # the time it took, instead of a single snapshot that punishes a
-    # short quiet window.
+    # Convergence: all RF replicas byte-agree on every key's digest
+    # (_replica_digest_scan — the same walk the --partition phase
+    # uses).  Post-churn convergence is ASYMPTOTIC (hint replay +
+    # bucketed anti-entropy catch a just-restarted replica up over a
+    # few cycles): poll until every key's replicas byte-agree and
+    # report the time it took, instead of a single snapshot that
+    # punishes a short quiet window.
     t_conv0 = time.time()
     deadline = t_conv0 + 150
-    while True:
-        divergent = await divergence_scan()
-        if not divergent or time.time() > deadline:
-            break
-        log(
-            f"{len(divergent)} keys still divergent; waiting on "
-            "anti-entropy ..."
-        )
-        await asyncio.sleep(5)
+    scan_conns: dict = {}
+    try:
+        while True:
+            divergent = await _replica_digest_scan(
+                client, sorted(acks.last), scan_conns
+            )
+            if not divergent or time.time() > deadline:
+                break
+            log(
+                f"{len(divergent)} keys still divergent; waiting on "
+                "anti-entropy ..."
+            )
+            await asyncio.sleep(5)
+    finally:
+        for c in scan_conns.values():
+            c.close_pool()
     report["convergence_s"] = round(time.time() - t_conv0, 1)
     if lost:
         # Post-mortem: every node's view of the ring + where each
@@ -445,8 +405,6 @@ async def final_checks(nodes, acks, report):
     ]
     if divergent:
         log("DIVERGENT:", divergent[:5])
-    for c in conns.values():
-        c.close_pool()
     client.close()
     return not lost and not divergent
 
@@ -573,6 +531,221 @@ async def disk_fault_phase(nodes, acks, seeds, report):
     return ok
 
 
+async def _replica_digest_scan(client, keys, conns=None):
+    """Per-key replica digests over the remote shard plane: returns
+    (key, owners, digests) for every key whose RF owners do NOT
+    byte-agree on (ts, value-hash).  The ONE replica-ownership walk +
+    digest comparison, shared by the final convergence check and the
+    --partition phase.  Pollers pass a shared ``conns`` dict so the
+    pooled replica connections persist across iterations (the caller
+    closes them); otherwise connections are per-call."""
+    import bisect
+
+    from dbeel_tpu.utils.murmur import hash_string
+
+    md = await client.get_cluster_metadata()
+    node_md = {m.name: m for m in md.nodes}
+    ring = []
+    for m in md.nodes:
+        for sid in m.ids:
+            ring.append((hash_string(f"{m.name}-{sid}"), m.name, sid))
+    ring.sort()
+    hashes = [r[0] for r in ring]
+    own_conns = conns is None
+    if own_conns:
+        conns = {}
+    divergent = []
+    for key in keys:
+        key_b = msgpack.packb(key, use_bin_type=True)
+        h = hash_bytes(key_b)
+        start = bisect.bisect_left(hashes, h) % len(ring)
+        owners = []
+        seen = set()
+        for off in range(len(ring)):
+            _hh, name, sid = ring[(start + off) % len(ring)]
+            if name in seen:
+                continue
+            seen.add(name)
+            owners.append((name, sid))
+            if len(owners) == RF:
+                break
+        digests = []
+        for name, sid in owners:
+            addr = (
+                f"{node_md[name].ip}:"
+                f"{node_md[name].remote_shard_base_port + sid}"
+            )
+            conn = conns.get(addr)
+            if conn is None:
+                conn = RemoteShardConnection(addr, pooled=True)
+                conns[addr] = conn
+            try:
+                resp = await conn.send_request(
+                    ShardRequest.get_digest(COLLECTION, key_b)
+                )
+                digests.append(resp[2])
+            except Exception as e:
+                digests.append(f"ERR {repr(e)[:60]}")
+        if any(d != digests[0] for d in digests[1:]):
+            divergent.append((key, owners, digests))
+    if own_conns:
+        for c in conns.values():
+            c.close_pool()
+    return divergent
+
+
+async def partition_phase(nodes, seeds, report, quick):
+    """--partition: restart one node with an ASYMMETRIC partition
+    armed (DBEEL_REMOTE_FAULTS → the remote_comm.set_fault seam: the
+    victim cannot reach any peer's shard plane; peers reach it fine),
+    drive quorum writes through the window — victim-coordinated
+    fan-outs fail/skip their replicas and queue WAL-backed hints —
+    then heal with a CLEAN restart (the hint log must survive it) and
+    assert every phase key's RF replicas byte-agree within the
+    hint-drain SLO."""
+    victim = nodes[1]
+    peer_addrs = [
+        f"127.0.0.1:{n.remote_port + sid}"
+        for n in nodes
+        if n is not victim
+        for sid in range(SHARDS)
+    ]
+    spec = ",".join(f"{a}=blackhole" for a in peer_addrs)
+    arm_delay = 6.0
+    log(
+        f"PARTITION: restarting {victim.name}; asymmetric partition "
+        f"against {len(peer_addrs)} peer shards arms in {arm_delay}s"
+    )
+    victim.kill()
+    # The partition arms AFTER boot (delay seam): the victim must
+    # first rediscover its peers and rejoin — a node that never knew
+    # its peers existed would neither stall nor hint.  Short remote
+    # timeouts for this incarnation: the blackhole seam hangs for the
+    # read timeout, and those stalls should cost seconds, not the
+    # production 15 s.
+    victim.start(
+        seeds,
+        extra_env={
+            "DBEEL_REMOTE_FAULTS": spec,
+            "DBEEL_REMOTE_FAULTS_DELAY_S": str(arm_delay),
+        },
+        extra_argv=[
+            "--remote-shard-connect-timeout", "1000",
+            "--remote-shard-read-timeout", "2000",
+            "--remote-shard-write-timeout", "2000",
+        ],
+    )
+    await wait_port(victim.db_port)
+    # Confirm the victim rejoined before the partition drops.
+    rejoin_cl = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", victim.db_port)]
+    )
+    for _ in range(30):
+        try:
+            md = await rejoin_cl.get_cluster_metadata()
+            if len(md.nodes) >= N_NODES:
+                break
+        except Exception:
+            pass
+        await asyncio.sleep(0.5)
+    rejoin_cl.close()
+    # Let the partition arm and the victim's failure detector declare
+    # the unreachable peers dead (ring removal → departed-node
+    # hinting takes over for the write fan-outs).
+    await asyncio.sleep(arm_delay + (6 if quick else 10))
+
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", victim.db_port)]
+    )
+    col = client.collection(COLLECTION)
+    n_keys = 24 if quick else 60
+    keys = [f"pk{i:03d}" for i in range(n_keys)]
+    writes_ok = write_errors = 0
+    for i, key in enumerate(keys):
+        try:
+            await asyncio.wait_for(
+                col.set(
+                    key, {"v": i, "p": 1},
+                    consistency=Consistency.fixed(2),
+                ),
+                20,
+            )
+            writes_ok += 1
+        except Exception:
+            write_errors += 1
+    hints_during = -1
+    try:
+        stats = await client.get_stats("127.0.0.1", victim.db_port)
+        hints_during = stats["convergence"]["hints_queued"]
+    except Exception as e:
+        log(f"PARTITION: victim stats failed: {repr(e)[:80]}")
+    client.close()
+    log(
+        f"PARTITION: {writes_ok}/{n_keys} writes acked; victim "
+        f"hints_queued={hints_during}"
+    )
+
+    # Heal: clean restart — hints reload from the WAL-backed log and
+    # the periodic drain replays them once peers are rediscovered.
+    log(f"PARTITION: healing (clean restart of {victim.name})")
+    victim.kill()
+    victim.start(seeds)
+    await wait_port(victim.db_port)
+    slo_s = 60.0 if quick else 120.0
+    t0 = time.time()
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)]
+    )
+    scan_conns: dict = {}
+    try:
+        while True:
+            divergent = await _replica_digest_scan(
+                client, keys, scan_conns
+            )
+            if not divergent or time.time() - t0 > slo_s:
+                break
+            log(
+                f"PARTITION: {len(divergent)} keys still divergent; "
+                "waiting on hint drain ..."
+            )
+            await asyncio.sleep(3)
+    finally:
+        for c in scan_conns.values():
+            c.close_pool()
+    convergence_s = round(time.time() - t0, 1)
+    hints_replayed = 0
+    for n in nodes:
+        for sid in range(SHARDS):
+            try:
+                s = await client.get_stats(
+                    "127.0.0.1", n.db_port + sid
+                )
+                hints_replayed += s["convergence"]["hints_replayed"]
+            except Exception:
+                pass
+    client.close()
+    phase = {
+        "victim": victim.name,
+        "keys": n_keys,
+        "writes_ok": writes_ok,
+        "write_errors": write_errors,
+        "hints_queued_during": hints_during,
+        "hints_replayed_total": hints_replayed,
+        "hint_drain_slo_s": slo_s,
+        "convergence_s": convergence_s,
+        "divergent_after_slo": len(divergent),
+        "divergent_samples": [
+            (k, o, [str(d) for d in ds])
+            for k, o, ds in divergent[:5]
+        ],
+    }
+    report["partition"] = phase
+    log(f"PARTITION: {phase}")
+    ok = not divergent and writes_ok >= max(1, n_keys // 2)
+    phase["pass"] = ok
+    return ok
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -597,6 +770,13 @@ async def main():
         "(asserting zero corrupt client payloads) and run an ENOSPC "
         "window on one node's store (asserting it degrades to "
         "read-only instead of crashing)",
+    )
+    ap.add_argument(
+        "--partition", action="store_true",
+        help="after churn: impose an asymmetric partition on one node "
+        "during quorum writes (its fan-outs fail and hint), heal it "
+        "with a clean restart, and assert all replicas of every phase "
+        "key byte-agree within the hint-drain SLO",
     )
     ap.add_argument(
         "--quick", action="store_true",
@@ -705,6 +885,10 @@ async def main():
         # Let quarantine repair + anti-entropy re-converge the
         # bit-flipped replica before the divergence scan.
         await asyncio.sleep(min(args.quiet_window, 15.0))
+    if args.partition:
+        ok = (
+            await partition_phase(nodes, seeds, report, args.quick)
+        ) and ok
     ok = (await final_checks(nodes, acks, report)) and ok
     if not args.quick:
         # Quick mode waives the rate gate: one unlucky op in a tiny

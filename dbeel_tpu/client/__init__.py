@@ -736,6 +736,31 @@ class DbeelClient:
         )
         return ClusterMetadata.from_wire(msgpack.unpackb(raw, raw=False))
 
+    async def get_stats(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> dict:
+        """Per-shard observability snapshot from one server (the
+        first seed by default): durability, scheduler, metrics and
+        the ``convergence`` block (hints queued/replayed/expired,
+        read repairs, anti-entropy rounds / keys healed)."""
+        if host is None or port is None:
+            host, port = self._seeds[0]
+        raw = await self._send_to(host, port, {"type": "get_stats"})
+        return msgpack.unpackb(raw, raw=False)
+
+    async def rearm(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> None:
+        """Admin: tell one node (the first seed by default) to exit
+        sticky degraded read-only mode after disk replacement — the
+        node re-runs its free-space/WAL-append pre-checks on every
+        shard and re-registers the native write plane.  Raises the
+        server's error (node stays degraded) when a pre-check still
+        fails."""
+        if host is None or port is None:
+            host, port = self._seeds[0]
+        await self._send_to(host, port, {"type": "rearm"})
+
 
 class DbeelCollection:
     def __init__(self, client: DbeelClient, name: str, rf: int):
@@ -882,6 +907,12 @@ class DbeelClientSync:
 
     def collection(self, name):
         return SyncCollection(self, self._client.collection(name))
+
+    def get_stats(self, host=None, port=None):
+        return self._run(self._client.get_stats(host, port))
+
+    def rearm(self, host=None, port=None):
+        self._run(self._client.rearm(host, port))
 
     def close(self):
         self._loop.close()

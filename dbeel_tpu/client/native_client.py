@@ -124,6 +124,15 @@ def _bind(lib) -> None:
             ctypes.c_uint32,
             ctypes.c_uint32,
         ]
+    if hasattr(lib, "dbeel_cli_get_stats"):  # stale .so tolerance
+        lib.dbeel_cli_get_stats.restype = ctypes.c_int64
+        lib.dbeel_cli_get_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+            u8p,
+            ctypes.c_uint64,
+        ]
     if hasattr(lib, "dbeel_cli_multi_set"):
         lib.dbeel_cli_multi_set.restype = ctypes.c_int64
         lib.dbeel_cli_multi_set.argtypes = [
@@ -218,6 +227,31 @@ class NativeDbeelClient:
             self._h, op_deadline_ms, backoff_base_ms, backoff_cap_ms
         )
         return True
+
+    def get_stats(
+        self, ip: str = "", port: int = 0
+    ) -> dict:
+        """One server's get_stats snapshot (the bootstrap seed by
+        default), unpacked — same schema as the Python client's
+        get_stats(), incl. the replica-convergence block.  Raises on
+        a stale .so without the ABI."""
+        if not hasattr(self._lib, "dbeel_cli_get_stats"):
+            raise DbeelError(
+                "native library predates dbeel_cli_get_stats"
+            )
+        cap = 1 << 20
+        for _ in range(2):
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.dbeel_cli_get_stats(
+                self._h, ip.encode(), port, buf, cap
+            )
+            if n <= -10:
+                cap = -int(n) - 10
+                continue
+            break
+        if n < 0:
+            raise DbeelError(self._err())
+        return msgpack.unpackb(bytes(buf[: int(n)]), raw=False)
 
     def create_collection(
         self, name: str, replication_factor: int = 1

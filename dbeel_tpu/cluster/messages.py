@@ -101,10 +101,19 @@ class ShardRequest:
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
+    REARM = "rearm"
 
     @staticmethod
     def ping() -> list:
         return ["request", ShardRequest.PING]
+
+    @staticmethod
+    def rearm() -> list:
+        """Admin: exit sticky degraded read-only mode after disk
+        replacement — the shard re-runs its free-space/WAL-append
+        pre-checks and re-registers the native write plane, or
+        answers an error frame while the disk is still bad."""
+        return ["request", ShardRequest.REARM]
 
     @staticmethod
     def get_metadata() -> list:
@@ -228,6 +237,7 @@ class ShardResponse:
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
+    REARM = "rearm"
     ERROR = "error"
 
     @staticmethod

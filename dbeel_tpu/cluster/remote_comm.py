@@ -10,6 +10,7 @@ send_request), plus a persistent stream for migration
 from __future__ import annotations
 
 import asyncio
+import os
 import struct
 from typing import List, Tuple
 
@@ -52,6 +53,47 @@ def set_fault(address: str, mode) -> None:
 
 def clear_faults() -> None:
     _faults.clear()
+
+
+def _arm_from_env() -> None:
+    """``DBEEL_REMOTE_FAULTS="<ip:port>=<mode>[,...]"`` pre-arms
+    faults at import — the subprocess twin of set_fault (mirroring
+    storage/file_io's DBEEL_DISK_FAULTS), so harnesses running real
+    node processes (chaos_soak --partition) can impose an ASYMMETRIC
+    partition: the armed node cannot reach the listed peers' shard
+    planes while they reach it fine.
+
+    ``DBEEL_REMOTE_FAULTS_DELAY_S=N`` arms them N seconds AFTER
+    import instead: the node boots cleanly, discovers its peers and
+    joins the ring, and the partition then drops mid-operation — the
+    realistic onset, and the one that exercises detector-bounded
+    blind windows plus departed-node hinting rather than a node that
+    never learned its peers existed."""
+    spec = os.environ.get("DBEEL_REMOTE_FAULTS", "")
+    if not spec:
+        return
+
+    def arm() -> None:
+        for part in spec.split(","):
+            if "=" in part:
+                address, mode = part.rsplit("=", 1)
+                if address and mode:
+                    _faults[address] = mode
+
+    delay = float(
+        os.environ.get("DBEEL_REMOTE_FAULTS_DELAY_S", "0") or 0
+    )
+    if delay > 0:
+        import threading
+
+        timer = threading.Timer(delay, arm)
+        timer.daemon = True
+        timer.start()
+    else:
+        arm()
+
+
+_arm_from_env()
 
 
 async def _apply_fault(conn: "RemoteShardConnection") -> None:

@@ -53,6 +53,19 @@ class Config:
     # bounded byte rate under the share scheduler.  0 disables.
     scrub_interval_ms: int = 600_000
     scrub_bytes_per_sec: int = 8 << 20
+    # Replica-convergence plane (hinted handoff).  A hint older than
+    # the TTL is dropped at drain time (anti-entropy backfills nodes
+    # gone longer); 0 disables hinted handoff entirely.
+    hint_ttl_ms: int = 3 * 3600 * 1000
+    hint_max_per_node: int = 10_000
+    # Periodic hint-drain retry cadence (the Alive-gossip edge also
+    # triggers a drain immediately) and the replay rate ceiling.
+    hint_drain_interval_ms: int = 5_000
+    hint_drain_keys_per_sec: int = 8192
+    # Quorum read-repair pushes per second per shard (opportunistic:
+    # beyond the cap the repair is skipped and anti-entropy catches
+    # the divergence).  0 = uncapped.
+    read_repair_max_per_sec: int = 256
 
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
@@ -161,6 +174,39 @@ def build_parser() -> argparse.ArgumentParser:
         default=d.scrub_bytes_per_sec,
         help="scrub read-rate ceiling in bytes/sec",
     )
+    p.add_argument(
+        "--hint-ttl",
+        type=int,
+        dest="hint_ttl_ms",
+        default=d.hint_ttl_ms,
+        help="hinted-handoff TTL in ms (0 disables hints)",
+    )
+    p.add_argument(
+        "--hint-max-per-node",
+        type=int,
+        default=d.hint_max_per_node,
+        help="cap on queued hints per target node (oldest drop first)",
+    )
+    p.add_argument(
+        "--hint-drain-interval",
+        type=int,
+        dest="hint_drain_interval_ms",
+        default=d.hint_drain_interval_ms,
+        help="periodic hint-drain retry cadence in ms",
+    )
+    p.add_argument(
+        "--hint-drain-keys-per-sec",
+        type=int,
+        default=d.hint_drain_keys_per_sec,
+        help="hint replay rate ceiling in keys/sec",
+    )
+    p.add_argument(
+        "--read-repair-max-per-sec",
+        type=int,
+        default=d.read_repair_max_per_sec,
+        help="quorum read-repair pushes per second per shard "
+        "(0 = uncapped)",
+    )
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
@@ -229,6 +275,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         anti_entropy_buckets=ns.anti_entropy_buckets,
         scrub_interval_ms=ns.scrub_interval_ms,
         scrub_bytes_per_sec=ns.scrub_bytes_per_sec,
+        hint_ttl_ms=ns.hint_ttl_ms,
+        hint_max_per_node=ns.hint_max_per_node,
+        hint_drain_interval_ms=ns.hint_drain_interval_ms,
+        hint_drain_keys_per_sec=ns.hint_drain_keys_per_sec,
+        read_repair_max_per_sec=ns.read_repair_max_per_sec,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,

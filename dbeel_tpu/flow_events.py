@@ -38,6 +38,8 @@ class FlowEvent(enum.Enum):
     REPAIR_DONE = "RepairDone"  # quarantine repair pull completed
     SCRUB_PASS_DONE = "ScrubPassDone"  # one full scrub cycle finished
     SHARD_DEGRADED = "ShardDegraded"  # WAL EIO/ENOSPC: now read-only
+    # Replica-convergence plane (PR 4).
+    SHARD_REARMED = "ShardRearmed"  # admin rearm cleared degraded mode
 
 
 _enabled = False

@@ -113,6 +113,22 @@ async def handle_request(
         # Observability extension (no reference analog).
         return msgpack.packb(my_shard.get_stats(), use_bin_type=True)
 
+    if rtype == "rearm":
+        # Admin: exit sticky degraded read-only mode after disk
+        # replacement, no restart — re-runs the free-space/WAL-append
+        # pre-checks, re-registers the native write plane, and fans
+        # the verb out to this node's sibling shards over the REARM
+        # peer frame (every shard of the node shares the replaced
+        # disk; the peer handler never re-enters this path, so the
+        # fan-out cannot recurse).  Errors (pre-check still failing
+        # on any shard) surface as the usual error frame; the shard
+        # stays degraded.
+        await my_shard.rearm()
+        await my_shard.send_request_to_local_shards(
+            ShardRequest.rearm(), ShardResponse.REARM
+        )
+        return None
+
     if rtype == "create_collection":
         name = _extract(request, "name")
         rf = request.get("replication_factor")
@@ -658,7 +674,11 @@ async def _digest_quorum_round(
             newer = True
     if newer:
         return False
-    if stale and local_value is not None:
+    if (
+        stale
+        and local_value is not None
+        and my_shard.allow_read_repair()
+    ):
         my_shard.spawn(
             _read_repair(
                 my_shard,
@@ -688,8 +708,10 @@ def _merge_quorum_get(
     (db_server.rs:353-363).  Read repair (improvement over the
     reference, which has none — SURVEY §5): any replica that answered
     with a missing or older entry gets the winning version
-    re-propagated in the background; idempotent, since replicas keep
-    the newest timestamp and duplicates collapse at compaction.
+    re-propagated in the background — rate-capped through the
+    shard's token bucket (beyond it the repair is skipped and
+    counted; anti-entropy owns the tail); idempotent, since replicas
+    keep the newest timestamp and duplicates collapse at compaction.
     Returns the winning value or raises KeyNotFound
     (tombstone/absence)."""
     entries = [(bytes(v[0]), v[1]) for v in values if v is not None]
@@ -700,7 +722,9 @@ def _merge_quorum_get(
         stale_acks += 1
     if entries:
         win_value, win_ts = max(entries, key=lambda e: e[1])
-        if stale_acks or any(ts != win_ts for _v, ts in entries):
+        if (
+            stale_acks or any(ts != win_ts for _v, ts in entries)
+        ) and my_shard.allow_read_repair():
             my_shard.spawn(
                 _read_repair(
                     my_shard,

@@ -212,6 +212,11 @@ async def run_shard(
         coros.append(tasks.run_anti_entropy(my_shard))
     if my_shard.config.scrub_interval_ms > 0:
         coros.append(tasks.run_scrub_loop(my_shard))
+    if (
+        my_shard.config.hint_ttl_ms > 0
+        and my_shard.config.hint_drain_interval_ms > 0
+    ):
+        coros.append(tasks.run_hint_drain(my_shard))
     if is_node_managing:
         coros.append(tasks.run_gossip_server(my_shard))
         coros.append(tasks.run_failure_detector(my_shard))

@@ -18,7 +18,6 @@ import re
 import secrets
 import socket
 import threading
-from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -126,9 +125,34 @@ class MyShard:
         self.collections: Dict[str, Collection] = {}
         self.collections_change_event = LocalEvent()
         # Hinted handoff (improvement over the reference, which has
-        # none — SURVEY §5): mutations whose replica fan-out failed,
-        # keyed by the unreachable node, replayed on its next Alive.
-        self.hints: Dict[str, deque] = {}
+        # none — SURVEY §5): (collection, key, ts) of mutations whose
+        # replica fan-out skipped or failed a node, keyed by that
+        # node, replayed on its next Alive (and by the periodic drain
+        # loop).  WAL-backed per shard — hints survive a restart.
+        from .hints import HintLog
+
+        self.hint_log = HintLog(
+            os.path.join(config.dir, f"hints-{shard_id}.log")
+            if config.dir and config.hint_ttl_ms > 0
+            else None,
+            max_per_node=config.hint_max_per_node,
+            ttl_s=config.hint_ttl_ms / 1000.0,
+        )
+        # Ring entries of nodes the failure detector removed: a
+        # write's NATURAL replica set does not shrink because a node
+        # is down — departed nodes that would have been in the
+        # distinct-node walk get hints instead of frames.  Entries
+        # leave on the node's next Alive, or when the hint-drain
+        # sweep closes their TTL window (a node gone longer than
+        # --hint-ttl gets anti-entropy backfill, not hints — and a
+        # permanently decommissioned node stops costing a hint per
+        # write).
+        self.departed_shards: Dict[str, List[Shard]] = {}
+        self.departed_at: Dict[str, float] = {}
+        # Rotated live+departed walk order, rebuilt lazily on ring or
+        # departed-set changes: _hint_departed runs on EVERY fan-out
+        # while a node is down and must not pay a sort per request.
+        self._merged_walk_cache: Optional[List[Shard]] = None
         # Failure-aware request plane: nodes the failure detector (or
         # Dead gossip) declared dead.  Fan-outs treat these peers as
         # immediately failed instead of stalling into connect/read
@@ -163,6 +187,18 @@ class MyShard:
         # ~range/buckets entries, not the whole range).
         self.ae_entries_pushed = 0
         self.ae_entries_fetched = 0
+        # Convergence-plane counters (get_stats.convergence).
+        self.ae_rounds = 0
+        # Local applies performed by convergence machinery (RANGE_PUSH
+        # receipts — hint replay and AE pushes land here — plus
+        # RANGE_PULL applies): every healed key on THIS shard counts
+        # exactly once.
+        self.keys_healed = 0
+        self.read_repairs = 0
+        self.read_repairs_skipped = 0
+        # Read-repair token bucket (opportunistic rate cap).
+        self._rr_tokens = float(config.read_repair_max_per_sec)
+        self._rr_refill_at: Optional[float] = None
         # Native serving data plane (SURVEY §7: compiled hot path,
         # Python keeps the cluster/replication brain).  None when the
         # native library is unavailable — everything then runs the
@@ -226,6 +262,10 @@ class MyShard:
         )
         self._hash_sorted = sorted(self.shards, key=lambda s: s.hash)
         self._sorted_hashes = [s.hash for s in self._hash_sorted]
+        # getattr: sort runs once from __init__ before the cache
+        # attribute exists.
+        if getattr(self, "_merged_walk_cache", None) is not None:
+            self._merged_walk_cache = None
         self._refresh_dataplane_ownership()
 
     def _refresh_dataplane_ownership(self) -> None:
@@ -582,6 +622,58 @@ class MyShard:
         except Exception:
             log.exception("dataplane re-register(%s) failed", name)
 
+    async def rearm(self) -> None:
+        """Operator-initiated exit from sticky degraded mode (the
+        ROADMAP "re-arm after disk replacement" item): re-run the
+        free-space and WAL-append pre-checks on every collection's
+        store, clear read-only, and re-register the native write
+        plane — no restart.  Raises ShardDegraded (shard STAYS
+        degraded) when any pre-check still fails."""
+        if not self.degraded and not any(
+            col.tree.read_only for col in self.collections.values()
+        ):
+            return  # already armed: idempotent no-op
+        # Probe every tree BEFORE clearing anything: a node with one
+        # replaced disk and one still-dead disk must stay degraded.
+        for name, col in list(self.collections.items()):
+            await col.tree.rearm_precheck()
+        for col in self.collections.values():
+            col.tree.read_only = False
+        self.degraded = False
+        self.degraded_reason = None
+        log.info("shard %s re-armed: degraded mode cleared",
+                 self.shard_name)
+        for name, col in list(self.collections.items()):
+            # Retry any flush the degraded window refused (frees the
+            # memtable) and re-register the native write plane.
+            self.spawn(col.tree.flush())
+            self._resume_dataplane(name)
+        self.flow.notify(FlowEvent.SHARD_REARMED)
+
+    def allow_read_repair(self) -> bool:
+        """Token-bucket admission for quorum read-repair pushes:
+        beyond the configured rate the repair is skipped (counted;
+        anti-entropy owns the tail) so a stale-replica hot spot
+        cannot turn every read into a write storm."""
+        rate = self.config.read_repair_max_per_sec
+        if rate <= 0:
+            self.read_repairs += 1
+            return True
+        now = asyncio.get_event_loop().time()
+        if self._rr_refill_at is None:
+            self._rr_refill_at = now
+        self._rr_tokens = min(
+            float(rate),
+            self._rr_tokens + (now - self._rr_refill_at) * rate,
+        )
+        self._rr_refill_at = now
+        if self._rr_tokens >= 1.0:
+            self._rr_tokens -= 1.0
+            self.read_repairs += 1
+            return True
+        self.read_repairs_skipped += 1
+        return False
+
     def get_stats(self) -> dict:
         """Per-shard observability snapshot (no reference analog —
         SURVEY.md §5 marks tracing/metrics as a gap to improve on)."""
@@ -619,8 +711,21 @@ class MyShard:
             "nodes_known": len(self.nodes),
             "ring_size": len(self.shards),
             "dead_nodes": sorted(self.dead_nodes),
-            "hints_queued": {
-                n: len(q) for n, q in self.hints.items()
+            "hints_queued": self.hint_log.queued_by_node(),
+            # Replica-convergence plane (PR 4): hinted handoff,
+            # quorum read-repair, background anti-entropy.
+            "convergence": {
+                "hints_queued": self.hint_log.queued_total(),
+                "hints_recorded": self.hint_log.recorded,
+                "hints_replayed": self.hint_log.replayed,
+                "hints_expired": self.hint_log.expired,
+                "hints_dropped_capacity": (
+                    self.hint_log.dropped_capacity
+                ),
+                "read_repairs": self.read_repairs,
+                "read_repairs_skipped": self.read_repairs_skipped,
+                "anti_entropy_rounds": self.ae_rounds,
+                "keys_healed": self.keys_healed,
             },
             "wal_fsync_errors": hub_fsync_errors(),
             # Group-commit shape: durable acks released per completed
@@ -786,67 +891,154 @@ class MyShard:
     # Replica fan-out (shards.rs:463-543)
     # ------------------------------------------------------------------
 
-    MAX_HINTS_PER_NODE = 10_000
+    # Hints per RANGE_PUSH frame during a drain (one bg_slice each).
+    HINT_REPLAY_PAGE = 256
 
     def _record_hint(self, node_name: str, request: list) -> None:
-        """Queue a failed replica mutation for replay when the node
-        returns (bounded; oldest hints drop first — read repair then
-        covers the remainder)."""
-        kind = request[1] if len(request) > 1 else None
-        if kind not in (
-            ShardRequest.SET,
-            ShardRequest.DELETE,
-            ShardRequest.MULTI_SET,
-        ):
+        """Queue the (collection, key, ts) of a failed replica
+        mutation for replay when the node returns.  Values are NOT
+        stored: replay pushes this shard's CURRENT newest entry, so
+        repeated overwrites dedup to one hint (newest ts kept) and
+        one transfer."""
+        if self.config.hint_ttl_ms <= 0:
             return
-        self.hints.setdefault(
-            node_name, deque(maxlen=self.MAX_HINTS_PER_NODE)
-        ).append(request)
-        self.flow.notify(FlowEvent.HINT_RECORDED)
+        kind = request[1] if len(request) > 1 else None
+        changed = False
+        if kind in (ShardRequest.SET, ShardRequest.DELETE):
+            changed = self.hint_log.record(
+                node_name,
+                request[2],
+                bytes(request[3]),
+                int(request[5] if kind == ShardRequest.SET else request[4]),
+            )
+        elif kind == ShardRequest.MULTI_SET:
+            col = request[2]
+            for key, _value, ts in request[3]:
+                changed |= self.hint_log.record(
+                    node_name, col, bytes(key), int(ts)
+                )
+        else:
+            return
+        if changed:
+            self.flow.notify(FlowEvent.HINT_RECORDED)
+
+    def _node_shard_for_key(
+        self, key_hash: int, node_name: str
+    ) -> Optional[Shard]:
+        """The shard of ``node_name`` that serves ``key_hash`` — the
+        first shard of that node on the distinct-node replica walk
+        (the same walk the client and owns_key use), i.e. the first
+        of its shards at/after the hash on the sorted ring."""
+        ring = self._hash_sorted
+        if not ring:
+            return None
+        import bisect
+
+        start = bisect.bisect_left(
+            self._sorted_hashes, key_hash
+        ) % len(ring)
+        for off in range(len(ring)):
+            s = ring[(start + off) % len(ring)]
+            if s.node_name == node_name:
+                return s
+        return None
 
     async def replay_hints(self, node_name: str) -> None:
-        queued = self.hints.pop(node_name, None)
-        if not queued:
+        """Drain this shard's queued hints for ``node_name``: page
+        them out oldest-first, resolve each key to its CURRENT local
+        newest entry, and push per-target-shard RANGE_PUSH batches
+        (applied strictly-newer on the peer).  Bounded rate: each
+        page runs under a bg_slice and the configured keys/sec
+        ceiling paces consecutive pages."""
+        if not self.hint_log.has(node_name):
             return
-        shard = next(
-            (s for s in self.shards if s.node_name == node_name), None
-        )
+        rate = max(1, self.config.hint_drain_keys_per_sec)
         replayed = 0
-        pending = list(queued)
         failed = False
-        # Replay in background units so a large hint drain defers to
-        # live serving under the share scheduler.
-        while pending and not failed and shard is not None:
+        while not failed:
+            page = self.hint_log.take_page(
+                node_name, self.HINT_REPLAY_PAGE
+            )
+            if not page:
+                break
+            # Resolve hints to current entries, grouped by the target
+            # node's serving shard for each key (multi-shard nodes:
+            # the replica walk picks a specific shard per key).
+            # Each batch keeps its source hints so a failed push can
+            # requeue exactly what it owed.
+            batches: Dict[str, list] = {}  # -> [shard, col, entries, hints]
             async with self.scheduler.bg_slice():
-                for _ in range(32):
-                    if not pending:
-                        break
-                    request = pending[0]
+                for hint in page:
+                    col_name, key, _ts, _created = hint
+                    col = self.collections.get(col_name)
+                    if col is None:
+                        continue  # collection dropped: hint is moot
                     try:
-                        msgs.response_to_result(
-                            await shard.connection.send_request(request),
-                            {
-                                ShardRequest.SET: ShardResponse.SET,
-                                ShardRequest.MULTI_SET: (
-                                    ShardResponse.MULTI_SET
-                                ),
-                            }.get(request[1], ShardResponse.DELETE),
-                        )
-                        pending.pop(0)
-                        replayed += 1
-                    except DbeelError as e:
-                        log.warning(
-                            "hint replay to %s stopped after %d: %s",
-                            node_name,
-                            replayed,
-                            e,
-                        )
+                        entry = await col.tree.get_entry(bytes(key))
+                    except DbeelError:
+                        # Suspect local read (quarantine pending):
+                        # keep the hint for a later drain.
+                        self.hint_log.requeue(node_name, [hint])
                         failed = True
-                        break
-        # Anything untried or failed goes back on the queue (node raced
-        # back down, shard missing, etc.) — never dropped.
-        for request in pending:
-            self._record_hint(node_name, request)
+                        continue
+                    if entry is None:
+                        # Nothing to push (tombstone GC'd before the
+                        # drain): anti-entropy owns the remainder.
+                        self.hint_log.expired += 1
+                        continue
+                    shard = self._node_shard_for_key(
+                        hash_bytes(bytes(key)), node_name
+                    )
+                    if shard is None:
+                        failed = True  # node left the ring again
+                        self.hint_log.requeue(node_name, [hint])
+                        continue
+                    value, local_ts = entry
+                    batch = batches.setdefault(
+                        f"{shard.name}/{col_name}",
+                        [shard, col_name, [], []],
+                    )
+                    batch[2].append(
+                        [bytes(key), bytes(value), int(local_ts)]
+                    )
+                    batch[3].append(hint)
+            for shard, col_name, entries, hints in batches.values():
+                if failed:
+                    self.hint_log.requeue(node_name, hints)
+                    continue
+                try:
+                    msgs.response_to_result(
+                        await shard.connection.send_request(
+                            ShardRequest.range_push(col_name, entries)
+                        ),
+                        ShardResponse.RANGE_PUSH,
+                    )
+                    replayed += len(entries)
+                except (DbeelError, OSError) as e:
+                    log.warning(
+                        "hint replay to %s stopped after %d: %s",
+                        node_name,
+                        replayed,
+                        e,
+                    )
+                    failed = True
+                    # Untried/failed hints go back on the queue (node
+                    # raced back down etc.) — never dropped.
+                    self.hint_log.requeue(node_name, hints)
+            if failed:
+                break
+            # Bounded drain rate.
+            await asyncio.sleep(len(page) / rate)
+        if replayed or not failed:
+            # A COMPLETE drain persists the drop marker even when it
+            # replayed nothing (everything TTL-expired / resolved to
+            # absent entries) — without it, a restart resurrects the
+            # dead records from the log.  Partial (failed) drains
+            # skip the marker: its watermark would erase the
+            # requeued survivors across a restart.
+            self.hint_log.mark_drained(
+                node_name, replayed, drop_marker=not failed
+            )
         if replayed:
             log.info(
                 "replayed %d hints to %s", replayed, node_name
@@ -867,6 +1059,7 @@ class MyShard:
         hints for the unreachable node.  ``op_status`` (when given)
         collects failure context for the caller's error frame:
         ``peer_dead`` / ``peer_unreachable`` flags."""
+        self._hint_departed(number_of_nodes, lambda: request)
         return await self._fan_out_to_replicas(
             lambda c: c.send_request(request),
             lambda resp: msgs.response_to_result(
@@ -898,6 +1091,7 @@ class MyShard:
         in C (shards.rs:463-543 parity); the asyncio fan-out below is
         the always-available fallback."""
         hint_request_fn = lambda: msgs.unpack_message(framed[4:])  # noqa: E731
+        self._hint_departed(number_of_nodes, hint_request_fn)
         connections = self._replica_connections(number_of_nodes)
         if op_status is not None:
             # The walk targets, for PeerDead-vs-Timeout attribution
@@ -936,6 +1130,70 @@ class MyShard:
             connections=connections,
             op_status=op_status,
         )
+
+    def _hint_departed(
+        self, number_of_nodes: int, hint_request_fn
+    ) -> None:
+        """Record hints for departed (detector-removed) nodes that
+        would sit in this mutation's replica set had they been alive.
+        The live fan-out walks the SHRUNK ring (availability: the
+        next distinct node genuinely owns the slot now), but the
+        down node's copy must not silently stay stale until
+        anti-entropy — the write's natural owner gets a hint, and the
+        Alive-edge drain replays it the moment the node returns.
+
+        Walk budget: ``number_of_nodes`` live slots PLUS one slot per
+        departed node — a departed node occupies a replica slot
+        without consuming the live budget, so a coordinator serving
+        at replica_index>0 BECAUSE the primary is down (its remaining
+        live fan-out may be zero nodes) still hints that primary.
+        Slightly over-hints when a departed node sits just past the
+        natural set (harmless: replay is an idempotent strictly-newer
+        push, and cap+TTL bound it); a departed natural replica
+        beyond the wrap can still be missed — anti-entropy is the
+        backstop for that tail."""
+        if (
+            not self.departed_shards
+            or self.config.hint_ttl_ms <= 0
+        ):
+            return
+        kind = None
+        request: Optional[list] = None
+        # The merged walk: live + departed ring entries in rotated
+        # order — the replica set of the unshrunk ring.  Cached:
+        # rebuilt only when the ring or the departed set changes.
+        merged = self._merged_walk_cache
+        if merged is None:
+            merged = list(self.shards)
+            for shards in self.departed_shards.values():
+                merged.extend(shards)
+            threshold = self.hash
+            merged.sort(key=lambda s: (s.hash < threshold, s.hash))
+            self._merged_walk_cache = merged
+        budget = number_of_nodes + len(self.departed_shards)
+        nodes: set = set()
+        for s in merged:
+            if len(nodes) >= budget:
+                break
+            if s.node_name == self.config.name or s.node_name in nodes:
+                continue
+            nodes.add(s.node_name)
+            if s.node_name in self.departed_shards:
+                if request is None:
+                    request = hint_request_fn()
+                    kind = request[1] if len(request) > 1 else None
+                    if kind not in (
+                        ShardRequest.SET,
+                        ShardRequest.DELETE,
+                        ShardRequest.MULTI_SET,
+                    ):
+                        return  # reads never hint
+                # Deliberately NOT op_status["peer_dead"]: the live
+                # fan-out may satisfy the quorum fine — a later
+                # deadline expiry on a merely-slow LIVE peer must
+                # report Timeout, not PeerDead (the flag is set only
+                # where a requested target actually failed).
+                self._record_hint(s.node_name, request)
 
     def _replica_connections(self, number_of_nodes: int) -> List[tuple]:
         """First ``number_of_nodes`` distinct-OTHER-node shards on the
@@ -1133,6 +1391,9 @@ class MyShard:
         kind = request[1]
         if kind == ShardRequest.PING:
             return ShardResponse.pong()
+        if kind == ShardRequest.REARM:
+            await self.rearm()
+            return ShardResponse.empty(ShardResponse.REARM)
         if kind == ShardRequest.GET_METADATA:
             return ShardResponse.get_metadata(self.get_nodes())
         if kind == ShardRequest.GET_COLLECTIONS:
@@ -1267,11 +1528,22 @@ class MyShard:
             col = self.collections.get(request[2])
             if col is None:
                 raise CollectionNotFound(request[2])
+            pushed_any = False
             async with self.scheduler.bg_slice():
                 for key, value, ts in request[3]:
-                    await self.apply_if_newer(
+                    if await self.apply_if_newer(
                         col.tree, bytes(key), bytes(value), int(ts)
-                    )
+                    ):
+                        # Convergence accounting: hint replays and AE
+                        # pushes land here — every key this shard was
+                        # missing (or held stale) counts once.
+                        self.keys_healed += 1
+                        pushed_any = True
+            if pushed_any:
+                # The items WERE set from a shard message: fire the
+                # same milestone the Set-frame path fires, so tests
+                # waiting on replicated writes stay event-driven.
+                self.flow.notify(FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
             return ShardResponse.empty(ShardResponse.RANGE_PUSH)
         raise DbeelError(f"unknown shard request {kind!r}")
 
@@ -1292,6 +1564,88 @@ class MyShard:
     # anti-entropy as a gap in the reference's replication design,
     # alongside hinted handoff and read repair, both also added here)
     # ------------------------------------------------------------------
+
+    def replica_arcs(
+        self, rf: int
+    ) -> List[Tuple[int, int, List[Shard]]]:
+        """The EXACT owned-range union for this shard under the
+        distinct-node replica walk, as (start, end, peer_shards)
+        arcs: for every ring arc, the walk from the arc's owning
+        ring point selects one shard per distinct node until ``rf``
+        nodes; arcs where THIS shard is selected are owned, and
+        ``peer_shards`` are the other selected shards (the replicas
+        that must agree with us over that arc).
+
+        Bounds come back +1-shifted into the half-open [start, end)
+        form the anti-entropy filters take; start == end means the
+        whole ring.  Adjacent arcs with identical peer sets merge,
+        so the common single-shard-per-node ring costs ~rf arcs.
+
+        Replaces the (rf-th-distinct-predecessor, self] arc, which
+        under interleaved multi-shard nodes over-approximates the
+        union (ROADMAP open item) — importing ranges this shard can
+        never serve and missing none, but paying transfer for them.
+        Shared by quarantine repair and the background anti-entropy
+        loop so their notion of "what this shard stores" can never
+        diverge.  Property-tested against owns_key in
+        tests/test_convergence.py."""
+        ring = self._hash_sorted
+        n = len(ring)
+        shifted_self = (self.hash + 1) & 0xFFFFFFFF
+        if n < 2:
+            return [(shifted_self, shifted_self, [])]
+        arcs: List[list] = []
+        for i in range(n):
+            # Arc (ring[i-1].hash, ring[i].hash]: the walk starts at
+            # ring[i] (first shard at/after every hash in the arc).
+            nodes: set = set()
+            selected: List[Shard] = []
+            for off in range(n):
+                s = ring[(i + off) % n]
+                if s.node_name in nodes:
+                    continue
+                nodes.add(s.node_name)
+                selected.append(s)
+                if len(nodes) >= rf:
+                    break
+            if not any(s.name == self.shard_name for s in selected):
+                continue
+            peers = [
+                s
+                for s in selected
+                if s.name != self.shard_name
+                and s.node_name != self.config.name
+            ]
+            arcs.append(
+                [
+                    (ring[i - 1].hash + 1) & 0xFFFFFFFF,
+                    (ring[i].hash + 1) & 0xFFFFFFFF,
+                    peers,
+                ]
+            )
+        # Merge ring-adjacent arcs with identical peer sets (arcs are
+        # in sorted-ring order, so arc i's end is arc i+1's start;
+        # the (last, first) pair wraps).
+        merged: List[list] = []
+        for arc in arcs:
+            if (
+                merged
+                and merged[-1][1] == arc[0]
+                and {s.name for s in merged[-1][2]}
+                == {s.name for s in arc[2]}
+            ):
+                merged[-1][1] = arc[1]
+            else:
+                merged.append(arc)
+        if (
+            len(merged) > 1
+            and merged[-1][1] == merged[0][0]
+            and {s.name for s in merged[-1][2]}
+            == {s.name for s in merged[0][2]}
+        ):
+            merged[0][0] = merged[-1][0]
+            merged.pop()
+        return [(s, e, p) for s, e, p in merged]
 
     @staticmethod
     async def apply_if_newer(
@@ -1505,6 +1859,8 @@ class MyShard:
             node = NodeMetadata.from_wire(event[1])
             if node.name != self.config.name:
                 self.dead_nodes.discard(node.name)
+                self.departed_shards.pop(node.name, None)
+                self.departed_at.pop(node.name, None)
                 newly_added = node.name not in self.nodes
                 if newly_added:
                     self.nodes[node.name] = node
@@ -1515,7 +1871,7 @@ class MyShard:
                 self._reset_gossip_counters(
                     node.name, GossipEvent.DEAD
                 )
-                if node.name in self.hints:
+                if self.hint_log.has(node.name):
                     self.spawn(self.replay_hints(node.name))
                 self.flow.notify(FlowEvent.ALIVE_NODE_GOSSIP)
                 if newly_added:
@@ -1597,6 +1953,14 @@ class MyShard:
         # handle_gossip_event).
         self._reset_gossip_counters(node_name, GossipEvent.ALIVE)
         removed = [s for s in self.shards if s.node_name == node_name]
+        if removed and self.config.hint_ttl_ms > 0:
+            # Keep the dead node's ring entries for hint targeting:
+            # mutations keep hinting its natural replica slots until
+            # it re-announces or its TTL window closes.
+            import time as _time
+
+            self.departed_shards[node_name] = removed
+            self.departed_at[node_name] = _time.time()
         self.shards = [
             s for s in self.shards if s.node_name != node_name
         ]
@@ -1867,5 +2231,6 @@ class MyShard:
         self.close_db_connections()
         if self.quorum_fanout is not None:
             self.quorum_fanout.close()
+        self.hint_log.close()
         for col in self.collections.values():
             col.tree.close()

@@ -329,8 +329,10 @@ async def run_compaction_loop(my_shard: MyShard) -> None:
 # ----------------------------------------------------------------------
 # Anti-entropy (beyond-reference: SURVEY §5 lists anti-entropy as a gap
 # in the reference's replication design).  Each shard periodically
-# compares a digest of its PRIMARY range — (ring predecessor, self] —
-# with the rf-1 distinct-node successors that replicate it; on
+# compares per-bucket digests of every arc in its EXACT owned-range
+# union (MyShard.replica_arcs: primary range + the replicated
+# predecessor slices, exact under interleaved multi-shard nodes) with
+# that arc's replica shards — successors AND predecessors; on
 # mismatch it pushes its entries (batched RANGE_PUSH, applied on the
 # peer only when strictly newer than the peer's newest — never through
 # raw Set events, which could shadow newer flushed values) and pulls
@@ -418,77 +420,129 @@ async def _sync_range_with_peer(
 
 
 async def run_anti_entropy(my_shard: MyShard) -> None:
+    """Background anti-entropy — the convergence backstop that fires
+    with no reads and no hints (expired TTL, capacity drops, crashed
+    coordinators): every interval, exchange per-bucket range digests
+    with the replicas of each arc in this shard's EXACT owned-range
+    union (MyShard.replica_arcs — the same helper the quarantine
+    repair scopes its pulls with) and push/pull only the diverged
+    buckets.  Every unit runs under the share scheduler, a sibling of
+    the scrub loop: continuous maintenance priced like compaction."""
     interval = my_shard.config.anti_entropy_interval_ms / 1000.0
     if interval <= 0:
         return
     nb = max(1, my_shard.config.anti_entropy_buckets)
     while True:
         await asyncio.sleep(interval)
-        # Primary ownership range is (predecessor, self] — shift both
-        # ends by +1 into the half-open form the range filter takes
-        # (a key hashing exactly onto our ring point IS ours; one on
-        # the predecessor's point is NOT).  start == end after the
-        # shift means we are the only ring point: the whole ring.
-        prev_hash = (
-            my_shard.shards[-1].hash if my_shard.shards else 0
-        )
-        start = (prev_hash + 1) & 0xFFFFFFFF
-        end = (my_shard.hash + 1) & 0xFFFFFFFF
         for name, col in list(my_shard.collections.items()):
             rf = col.replication_factor
             if rf <= 1:
                 continue
-            # rf-1 distinct-node successors replicate my primary range
-            # (the same walk as the replica fan-out).
-            nodes: set = set()
-            peers = []
-            for s in my_shard.shards:
-                if s.node_name == my_shard.config.name:
+            # The owned-range union, one entry per merged arc with
+            # the peer shards that replicate that arc.  On the common
+            # single-shard-per-node ring with nodes <= rf the arcs
+            # collapse to ONE whole-ring range; interleaved
+            # multi-shard nodes get their exact slices.
+            for start, end, peers in my_shard.replica_arcs(rf):
+                if not peers:
                     continue
-                if s.node_name in nodes:
-                    continue
-                nodes.add(s.node_name)
-                peers.append(s)
-                if len(peers) >= rf - 1:
-                    break
-            if not peers:
-                continue
-            # One digest scan per collection per cycle fills ALL
-            # sub-range buckets, shared by the rf-1 peer comparisons.
-            async with my_shard.scheduler.bg_slice():
-                counts, digests = await my_shard.compute_range_digests(
-                    col.tree, start, end, nb
-                )
-            for peer in peers:
-                try:
-                    pulled_any = await _sync_range_with_peer(
-                        my_shard,
-                        name,
-                        col.tree,
-                        peer,
-                        start,
-                        end,
-                        counts,
-                        digests,
+                # One digest scan per arc fills ALL sub-range
+                # buckets, shared by that arc's peer comparisons.
+                async with my_shard.scheduler.bg_slice():
+                    counts, digests = (
+                        await my_shard.compute_range_digests(
+                            col.tree, start, end, nb
+                        )
                     )
-                    if pulled_any:
-                        # A pull changed our range: later peers must
-                        # compare against the CURRENT digests or every
-                        # one of them re-syncs.
-                        async with my_shard.scheduler.bg_slice():
-                            counts, digests = (
-                                await my_shard.compute_range_digests(
-                                    col.tree, start, end, nb
+                for peer in peers:
+                    try:
+                        pulled_any = await _sync_range_with_peer(
+                            my_shard,
+                            name,
+                            col.tree,
+                            peer,
+                            start,
+                            end,
+                            counts,
+                            digests,
+                        )
+                        if pulled_any:
+                            # A pull changed our range: later peers
+                            # must compare against the CURRENT
+                            # digests or every one of them re-syncs.
+                            async with my_shard.scheduler.bg_slice():
+                                counts, digests = (
+                                    await my_shard.compute_range_digests(
+                                        col.tree, start, end, nb
+                                    )
                                 )
-                            )
-                except (DbeelError, OSError) as e:
-                    log.warning(
-                        "anti-entropy %s with %s failed: %s",
-                        name,
-                        peer.name,
-                        e,
-                    )
+                    except (DbeelError, OSError) as e:
+                        log.warning(
+                            "anti-entropy %s with %s failed: %s",
+                            name,
+                            peer.name,
+                            e,
+                        )
+        my_shard.ae_rounds += 1
         my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_DONE)
+
+
+# ----------------------------------------------------------------------
+# Hint drain (replica-convergence plane, PR 4): the periodic retry leg
+# of hinted handoff.  The Alive-gossip edge replays immediately; this
+# loop covers everything the edge misses — hints reloaded from the WAL
+# after a restart (the target was discovered at boot, no Alive edge
+# fires), a replay that failed midway, a target that bounced.  Skips
+# nodes still believed down; every page runs under the share scheduler
+# at the configured keys/sec ceiling (MyShard.replay_hints).
+# ----------------------------------------------------------------------
+
+
+async def run_hint_drain(my_shard: MyShard) -> None:
+    import time as _time
+
+    interval = my_shard.config.hint_drain_interval_ms / 1000.0
+    ttl_s = my_shard.config.hint_ttl_ms / 1000.0
+    if interval <= 0 or my_shard.config.hint_ttl_ms <= 0:
+        return
+    while True:
+        await asyncio.sleep(interval)
+        # Close the TTL window of nodes that never came back: stop
+        # hinting them (every write was paying a hint-log append),
+        # expire their queued hints, and hand their backfill to
+        # anti-entropy.  A node decommissioned via the detector-Dead
+        # path stops costing anything after one TTL.
+        now = _time.time()
+        for node, since in list(my_shard.departed_at.items()):
+            if now - since > ttl_s:
+                my_shard.departed_shards.pop(node, None)
+                my_shard.departed_at.pop(node, None)
+                my_shard._merged_walk_cache = None
+                dropped = my_shard.hint_log.expire_node(node)
+                log.info(
+                    "hint TTL window for %s closed: %d hints "
+                    "expired; anti-entropy owns its backfill",
+                    node,
+                    dropped,
+                )
+        for node in my_shard.hint_log.nodes_with_hints():
+            if (
+                node in my_shard.dead_nodes
+                or node not in my_shard.nodes
+            ):
+                # Still down/unknown: keep queued, but the TTL clock
+                # runs regardless — expiry cannot depend on a drain
+                # that may never happen (a coordinator restart also
+                # loses departed_at, so log-reloaded hints for a
+                # never-rediscovered node expire HERE).
+                my_shard.hint_log.expire_ttl_dead(node)
+                continue
+            try:
+                await my_shard.replay_hints(node)
+            except (DbeelError, OSError) as e:
+                log.warning(
+                    "hint drain to %s failed: %s", node, e
+                )
 
 
 # ----------------------------------------------------------------------
@@ -499,10 +553,9 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
 # pulls the lost range back from its replicas THROUGH the existing
 # anti-entropy machinery — per-bucket range digests gate the transfer,
 # so only the buckets the quarantine actually diverged move, and
-# apply_if_newer keeps the pulls LWW-safe.  The pull covers the arc
-# this shard can store — its primary range plus the rf-1 predecessor
-# primaries it replicates — from peers in BOTH walk directions, and
-# buckets that agree cost one digest frame.  Only after the pull
+# apply_if_newer keeps the pulls LWW-safe.  The pull covers the EXACT
+# owned-range union (MyShard.replica_arcs), one pull per arc per
+# replica of that arc, and buckets that agree cost one digest frame.  Only after the pull
 # completes are the quarantined files retired (tree.finish_repair)
 # and suspect-miss reads re-enabled.
 #
@@ -551,6 +604,9 @@ async def _pull_buckets_from_peer(
                     tree, bytes(key), bytes(value), int(ts)
                 ):
                     applied += 1
+                    # Convergence accounting (get_stats.convergence):
+                    # AE and repair pulls heal keys locally here.
+                    my_shard.keys_healed += 1
         if len(entries) < ANTI_ENTROPY_PAGE:
             break
         page_after = bytes(entries[-1][0])
@@ -602,7 +658,18 @@ async def _pull_diverged_from_peer(
 
 async def repair_collection(my_shard: MyShard, name: str) -> None:
     """Re-fetch whatever a quarantined table lost from this
-    collection's replicas, then retire the quarantined files."""
+    collection's replicas, then retire the quarantined files.
+
+    Scope: the EXACT owned-range union (MyShard.replica_arcs — the
+    same helper the anti-entropy loop walks), one digest-gated pull
+    per (arc, replica-of-that-arc).  The old
+    (rf-th-distinct-predecessor, self] arc over-approximated the
+    union under interleaved multi-shard nodes, importing ranges this
+    shard can never serve (ROADMAP open item, now closed); the exact
+    arcs also pick each arc's TRUE replicas instead of a blanket
+    both-directions node walk.  RF=1 (or a ring with no other node)
+    has NO peer holding our data: the honest outcome is the
+    lost-data branch, never a pull from a non-replica."""
     col = my_shard.collections.get(name)
     if col is None:
         return
@@ -610,57 +677,9 @@ async def repair_collection(my_shard: MyShard, name: str) -> None:
     covered = tree._quarantine_pending
     rf = col.replication_factor
     nb = max(1, my_shard.config.anti_entropy_buckets)
-    # Scope the pull to the arc this shard can actually STORE — the
-    # union of its primary range and the rf-1 predecessor primaries
-    # it replicates, i.e. (rf-th-distinct-node-predecessor, self].
-    # An unscoped whole-ring compare would import every peer-only
-    # range wholesale (unbounded store bloat in clusters with
-    # nodes > rf).  With fewer distinct nodes than rf the arc IS the
-    # whole ring (start == end).  Over-approximation under ring churn
-    # is safe: apply_if_newer is LWW and migration cleanup owns
-    # unowned-range hygiene.
-    seen_pred: set = set()
-    start_hash = my_shard.hash  # start == end ⇒ whole ring
-    for s in reversed(my_shard.shards):  # rotated: [-1] = predecessor
-        if s.node_name == my_shard.config.name:
-            continue
-        if s.node_name not in seen_pred:
-            seen_pred.add(s.node_name)
-        start_hash = s.hash
-        if len(seen_pred) >= rf:
-            break
-    if len(seen_pred) < rf:
-        start_hash = my_shard.hash
-    start = (start_hash + 1) & 0xFFFFFFFF
-    end = (my_shard.hash + 1) & 0xFFFFFFFF
-    # The peers that can hold data this shard stores are BOTH walk
-    # directions: the rf-1 distinct-node SUCCESSORS replicate our
-    # primary range, and the rf-1 distinct-node PREDECESSORS own the
-    # ranges we hold as a replica — pulling from successors alone
-    # would silently never recover a quarantined replica range.
-    # RF=1 has NO peer holding our data: the honest outcome is the
-    # lost-data branch below, never a pull from a non-replica.
-    nodes: set = set()
-    peers = []
-
-    def _collect(walk):
-        found = 0
-        for s in walk:
-            if (
-                s.node_name == my_shard.config.name
-                or s.node_name in nodes
-            ):
-                continue
-            nodes.add(s.node_name)
-            peers.append(s)
-            found += 1
-            if found >= rf - 1:
-                return
-
-    if rf > 1:
-        _collect(my_shard.shards)  # forward: successors
-        _collect(reversed(my_shard.shards))  # backward: predecessors
-    if not peers:
+    arcs = my_shard.replica_arcs(rf) if rf > 1 else []
+    arcs = [a for a in arcs if a[2]]  # only arcs with live peers
+    if not arcs:
         log.warning(
             "repair of %s: no replica holds this shard's data — "
             "whatever only the quarantined table held is LOST; "
@@ -672,29 +691,42 @@ async def repair_collection(my_shard: MyShard, name: str) -> None:
         return
     applied = 0
     ok = 0
-    for peer in peers:
-        try:
-            applied += await _pull_diverged_from_peer(
-                my_shard, name, tree, peer, start, end, nb
-            )
-            ok += 1
-        except (DbeelError, OSError) as e:
-            log.warning(
-                "repair pull of %s from %s failed: %s",
+    for start, end, peers in arcs:
+        arc_ok = 0
+        for peer in peers:
+            try:
+                applied += await _pull_diverged_from_peer(
+                    my_shard, name, tree, peer, start, end, nb
+                )
+                arc_ok += 1
+            except (DbeelError, OSError) as e:
+                log.warning(
+                    "repair pull of %s from %s failed: %s",
+                    name,
+                    peer.name,
+                    e,
+                )
+        if arc_ok == 0:
+            # Every replica of this arc failed: the arc's lost range
+            # is NOT yet recovered — keep the suspect state (reads
+            # keep walking to replicas) and retry on a later
+            # quarantine/scrub trigger rather than declaring a
+            # repair that left a hole.
+            log.error(
+                "repair of %s: no peer reachable for arc "
+                "[%d, %d); will retry",
                 name,
-                peer.name,
-                e,
+                start,
+                end,
             )
-    if ok == 0:
-        # Every peer failed: keep the suspect state (reads keep
-        # walking to replicas) and retry on a later quarantine/scrub
-        # trigger rather than declaring a repair that never ran.
-        log.error("repair of %s: no peer reachable; will retry", name)
-        return
+            return
+        ok += arc_ok
     log.info(
-        "repair of %s complete: %d entries re-applied from %d peers",
+        "repair of %s complete: %d entries re-applied over %d arcs "
+        "(%d peer pulls)",
         name,
         applied,
+        len(arcs),
         ok,
     )
     tree.finish_repair(covered)

@@ -438,6 +438,49 @@ class LSMTree:
             except Exception:
                 log.exception("on_disk_error callback failed")
 
+    async def rearm_precheck(self) -> None:
+        """Admin ``rearm`` pre-checks (operator replaced the disk):
+        prove this store's filesystem is writable again — free space
+        back above the flush floor, plus a write+fsync round trip
+        through the same fault seam the WAL append path uses —
+        WITHOUT clearing read-only (the shard layer does, once every
+        collection's tree passes).  Raises ShardDegraded while the
+        disk is still bad.  The probe uses a scratch file, not the
+        live WAL: a post-EIO WAL fd may be stale regardless, and the
+        flush the shard spawns right after re-arming rotates to a
+        fresh WAL anyway (two-WAL protocol) — if THAT still fails,
+        the on_error hook re-degrades immediately."""
+        probe = os.path.join(self.dir_path, ".rearm-probe")
+        if file_io.free_disk_space(probe) < MIN_FREE_BYTES:
+            raise ShardDegraded(
+                f"rearm {self.dir_path}: still below the "
+                f"free-space floor"
+            )
+
+        def _probe_write() -> None:
+            file_io.check_write_fault(probe)
+            fd = os.open(
+                probe, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644
+            )
+            try:
+                os.write(fd, b"\x00" * 4096)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+                try:
+                    os.unlink(probe)
+                except OSError:
+                    pass
+
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, _probe_write
+            )
+        except OSError as e:
+            raise ShardDegraded(
+                f"rearm {self.dir_path}: WAL-append probe failed: {e}"
+            ) from e
+
     @property
     def reads_suspect(self) -> bool:
         """True while a quarantine awaits repair: a local miss may be

@@ -1028,6 +1028,38 @@ const char* dbeel_cli_last_error(void* h) {
   return static_cast<Client*>(h)->last_error.c_str();
 }
 
+// Fetch one server's get_stats snapshot (raw msgpack map — the
+// schema, incl. the replica-convergence block, is shared with the
+// Python client's get_stats()).  ip/port target a specific shard
+// listener; empty ip falls back to the seed.  Returns bytes written
+// into out, -2 on error, or <= -10 encoding the needed buffer size
+// as -(rc) - 10.
+int64_t dbeel_cli_get_stats(void* h, const char* ip, uint16_t port,
+                            uint8_t* out, uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string target_ip = (ip && *ip) ? ip : c->seed_ip;
+  uint16_t target_port = port ? port : c->seed_port;
+  MpBuf m;
+  m.map_header(2);
+  common_fields(&m, "get_stats", "", true);
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, target_ip, target_port, m, &body, &rtype)) {
+    return -2;
+  }
+  if (rtype == 0) {
+    std::string msg;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+    return -2;
+  }
+  if (body.size() > cap) {
+    c->last_error = "stats exceed caller buffer";
+    return -((int64_t)body.size()) - 10;
+  }
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
 int dbeel_cli_create_collection(void* h, const char* name,
                                 uint32_t rf) {
   Client* c = static_cast<Client*>(h);

@@ -107,6 +107,32 @@ def test_anti_entropy_noop_when_in_sync(tmp_dir):
             await asyncio.wait_for(asyncio.gather(*created), 10)
             for i in range(10):
                 await col.set(f"s{i}", i, consistency=Consistency.ALL)
+            # Steady state first (same guard as the proportionality
+            # test): a digest scan racing the base writes mid-cycle
+            # legitimately syncs in-flight entries — wait for one
+            # cycle where neither node repaired anything before
+            # asserting silence.
+            for _ in range(30):
+                settled = [
+                    n.flow_event(0, FlowEvent.ANTI_ENTROPY_SYNCED)
+                    for n in (node1, node2)
+                ]
+                await asyncio.wait_for(
+                    asyncio.gather(
+                        node1.flow_event(
+                            0, FlowEvent.ANTI_ENTROPY_DONE
+                        ),
+                        node2.flow_event(
+                            0, FlowEvent.ANTI_ENTROPY_DONE
+                        ),
+                    ),
+                    20,
+                )
+                clean = not any(f.done() for f in settled)
+                for f in settled:
+                    f.cancel()
+                if clean:
+                    break
             # Two full cycles with no client traffic: a digest
             # mismatch would fire ANTI_ENTROPY_SYNCED (the repair
             # path's own milestone) — those subscriptions must stay

@@ -35,6 +35,19 @@ REPORT_KEYS = {
     "pass",
 }
 
+PARTITION_KEYS = {
+    "victim",
+    "keys",
+    "writes_ok",
+    "write_errors",
+    "hints_queued_during",
+    "hints_replayed_total",
+    "hint_drain_slo_s",
+    "convergence_s",
+    "divergent_after_slo",
+    "pass",
+}
+
 
 @pytest.mark.slow
 def test_chaos_soak_quick_schema(tmp_dir):
@@ -44,7 +57,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
     import signal
 
     if hasattr(signal, "SIGALRM"):
-        signal.alarm(590)
+        signal.alarm(890)
     report_path = os.path.join(tmp_dir, "report.json")
     proc = subprocess.run(
         [
@@ -52,13 +65,14 @@ def test_chaos_soak_quick_schema(tmp_dir):
             os.path.join(REPO, "chaos_soak.py"),
             "--quick",
             "--disk-faults",
+            "--partition",
             "--report",
             report_path,
         ],
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=900,
     )
     assert os.path.exists(report_path), proc.stdout[-2000:]
     with open(report_path) as f:
@@ -79,6 +93,14 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert df["enospc"]["victim_alive"] is True
     if df["bitflip"] is not None:
         assert df["bitflip"]["corrupt_payloads"] == 0
+    # --partition phase schema (replica-convergence plane, ISSUE 4):
+    # asymmetric partition → hints queued → heal → every phase key's
+    # replicas byte-agree within the hint-drain SLO.
+    pt = report["partition"]
+    missing = PARTITION_KEYS - set(pt)
+    assert not missing, missing
+    assert pt["divergent_after_slo"] == 0, pt
+    assert pt["writes_ok"] > 0
     assert report["quick"] is True
     # The quick mode must still uphold the hard invariants (loss /
     # divergence), even though the error-rate gate is waived.

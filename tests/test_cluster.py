@@ -255,10 +255,9 @@ def test_hinted_handoff_replays_missed_writes(tmp_dir):
 
         def total_hints():
             return sum(
-                len(q)
+                s.hint_log.queued_total()
                 for n in nodes[:2]
                 for s in n.shards
-                for q in s.hints.values()
             )
 
         # At least one coordinator records a hint (flow milestone; the
@@ -276,7 +275,7 @@ def test_hinted_handoff_replays_missed_writes(tmp_dir):
             s
             for n in nodes[:2]
             for s in n.shards
-            if s.hints
+            if s.hint_log.queued_total()
         ]
         replays = [
             s.flow.subscribe(FlowEvent.HINTS_REPLAYED)

@@ -230,7 +230,7 @@ def test_midflight_death_mark_unblocks_blackholed_quorum(tmp_dir):
             # 5 s op timeout / 15 s read timeout.
             assert elapsed < 3.0, elapsed
             assert c_cfg.name in a.dead_nodes
-            assert a.hints.get(c_cfg.name), "mutation not hinted"
+            assert a.hint_log.has(c_cfg.name), "mutation not hinted"
         finally:
             remote_comm.clear_faults()
             for n in nodes:
@@ -336,7 +336,9 @@ def test_dead_peer_prefilter_fast_fails_without_dialing(tmp_dir):
             assert results == []
             assert op_status["peer_dead"] is True
             assert op_status["targets"] == ["ghost"]
-            assert len(shard.hints.get("ghost", ())) == 1
+            assert (
+                shard.hint_log.queued_by_node().get("ghost") == 1
+            )
         finally:
             await node.stop()
 
