@@ -270,6 +270,213 @@ def main_native(args):
     boot.close()
 
 
+async def main_overload_knee(args):
+    """--overload-knee: the overload-control plane's headline curve.
+    Measure the SAME-SESSION sustainable closed-loop rate, then sweep
+    open-loop offered load across multiples of it, recording goodput
+    and p99-of-admitted per step — the knee: goodput should plateau
+    (not collapse) and tail latency should stay bounded as offered
+    load crosses sustainable, because the governor sheds instead of
+    queueing.  Rows go to BENCH.md with the mandatory same-session
+    baseline (ROADMAP "host weather" rule)."""
+    import time as _time
+
+    from dbeel_tpu.errors import (
+        ERROR_CLASS_OVERLOAD,
+        CollectionAlreadyExists,
+        classify_error,
+    )
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)], op_deadline_s=1.5
+    )
+    try:
+        await client.create_collection(
+            args.collection, args.replication_factor
+        )
+    except CollectionAlreadyExists:
+        pass
+    col = client.collection(args.collection)
+    value = {"blob": "x" * args.value_size}
+    loop = asyncio.get_event_loop()
+
+    # Same-session sustainable baseline: closed loop, N workers.
+    base_dur = 6.0
+    base_ok = 0
+    base_lat = []
+    stop_at = loop.time() + base_dur
+
+    async def base_worker(wid):
+        nonlocal base_ok
+        i = 0
+        while loop.time() < stop_at:
+            i += 1
+            t0 = _time.perf_counter()
+            try:
+                await col.set(f"kb{wid}x{i}", value)
+                base_lat.append(_time.perf_counter() - t0)
+                base_ok += 1
+            except Exception:
+                pass
+
+    t0 = _time.time()
+    await asyncio.gather(
+        *[base_worker(w) for w in range(args.clients)]
+    )
+    wall = max(0.001, _time.time() - t0)
+    sustainable = base_ok / wall
+    base_lat.sort()
+    base_p99 = (
+        base_lat[int(0.99 * (len(base_lat) - 1))] if base_lat else 0.0
+    )
+    print(
+        f"sustainable (closed loop, {args.clients} clients): "
+        f"{sustainable:,.0f} ops/s  p99 {base_p99 * 1000:.2f}ms"
+    )
+    print(
+        f"{'offered x':>9} {'offered/s':>10} {'goodput/s':>10} "
+        f"{'ratio':>6} {'p99 ms':>8} {'overload':>9} {'other err':>9}"
+    )
+
+    # Open-loop generators run as SUBPROCESSES: one Python client
+    # process saturates ITSELF (~ms/op of pack+syscall+asyncio) long
+    # before the native serving path saturates the server — measured
+    # on this host: a single-process "3x" sweep collapsed its own
+    # goodput with the server half idle.  N processes also contend
+    # with the server for CPU, which is exactly how real co-located
+    # overload presents.
+    import json as _json
+    import subprocess as _sp
+    import sys as _sys
+
+    gen_procs = 3
+    for mult in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0):
+        offered = max(10.0, sustainable * mult)
+        dur = 8.0
+        procs = [
+            _sp.Popen(
+                [
+                    _sys.executable,
+                    os.path.abspath(__file__),
+                    "--overload-knee-worker",
+                    "--knee-rate", str(offered / gen_procs),
+                    "--knee-duration", str(dur),
+                    "--host", args.host,
+                    "--port", str(args.port),
+                    "--collection", args.collection,
+                    "--value-size", str(args.value_size),
+                    "--seed", str(args.seed + wi),
+                ],
+                stdout=_sp.PIPE,
+                text=True,
+            )
+            for wi in range(gen_procs)
+        ]
+        ok = launched = 0
+        lat: list = []
+        err: dict = {}
+        for p in procs:
+            out, _ = p.communicate(timeout=dur + 60)
+            row = _json.loads(out.strip().splitlines()[-1])
+            ok += row["ok"]
+            launched += row["launched"]
+            lat.extend(row["lat_ms"])
+            for k, v in row["err"].items():
+                err[k] = err.get(k, 0) + v
+        lat.sort()
+        p99 = lat[int(0.99 * (len(lat) - 1))] if lat else float("nan")
+        overload_errs = err.get(ERROR_CLASS_OVERLOAD, 0)
+        other_errs = sum(err.values()) - overload_errs
+        print(
+            f"{mult:>9.1f} {offered:>10,.0f} {ok / dur:>10,.0f} "
+            f"{ok / dur / max(1e-9, sustainable):>6.2f} "
+            f"{p99:>8.1f} {overload_errs:>9} {other_errs:>9}"
+        )
+    # The governor's view after the sweep.
+    stats = await client.get_stats(args.host, args.port)
+    ov = stats.get("overload", {})
+    sig = ov.get("signals", {})
+    print(
+        f"server: sheds={ov.get('shed_ops')} "
+        f"deadline_drops={ov.get('deadline_drops')} "
+        f"dead_completions={ov.get('dead_completions')} "
+        f"window_min_seen={ov.get('window_min_seen')} "
+        f"bg_delays={ov.get('bg_delays')} "
+        f"loop_lag_ms={sig.get('loop_lag_ms')}"
+    )
+    client.close()
+
+
+async def main_knee_worker(args):
+    """One open-loop generator process (see main_overload_knee):
+    paces ops at --knee-rate for --knee-duration, prints one JSON
+    row of outcomes."""
+    import json as _json
+    import time as _time
+
+    from dbeel_tpu.errors import classify_error
+
+    # Pipelined transport: one socket, multiplexed — the cheapest
+    # per-op client path in Python, so the generator's own ceiling
+    # sits well above the closed-loop sustainable rate.
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)],
+        op_deadline_s=1.5,
+        pipeline_window=256,
+    )
+    col = client.collection(args.collection)
+    value = {"blob": "x" * args.value_size}
+    loop = asyncio.get_event_loop()
+    inflight: set = set()
+    ok = launched = 0
+    lat: list = []
+    err: dict = {}
+
+    async def one(i):
+        nonlocal ok
+        t0 = _time.perf_counter()
+        try:
+            await asyncio.wait_for(
+                col.set(f"ko{args.seed}x{i}", value), 10
+            )
+            lat.append(
+                round((_time.perf_counter() - t0) * 1000, 2)
+            )
+            ok += 1
+        except Exception as e:
+            cls = classify_error(e) or "other"
+            err[cls] = err.get(cls, 0) + 1
+
+    t_start = loop.time()
+    tick = 0.02
+    carry = 0.0
+    while loop.time() - t_start < args.knee_duration:
+        carry += args.knee_rate * tick
+        n = int(carry)
+        carry -= n
+        for _ in range(n):
+            if len(inflight) >= 1500:
+                continue
+            launched += 1
+            t = asyncio.ensure_future(one(launched))
+            inflight.add(t)
+            t.add_done_callback(inflight.discard)
+        await asyncio.sleep(tick)
+    if inflight:
+        await asyncio.wait(inflight, timeout=15)
+    client.close()
+    print(
+        _json.dumps(
+            {
+                "ok": ok,
+                "launched": launched,
+                "lat_ms": lat,
+                "err": err,
+            }
+        )
+    )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -310,10 +517,35 @@ def main():
         help="batched mode: multi_set/multi_get frames of N keys "
         "grouped by owning node",
     )
+    ap.add_argument(
+        "--overload-knee",
+        action="store_true",
+        help="offered-load sweep (open loop, multiples of the "
+        "same-session sustainable rate) recording goodput + p99 vs "
+        "load — the overload-control knee curve",
+    )
+    ap.add_argument(
+        "--overload-knee-worker",
+        action="store_true",
+        help=argparse.SUPPRESS,  # internal: one generator subprocess
+    )
+    ap.add_argument(
+        "--knee-rate", type=float, default=0.0, help=argparse.SUPPRESS
+    )
+    ap.add_argument(
+        "--knee-duration",
+        type=float,
+        default=8.0,
+        help=argparse.SUPPRESS,
+    )
     args = ap.parse_args()
     if args.pipeline and args.batch:
         ap.error("--pipeline and --batch are separate phases")
-    if args.native_client:
+    if args.overload_knee_worker:
+        asyncio.run(main_knee_worker(args))
+    elif args.overload_knee:
+        asyncio.run(main_overload_knee(args))
+    elif args.native_client:
         main_native(args)
     else:
         asyncio.run(main_async(args))

@@ -746,6 +746,216 @@ async def partition_phase(nodes, seeds, report, quick):
     return ok
 
 
+async def overload_phase(nodes, report, quick):
+    """--overload: measure the SAME-SESSION sustainable closed-loop
+    rate, then offer >= 3x that in OPEN LOOP (ops launch on a fixed
+    schedule, never paced by responses) against the live cluster.
+    Gates:
+      * every node stays alive (sheds, never collapses/OOMs);
+      * goodput (acked ops/s) stays >= 70% of the sustainable
+        baseline, OR the node is honestly shedding (overload-class
+        errors / shed counters) with admitted p99 still bounded —
+        on a 2-core CI host the generator and the server contend for
+        the SAME cpu at 3x offered load, so absolute goodput under
+        pressure is host weather (BENCH.md r8), while "alive, honest,
+        bounded" is the actual overload-control contract;
+      * p99 of ADMITTED ops stays bounded (<= max(20x baseline p99,
+        1s)) — queues cannot silently stretch into minutes;
+      * overload surfaces honestly: overload-class client errors or
+        server-side shed counters, never silent hangs;
+      * the get_stats ``overload`` block is visible through BOTH
+        clients (Python and compiled C)."""
+    from dbeel_tpu.errors import ERROR_CLASS_OVERLOAD
+
+    # 4s budget: admitted quorum ops need headroom over the baseline
+    # p99 (hundreds of ms on this host class) while still making
+    # stretched completions read as DEAD work server-side.
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)], op_deadline_s=4.0
+    )
+    col = client.collection(COLLECTION)
+    loop = asyncio.get_event_loop()
+
+    # ---- same-session sustainable baseline (closed loop) -------------
+    base_dur = 4.0 if quick else 8.0
+    base_lat = []
+    base_ok = 0
+    base_stop = loop.time() + base_dur
+
+    async def base_worker(wid):
+        nonlocal base_ok
+        i = 0
+        while loop.time() < base_stop:
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                await asyncio.wait_for(
+                    col.set(
+                        f"ovb{wid}x{i}", {"v": i},
+                        consistency=Consistency.fixed(2),
+                    ),
+                    10,
+                )
+                base_lat.append(time.perf_counter() - t0)
+                base_ok += 1
+            except Exception:
+                pass
+
+    t0 = time.time()
+    await asyncio.gather(*[base_worker(w) for w in range(8)])
+    base_wall = max(0.001, time.time() - t0)
+    sustainable = base_ok / base_wall
+    base_lat.sort()
+    base_p99 = (
+        base_lat[int(0.99 * (len(base_lat) - 1))]
+        if base_lat
+        else 0.05
+    )
+    log(
+        f"OVERLOAD: sustainable {sustainable:,.0f} ops/s, "
+        f"baseline p99 {base_p99 * 1000:.1f} ms"
+    )
+
+    # ---- open-loop offered load >= 3x --------------------------------
+    multiplier = 3.0
+    offered = max(20.0, sustainable * multiplier)
+    dur = 8.0 if quick else 15.0
+    max_outstanding = 3000  # client memory bound, counted when hit
+    inflight = set()
+    ok = 0
+    lat = []
+    err: dict = {}
+    not_launched = 0
+    launched = 0
+
+    async def one(i):
+        nonlocal ok
+        t0 = time.perf_counter()
+        try:
+            await asyncio.wait_for(
+                col.set(
+                    f"ovl{i}", {"v": i},
+                    consistency=Consistency.fixed(2),
+                ),
+                10,
+            )
+            lat.append(time.perf_counter() - t0)
+            ok += 1
+        except Exception as e:
+            cls = classify_error(e) or "other"
+            err[cls] = err.get(cls, 0) + 1
+
+    t_start = loop.time()
+    tick = 0.02
+    per_tick = offered * tick
+    carry = 0.0
+    while loop.time() - t_start < dur:
+        carry += per_tick
+        n = int(carry)
+        carry -= n
+        for _ in range(n):
+            if len(inflight) >= max_outstanding:
+                not_launched += 1
+                continue
+            launched += 1
+            t = asyncio.ensure_future(one(launched))
+            inflight.add(t)
+            t.add_done_callback(inflight.discard)
+        await asyncio.sleep(tick)
+    wall = loop.time() - t_start
+    if inflight:
+        await asyncio.wait(inflight, timeout=15)
+    goodput = ok / wall
+    lat.sort()
+    adm_p99 = lat[int(0.99 * (len(lat) - 1))] if lat else float("inf")
+    p99_bound = max(20 * base_p99, 1.0)
+
+    # ---- server-side counters + both clients' stats blocks -----------
+    server_sheds = server_deadline_drops = bg_delays = 0
+    py_block = True
+    for n_ in nodes:
+        for sid in range(SHARDS):
+            try:
+                s = await client.get_stats(
+                    "127.0.0.1", n_.db_port + sid
+                )
+                ov = s.get("overload")
+                if not isinstance(ov, dict):
+                    py_block = False
+                    continue
+                server_sheds += ov.get("shed_ops", 0)
+                server_deadline_drops += ov.get(
+                    "deadline_drops", 0
+                ) + ov.get("replica_deadline_drops", 0)
+                bg_delays += ov.get("bg_delays", 0)
+            except Exception as e:
+                log(f"OVERLOAD: stats {n_.name}-{sid}: {repr(e)[:60]}")
+                py_block = False
+    native_block = False
+    try:
+        from dbeel_tpu.client.native_client import NativeDbeelClient
+
+        ncli = NativeDbeelClient("127.0.0.1", nodes[0].db_port)
+        nstats = ncli.get_stats()
+        native_block = isinstance(nstats.get("overload"), dict)
+        ncli.close()
+    except Exception as e:
+        log(f"OVERLOAD: native client stats failed: {repr(e)[:80]}")
+    client.close()
+
+    total_err = sum(err.values())
+    overload_visible = (
+        total_err == 0
+        or err.get(ERROR_CLASS_OVERLOAD, 0) > 0
+        or server_sheds > 0
+        or server_deadline_drops > 0
+    )
+    alive = all(n_.alive() for n_ in nodes)
+    phase = {
+        "sustainable_ops_per_s": round(sustainable, 1),
+        "baseline_p99_ms": round(base_p99 * 1000, 2),
+        "offered_multiplier": multiplier,
+        "offered_ops_per_s": round(offered, 1),
+        "duration_s": round(wall, 1),
+        "launched": launched,
+        "not_launched_outstanding_cap": not_launched,
+        "ok": ok,
+        "errors_by_class": dict(err),
+        "goodput_ops_per_s": round(goodput, 1),
+        "goodput_ratio": round(goodput / max(1e-9, sustainable), 3),
+        "admitted_p99_ms": round(adm_p99 * 1000, 2),
+        "p99_bound_ms": round(p99_bound * 1000, 1),
+        "server_sheds": server_sheds,
+        "server_deadline_drops": server_deadline_drops,
+        "bg_delays": bg_delays,
+        "stats_overload_block_py": py_block,
+        "stats_overload_block_native": native_block,
+        "nodes_alive": alive,
+    }
+    # Honest shedding: the server visibly refused work (shed counters
+    # or overload-class client errors) rather than hanging.  When the
+    # node sheds honestly and admitted p99 stays bounded, absolute
+    # goodput is generator-vs-server cpu weather on this host class
+    # (BENCH.md r8), not an overload-control regression.
+    honest_shed = (
+        err.get(ERROR_CLASS_OVERLOAD, 0) > 0
+        or server_sheds > 0
+        or server_deadline_drops > 0
+    )
+    ok_gate = (
+        alive
+        and (goodput >= 0.70 * sustainable or honest_shed)
+        and adm_p99 <= p99_bound
+        and overload_visible
+        and py_block
+        and native_block
+    )
+    phase["pass"] = ok_gate
+    report["overload"] = phase
+    log(f"OVERLOAD: {phase}")
+    return ok_gate
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -777,6 +987,15 @@ async def main():
         "during quorum writes (its fan-outs fail and hint), heal it "
         "with a clean restart, and assert all replicas of every phase "
         "key byte-agree within the hint-drain SLO",
+    )
+    ap.add_argument(
+        "--overload", action="store_true",
+        help="after churn: offer >= 3x the same-session sustainable "
+        "rate in open loop; assert the node sheds with retryable "
+        "overload errors instead of hanging/OOMing, goodput stays >= "
+        "70%% of sustainable (or the node is honestly shedding with "
+        "admitted p99 still bounded), and both clients surface the "
+        "get_stats overload block",
     )
     ap.add_argument(
         "--quick", action="store_true",
@@ -889,6 +1108,13 @@ async def main():
         ok = (
             await partition_phase(nodes, seeds, report, args.quick)
         ) and ok
+    if args.overload:
+        ok = (
+            await overload_phase(nodes, report, args.quick)
+        ) and ok
+        # Let the shed/backlogged writes' hints drain and windows
+        # recover before the byte-equality scan.
+        await asyncio.sleep(min(args.quiet_window, 15.0))
     ok = (await final_checks(nodes, acks, report)) and ok
     if not args.quick:
         # Quick mode waives the rate gate: one unlucky op in a tiny

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import random
 import struct
+import time
 from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass
@@ -21,6 +22,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import msgpack
 
 from ..errors import (
+    ERROR_CLASS_OVERLOAD,
     ConnectionError_,
     DbeelError,
     KeyNotFound,
@@ -434,6 +436,13 @@ class DbeelClient:
 
         loop = asyncio.get_event_loop()
         deadline = loop.time() + self._op_deadline_s
+        # Deadline propagation (overload plane): the op's absolute
+        # wall-clock budget rides the request frame, so the server can
+        # drop the work server-side (and replicas replica-side) once
+        # we have given up — instead of computing a dead response.
+        request["deadline_ms"] = int(
+            (time.time() + self._op_deadline_s) * 1000
+        )
         attempt = 0
         last_error: Optional[Exception] = None
         while True:
@@ -520,8 +529,17 @@ class DbeelClient:
                     )
                 except (DbeelError, OSError, asyncio.TimeoutError):
                     pass
+            backoff_attempt = attempt
+            if (
+                last_error is not None
+                and classify_error(last_error) == ERROR_CLASS_OVERLOAD
+            ):
+                # The server is SHEDDING: retrying fast only feeds
+                # the overload — skip ahead in the backoff schedule
+                # (the jittered cap still bounds the pause).
+                backoff_attempt += 2
             pause = min(
-                self._backoff_s(attempt, self._rng),
+                self._backoff_s(backoff_attempt, self._rng),
                 max(0.0, deadline - loop.time()),
             )
             if pause > 0:
@@ -585,6 +603,10 @@ class DbeelClient:
                 # deadline budget.
                 "timeout": max(
                     100, min(5000, int(self._op_deadline_s * 1000))
+                ),
+                # Deadline propagation for the whole batch frame.
+                "deadline_ms": int(
+                    (time.time() + self._op_deadline_s) * 1000
                 ),
             }
             if consistency is not None:

@@ -131,42 +131,89 @@ class ShardRequest:
     def drop_collection(name: str) -> list:
         return ["request", ShardRequest.DROP_COLLECTION, name]
 
-    @staticmethod
-    def set(collection: str, key: bytes, value: bytes, ts: int) -> list:
-        return ["request", ShardRequest.SET, collection, key, value, ts]
+    # Data-op peer frames optionally carry ONE trailing element: the
+    # coordinator's absolute wall-clock deadline in ms (overload
+    # plane, PR 5).  A replica drops expired work with a retryable
+    # Overloaded error instead of computing a dead response; old-
+    # dialect frames simply lack the element (every consumer indexes
+    # from the front, and the native parser accepts both arities).
 
     @staticmethod
-    def delete(collection: str, key: bytes, ts: int) -> list:
-        return ["request", ShardRequest.DELETE, collection, key, ts]
+    def _with_deadline(frame: list, deadline_ms) -> list:
+        if isinstance(deadline_ms, int) and deadline_ms > 0:
+            frame.append(deadline_ms)
+        return frame
 
     @staticmethod
-    def get(collection: str, key: bytes) -> list:
-        return ["request", ShardRequest.GET, collection, key]
+    def set(
+        collection: str, key: bytes, value: bytes, ts: int,
+        deadline_ms: "int | None" = None,
+    ) -> list:
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.SET, collection, key, value, ts],
+            deadline_ms,
+        )
 
     @staticmethod
-    def get_digest(collection: str, key: bytes) -> list:
+    def delete(
+        collection: str, key: bytes, ts: int,
+        deadline_ms: "int | None" = None,
+    ) -> list:
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.DELETE, collection, key, ts],
+            deadline_ms,
+        )
+
+    @staticmethod
+    def get(
+        collection: str, key: bytes,
+        deadline_ms: "int | None" = None,
+    ) -> list:
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.GET, collection, key],
+            deadline_ms,
+        )
+
+    @staticmethod
+    def get_digest(
+        collection: str, key: bytes,
+        deadline_ms: "int | None" = None,
+    ) -> list:
         """Digest read (quorum-get fast path, beyond the reference —
         db_server.rs:318-370 ships RF full entries): the replica
         answers (timestamp, murmur3_32(value)) instead of the value,
         so agreeing replicas cost a byte-compare, not a payload."""
-        return ["request", ShardRequest.GET_DIGEST, collection, key]
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.GET_DIGEST, collection, key],
+            deadline_ms,
+        )
 
     @staticmethod
-    def multi_set(collection: str, entries: list) -> list:
+    def multi_set(
+        collection: str, entries: list,
+        deadline_ms: "int | None" = None,
+    ) -> list:
         """Batched replica mutation: ``entries`` is
         [[key, value, ts], ...] (tombstone value = delete).  ONE
         frame and ONE ack per peer per client batch, instead of one
         round trip per sub-op — the replica applies each entry under
         the same watermark guard as a single SET."""
-        return [
-            "request", ShardRequest.MULTI_SET, collection, entries
-        ]
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.MULTI_SET, collection, entries],
+            deadline_ms,
+        )
 
     @staticmethod
-    def multi_get(collection: str, keys: list) -> list:
+    def multi_get(
+        collection: str, keys: list,
+        deadline_ms: "int | None" = None,
+    ) -> list:
         """Batched replica read: the response carries one entry (or
         nil) per key, aligned with ``keys``."""
-        return ["request", ShardRequest.MULTI_GET, collection, keys]
+        return ShardRequest._with_deadline(
+            ["request", ShardRequest.MULTI_GET, collection, keys],
+            deadline_ms,
+        )
 
     @staticmethod
     def range_digest(

@@ -14,7 +14,12 @@ import os
 import struct
 from typing import List, Tuple
 
-from ..errors import ConnectionError_, ProtocolError, Timeout
+from ..errors import (
+    ConnectionError_,
+    Overloaded,
+    ProtocolError,
+    Timeout,
+)
 from . import messages
 from .messages import (
     NodeMetadata,
@@ -144,9 +149,25 @@ class RemoteShardConnection:
     reference's connect-per-request (rs:50-72) dominates quorum
     latency.  Events stay connect-per-send: an event error produces a
     server-side error response with no reader, which would desync a
-    pooled stream."""
+    pooled stream.
+
+    Slow-peer isolation (overload plane, ISSUE 5): in-flight ops (and
+    pre-packed frame bytes) to this peer are capped.  Over the cap,
+    the NEW send is shed immediately with the retryable ``Overloaded``
+    error — LIFO-over-limit: work already in flight keeps its place,
+    the newest arrival is the one refused — so one degraded replica
+    stalling its reads can never absorb an unbounded slice of a
+    coordinator's memory in parked frames and blocked tasks.  The
+    fan-out layer treats the shed exactly like an unreachable peer:
+    mutations fall back to the hint path and converge when the peer
+    recovers."""
 
     MAX_POOL = 4
+    # Class defaults for directly-constructed connections (tests,
+    # probes); ring entries get the configured values via from_config.
+    # 0 disables a cap.
+    MAX_INFLIGHT_OPS = 128
+    MAX_INFLIGHT_BYTES = 8 << 20
 
     def __init__(
         self,
@@ -155,6 +176,8 @@ class RemoteShardConnection:
         read_timeout_ms: int = 15000,
         write_timeout_ms: int = 15000,
         pooled: bool = False,
+        max_inflight_ops: "int | None" = None,
+        max_inflight_bytes: "int | None" = None,
     ) -> None:
         self.address = address
         host, port = address.rsplit(":", 1)
@@ -166,6 +189,19 @@ class RemoteShardConnection:
         self.pooled = pooled
         self._pool: list = []
         self._pool_closed = False
+        self.max_inflight_ops = (
+            self.MAX_INFLIGHT_OPS
+            if max_inflight_ops is None
+            else max_inflight_ops
+        )
+        self.max_inflight_bytes = (
+            self.MAX_INFLIGHT_BYTES
+            if max_inflight_bytes is None
+            else max_inflight_bytes
+        )
+        self.inflight_ops = 0
+        self.inflight_bytes = 0
+        self.shed_count = 0  # summed into get_stats.overload
 
     @classmethod
     def from_config(
@@ -177,7 +213,32 @@ class RemoteShardConnection:
             cfg.remote_shard_read_timeout_ms,
             cfg.remote_shard_write_timeout_ms,
             pooled=pooled,
+            max_inflight_ops=getattr(
+                cfg, "peer_queue_max_ops", None
+            ),
+            max_inflight_bytes=getattr(
+                cfg, "peer_queue_max_bytes", None
+            ),
         )
+
+    def _admit(self, nbytes: int) -> None:
+        """Outbound-queue cap check; raises Overloaded (counted) when
+        this peer already holds its limit of our in-flight work."""
+        if (
+            self.max_inflight_ops
+            and self.inflight_ops >= self.max_inflight_ops
+        ) or (
+            self.max_inflight_bytes
+            and nbytes
+            and self.inflight_bytes + nbytes
+            > self.max_inflight_bytes
+        ):
+            self.shed_count += 1
+            raise Overloaded(
+                f"outbound queue to {self.address} full "
+                f"({self.inflight_ops} ops / "
+                f"{self.inflight_bytes} bytes in flight)"
+            )
 
     def close_pool(self) -> None:
         """Permanently close: in-flight round trips finishing after this
@@ -215,10 +276,21 @@ class RemoteShardConnection:
             get_message_from_stream(reader), self.read_timeout
         )
 
-    async def _rpc(self, op):
+    async def _rpc(self, op, nbytes: int = 0):
         """Run ``op(reader, writer) -> result`` with the pooled
         persistent-stream semantics when enabled, else
-        connect-per-request (remote_shard_connection.rs:50-72)."""
+        connect-per-request (remote_shard_connection.rs:50-72).
+        ``nbytes`` (pre-packed frames) feeds the byte cap."""
+        self._admit(nbytes)
+        self.inflight_ops += 1
+        self.inflight_bytes += nbytes
+        try:
+            return await self._rpc_inner(op)
+        finally:
+            self.inflight_ops -= 1
+            self.inflight_bytes -= nbytes
+
+    async def _rpc_inner(self, op):
         if _faults:
             await _apply_fault(self)
         if self.pooled:
@@ -289,7 +361,8 @@ class RemoteShardConnection:
         stripped, NOT unpacked).  Callers byte-compare against the
         expected constant ack and only unpack on mismatch."""
         return await self._rpc(
-            lambda r, w: self._round_trip_packed(r, w, framed)
+            lambda r, w: self._round_trip_packed(r, w, framed),
+            nbytes=len(framed),
         )
 
     async def send_request(self, request: list) -> list:
@@ -298,6 +371,14 @@ class RemoteShardConnection:
 
     async def send_event(self, event: list) -> None:
         """Fire one ShardEvent (no reply expected) and close."""
+        self._admit(0)
+        self.inflight_ops += 1
+        try:
+            await self._send_event_inner(event)
+        finally:
+            self.inflight_ops -= 1
+
+    async def _send_event_inner(self, event: list) -> None:
         if _faults:
             await _apply_fault(self)
         reader, writer = await self._connect()

@@ -67,6 +67,39 @@ class Config:
     # the divergence).  0 = uncapped.
     read_repair_max_per_sec: int = 256
 
+    # ---- Overload-control plane (PR 5) -------------------------------
+    # Per-shard load governor thresholds on the admitted-work total
+    # (in-flight + queued + sync-parked ops across connections): past
+    # soft, background loops (anti-entropy, scrub, hint drain,
+    # migration) are delayed and the AIMD connection window shrinks;
+    # past hard, new data ops are shed with the retryable `Overloaded`
+    # error.  0 disables that limit.
+    overload_soft_ops: int = 192
+    overload_hard_ops: int = 768
+    # Soft signal: sstable count on any collection beyond this means
+    # compaction is behind — shrink windows / delay background work
+    # before the read path degrades.  0 disables.
+    overload_compaction_debt: int = 16
+    # Upper bound of the per-connection AIMD pipeline window (the old
+    # fixed PIPELINE_WINDOW=32); the governor drives the window
+    # between overload_window_min and this.
+    pipeline_window_max: int = 32
+    overload_window_min: int = 2
+    # Slow-peer isolation: per-peer outbound caps — ops in flight and
+    # (for pre-packed frames) bytes in flight to one peer.  Over the
+    # cap the NEW send is shed (LIFO-over-limit: in-flight work keeps
+    # its place) with `Overloaded`; shed replica mutations feed the
+    # hint path.  0 disables.
+    peer_queue_max_ops: int = 128
+    peer_queue_max_bytes: int = 8 << 20
+    # Tombstone GC grace (the delete-resurrection hazard): compaction
+    # refuses to drop a tombstone younger than this, so a replica that
+    # missed the delete cannot resurrect the old value through hint
+    # replay / anti-entropy after the tombstone would have been GC'd.
+    # -1 = auto: max(hint_ttl, 2 x anti-entropy interval).  0 disables
+    # (reference behavior: drop all tombstones at the bottom level).
+    gc_grace_ms: int = -1
+
     # Rebuild-specific knobs (no reference analog).
     shards: int = 0  # 0 = one shard per online CPU core.
     # auto | device | distributed | coalesced | device_full | cpu |
@@ -80,6 +113,18 @@ class Config:
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+    def gc_grace_s(self) -> float:
+        """Resolved tombstone-GC grace in seconds (auto = the widest
+        window a delete needs to out-live its laggard replicas:
+        hints replay within hint_ttl, anti-entropy converges within
+        ~2 intervals)."""
+        ms = self.gc_grace_ms
+        if ms < 0:
+            ms = max(
+                self.hint_ttl_ms, 2 * self.anti_entropy_interval_ms
+            )
+        return ms / 1000.0
 
     def db_port(self, shard_id: int) -> int:
         return self.port + shard_id
@@ -207,6 +252,63 @@ def build_parser() -> argparse.ArgumentParser:
         help="quorum read-repair pushes per second per shard "
         "(0 = uncapped)",
     )
+    p.add_argument(
+        "--overload-soft-ops",
+        type=int,
+        default=d.overload_soft_ops,
+        help="admitted-work soft limit per shard: beyond it "
+        "background loops delay and AIMD windows shrink (0 disables)",
+    )
+    p.add_argument(
+        "--overload-hard-ops",
+        type=int,
+        default=d.overload_hard_ops,
+        help="admitted-work hard limit per shard: beyond it new data "
+        "ops are shed with the retryable Overloaded error "
+        "(0 disables)",
+    )
+    p.add_argument(
+        "--overload-compaction-debt",
+        type=int,
+        default=d.overload_compaction_debt,
+        help="sstable count per collection that counts as soft "
+        "overload (compaction behind; 0 disables)",
+    )
+    p.add_argument(
+        "--pipeline-window-max",
+        type=int,
+        default=d.pipeline_window_max,
+        help="upper bound of the per-connection AIMD pipeline window",
+    )
+    p.add_argument(
+        "--overload-window-min",
+        type=int,
+        default=d.overload_window_min,
+        help="lower bound the AIMD window shrinks to under overload",
+    )
+    p.add_argument(
+        "--peer-queue-max-ops",
+        type=int,
+        default=d.peer_queue_max_ops,
+        help="per-peer outbound in-flight op cap; over it new sends "
+        "are shed (writes fall back to hints; 0 disables)",
+    )
+    p.add_argument(
+        "--peer-queue-max-bytes",
+        type=int,
+        default=d.peer_queue_max_bytes,
+        help="per-peer outbound in-flight byte cap for pre-packed "
+        "frames (0 disables)",
+    )
+    p.add_argument(
+        "--gc-grace",
+        type=int,
+        dest="gc_grace_ms",
+        default=d.gc_grace_ms,
+        help="tombstone GC grace in ms: compaction keeps tombstones "
+        "younger than this (-1 = auto: max(hint-ttl, 2x anti-entropy "
+        "interval); 0 = drop all, reference behavior)",
+    )
     p.add_argument("--shards", type=int, default=d.shards)
     p.add_argument(
         "--compaction-backend",
@@ -280,6 +382,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         hint_drain_interval_ms=ns.hint_drain_interval_ms,
         hint_drain_keys_per_sec=ns.hint_drain_keys_per_sec,
         read_repair_max_per_sec=ns.read_repair_max_per_sec,
+        overload_soft_ops=ns.overload_soft_ops,
+        overload_hard_ops=ns.overload_hard_ops,
+        overload_compaction_debt=ns.overload_compaction_debt,
+        pipeline_window_max=ns.pipeline_window_max,
+        overload_window_min=ns.overload_window_min,
+        peer_queue_max_ops=ns.peer_queue_max_ops,
+        peer_queue_max_bytes=ns.peer_queue_max_bytes,
+        gc_grace_ms=ns.gc_grace_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,
         memtable_capacity=ns.memtable_capacity,

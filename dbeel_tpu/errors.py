@@ -64,6 +64,12 @@ ShardDegraded = _mk(
     "The shard's disk failed (EIO/ENOSPC on the WAL); it is serving "
     "reads only — retry the write on another replica.",
 )
+Overloaded = _mk(
+    "Overloaded",
+    "The shard (or a peer's outbound queue) is past its hard load "
+    "limit and shed this request; retry after backoff — the backlog "
+    "drains, this is a transient condition, not a failure.",
+)
 
 _BY_KIND = {
     cls.kind: cls
@@ -89,6 +95,12 @@ ERROR_CLASS_NOT_OWNED = "not-owned"
 # replica.
 ERROR_CLASS_CORRUPTION = "data-corruption"
 ERROR_CLASS_DEGRADED = "degraded"
+# Overload-control plane (PR 5): the shard's load governor shed this
+# request past its hard limits (or a peer's capped outbound queue
+# refused it).  Retryable after backoff — shedding IS the mechanism
+# that keeps the node alive, so clients must treat it as "try again
+# shortly", never as data loss.
+ERROR_CLASS_OVERLOAD = "overload"
 ERROR_CLASS_OTHER = "other"
 ERROR_CLASSES = (
     ERROR_CLASS_COORDINATOR_DEAD,
@@ -97,6 +109,7 @@ ERROR_CLASSES = (
     ERROR_CLASS_NOT_OWNED,
     ERROR_CLASS_CORRUPTION,
     ERROR_CLASS_DEGRADED,
+    ERROR_CLASS_OVERLOAD,
     ERROR_CLASS_OTHER,
 )
 
@@ -134,6 +147,8 @@ def classify_error(exc: BaseException) -> "str | None":
             return ERROR_CLASS_CORRUPTION
         if kind == "ShardDegraded":
             return ERROR_CLASS_DEGRADED
+        if kind == "Overloaded":
+            return ERROR_CLASS_OVERLOAD
         if kind in _CONNECTION_KINDS:
             return ERROR_CLASS_COORDINATOR_DEAD
         return ERROR_CLASS_OTHER
@@ -161,6 +176,9 @@ def is_retryable_class(error_class: "str | None") -> bool:
         # writable WAL (degraded): always worth the walk.
         ERROR_CLASS_CORRUPTION,
         ERROR_CLASS_DEGRADED,
+        # Shedding is transient by design: back off and retry (walk
+        # too — another replica may be below its limits).
+        ERROR_CLASS_OVERLOAD,
     )
 
 

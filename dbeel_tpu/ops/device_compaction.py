@@ -67,6 +67,7 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
                 keep_tombstones,
                 bloom_min_size,
                 throttle=self.throttle,
+                tombstone_drop_before=self.tombstone_drop_before,
             )
             if result is not None:
                 return result
@@ -99,7 +100,13 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
         perm, keep = self._refine(cols, perm)
         self._tick()
         if not keep_tombstones:
-            keep = keep & ~cols.is_tombstone[perm]
+            from ..storage.compaction import drop_tombstones_mask
+
+            keep = keep & ~drop_tombstones_mask(
+                cols.is_tombstone[perm],
+                cols.timestamp[perm],
+                self.tombstone_drop_before,
+            )
         return write_output_columnar(
             cols, perm[keep], dir_path, output_index, cache,
             bloom_min_size, throttle=self.throttle,

@@ -327,6 +327,7 @@ def pipeline_merge(
     bloom_min_size: int,
     mesh=None,
     throttle=None,
+    tombstone_drop_before: "int | None" = None,
 ) -> Optional[MergeResult]:
     """Run the partitioned pipeline.  Returns None when unavailable
     (no native lib / no jax / pathological prefix skew) — the caller
@@ -360,6 +361,7 @@ def pipeline_merge(
                     bloom_min_size,
                     mesh,
                     throttle,
+                    tombstone_drop_before,
                 )
     return _pipeline_merge_impl(
         sources,
@@ -369,6 +371,7 @@ def pipeline_merge(
         bloom_min_size,
         mesh,
         throttle,
+        tombstone_drop_before,
     )
 
 
@@ -469,6 +472,31 @@ def _gather_tie_arrays(runs, run_base, off_cat, ks_cat, sel, lpad):
     return kwords, ~ts, ~ri.astype(np.uint32)
 
 
+def _gather_timestamps(runs, run_base, off_cat, sel):
+    """Per-record int64-ns timestamps (as u64 bit views) for the
+    GLOBAL indices ``sel`` — gathered lazily, because the pipeline
+    never materializes a full timestamp column; only gc_grace needs
+    them, and only for drop-candidate tombstones (a small fraction)."""
+    ri = (
+        np.searchsorted(run_base, sel, side="right") - 1
+    ).astype(np.int64)
+    off = off_cat[sel]
+    ts = np.zeros(sel.size, dtype=np.uint64)
+    w8 = np.uint64(1) << (
+        np.arange(8, dtype=np.uint64) * np.uint64(8)
+    )
+    for r in np.unique(ri):
+        msk = ri == r
+        tpos = (off[msk] + np.uint64(8))[:, None] + np.arange(
+            8, dtype=np.uint64
+        )
+        ts[msk] = (
+            runs[r].data[tpos.astype(np.int64)].astype(np.uint64)
+            @ w8
+        )
+    return ts
+
+
 def _pipeline_merge_impl(
     sources: Sequence,
     dir_path: str,
@@ -477,6 +505,7 @@ def _pipeline_merge_impl(
     bloom_min_size: int,
     mesh=None,
     throttle=None,
+    tombstone_drop_before: "int | None" = None,
 ) -> Optional[MergeResult]:
     from ..storage import native as native_mod
 
@@ -949,7 +978,23 @@ def _pipeline_merge_impl(
                     keep[positions[bm]] = ~dup
 
             if not keep_tombstones:
-                keep &= ~tomb_cat[gidx]
+                drop = tomb_cat[gidx]
+                if tombstone_drop_before and drop.any():
+                    # gc_grace: tombstones younger than the cutoff
+                    # survive the drop.  Timestamps are gathered only
+                    # for the drop candidates.
+                    drop = drop.copy()
+                    cand = np.flatnonzero(drop)
+                    cand_ts = _gather_timestamps(
+                        runs, run_base, off_cat, gidx[cand]
+                    )
+                    drop[
+                        cand[
+                            cand_ts
+                            >= np.uint64(tombstone_drop_before)
+                        ]
+                    ] = False
+                keep &= ~drop
             if not keep.all():
                 sel = gidx[keep]
                 src_run = np.ascontiguousarray(rids32[keep])

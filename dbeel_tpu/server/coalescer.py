@@ -143,6 +143,10 @@ class CoalescedDeviceMergeStrategy:
     # Intra-merge latency-class hook (see CompactionStrategy.throttle;
     # this class is duck-typed, not a subclass, so it needs its own).
     throttle = None
+    # GC-grace cutoff (see CompactionStrategy.tombstone_drop_before) —
+    # same duck-typing story: LSMTree.compact() stamps it, but a
+    # directly-constructed strategy must default to "keep tombstones".
+    tombstone_drop_before = None
 
     def __init__(
         self, coalescer: Optional[CompactionCoalescer] = None
@@ -155,6 +159,7 @@ class CoalescedDeviceMergeStrategy:
 
         s = DeviceMergeStrategy()
         s.throttle = self.throttle
+        s.tombstone_drop_before = self.tombstone_drop_before
         return s.merge(*args, **kwargs)
 
     async def merge_async(
@@ -187,6 +192,7 @@ class CoalescedDeviceMergeStrategy:
                     keep_tombstones,
                     bloom_min_size,
                     throttle=self.throttle,
+                    tombstone_drop_before=self.tombstone_drop_before,
                 ),
             )
             if result is not None:
@@ -210,11 +216,17 @@ class CoalescedDeviceMergeStrategy:
             perm = columnar.fixup_long_key_ties(cols, perm)
 
         def finish():
+            from ..storage.compaction import drop_tombstones_mask
+
             p, keep = columnar.fixup_and_dedup_prefix(
                 cols, perm, words=2
             )
             if not keep_tombstones:
-                keep = keep & ~cols.is_tombstone[p]
+                keep = keep & ~drop_tombstones_mask(
+                    cols.is_tombstone[p],
+                    cols.timestamp[p],
+                    self.tombstone_drop_before,
+                )
             order = p[keep]
             return write_output_columnar(
                 cols, order, dir_path, output_index, cache,

@@ -27,6 +27,7 @@ from ..errors import (
     KeyNotFound,
     KeyNotOwnedByShard,
     MissingField,
+    Overloaded,
     PeerDead,
     Timeout,
     UnsupportedField,
@@ -62,7 +63,11 @@ def _quorum_error(my_shard: MyShard, op: str, op_status: dict):
     """Error for a quorum deadline expiry: ``PeerDead`` when a
     fan-out target was marked Dead during (or just before) the wait —
     the op stalled on a dead replica, distinct from a merely slow
-    quorum — else plain ``Timeout``."""
+    quorum; ``Overloaded`` when a replica SHED the request (its
+    governor past the hard limit / propagated deadline expired / our
+    capped outbound queue refused it) — the client should back off,
+    not immediately hammer the next replica; else plain
+    ``Timeout``."""
     targets = op_status.get("targets", ())
     if op_status.get("peer_dead") or any(
         t in my_shard.dead_nodes for t in targets
@@ -70,7 +75,69 @@ def _quorum_error(my_shard: MyShard, op: str, op_status: dict):
         return PeerDead(
             f"{op}: replica marked Dead during quorum wait"
         )
+    if op_status.get("peer_overloaded"):
+        return Overloaded(
+            f"{op}: replica shed the request during quorum wait"
+        )
     return Timeout(op)
+
+
+def _wall_deadline_ms(request: dict, timeout_ms: int) -> int:
+    """Absolute wall-clock deadline (ms) to propagate on peer frames:
+    the client's own budget when it sent one (deadline_ms), else this
+    op's timeout from receipt.  Wall clock like the LWW timestamps —
+    replicas on loosely-synced clocks share the caveat the data model
+    already accepts."""
+    deadline_ms = request.get("deadline_ms")
+    if isinstance(deadline_ms, int) and deadline_ms > 0:
+        return deadline_ms
+    return int(time.time() * 1000) + timeout_ms
+
+
+# Ops the governor may shed at the hard limit.  Admin/observability
+# (get_stats, metadata, rearm, collection DDL) always serve: an
+# operator must be able to see into — and command — an overloaded
+# node, and DDL is rare enough to never be the overload source.
+_SHEDDABLE_OPS = frozenset(
+    {"set", "get", "delete", "multi_set", "multi_get"}
+)
+
+
+def _note_completion(
+    my_shard: MyShard,
+    op: str,
+    started: float,
+    timeout_ms: Optional[int],
+    deadline_ms: Optional[int],
+) -> None:
+    """Feed the governor's dead-completion signal: a data op that
+    finished after the budget its client gave it (propagated
+    deadline, or its own timeout field) produced a response nobody
+    was waiting for."""
+    if op not in _SHEDDABLE_OPS:
+        return
+    if isinstance(deadline_ms, int) and deadline_ms > 0:
+        dead = time.time() * 1000.0 > deadline_ms
+    else:
+        elapsed_ms = (time.monotonic() - started) * 1000.0
+        dead = elapsed_ms > float(
+            timeout_ms or DEFAULT_SET_TIMEOUT_MS
+        )
+    my_shard.governor.note_completion(dead)
+
+
+def _deadline_dead_on_arrival(my_shard: MyShard, request: dict) -> bool:
+    """Client-supplied absolute deadline already expired at dispatch
+    (the frame sat in a backlogged queue longer than the client was
+    willing to wait): drop the work instead of computing a dead
+    response."""
+    deadline_ms = request.get("deadline_ms")
+    if not isinstance(deadline_ms, int) or deadline_ms <= 0:
+        return False
+    if time.time() * 1000.0 <= deadline_ms:
+        return False
+    my_shard.governor.deadline_drops += 1
+    return True
 
 
 def _extract(map_: dict, field: str):
@@ -103,6 +170,16 @@ async def handle_request(
     """Returns the response payload (None => plain 'OK')."""
     timestamp = now_nanos()
     rtype = request.get("type")
+
+    if rtype in _SHEDDABLE_OPS and _deadline_dead_on_arrival(
+        my_shard, request
+    ):
+        # Deadline propagation, coordinator side: the client's budget
+        # expired while this frame waited its turn — every cycle
+        # spent on it now (local read, quorum fan-out) would compute
+        # a response nobody reads.  Retryable by taxonomy; the client
+        # has long since walked on.
+        raise Overloaded("client deadline expired before dispatch")
 
     if rtype == "get_cluster_metadata":
         return msgpack.packb(
@@ -188,10 +265,17 @@ async def handle_request(
             await col.tree.set_with_timestamp(key, value, timestamp)
 
         if rf > 1:
+            peer_deadline = _wall_deadline_ms(request, timeout_ms)
             remote_request = (
-                ShardRequest.set(collection_name, key, value, timestamp)
+                ShardRequest.set(
+                    collection_name, key, value, timestamp,
+                    deadline_ms=peer_deadline,
+                )
                 if rtype == "set"
-                else ShardRequest.delete(collection_name, key, timestamp)
+                else ShardRequest.delete(
+                    collection_name, key, timestamp,
+                    deadline_ms=peer_deadline,
+                )
             )
             expected = (
                 ShardResponse.SET
@@ -274,6 +358,9 @@ async def handle_request(
                         deadline - asyncio.get_event_loop().time(),
                     ),
                     op_status=op_status,
+                    deadline_ms=_wall_deadline_ms(
+                        request, timeout_ms
+                    ),
                 ):
                     if (
                         local_value is None
@@ -295,7 +382,13 @@ async def handle_request(
                         raise KeyNotFound(repr(key))
                     return bytes(local_value[0])
             remote = my_shard.send_request_to_replicas(
-                ShardRequest.get(collection_name, key),
+                ShardRequest.get(
+                    collection_name,
+                    key,
+                    deadline_ms=_wall_deadline_ms(
+                        request, timeout_ms
+                    ),
+                ),
                 consistency - 1,
                 rf - replica_index - 1,
                 ShardResponse.GET,
@@ -484,6 +577,7 @@ async def _multi_set_keyed(
                 ShardRequest.multi_set(
                     collection_name,
                     [[k, v, ts] for k, v, ts in entries],
+                    deadline_ms=int(time.time() * 1000) + timeout_ms,
                 ),
                 consistency - 1,
                 rf - replica_index - 1,
@@ -533,7 +627,11 @@ async def _multi_get_keyed(
             # per-key byte-compare trick and does not compose with
             # one-frame-per-peer batching (ARCHITECTURE.md).
             remote = my_shard.send_request_to_replicas(
-                ShardRequest.multi_get(collection_name, keys),
+                ShardRequest.multi_get(
+                    collection_name,
+                    keys,
+                    deadline_ms=int(time.time() * 1000) + timeout_ms,
+                ),
                 consistency - 1,
                 number_of_nodes,
                 ShardResponse.MULTI_GET,
@@ -616,6 +714,7 @@ async def _digest_quorum_round(
     number_of_nodes: int,
     timeout_s: float,
     op_status: Optional[dict] = None,
+    deadline_ms: Optional[int] = None,
 ):
     """Digest-read round for an RF>1 get (beyond the reference, which
     ships RF full entries — db_server.rs:318-370): replicas answer
@@ -632,7 +731,9 @@ async def _digest_quorum_round(
     full-entry round, which merges by max timestamp and read-repairs
     as before.  Raises Timeout like the full round would."""
     digest = pack_message(
-        ShardRequest.get_digest(collection_name, key)
+        ShardRequest.get_digest(
+            collection_name, key, deadline_ms=deadline_ms
+        )
     )
     framed = struct.pack("<I", len(digest)) + digest
     expected = pack_message(ShardResponse.get_digest(local_value))
@@ -890,6 +991,7 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
         my_shard.metrics.record_error(classify_error(e))
         buf = _error_response(e)
     my_shard.metrics.record_request(op, started)
+    _note_completion(my_shard, op, started, timeout_ms, None)
     return buf, keepalive
 
 
@@ -993,6 +1095,14 @@ async def _serve_frame(
         my_shard.metrics.record_error(classify_error(e))
         buf = _error_response(e)
     my_shard.metrics.record_request(op, started)
+    if isinstance(req, dict):
+        _note_completion(
+            my_shard,
+            op,
+            started,
+            req.get("timeout"),
+            req.get("deadline_ms"),
+        )
     return buf, keepalive
 
 
@@ -1024,24 +1134,31 @@ class _DbProtocol(framed.FramedServerProtocol):
     backpressure live in FramedServerProtocol, shared with the peer
     plane.
 
-    Pipelined execution (ISSUE 2): up to PIPELINE_WINDOW queued
-    frames run CONCURRENTLY per connection — a head-of-line quorum
-    fan-out or parked WAL ack no longer serializes the frames behind
-    it — while responses are RELEASED strictly in arrival order
-    through the parked queue (the same mechanism that already ordered
-    wal-sync deferred acks), so the wire contract is unchanged: the
-    N-th response always answers the N-th request.  Native-fast
-    frames found behind a slow frame are answered synchronously at
-    dispatch and take an in-order parked slot instead of waiting for
-    the slow task."""
+    Pipelined execution (ISSUE 2): up to ``window`` queued frames run
+    CONCURRENTLY per connection — a head-of-line quorum fan-out or
+    parked WAL ack no longer serializes the frames behind it — while
+    responses are RELEASED strictly in arrival order through the
+    parked queue (the same mechanism that already ordered wal-sync
+    deferred acks), so the wire contract is unchanged: the N-th
+    response always answers the N-th request.  Native-fast frames
+    found behind a slow frame are answered synchronously at dispatch
+    and take an in-order parked slot instead of waiting for the slow
+    task.
+
+    Overload control (ISSUE 5): the fixed 32-frame window became a
+    per-connection AIMD window driven by the shard's load governor —
+    multiplicative decrease toward --overload-window-min while the
+    backlog signals read soft-overloaded (at most one halving per
+    window of completions), additive recovery to
+    --pipeline-window-max once they clear.  Past the governor's HARD
+    limit, new data ops are shed at dispatch with the retryable
+    ``Overloaded`` error (admin/observability frames always serve);
+    frames whose client-supplied deadline already expired in the
+    queue are dropped the same way instead of computing dead
+    responses."""
 
     HEADER = 2
     MAX_FRAME = None  # u16 length is its own bound
-
-    # Concurrent frames in flight per connection.  Beyond this the
-    # drain stops popping, `pending` grows, and the PENDING_HIGH
-    # read-pause applies the usual backpressure.
-    PIPELINE_WINDOW = 32
     # Consecutive queued RF=1 gets coalesce into ONE internal
     # multi_get task (shared memtable/sstable probe setup) — the
     # drain-level mirror of the client's multi_get frames.
@@ -1062,6 +1179,10 @@ class _DbProtocol(framed.FramedServerProtocol):
         self._slot_free: "asyncio.Event | None" = None
         self._get_batch: list = []  # (park entry, request map, t0)
         self._get_batch_col: Optional[str] = None
+        # AIMD pipeline window (overload plane): starts at the max —
+        # an idle shard gives new connections full pipelining; the
+        # governor shrinks it the moment backlog builds.
+        self.window = float(my_shard.config.pipeline_window_max)
 
     def _registry(self) -> set:
         return self.shard.db_connections
@@ -1091,6 +1212,12 @@ class _DbProtocol(framed.FramedServerProtocol):
         # task hop, no interpreter dispatch.  Only consulted by
         # data_received when nothing is queued or in flight, so the
         # direct transport.write cannot overtake a parked response.
+        if self.shard.governor.should_shed():
+            # Hard overload: the native plane must not keep feeding
+            # the backlogged memtable/WAL behind the governor's back —
+            # queue the frame so _dispatch parses it and sheds data
+            # ops (admin frames still serve there).
+            return framed.FAST_MISS
         dp = self.shard.dataplane
         if dp is None:
             return framed.FAST_MISS
@@ -1136,16 +1263,37 @@ class _DbProtocol(framed.FramedServerProtocol):
     async def _drain(self) -> None:
         try:
             while self.pending and not self.closing:
-                if len(self.inflight) >= self.PIPELINE_WINDOW:
+                if len(self.inflight) >= max(
+                    1, int(self.window)
+                ) and not self.shard.governor.should_shed():
                     # Window full: stop popping (pending grows and
                     # the PENDING_HIGH read-pause backpressures the
                     # socket) until a task completes.  Don't sit on
-                    # coalesced gets while waiting.
+                    # coalesced gets while waiting.  Under HARD
+                    # overload the wait is skipped: queued data ops
+                    # must shed NOW with a cheap retryable error, not
+                    # rot behind a full window until the client's
+                    # timeout turns them into opaque Timeouts.
                     self._flush_get_batch()
                     if self._slot_free is None:
                         self._slot_free = asyncio.Event()
                     self._slot_free.clear()
-                    await self._slot_free.wait()
+                    try:
+                        # Bounded wait: a completion wakes us
+                        # instantly; the timeout re-samples the
+                        # governor so a backlog crossing the HARD
+                        # limit starts shedding the queue even while
+                        # every window slot is stuck on slow work.
+                        # Keep the poll SHORT even at LEVEL_OK: the
+                        # wal-sync plane parks acks behind fdatasync
+                        # tickets, and a full window must re-check
+                        # promptly or durable-ack pipelines stall a
+                        # poll period per refill.
+                        await asyncio.wait_for(
+                            self._slot_free.wait(), 0.05
+                        )
+                    except asyncio.TimeoutError:
+                        pass
                     continue
                 frame = self.pending.popleft()
                 if (
@@ -1181,7 +1329,9 @@ class _DbProtocol(framed.FramedServerProtocol):
         multi_get task; everything else reserves its slot and runs as
         a windowed concurrent task.  Returns False to stop draining
         this connection."""
-        dp = self.shard.dataplane
+        gov = self.shard.governor
+        shedding = gov.should_shed()
+        dp = None if shedding else self.shard.dataplane
         if (
             dp is not None
             and self.writable.is_set()
@@ -1229,6 +1379,33 @@ class _DbProtocol(framed.FramedServerProtocol):
             keepalive = isinstance(req, dict) and bool(
                 req.get("keepalive")
             )
+            if (
+                shedding
+                and isinstance(req, dict)
+                and req.get("type") in _SHEDDABLE_OPS
+            ):
+                # Hard-limit admission: answer a cheap retryable
+                # error NOW instead of adding this op to the backlog
+                # that made the shard overloaded.  The error frame
+                # takes an in-order parked slot like any response;
+                # non-keepalive semantics are preserved.
+                op = str(req.get("type"))
+                gov.record_shed(op)
+                err = Overloaded(
+                    f"shard {self.shard.shard_name} shedding load"
+                )
+                self.shard.metrics.record_error(classify_error(err))
+                self.park_response(
+                    _frame_response(_error_response(err)),
+                    keepalive,
+                    op,
+                    time.monotonic(),
+                    done=True,
+                )
+                if not keepalive:
+                    self.closing = True
+                    return False
+                return True
             if (
                 keepalive
                 and isinstance(req, dict)
@@ -1369,6 +1546,14 @@ class _DbProtocol(framed.FramedServerProtocol):
 
     def _pipelined_done(self, task) -> None:
         self.inflight.discard(task)
+        # One completed pipelined unit = one AIMD sample: shrink
+        # while the governor reads backlog, recover toward the max
+        # once it clears.
+        cfg = self.shard.config
+        self.aimd_tick(
+            float(max(1, cfg.overload_window_min)),
+            float(cfg.pipeline_window_max),
+        )
         if self._slot_free is not None:
             self._slot_free.set()
 

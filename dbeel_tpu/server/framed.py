@@ -62,6 +62,8 @@ class FramedServerProtocol(asyncio.Protocol):
         "_wbuf",
         "_wclose",
         "_wflush_scheduled",
+        "window",
+        "_aimd_cooldown",
     )
 
     def __init__(self, my_shard) -> None:
@@ -92,6 +94,45 @@ class FramedServerProtocol(asyncio.Protocol):
         self._wbuf: list = []
         self._wclose = False
         self._wflush_scheduled = False
+        # AIMD per-connection window (overload plane, PR 5): the
+        # public plane caps concurrent pipelined frames with it, the
+        # peer plane derives its read-pause watermark from it.  None =
+        # static behavior (the subclass never initialized it).
+        self.window: "float | None" = None
+        self._aimd_cooldown = 0
+
+    # -- AIMD window (overload plane) -------------------------------
+
+    def aimd_tick(self, wmin: float, wmax: float) -> None:
+        """One completed unit of work: multiplicative decrease while
+        the shard's governor reports backlog (at most once per
+        window's worth of completions — one halving per 'round trip',
+        the classic AIMD guard), additive increase back toward wmax
+        while it doesn't.  Drives queueing back into clients when the
+        shard is the bottleneck and recovers to full pipelining the
+        moment the backlog drains."""
+        if self.window is None:
+            return
+        if self._aimd_cooldown > 0:
+            self._aimd_cooldown -= 1
+        gov = getattr(self.shard, "governor", None)
+        if gov is None:
+            return
+        if gov.soft_overloaded():
+            if self._aimd_cooldown == 0:
+                self.window = max(wmin, self.window / 2.0)
+                self._aimd_cooldown = max(1, int(self.window))
+                gov.note_window(self.window, True)
+        elif self.window < wmax:
+            self.window = min(
+                wmax, self.window + 1.0 / max(1.0, self.window)
+            )
+
+    def _pending_high(self) -> int:
+        """Read-pause watermark; subclasses may derive it from the
+        AIMD window so a backlogged shard pushes bytes back into the
+        kernel/client instead of buffering frames."""
+        return self.PENDING_HIGH
 
     # -- lifecycle --------------------------------------------------
 
@@ -299,7 +340,7 @@ class FramedServerProtocol(asyncio.Protocol):
             self.pending.append(frame)
             parsed = True
         if (
-            len(self.pending) > self.PENDING_HIGH
+            len(self.pending) > self._pending_high()
             and not self.paused_reading
         ):
             self.paused_reading = True

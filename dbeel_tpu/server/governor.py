@@ -1,0 +1,323 @@
+"""Per-shard load governor — the overload-control brain (PR 5).
+
+The serving plane survives dead peers, corrupt disks and partitions,
+but nothing protected it from *too much traffic*: past the fixed
+per-connection window, queues grew without bound, a flush/compaction
+backlog silently inflated tail latency until the WAL or memtable path
+fell over, and a slow replica could absorb a coordinator's memory.
+The compaction design-space literature (PAPERS.md: "Constructing and
+Analyzing the LSM Compaction Design Space"; RESYSTANCE) is blunt about
+the fix: backlog-aware admission and write throttling are what keep an
+LSM store stable under sustained load.
+
+This governor samples the shard's backlog signals and folds them into
+one of three levels:
+
+  * ``LEVEL_OK`` (0)   — nothing to do.
+  * ``LEVEL_SOFT`` (1) — backlog building: LOW-PRIORITY work yields
+    first.  Background units (anti-entropy, scrub, hint drain,
+    migration — everything already under ``scheduler.bg_slice``) are
+    delayed before they start, and every connection's AIMD window
+    shrinks multiplicatively, pushing queueing back into the clients.
+  * ``LEVEL_HARD`` (2) — backlog past the point where admitting more
+    work only converts latency into collapse: NEW data ops are shed
+    with the retryable ``Overloaded`` error (cheap to produce, honest
+    to the client, and the client's backoff walk spreads the retry),
+    while admin/observability ops (``get_stats``, metadata, rearm)
+    keep serving so an operator can always see in.
+
+Signals (sampled at most once per SAMPLE_S — the serving path pays a
+cached integer compare):
+
+  * admitted work: queued + in-flight + sync-parked ops across every
+    client connection (the parked count IS the WAL-sync backlog at
+    the serving layer: acks waiting on fdatasync);
+  * memtable fill: entries and appends-since-swap against capacity on
+    the busiest collection (appends >> capacity means flushes cannot
+    keep up — the WAL grows without bound);
+  * flush/compaction debt: sstable count beyond
+    ``overload_compaction_debt`` on any collection.  An unfinished
+    flush swap is reported (``flush_backlog``) for observability but
+    is not itself a level trigger: a wedged flush blocks the next
+    swap, so the memtable fill/appends signals above cross their own
+    thresholds within one memtable's worth of traffic — and with no
+    traffic there is nothing to govern;
+  * event-loop lag: EWMA overshoot of a 50ms heartbeat sleep.  The
+    native data plane answers RF=1 ops synchronously inside
+    data_received — overload there never shows up in any tracked
+    queue; it shows up as the loop's callback queue stretching, which
+    is exactly what the heartbeat measures.  (Found by the
+    --overload-knee bench: without this signal, 3x offered load
+    collapsed goodput 5x through pure queueing with every queue
+    signal reading zero.)
+
+Shedding never applies to the PEER plane (replica work keeps quorums
+alive; its protection is deadline drops + the per-peer outbound caps
+in remote_comm), and never to reads of the governor's own state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+LEVEL_OK = 0
+LEVEL_SOFT = 1
+LEVEL_HARD = 2
+
+# Memtable-fill thresholds (fractions of tree capacity).  Soft at 85%
+# of either signal; hard only when appends since the last swap exceed
+# TWICE capacity — the flush trigger fires at 1x, so 2x means the
+# flush path is genuinely behind, not merely scheduled.
+MEMTABLE_SOFT_FILL = 0.85
+MEMTABLE_HARD_APPENDS = 2.0
+
+# Background work delayed at soft overload waits in these slices, up
+# to the cap — maintenance yields to serving but is never starved
+# outright (anti-entropy owns correctness tails).
+BG_DELAY_SLICE_S = 0.05
+BG_DELAY_MAX_S = 5.0
+
+# Event-loop lag heartbeat: sleep HB_S, measure the overshoot, EWMA
+# it.  Lag thresholds are intentionally far above a healthy loop's
+# jitter (this class of host shows tens of ms under legitimate full
+# load) — soft/hard fire only when the callback queue is genuinely
+# stretching into client-visible latency.
+HB_S = 0.05
+LAG_EWMA_ALPHA = 0.3
+LAG_SOFT_S = 0.10
+LAG_HARD_S = 0.40
+
+# Dead-completion fraction: the EWMA share of served data ops that
+# finished AFTER the budget their client gave them (the propagated
+# deadline_ms, or the op's own timeout field) — i.e. responses nobody
+# was still waiting for.  This is the signal that fires when overload
+# lives in WALL TIME rather than any queue: a saturated quorum path
+# (CPU contention, fdatasync storms, slow replicas) stretches every
+# op past its deadline while pending/inflight counts stay small
+# because clients give up and retry.  Sustained dead work means new
+# admissions are hopeless too — shed them instead.  The EWMA needs
+# ~log(0.5)/log(1-alpha) ≈ 7 consecutive dead completions to cross
+# the hard bar, so one pathological op (a 15s blackhole timeout)
+# cannot flip the shard.
+DEAD_EWMA_ALPHA = 0.1
+DEAD_FRAC_SOFT = 0.25
+DEAD_FRAC_HARD = 0.5
+
+
+class LoadGovernor:
+    SAMPLE_S = 0.02  # signal cache lifetime
+
+    __slots__ = (
+        "shard",
+        "config",
+        "_level",
+        "_sampled_at",
+        "_signals",
+        "_forced",
+        "_lag_ewma",
+        "_hb_task",
+        "_dead_ewma",
+        "dead_completions",
+        # counters (get_stats.overload)
+        "shed_ops",
+        "shed_by_op",
+        "deadline_drops",
+        "replica_deadline_drops",
+        "bg_delays",
+        "bg_delayed_s",
+        "soft_transitions",
+        "hard_transitions",
+        "window_decreases",
+        "window_min_seen",
+    )
+
+    def __init__(self, shard, config) -> None:
+        self.shard = shard
+        self.config = config
+        self._level = LEVEL_OK
+        self._sampled_at = 0.0
+        self._signals: dict = {}
+        # Test seam (the set_fault pattern): force a level regardless
+        # of the sampled signals; None disarms.
+        self._forced: Optional[int] = None
+        self._lag_ewma = 0.0
+        self._hb_task = None
+        self._dead_ewma = 0.0
+        self.dead_completions = 0
+        self.shed_ops = 0
+        self.shed_by_op: dict = {}
+        self.deadline_drops = 0
+        self.replica_deadline_drops = 0
+        self.bg_delays = 0
+        self.bg_delayed_s = 0.0
+        self.soft_transitions = 0
+        self.hard_transitions = 0
+        self.window_decreases = 0
+        self.window_min_seen = float(config.pipeline_window_max)
+
+    # -- test seam -----------------------------------------------------
+
+    def force_level(self, level: Optional[int]) -> None:
+        """Pin the governor to ``level`` (None disarms) — the
+        deterministic fault seam tests drive shedding/AIMD through
+        without constructing a real timing-dependent backlog."""
+        self._forced = level
+        self._sampled_at = 0.0  # next level() re-evaluates
+
+    def note_completion(self, dead: bool) -> None:
+        """One served data op finished; ``dead`` = after the budget
+        its client gave it (the response fed nobody).  Called from
+        the serving completion points — never from the shed path, so
+        shedding itself cannot mask the signal it reacts to."""
+        if dead:
+            self.dead_completions += 1
+        self._dead_ewma += DEAD_EWMA_ALPHA * (
+            (1.0 if dead else 0.0) - self._dead_ewma
+        )
+
+    # -- event-loop lag heartbeat --------------------------------------
+
+    def _ensure_heartbeat(self) -> None:
+        if self._hb_task is not None and not self._hb_task.done():
+            return
+        import asyncio
+
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (direct construction in tests)
+        self._hb_task = self.shard.spawn(self._heartbeat())
+
+    async def _heartbeat(self) -> None:
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(HB_S)
+            lag = max(0.0, loop.time() - t0 - HB_S)
+            e = self._lag_ewma
+            self._lag_ewma = (
+                lag if e == 0.0 else e + LAG_EWMA_ALPHA * (lag - e)
+            )
+
+    # -- sampling ------------------------------------------------------
+
+    def _sample(self) -> int:
+        shard = self.shard
+        cfg = self.config
+        ops = 0
+        for conn in shard.db_connections:
+            ops += len(conn.pending) + len(conn.parked)
+            ops += len(getattr(conn, "inflight", ()))
+        mem_fill = 0.0
+        appends_fill = 0.0
+        flush_backlog = False
+        debt = 0
+        for col in shard.collections.values():
+            tree = col.tree
+            cap = max(1, tree.capacity)
+            mem_fill = max(mem_fill, len(tree._active) / cap)
+            appends_fill = max(
+                appends_fill, tree._appends_since_swap / cap
+            )
+            if tree._pending_flush is not None:
+                flush_backlog = True
+            debt = max(debt, len(tree._sstables.tables))
+        lag = self._lag_ewma
+        dead = self._dead_ewma
+        self._signals = {
+            "ops": ops,
+            "memtable_fill": round(max(mem_fill, appends_fill), 3),
+            "flush_backlog": int(flush_backlog),
+            "sstable_debt": debt,
+            "loop_lag_ms": round(lag * 1000, 1),
+            "dead_completion_frac": round(dead, 3),
+        }
+        level = LEVEL_OK
+        if (cfg.overload_soft_ops and ops > cfg.overload_soft_ops) or (
+            max(mem_fill, appends_fill) > MEMTABLE_SOFT_FILL
+        ) or (
+            cfg.overload_compaction_debt
+            and debt > cfg.overload_compaction_debt
+        ) or lag > LAG_SOFT_S or dead > DEAD_FRAC_SOFT:
+            level = LEVEL_SOFT
+        if (cfg.overload_hard_ops and ops > cfg.overload_hard_ops) or (
+            appends_fill > MEMTABLE_HARD_APPENDS
+        ) or lag > LAG_HARD_S or dead > DEAD_FRAC_HARD:
+            level = LEVEL_HARD
+        return level
+
+    def level(self) -> int:
+        if self._forced is not None:
+            return self._forced
+        self._ensure_heartbeat()
+        now = time.monotonic()
+        if now - self._sampled_at >= self.SAMPLE_S:
+            self._sampled_at = now
+            prev = self._level
+            self._level = self._sample()
+            if self._level > prev:
+                if self._level >= LEVEL_HARD:
+                    self.hard_transitions += 1
+                else:
+                    self.soft_transitions += 1
+        return self._level
+
+    # -- decision points ----------------------------------------------
+
+    def should_shed(self) -> bool:
+        """Hard-limit admission check for NEW public data ops."""
+        return self.level() >= LEVEL_HARD
+
+    def soft_overloaded(self) -> bool:
+        return self.level() >= LEVEL_SOFT
+
+    def record_shed(self, op: str) -> None:
+        self.shed_ops += 1
+        self.shed_by_op[op] = self.shed_by_op.get(op, 0) + 1
+
+    def note_window(self, window: float, decreased: bool) -> None:
+        if decreased:
+            self.window_decreases += 1
+        if window < self.window_min_seen:
+            self.window_min_seen = window
+
+    async def bg_gate(self) -> None:
+        """Delay point for low-priority work under soft overload:
+        background units wait (bounded) for the backlog to ease
+        before starting — serving latency recovers first, maintenance
+        resumes the moment pressure lifts (and after BG_DELAY_MAX_S
+        regardless: anti-entropy/scrub must never starve outright)."""
+        import asyncio
+
+        if self.level() < LEVEL_SOFT:
+            return
+        self.bg_delays += 1
+        waited = 0.0
+        while waited < BG_DELAY_MAX_S and self.level() >= LEVEL_SOFT:
+            await asyncio.sleep(BG_DELAY_SLICE_S)
+            waited += BG_DELAY_SLICE_S
+        self.bg_delayed_s += waited
+
+    # -- observability -------------------------------------------------
+
+    def stats(self) -> dict:
+        level = self.level()
+        return {
+            "level": level,
+            "signals": dict(self._signals),
+            "shed_ops": self.shed_ops,
+            "shed_by_op": dict(self.shed_by_op),
+            "deadline_drops": self.deadline_drops,
+            "replica_deadline_drops": self.replica_deadline_drops,
+            "dead_completions": self.dead_completions,
+            "bg_delays": self.bg_delays,
+            "bg_delayed_s": round(self.bg_delayed_s, 3),
+            "soft_transitions": self.soft_transitions,
+            "hard_transitions": self.hard_transitions,
+            "window_decreases": self.window_decreases,
+            "window_min_seen": round(self.window_min_seen, 2),
+            "window_max": self.config.pipeline_window_max,
+        }

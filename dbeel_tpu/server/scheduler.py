@@ -57,6 +57,12 @@ class ShareScheduler:
             raise ValueError("task shares must be positive")
         self.fg_shares = fg_shares
         self.bg_shares = bg_shares
+        # Overload-control hook (PR 5): the shard's LoadGovernor
+        # installs its bg_gate here — past the soft limit, background
+        # units wait (bounded) BEFORE starting, so low-priority work
+        # is the first thing an overloaded shard delays.  None (tests,
+        # benches, unwired trees) is free.
+        self.overload_gate = None
         self._ratio = fg_shares / bg_shares
         self._last_fg = float("-inf")
         self._fg_gap_ewma = 0.0
@@ -109,6 +115,11 @@ class ShareScheduler:
         units on other trees can tick the same scheduler inside this
         window — the subtraction then errs toward less throttling,
         never more.)"""
+        gate = self.overload_gate
+        if gate is not None:
+            # Soft-overload delay BEFORE the unit runs: shedding
+            # order is background first, serving last.
+            await gate()
         t0 = time.monotonic()
         thr0 = self.bg_throttled_s
         pre0 = self.bg_precharged_s

@@ -30,6 +30,7 @@ from ..errors import (
     CollectionNotFound,
     ConnectionError_,
     DbeelError,
+    Overloaded,
     Timeout,
 )
 from ..flow_events import FlowEvent
@@ -182,6 +183,16 @@ class MyShard:
         from .metrics import ShardMetrics
 
         self.metrics = ShardMetrics()
+        # Overload-control plane (PR 5): one governor per shard folds
+        # the backlog signals (admitted work, memtable fill, flush/
+        # compaction debt) into an OK/soft/hard level.  Soft delays
+        # background units (installed as the scheduler's gate) and
+        # shrinks the AIMD connection windows; hard sheds new public
+        # data ops with the retryable Overloaded error.
+        from .governor import LoadGovernor
+
+        self.governor = LoadGovernor(self, config)
+        self.scheduler.overload_gate = self.governor.bg_gate
         # Anti-entropy transfer counters (observability + the
         # sub-range proportionality test: one diverged key must move
         # ~range/buckets entries, not the whole range).
@@ -518,6 +529,7 @@ class MyShard:
             bloom_min_size=self.config.sstable_bloom_min_size,
             strategy=strategy,
             memtable_kind=self.config.memtable_kind,
+            gc_grace_s=self.config.gc_grace_s(),
         )
         # Durability-plane escalation hooks: disk errors degrade the
         # whole shard; a corruption quarantine pulls the lost range
@@ -705,9 +717,27 @@ class MyShard:
             degraded_reason=self.degraded_reason,
         )
 
+        # Overload-control block (PR 5): governor level/signals, shed
+        # and deadline-drop counters, AIMD window shape, and the
+        # slow-peer outbound-queue sheds summed over ring peers.
+        overload = self.governor.stats()
+        overload["peer_queue_sheds"] = sum(
+            getattr(s.connection, "shed_count", 0)
+            for s in self.shards
+        )
+        windows = [
+            conn.window
+            for conn in self.db_connections
+            if getattr(conn, "window", None) is not None
+        ]
+        overload["window_cur"] = (
+            round(sum(windows) / len(windows), 2) if windows else None
+        )
+
         return {
             "shard": self.shard_name,
             "durability": durability,
+            "overload": overload,
             "nodes_known": len(self.nodes),
             "ring_size": len(self.shards),
             "dead_nodes": sorted(self.dead_nodes),
@@ -1283,10 +1313,19 @@ class MyShard:
                         "replica %s died mid-request: cancelled", name
                     )
                     self._record_hint(name, hint_request_fn())
-                except (Timeout, ConnectionError_) as e:
-                    # Unreachable replica: hand off later.
+                except (Timeout, ConnectionError_, Overloaded) as e:
+                    # Unreachable replica — or one that SHED the
+                    # request (its governor past the hard limit, its
+                    # deadline check found the work already dead, or
+                    # OUR capped outbound queue to it refused the
+                    # send): either way the mutation did not land
+                    # there, so it hands off to the hint path and the
+                    # drain/anti-entropy converge it later.
                     if op_status is not None:
-                        op_status["peer_unreachable"] = True
+                        if isinstance(e, Overloaded):
+                            op_status["peer_overloaded"] = True
+                        else:
+                            op_status["peer_unreachable"] = True
                     log.error("unreachable replica: %s", e)
                     self._record_hint(name, hint_request_fn())
                 except DbeelError as e:
@@ -1387,8 +1426,49 @@ class MyShard:
             await self.apply_if_newer(col.tree, key, value, ts)
         self.flow.notify(FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE)
 
+    # Position of the OPTIONAL trailing wall-clock deadline (ms) a
+    # coordinator appends to data-op peer frames (deadline
+    # propagation, PR 5).  Old-dialect frames simply lack the element.
+    _PEER_DEADLINE_INDEX = {
+        ShardRequest.SET: 6,
+        ShardRequest.DELETE: 5,
+        ShardRequest.GET: 4,
+        ShardRequest.GET_DIGEST: 4,
+        ShardRequest.MULTI_SET: 4,
+        ShardRequest.MULTI_GET: 4,
+    }
+
+    def _peer_deadline_expired(self, request: list) -> bool:
+        """True when the frame carries a propagated deadline that has
+        already passed: the coordinator's client gave up — computing
+        the response would burn replica CPU on a dead answer.  Wall
+        clock, like the LWW timestamps (same loose-sync caveat)."""
+        idx = self._PEER_DEADLINE_INDEX.get(request[1])
+        if idx is None or len(request) <= idx:
+            return False
+        deadline_ms = request[idx]
+        if not isinstance(deadline_ms, int) or deadline_ms <= 0:
+            return False
+        import time as _time
+
+        if _time.time() * 1000.0 <= deadline_ms:
+            return False
+        self.governor.replica_deadline_drops += 1
+        return True
+
     async def handle_shard_request(self, request: list) -> list:
         kind = request[1]
+        if kind in self._PEER_DEADLINE_INDEX and (
+            self._peer_deadline_expired(request)
+        ):
+            # Deadline propagation: drop dead work instead of
+            # computing it.  The error is retryable; for mutations the
+            # coordinator's fan-out records a hint, so convergence
+            # still owns the write (settle() treats Overloaded like an
+            # unreachable replica).
+            raise Overloaded(
+                "deadline expired before the replica served it"
+            )
         if kind == ShardRequest.PING:
             return ShardResponse.pong()
         if kind == ShardRequest.REARM:

@@ -77,12 +77,29 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
     order through the unchanged handle_shard_message path.  Wire
     format and error behavior identical to the stream version
     (remote_shard_server.rs:23-49 parity: persistent multi-message
-    connections)."""
+    connections).
+
+    Overload plane (ISSUE 5): the peer plane never SHEDS (replica
+    work is what keeps quorums alive; its admission happened at the
+    coordinator), but its read-pause watermark rides the same AIMD
+    window the public plane uses — while this shard's governor reads
+    backlog, frames pause earlier, pushing bytes back into the
+    coordinator's capped outbound queue instead of buffering them
+    here.  Expired-deadline peer frames are dropped by
+    handle_shard_request (deadline propagation)."""
 
     HEADER = 4
     MAX_FRAME = MAX_MESSAGE
+    WINDOW_MIN = 8.0
 
     __slots__ = ()
+
+    def __init__(self, my_shard) -> None:
+        super().__init__(my_shard)
+        self.window = float(self.PENDING_HIGH)
+
+    def _pending_high(self) -> int:
+        return max(int(self.WINDOW_MIN), int(self.window))
 
     def _registry(self) -> set:
         # Tracked for shutdown: py3.12 Server.wait_closed() waits on
@@ -199,6 +216,7 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             self._write_out(
                 len(payload).to_bytes(4, "little") + payload
             )
+        self.aimd_tick(self.WINDOW_MIN, float(self.PENDING_HIGH))
         return True
 
 

@@ -61,6 +61,16 @@ class CompactionStrategy(ABC):
     # chunks; None (the default, e.g. in tests and bench) is free.
     throttle = None
 
+    # Tombstone GC grace (gc_grace, the delete-resurrection hazard):
+    # when a merge is asked to DROP tombstones, any tombstone whose
+    # timestamp is >= this nanosecond cutoff is kept anyway — it is
+    # younger than the window a delete needs to out-live its laggard
+    # replicas (hint replay / anti-entropy could otherwise resurrect
+    # the old value after the tombstone was GC'd).  None/0 = drop all
+    # (reference behavior; tests/benches constructing strategies
+    # directly are unchanged).  Set per merge by LSMTree.compact.
+    tombstone_drop_before = None
+
     def _tick(self) -> None:
         t = self.throttle
         if t is not None:
@@ -127,7 +137,12 @@ class HeapMergeStrategy(CompactionStrategy):
                 continue  # dedup: first occurrence was the newest
             last_key = key
             if value == b"" and not keep_tombstones:
-                continue
+                cutoff = self.tombstone_drop_before
+                if not cutoff or (~_nts) < cutoff:
+                    continue
+                # gc_grace: the tombstone is younger than the grace
+                # window — keep it so a laggard replica cannot
+                # resurrect the deleted value.
             writer.write(key, value, ~_nts)
             keys.append(key)
         data_size = writer.close()
@@ -178,12 +193,30 @@ class ColumnarMergeStrategy(CompactionStrategy):
         perm, keep = self.sort_and_dedup(cols)
         self._tick()
         if not keep_tombstones:
-            keep = keep & ~cols.is_tombstone[perm]
+            keep = keep & ~drop_tombstones_mask(
+                cols.is_tombstone[perm],
+                cols.timestamp[perm],
+                self.tombstone_drop_before,
+            )
         order = perm[keep]
         return write_output_columnar(
             cols, order, dir_path, output_index, cache, bloom_min_size,
             throttle=self.throttle,
         )
+
+
+def drop_tombstones_mask(
+    is_tombstone: np.ndarray,
+    timestamps: np.ndarray,
+    cutoff: "int | None",
+) -> np.ndarray:
+    """Vectorized tombstone-drop mask honoring the gc_grace cutoff:
+    True where the record is a tombstone OLD enough to GC.  Shared by
+    every columnar-shaped merge path so the grace semantics can never
+    diverge between backends."""
+    if not cutoff:
+        return is_tombstone
+    return is_tombstone & (timestamps < np.uint64(max(0, cutoff)))
 
 
 def write_output_columnar(

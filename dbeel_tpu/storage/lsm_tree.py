@@ -130,6 +130,7 @@ class LSMTree:
         bloom_min_size: int = DEFAULT_BLOOM_MIN_SIZE,
         strategy: Optional[CompactionStrategy] = None,
         memtable_kind: str = "sorted",
+        gc_grace_s: float = 0.0,
     ) -> None:
         self.dir_path = dir_path
         self.cache = cache
@@ -137,6 +138,13 @@ class LSMTree:
         self.wal_sync = wal_sync
         self.wal_sync_delay_us = wal_sync_delay_us
         self.bloom_min_size = bloom_min_size
+        # Tombstone GC grace (delete-resurrection hazard): a
+        # drop-tombstones compaction keeps any tombstone younger than
+        # this window, so a replica that missed the delete (down past
+        # its hints, anti-entropy not yet run) cannot resurrect the
+        # old value after the tombstone would have been GC'd.  0 =
+        # reference behavior (drop all at the bottom level).
+        self.gc_grace_s = gc_grace_s
         self.strategy = strategy or HeapMergeStrategy()
         # "sorted" = SortedDict kept ordered per insert (reference's
         # rbtree contract); "hash" = O(1) dict, ordered once at flush by
@@ -1159,6 +1167,14 @@ class LSMTree:
                 # A fresh merge must not inherit debt accumulated since
                 # the previous merge's last tick.
                 throttle.reset()
+            # gc_grace: when this merge DROPS tombstones, those newer
+            # than (now - grace) survive anyway.  Stamped per merge so
+            # the window tracks wall time, not tree lifetime.
+            self.strategy.tombstone_drop_before = (
+                now_nanos() - int(self.gc_grace_s * 1e9)
+                if not keep_tombstones and self.gc_grace_s > 0
+                else None
+            )
             merge_async = getattr(self.strategy, "merge_async", None)
             if merge_async is not None:
                 result = await merge_async(

@@ -493,6 +493,23 @@ def _load() -> Optional[ctypes.CDLL]:
             TICK_FN,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_merge_grace_cb"):
+        # gc_grace merge (tombstones younger than the int64-ns cutoff
+        # survive a drop-tombstones merge).
+        lib.dbeel_merge_grace_cb.restype = ctypes.c_int64
+        lib.dbeel_merge_grace_cb.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_int64,
+            u8p,
+            ctypes.POINTER(ctypes.c_uint64),
+            u8p,
+            TICK_FN,
+            ctypes.c_uint64,
+        ]
     _lib = lib
     return _lib
 
@@ -607,17 +624,38 @@ class NativeMergeStrategy(CompactionStrategy):
 
         DataArr = ctypes.c_char_p * len(sources)
         CountArr = ctypes.c_uint64 * len(sources)
+        keep = 1 if keep_tombstones else 0
+        cutoff = int(self.tombstone_drop_before or 0)
+        if (
+            not keep
+            and cutoff > 0
+            and not hasattr(lib, "dbeel_merge_grace_cb")
+        ):
+            # Stale .so without the grace merge: keeping ALL
+            # tombstones is the conservative degradation (never
+            # resurrect a delete; the space is reclaimed once the
+            # library is rebuilt).
+            keep = 1
+            cutoff = 0
         args = (
             DataArr(*[_as_cptr(d) for d in datas]),
             DataArr(*[_as_cptr(i) for i in indexes]),
             CountArr(*counts),
             len(sources),
-            1 if keep_tombstones else 0,
+            keep,
             out_data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.byref(out_size),
             out_index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
-        if hasattr(lib, "dbeel_merge_cb"):
+        if not keep and cutoff > 0:
+            n_out = lib.dbeel_merge_grace_cb(
+                *args[:5],
+                ctypes.c_int64(cutoff),
+                *args[5:],
+                tick_cb,
+                _MERGE_TICK_EVERY,
+            )
+        elif hasattr(lib, "dbeel_merge_cb"):
             # TICK_FN() is a NULL fn pointer — same as dbeel_merge.
             n_out = lib.dbeel_merge_cb(
                 *args, tick_cb, _MERGE_TICK_EVERY

@@ -247,6 +247,15 @@ uint64_t now_ms() {
   return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
 }
 
+// Wall clock for the deadline_ms request field (deadline propagation,
+// overload plane): the server compares against ITS wall clock — the
+// same loose-sync contract the LWW timestamps already accept.
+uint64_t wall_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000ull + (uint64_t)ts.tv_nsec / 1000000ull;
+}
+
 void sleep_ms(uint64_t ms) {
   struct timespec ts;
   ts.tv_sec = (time_t)(ms / 1000ull);
@@ -580,12 +589,18 @@ int keyed_request(Client* c, const char* type,
   // is spent — a dead coordinator costs the walk hop, not the op.
   int last_rc = -2;
   const uint64_t deadline = now_ms() + c->op_deadline_ms;
+  const uint64_t wall_deadline = wall_ms() + c->op_deadline_ms;
   for (int attempt = 0;; attempt++) {
     auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
     bool not_owned = false;
     // Per attempt: a post-resync walk that cleanly answers is not
     // tainted by pre-resync failures against the stale ring.
     bool transport_failed = false;
+    // A replica that SHED the op (Overloaded — its governor past the
+    // hard limit): retry after backoff like a transport failure —
+    // shedding is transient by design and hammering it back defeats
+    // the point.
+    bool shed = false;
     for (size_t ri = 0; ri < replicas.size(); ri++) {
       if (now_ms() >= deadline && ri > 0) {
         // Budget spent mid-walk (each dial can cost a socket
@@ -596,9 +611,9 @@ int keyed_request(Client* c, const char* type,
         break;
       }
       MpBuf m;
-      // type, collection, keepalive, key, hash, replica_index
-      // (+ value on set, + consistency when requested).
-      uint32_t fields = 6 + (is_set ? 1 : 0) +
+      // type, collection, keepalive, key, hash, replica_index,
+      // deadline_ms (+ value on set, + consistency when requested).
+      uint32_t fields = 7 + (is_set ? 1 : 0) +
                         (consistency > 0 ? 1 : 0);
       m.map_header(fields);
       common_fields(&m, type, collection, true);
@@ -616,6 +631,8 @@ int keyed_request(Client* c, const char* type,
       m.uint(key_hash);
       m.str("replica_index");
       m.uint((uint64_t)ri);
+      m.str("deadline_ms");
+      m.uint(wall_deadline);
       std::vector<uint8_t> body;
       uint8_t rtype = 0;
       if (!round_trip(c, replicas[ri]->ip, replicas[ri]->db_port, m,
@@ -640,13 +657,17 @@ int keyed_request(Client* c, const char* type,
       }
       if (kind == "KeyNotFound") {
         last_rc = -1;
+      } else if (kind == "Overloaded") {
+        shed = true;
+        last_rc = -2;
+        c->last_error = kind + ": " + msg;
       } else {
         last_rc = -2;
         c->last_error = kind + ": " + msg;
       }
       // walk on: the next replica may have the key / be healthy
     }
-    if (!not_owned && !transport_failed) {
+    if (!not_owned && !transport_failed && !shed) {
       // Walk finished on application outcomes only: final.
       if (last_rc == -2 && c->last_error.empty()) {
         c->last_error = "no replica reachable";

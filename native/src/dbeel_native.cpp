@@ -145,11 +145,22 @@ void dbeel_bloom_add_batch(uint8_t* bits, uint64_t num_bits,
 // that yields CPU to serving while it is busy); tick may be null.
 typedef void (*dbeel_tick_fn)(void);
 
-int64_t dbeel_merge_cb(const uint8_t** datas, const uint8_t** indexes,
-                       const uint64_t* counts, uint32_t nsrc,
-                       int keep_tombstones, uint8_t* out_data,
-                       uint64_t* out_data_size, uint8_t* out_index,
-                       dbeel_tick_fn tick, uint64_t tick_every) {
+// drop_tombstones_before (ns, overload/convergence plane gc_grace):
+// when dropping tombstones (keep_tombstones == 0), a tombstone whose
+// timestamp is >= this value is KEPT anyway — it is younger than the
+// grace window a delete needs to out-live its laggard replicas
+// (hint-replay / anti-entropy could otherwise resurrect the old
+// value after the tombstone was GC'd).  <= 0 = unconditional drop
+// (the old behavior).
+int64_t dbeel_merge_grace_cb(const uint8_t** datas,
+                             const uint8_t** indexes,
+                             const uint64_t* counts, uint32_t nsrc,
+                             int keep_tombstones,
+                             int64_t drop_tombstones_before,
+                             uint8_t* out_data,
+                             uint64_t* out_data_size,
+                             uint8_t* out_index, dbeel_tick_fn tick,
+                             uint64_t tick_every) {
   std::vector<HeapItem> heap;
   heap.reserve(nsrc);
 
@@ -197,7 +208,13 @@ int64_t dbeel_merge_cb(const uint8_t** datas, const uint8_t** indexes,
       last_key = item.key;
       last_key_len = item.key_len;
       const bool tombstone = ie->full_size == 16u + ie->key_size;
-      if (keep_tombstones || !tombstone) {
+      bool drop = tombstone && !keep_tombstones;
+      if (drop && drop_tombstones_before > 0) {
+        int64_t ts;
+        std::memcpy(&ts, rec + 8, 8);
+        if (ts >= drop_tombstones_before) drop = false;  // gc_grace
+      }
+      if (!drop) {
         std::memcpy(out_data + out_off, rec, ie->full_size);
         oindex[out_count].offset = out_off;
         oindex[out_count].key_size = ie->key_size;
@@ -216,6 +233,17 @@ int64_t dbeel_merge_cb(const uint8_t** datas, const uint8_t** indexes,
 
   *out_data_size = out_off;
   return out_count;
+}
+
+int64_t dbeel_merge_cb(const uint8_t** datas, const uint8_t** indexes,
+                       const uint64_t* counts, uint32_t nsrc,
+                       int keep_tombstones, uint8_t* out_data,
+                       uint64_t* out_data_size, uint8_t* out_index,
+                       dbeel_tick_fn tick, uint64_t tick_every) {
+  return dbeel_merge_grace_cb(datas, indexes, counts, nsrc,
+                              keep_tombstones, 0, out_data,
+                              out_data_size, out_index, tick,
+                              tick_every);
 }
 
 int64_t dbeel_merge(const uint8_t** datas, const uint8_t** indexes,
@@ -2677,7 +2705,12 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (!(k_set || k_del || k_get || k_dig)) return -1;
   const uint32_t want =
       k_set ? 6u : k_del ? 5u : 4u;
-  if (nelem != want) return -1;
+  // Optional trailing wall-clock deadline (ms) — deadline
+  // propagation (overload plane): an expired frame punts to Python,
+  // which answers the retryable Overloaded error and counts the
+  // drop; an unexpired one serves natively as before.
+  const bool has_deadline = nelem == want + 1u;
+  if (nelem != want && !has_deadline) return -1;
 
   const uint8_t* coll_s;
   uint32_t coll_n;
@@ -2688,6 +2721,18 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (k_set && !mp_read_bin(c, &val_s, &val_n)) return -1;
   int64_t ts = 0;
   if ((k_set || k_del) && !mp_read_int64(c, &ts)) return -1;
+  if (has_deadline) {
+    int64_t deadline_ms = 0;
+    if (!mp_read_int64(c, &deadline_ms)) return -1;
+    if (deadline_ms > 0) {
+      struct timespec now_ts;
+      clock_gettime(CLOCK_REALTIME, &now_ts);
+      const int64_t wall_ms =
+          (int64_t)now_ts.tv_sec * 1000ll +
+          (int64_t)now_ts.tv_nsec / 1000000ll;
+      if (wall_ms > deadline_ms) return -1;  // expired: Python drops
+    }
+  }
   if (c.p != c.end) return -1;
 
   int32_t col_idx = -1;

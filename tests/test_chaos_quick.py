@@ -48,6 +48,28 @@ PARTITION_KEYS = {
     "pass",
 }
 
+OVERLOAD_KEYS = {
+    "sustainable_ops_per_s",
+    "baseline_p99_ms",
+    "offered_multiplier",
+    "offered_ops_per_s",
+    "duration_s",
+    "launched",
+    "ok",
+    "errors_by_class",
+    "goodput_ops_per_s",
+    "goodput_ratio",
+    "admitted_p99_ms",
+    "p99_bound_ms",
+    "server_sheds",
+    "server_deadline_drops",
+    "bg_delays",
+    "stats_overload_block_py",
+    "stats_overload_block_native",
+    "nodes_alive",
+    "pass",
+}
+
 
 @pytest.mark.slow
 def test_chaos_soak_quick_schema(tmp_dir):
@@ -66,6 +88,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
             "--quick",
             "--disk-faults",
             "--partition",
+            "--overload",
             "--report",
             report_path,
         ],
@@ -101,6 +124,17 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert not missing, missing
     assert pt["divergent_after_slo"] == 0, pt
     assert pt["writes_ok"] > 0
+    # --overload phase schema (overload-control plane, ISSUE 5):
+    # open-loop >= 3x sustainable → alive + shed honestly + goodput
+    # floor + bounded admitted p99 + the overload stats block in
+    # BOTH clients.
+    ov = report["overload"]
+    missing = OVERLOAD_KEYS - set(ov)
+    assert not missing, missing
+    assert ov["nodes_alive"] is True
+    assert ov["stats_overload_block_py"] is True
+    assert ov["stats_overload_block_native"] is True
+    assert "overload" in ov["errors_by_class"] or ov["ok"] > 0
     assert report["quick"] is True
     # The quick mode must still uphold the hard invariants (loss /
     # divergence), even though the error-rate gate is waived.

@@ -1,0 +1,156 @@
+"""Tombstone GC grace (ISSUE 5 satellite): compaction refuses to drop
+a tombstone younger than ``gc_grace`` — closing the ROADMAP
+delete-resurrection hazard, where a bottom-level compaction GC'd a
+delete before every replica saw it and a later hint replay /
+anti-entropy push resurrected the old value.
+"""
+
+import pytest
+
+from dbeel_tpu.server.shard import MyShard
+from dbeel_tpu.storage.compaction import get_strategy
+from dbeel_tpu.storage.lsm_tree import LSMTree, TOMBSTONE
+from dbeel_tpu.storage.native import native_available
+from dbeel_tpu.utils.timestamps import now_nanos
+
+from conftest import run
+
+BACKENDS = ["heap", "cpu"] + (["native"] if native_available() else [])
+
+
+async def _seed_tombstone(tmp_dir, backend, gc_grace_s):
+    """Two sstables: one holding k=v, a newer one holding k's
+    tombstone; returns the tree ready to compact them to the bottom
+    level (keep_tombstones=False)."""
+    tree = LSMTree.open_or_create(
+        f"{tmp_dir}/t-{backend}-{gc_grace_s}",
+        capacity=8,
+        strategy=get_strategy(backend),
+        gc_grace_s=gc_grace_s,
+    )
+    old_ts = now_nanos()
+    await tree.set_with_timestamp(b"k", b"v1", old_ts)
+    await tree.set_with_timestamp(b"other", b"x", old_ts)
+    await tree.flush()
+    del_ts = now_nanos()
+    await tree.set_with_timestamp(b"k", TOMBSTONE, del_ts)
+    await tree.flush()
+    indices = [i for i, _s in tree.sstable_indices_and_sizes()]
+    assert len(indices) == 2, indices
+    return tree, indices, old_ts, del_ts
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_tombstone_survives_bottom_compaction_within_grace(
+    tmp_dir, backend
+):
+    async def main():
+        tree, indices, _old, del_ts = await _seed_tombstone(
+            tmp_dir, backend, gc_grace_s=3600.0
+        )
+        await tree.compact(
+            indices, max(indices) + 1, keep_tombstones=False
+        )
+        entry = await tree.get_entry(b"k")
+        assert entry is not None, (
+            "gc_grace must keep a fresh tombstone through the "
+            "bottom-level merge"
+        )
+        assert bytes(entry[0]) == TOMBSTONE
+        assert entry[1] == del_ts
+        # Non-tombstone survivors are untouched.
+        other = await tree.get_entry(b"other")
+        assert bytes(other[0]) == b"x"
+        tree.close()
+
+    run(main(), timeout=30)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_tombstone_dropped_without_grace(tmp_dir, backend):
+    """gc_grace=0 keeps the reference behavior: the bottom level
+    drops tombstones unconditionally."""
+
+    async def main():
+        tree, indices, _old, _del = await _seed_tombstone(
+            tmp_dir, backend, gc_grace_s=0.0
+        )
+        await tree.compact(
+            indices, max(indices) + 1, keep_tombstones=False
+        )
+        assert await tree.get_entry(b"k") is None
+        tree.close()
+
+    run(main(), timeout=30)
+
+
+def test_delete_survives_ae_replay_after_compaction(tmp_dir):
+    """THE resurrection regression: a stale replica pushing the
+    pre-delete value through the anti-entropy apply primitive
+    (apply_if_newer) must NOT resurrect it after the deleting shard
+    compacted — the graced tombstone out-timestamps the push.  With
+    grace off, the same replay resurrects (the documented hazard this
+    satellite closes)."""
+
+    async def main():
+        # With grace: the tombstone survives the merge and wins.
+        tree, indices, old_ts, _del = await _seed_tombstone(
+            tmp_dir, "heap", gc_grace_s=3600.0
+        )
+        await tree.compact(
+            indices, max(indices) + 1, keep_tombstones=False
+        )
+        applied = await MyShard.apply_if_newer(
+            tree, b"k", b"v1", old_ts
+        )
+        assert not applied, "stale AE push must lose to the tombstone"
+        entry = await tree.get_entry(b"k")
+        assert bytes(entry[0]) == TOMBSTONE
+        tree.close()
+
+        # Without grace: the replay resurrects — the hazard exists
+        # and the grace window is what prevents it.
+        tree2, indices2, old_ts2, _d2 = await _seed_tombstone(
+            f"{tmp_dir}/no-grace", "heap", gc_grace_s=0.0
+        )
+        await tree2.compact(
+            indices2, max(indices2) + 1, keep_tombstones=False
+        )
+        applied = await MyShard.apply_if_newer(
+            tree2, b"k", b"v1", old_ts2
+        )
+        assert applied, (
+            "without gc_grace the stale push resurrects (documents "
+            "the hazard)"
+        )
+        tree2.close()
+
+    run(main(), timeout=30)
+
+
+def test_old_tombstones_still_gc_past_grace(tmp_dir):
+    """A tombstone OLDER than the grace window still drops — the
+    grace must not become keep-forever (space reclamation)."""
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            f"{tmp_dir}/old",
+            capacity=8,
+            strategy=get_strategy("heap"),
+            gc_grace_s=0.001,  # 1ms: already past by compact time
+        )
+        await tree.set_with_timestamp(b"k", b"v1", now_nanos())
+        await tree.flush()
+        await tree.set_with_timestamp(b"k", TOMBSTONE, now_nanos())
+        await tree.flush()
+        import asyncio
+
+        await asyncio.sleep(0.01)
+        indices = [i for i, _s in tree.sstable_indices_and_sizes()]
+        await tree.compact(
+            indices, max(indices) + 1, keep_tombstones=False
+        )
+        assert await tree.get_entry(b"k") is None
+        tree.close()
+
+    run(main(), timeout=30)

@@ -1,0 +1,566 @@
+"""Overload-control plane (ISSUE 5 tentpole): admission + shedding,
+AIMD per-connection windows, deadline propagation, background-work
+delay ordering, and slow-peer outbound caps — all driven through the
+deterministic governor force seam (``LoadGovernor.force_level``, the
+set_fault pattern) or real event-gated backlogs; no timing-dependent
+assertions.
+"""
+
+import asyncio
+import time
+
+import msgpack
+import pytest
+
+from dbeel_tpu.client import Consistency, DbeelClient
+from dbeel_tpu.cluster import remote_comm
+from dbeel_tpu.cluster.messages import ShardRequest, ShardResponse
+from dbeel_tpu.errors import (
+    ERROR_CLASS_OVERLOAD,
+    Overloaded,
+    Timeout,
+    classify_error,
+    is_retryable_class,
+)
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.server import db_server
+from dbeel_tpu.server.governor import (
+    LEVEL_HARD,
+    LEVEL_SOFT,
+)
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_fanout(monkeypatch):
+    """Asyncio fan-out (the native QuorumFan writes to raw sockets
+    underneath the Python seams) + clean fault state."""
+    monkeypatch.setenv("DBEEL_NO_QF", "1")
+    yield
+    remote_comm.clear_faults()
+
+
+async def _one_node(tmp_dir, **kw):
+    cfg = make_config(tmp_dir, **kw)
+    node = await ClusterNode(cfg).start()
+    client = await DbeelClient.from_seed_nodes(
+        [node.db_address], op_deadline_s=1.5
+    )
+    col = await client.create_collection("ov", replication_factor=1)
+    return node, client, col
+
+
+# ----------------------------------------------------------------------
+# Taxonomy plumbing
+# ----------------------------------------------------------------------
+
+
+def test_overload_error_class_is_retryable():
+    assert classify_error(Overloaded("x")) == ERROR_CLASS_OVERLOAD
+    assert is_retryable_class(ERROR_CLASS_OVERLOAD)
+    # ...and crosses the wire by kind.
+    from dbeel_tpu.errors import from_wire
+
+    e = from_wire(["Overloaded", "shed"])
+    assert isinstance(e, Overloaded)
+
+
+# ----------------------------------------------------------------------
+# Hard-limit shedding (forced level: no timing in the loop)
+# ----------------------------------------------------------------------
+
+
+def test_forced_hard_shed_returns_overload_not_timeout(tmp_dir):
+    """Past the hard limit, a data op is answered with the retryable
+    Overloaded error FAST — never a hang, never an opaque timeout —
+    and the shed is counted in get_stats.overload."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            await col.set("k", {"v": 1})
+            shard.governor.force_level(LEVEL_HARD)
+            t0 = time.monotonic()
+            with pytest.raises(Overloaded):
+                await col.set("k", {"v": 2})
+            # The client retries overload with backoff until its
+            # 1.5s deadline: well under a server timeout horizon.
+            assert time.monotonic() - t0 < 5.0
+            stats = await client.get_stats(*node.db_address)
+            ov = stats["overload"]
+            assert ov["level"] == LEVEL_HARD
+            assert ov["shed_ops"] > 0
+            assert ov["shed_by_op"].get("set", 0) > 0
+            assert stats["metrics"]["errors"]["overload"] > 0
+            # Recovery: clearing the backlog signal re-admits.
+            shard.governor.force_level(None)
+            await col.set("k", {"v": 3})
+            assert (await col.get("k"))["v"] == 3
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_admin_ops_serve_under_hard_shed(tmp_dir):
+    """get_stats / metadata must keep serving while data ops shed —
+    an operator can always see into an overloaded node."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            shard.governor.force_level(LEVEL_HARD)
+            stats = await client.get_stats(*node.db_address)
+            assert stats["overload"]["level"] == LEVEL_HARD
+            md = await client.get_cluster_metadata()
+            assert md.nodes
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# AIMD window
+# ----------------------------------------------------------------------
+
+
+def test_window_shrinks_under_soft_and_recovers(tmp_dir):
+    """The per-connection window halves (at most once per window of
+    completions) while the governor reads soft overload, and climbs
+    additively back to the configured max once it clears."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, pipeline_window_max=8, overload_window_min=2
+        )
+        shard = node.shards[0]
+        # Kill the native fast paths: every op must run as a
+        # pipelined task (the AIMD tick point).
+        shard.dataplane = None
+        pipe_client = await DbeelClient.from_seed_nodes(
+            [node.db_address], pipeline_window=8
+        )
+        pcol = pipe_client.collection("ov")
+        try:
+            await pcol.set("w0", {"v": 0})
+            conns = [
+                c
+                for c in shard.db_connections
+                if getattr(c, "inflight", None) is not None
+            ]
+            assert conns, "pipelined connection not registered"
+            assert all(c.window == 8.0 for c in conns)
+            shard.governor.force_level(LEVEL_SOFT)
+            for i in range(24):
+                await pcol.set(f"w{i}", {"v": i})
+            # The connection that served the ops shrank (the control
+            # client's idle connection never ticks, so select by
+            # window).
+            conn = min(conns, key=lambda c: c.window)
+            assert conn.window <= 4.0, conn.window
+            assert shard.governor.window_min_seen <= 4.0
+            assert shard.governor.window_decreases >= 1
+            shrunk = conn.window
+            # Backlog drained: additive recovery to the FULL window.
+            shard.governor.force_level(None)
+            for i in range(80):
+                await pcol.set(f"r{i}", {"v": i})
+            assert conn.window == 8.0, (shrunk, conn.window)
+            stats = await client.get_stats(*node.db_address)
+            assert stats["overload"]["window_max"] == 8
+        finally:
+            shard.governor.force_level(None)
+            pipe_client.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Low-priority work throttles first
+# ----------------------------------------------------------------------
+
+
+def test_background_units_delay_first_under_soft(tmp_dir):
+    """Soft overload delays background units (the bg_slice gate)
+    BEFORE any client op is shed: the governor's shedding order is
+    maintenance first, serving last."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            shard.governor.force_level(LEVEL_SOFT)
+            ran = []
+
+            async def unit():
+                async with shard.scheduler.bg_slice():
+                    ran.append(1)
+
+            task = asyncio.ensure_future(unit())
+            await asyncio.sleep(0.12)
+            # The unit is parked in the gate, not running...
+            assert shard.governor.bg_delays == 1
+            assert not ran
+            # ...and client ops still serve (no shed at soft).
+            await col.set("s", {"v": 1})
+            assert shard.governor.shed_ops == 0
+            shard.governor.force_level(None)
+            await asyncio.wait_for(task, 5)
+            assert ran
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Deadline propagation
+# ----------------------------------------------------------------------
+
+
+def test_expired_client_deadline_dropped_at_dispatch(tmp_dir):
+    """A frame whose client-supplied absolute deadline passed while
+    it was queued is dropped (retryable error, counted) instead of
+    computing a dead response."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            await col.set("d", {"v": 1})
+            past = int(time.time() * 1000) - 5_000
+            req = {
+                "type": "get",
+                "collection": "ov",
+                "key": "d",
+                "deadline_ms": past,
+            }
+            with pytest.raises(Overloaded):
+                await db_server.handle_request(shard, req)
+            assert shard.governor.deadline_drops == 1
+            # An unexpired deadline serves normally.
+            req["deadline_ms"] = int(time.time() * 1000) + 60_000
+            payload = await db_server.handle_request(shard, req)
+            assert msgpack.unpackb(payload, raw=False) == {"v": 1}
+            assert shard.governor.deadline_drops == 1
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_expired_peer_deadline_dropped_replica_side(tmp_dir):
+    """A peer frame carrying an expired propagated deadline is
+    dropped by the replica with the retryable Overloaded error — the
+    coordinator's fan-out treats that like an unreachable peer, so
+    mutations still converge via hints."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        key = msgpack.packb("pk", use_bin_type=True)
+        val = msgpack.packb({"v": 9}, use_bin_type=True)
+        try:
+            past = int(time.time() * 1000) - 5_000
+            future = int(time.time() * 1000) + 60_000
+            with pytest.raises(Overloaded):
+                await shard.handle_shard_request(
+                    ShardRequest.set(
+                        "ov", key, val, 123, deadline_ms=past
+                    )
+                )
+            assert shard.governor.replica_deadline_drops == 1
+            with pytest.raises(Overloaded):
+                await shard.handle_shard_request(
+                    ShardRequest.get("ov", key, deadline_ms=past)
+                )
+            # Unexpired deadline: applies normally.
+            resp = await shard.handle_shard_request(
+                ShardRequest.set(
+                    "ov", key, val, 456, deadline_ms=future
+                )
+            )
+            assert resp[1] == ShardResponse.SET
+            entry = await shard.handle_shard_request(
+                ShardRequest.get("ov", key, deadline_ms=future)
+            )
+            assert entry[2] is not None
+            # Old-dialect frames (no deadline element) untouched.
+            resp = await shard.handle_shard_request(
+                ShardRequest.get("ov", key)
+            )
+            assert resp[1] == ShardResponse.GET
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Real (event-gated) backlog: shed, stay live, recover
+# ----------------------------------------------------------------------
+
+
+def test_real_backlog_sheds_then_recovers(tmp_dir):
+    """A genuine admitted-work backlog (writes parked on an event —
+    no governor forcing) trips the hard limit: later queued ops shed
+    with Overloaded instead of rotting behind the full window, and
+    once the backlog drains the shard admits again."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir,
+            pipeline_window_max=4,
+            overload_soft_ops=3,
+            overload_hard_ops=6,
+            overload_window_min=2,
+        )
+        shard = node.shards[0]
+        shard.dataplane = None  # every op runs the Python task path
+        tree = shard.collections["ov"].tree
+        gate = asyncio.Event()
+        real_set = tree.set_with_timestamp
+
+        async def gated_set(key, value, timestamp, **kw):
+            await gate.wait()
+            return await real_set(key, value, timestamp, **kw)
+
+        tree.set_with_timestamp = gated_set
+        pipe_client = await DbeelClient.from_seed_nodes(
+            [node.db_address], pipeline_window=32, op_deadline_s=1.0
+        )
+        pcol = pipe_client.collection("ov")
+        try:
+            results = await asyncio.gather(
+                *[pcol.set(f"b{i}", {"v": i}) for i in range(30)],
+                return_exceptions=True,
+            )
+            errors = [r for r in results if isinstance(r, Exception)]
+            assert errors, "a 30-op burst over a 6-op limit must shed"
+            assert shard.governor.shed_ops > 0
+            # The node is alive and observable mid-overload, and the
+            # sheds crossed the wire as overload-class error frames
+            # (the client retries them until its deadline, so its
+            # FINAL error may legitimately be the deadline Timeout).
+            stats = await client.get_stats(*node.db_address)
+            assert stats["overload"]["hard_transitions"] >= 1
+            assert stats["metrics"]["errors"]["overload"] > 0
+            # Drain the backlog: admitted ops complete, new ops land.
+            gate.set()
+            tree.set_with_timestamp = real_set
+            await pcol.set("after", {"v": 1})
+            assert (await pcol.get("after"))["v"] == 1
+        finally:
+            gate.set()
+            tree.set_with_timestamp = real_set
+            pipe_client.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Slow-peer isolation: capped outbound queues
+# ----------------------------------------------------------------------
+
+
+def test_peer_outbound_cap_sheds_newest_first(arun):
+    """Over the per-peer in-flight cap, the NEW send is refused
+    immediately (LIFO-over-limit: in-flight work keeps its place) —
+    one black-holed peer cannot absorb unbounded coordinator memory."""
+
+    async def main():
+        conn = remote_comm.RemoteShardConnection(
+            "127.0.0.1:1",
+            read_timeout_ms=2000,
+            max_inflight_ops=1,
+        )
+        remote_comm.set_fault(
+            "127.0.0.1:1", remote_comm.FAULT_BLACKHOLE
+        )
+        first = asyncio.ensure_future(conn.ping())
+        await asyncio.sleep(0)  # the first op occupies the slot
+        t0 = time.monotonic()
+        with pytest.raises(Overloaded):
+            await conn.ping()
+        assert time.monotonic() - t0 < 0.2  # shed instantly
+        assert conn.shed_count == 1
+        first.cancel()
+        with pytest.raises(
+            (asyncio.CancelledError, Timeout, Exception)
+        ):
+            await first
+        remote_comm.set_fault("127.0.0.1:1", None)
+        # Slot released: admission works again (fault disarmed, the
+        # dial now fails on connect — NOT on the cap).
+        assert conn.inflight_ops == 0
+
+    arun(main())
+
+
+def test_byte_cap_sheds_packed_frames(arun):
+    async def main():
+        conn = remote_comm.RemoteShardConnection(
+            "127.0.0.1:1",
+            read_timeout_ms=2000,
+            max_inflight_ops=0,  # op cap off: isolate the byte cap
+            max_inflight_bytes=64,
+        )
+        remote_comm.set_fault(
+            "127.0.0.1:1", remote_comm.FAULT_BLACKHOLE
+        )
+        big = b"\x00" * 64
+        first = asyncio.ensure_future(conn.send_packed(big))
+        await asyncio.sleep(0)
+        with pytest.raises(Overloaded):
+            await conn.send_packed(b"\x00" * 8)
+        assert conn.shed_count == 1
+        first.cancel()
+        try:
+            await first
+        except BaseException:
+            pass
+        remote_comm.set_fault("127.0.0.1:1", None)
+
+    arun(main())
+
+
+def test_overloaded_replica_feeds_hint_path(tmp_dir):
+    """A replica whose outbound queue sheds a mutation is treated
+    like an unreachable peer: the write is HINTED, and replayed once
+    the pressure clears — capped queues feed the existing
+    convergence machinery instead of dropping writes."""
+
+    async def main():
+        cfg = make_config(tmp_dir, default_replication_factor=2)
+        node0 = await ClusterNode(cfg).start()
+        alive = node0.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        cfg1 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[node0.seed_address]
+        )
+        node1 = await ClusterNode(cfg1).start()
+        await alive
+        client = await DbeelClient.from_seed_nodes([node0.db_address])
+        created = [
+            node0.flow_event(0, FlowEvent.COLLECTION_CREATED),
+            node1.flow_event(0, FlowEvent.COLLECTION_CREATED),
+        ]
+        col = await client.create_collection(
+            "hp", replication_factor=2
+        )
+        await asyncio.wait_for(asyncio.gather(*created), 10)
+        shard0 = node0.shards[0]
+        try:
+            # Statically exhaust the outbound budget to node1: every
+            # fan-out send sheds on the spot.
+            victims = [
+                s.connection
+                for s in shard0.shards
+                if s.node_name == cfg1.name
+            ]
+            assert victims
+            for c in victims:
+                c.max_inflight_ops = 1
+                c.inflight_ops = 1  # pinned over the cap
+            # A key COORDINATED by node0's shard 0 (the one whose
+            # outbound queue we pinned over the cap).
+            from dbeel_tpu.utils.murmur import hash_bytes
+
+            key = None
+            for i in range(512):
+                k = f"hk{i}"
+                h = hash_bytes(
+                    msgpack.packb(k, use_bin_type=True)
+                )
+                first = client._shards_for_key(h, 2)[0]
+                if (
+                    first.node_name == cfg.name
+                    and shard0.owns_key(h, 0)
+                ):
+                    key = k
+                    break
+            assert key is not None
+            hint = node0.flow_event(0, FlowEvent.HINT_RECORDED)
+            # W=1: the coordinator's own replica ack satisfies the
+            # client; the background replica send sheds and hints.
+            await col.set(
+                key, {"v": 7}, consistency=Consistency.fixed(1)
+            )
+            await asyncio.wait_for(hint, 10)
+            assert shard0.hint_log.queued_total() >= 1
+            stats0 = shard0.get_stats()
+            assert stats0["overload"]["peer_queue_sheds"] >= 1
+            # Pressure clears: the drain replays the hint and node1
+            # converges.
+            healed = node1.flow_event(
+                0, FlowEvent.ITEM_SET_FROM_SHARD_MESSAGE
+            )
+            for c in victims:
+                c.inflight_ops = 0
+            await shard0.replay_hints(cfg1.name)
+            await asyncio.wait_for(healed, 10)
+        finally:
+            client.close()
+            await node0.stop()
+            await node1.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# get_stats schema
+# ----------------------------------------------------------------------
+
+
+def test_overload_stats_schema(tmp_dir):
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        try:
+            stats = await client.get_stats(*node.db_address)
+            ov = stats["overload"]
+            for k in (
+                "level",
+                "signals",
+                "shed_ops",
+                "shed_by_op",
+                "deadline_drops",
+                "replica_deadline_drops",
+                "bg_delays",
+                "soft_transitions",
+                "hard_transitions",
+                "window_decreases",
+                "window_min_seen",
+                "window_max",
+                "peer_queue_sheds",
+                "window_cur",
+            ):
+                assert k in ov, k
+            for k in (
+                "ops",
+                "memtable_fill",
+                "flush_backlog",
+                "sstable_debt",
+            ):
+                assert k in ov["signals"], k
+            assert "overload" in stats["metrics"]["errors"]
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
