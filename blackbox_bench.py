@@ -270,6 +270,88 @@ def main_native(args):
     boot.close()
 
 
+async def main_native_floor(args):
+    """--native-floor: the all-native serving path's headline number.
+    Runs pipelined RF=1 sets+gets and batched multi_set/multi_get
+    against the running server and reports, PER PHASE, the throughput
+    and latency percentiles alongside the interval
+    ``native_served_frac`` (frames answered without entering the
+    Python dispatcher, from get_stats.native_path deltas).  For the
+    same-session Python-path baseline (BENCH host-weather rule), run
+    the same phase against a server started with DBEEL_NO_DATAPLANE=1
+    (whole interpreted path) or DBEEL_DP_NO_MULTI=1 (interpreted
+    multi fallback only) and compare in-session."""
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)],
+        pipeline_window=args.pipeline or 32,
+    )
+    try:
+        await client.create_collection(args.collection, 1)
+    except CollectionAlreadyExists:
+        pass
+
+    keys = [f"nf-{i:08}" for i in range(args.clients * args.requests)]
+    rng = random.Random(args.seed)
+    value = {"blob": "x" * args.value_size}
+    batch = args.batch or 64
+
+    async def snap():
+        stats = await client.get_stats(args.host, args.port)
+        np_ = stats.get("native_path") or {}
+        return {
+            "served": dict(np_.get("served") or {}),
+            "totals": dict(np_.get("totals") or {}),
+            "frac": np_.get("native_served_frac"),
+            "python_sheds": np_.get("python_sheds"),
+            "native_sheds": np_.get("native_sheds"),
+        }
+
+    def interval_frac(before, after, verbs):
+        served = sum(
+            after["served"].get(v, 0) - before["served"].get(v, 0)
+            for v in verbs
+        )
+        total = sum(
+            after["totals"].get(v, 0) - before["totals"].get(v, 0)
+            for v in verbs
+        )
+        if total <= 0:
+            return None
+        return min(1.0, served / total)
+
+    phases = (
+        ("pipelined set", "set", 0, ("write",)),
+        ("pipelined get", "get", 0, ("get",)),
+        ("batched multi_set", "set", batch, ("multi_set",)),
+        ("batched multi_get", "get", batch, ("multi_get",)),
+    )
+    for label, op, phase_batch, verbs in phases:
+        rng.shuffle(keys)
+        before = await snap()
+        total, lat = await run_phase(
+            client, args.collection, op, keys, args.clients, value,
+            None, batch=phase_batch,
+        )
+        after = await snap()
+        frac = interval_frac(before, after, verbs)
+        frac_s = "n/a (no dataplane)" if frac is None else f"{frac:.4f}"
+        print(
+            f"{label}: total {total:.3f}s "
+            f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}  "
+            f"native_served_frac[{'+'.join(verbs)}]: {frac_s}"
+        )
+    final = await snap()
+    print(
+        f"server: native_served_frac={final['frac']} "
+        f"served={final['served']} totals={final['totals']} "
+        f"native_sheds={final['native_sheds']} "
+        f"python_sheds={final['python_sheds']}"
+    )
+    client.close()
+
+
 async def main_overload_knee(args):
     """--overload-knee: the overload-control plane's headline curve.
     Measure the SAME-SESSION sustainable closed-loop rate, then sweep
@@ -396,13 +478,19 @@ async def main_overload_knee(args):
     stats = await client.get_stats(args.host, args.port)
     ov = stats.get("overload", {})
     sig = ov.get("signals", {})
+    np_ = stats.get("native_path") or {}
     print(
         f"server: sheds={ov.get('shed_ops')} "
         f"deadline_drops={ov.get('deadline_drops')} "
         f"dead_completions={ov.get('dead_completions')} "
         f"window_min_seen={ov.get('window_min_seen')} "
         f"bg_delays={ov.get('bg_delays')} "
-        f"loop_lag_ms={sig.get('loop_lag_ms')}"
+        f"loop_lag_ms={sig.get('loop_lag_ms')} "
+        # All-native shed gate: shed frames answered in C vs the
+        # interpreted residue (the zero-Python-dispatch claim).
+        f"native_sheds={np_.get('native_sheds')} "
+        f"python_sheds={np_.get('python_sheds')} "
+        f"native_deadline_drops={np_.get('native_deadline_drops')}"
     )
     client.close()
 
@@ -518,6 +606,15 @@ def main():
         "grouped by owning node",
     )
     ap.add_argument(
+        "--native-floor",
+        action="store_true",
+        help="all-native serving path phase: pipelined RF=1 sets/"
+        "gets + batched multi ops, reporting throughput, latency, "
+        "and the interval native_served_frac per phase (run again "
+        "vs DBEEL_NO_DATAPLANE=1 / DBEEL_DP_NO_MULTI=1 servers for "
+        "the same-session Python-path baseline)",
+    )
+    ap.add_argument(
         "--overload-knee",
         action="store_true",
         help="offered-load sweep (open loop, multiples of the "
@@ -543,6 +640,8 @@ def main():
         ap.error("--pipeline and --batch are separate phases")
     if args.overload_knee_worker:
         asyncio.run(main_knee_worker(args))
+    elif args.native_floor:
+        asyncio.run(main_native_floor(args))
     elif args.overload_knee:
         asyncio.run(main_overload_knee(args))
     elif args.native_client:

@@ -12,7 +12,8 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
-from typing import List, Tuple
+from collections import deque
+from typing import List, Optional, Tuple
 
 from ..errors import (
     ConnectionError_,
@@ -142,6 +143,76 @@ async def get_message_from_stream(reader: asyncio.StreamReader) -> list:
     return unpack_message(await read_frame(reader))
 
 
+def _pipeline_enabled() -> bool:
+    return os.environ.get("DBEEL_NO_PEER_PIPELINE", "0") in ("", "0")
+
+
+# Request kinds eligible for FIFO stream multiplexing: the quick data
+# verbs a coordinator fans out per-op.  Bulk transfers (RANGE_*) and
+# admin/probe traffic keep their own round trips — a multi-second
+# RANGE_PULL response parked in front of quorum acks would be
+# self-inflicted head-of-line blocking.
+_PIPE_KINDS = frozenset(
+    (
+        ShardRequest.SET,
+        ShardRequest.DELETE,
+        ShardRequest.GET,
+        ShardRequest.GET_DIGEST,
+        ShardRequest.MULTI_SET,
+        ShardRequest.MULTI_GET,
+    )
+)
+_MULTI_KINDS = frozenset(
+    (ShardRequest.MULTI_SET, ShardRequest.MULTI_GET)
+)
+# MULTI batches are data verbs but not bounded like single ops (up to
+# 4096 sub-ops of arbitrary values; a multi_get's aligned response
+# can be multi-MB off a small request).  One such frame parked on THE
+# shared stream would block every quick verb queued behind it — the
+# same head-of-line hazard RANGE_* is excluded for — and the FIFO
+# read timeout would kill the stream and fail every in-flight op.
+# Oversized batches take a pooled round trip instead.
+_PIPE_MAX_FRAME = 128 * 1024
+_PIPE_MAX_SUBOPS = 256
+
+
+class _PipeStream:
+    """One persistent peer stream carrying many in-flight frames,
+    FIFO-matched (all-native serving path, ISSUE 6): the remote shard
+    server releases responses strictly in frame-arrival order (the
+    framed base's parked queue), so the n-th response on the stream
+    answers the n-th request — the same multiplexing contract the
+    public plane's pipelined clients use.  A send is one buffered
+    ``writer.write`` with no await before the future is enqueued, so
+    concurrent senders can never interleave partial frames or desync
+    the FIFO."""
+
+    __slots__ = ("reader", "writer", "inflight", "dead", "task")
+
+    def __init__(self, reader, writer) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.inflight: deque = deque()
+        self.dead = False
+        self.task = None  # reader-loop task (strong ref, no GC)
+
+    def kill(self, why: str) -> None:
+        """Close the stream and fail every in-flight future: a stream
+        that timed out or errored may still deliver late bytes that
+        would FIFO-match the wrong op — it must never be reused."""
+        if self.dead:
+            return
+        self.dead = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        while self.inflight:
+            fut = self.inflight.popleft()
+            if not fut.done():
+                fut.set_exception(ConnectionError_(why))
+
+
 class RemoteShardConnection:
     """``pooled=True`` keeps request/response connections open between
     calls (the remote shard server is a persistent multi-message loop,
@@ -202,6 +273,18 @@ class RemoteShardConnection:
         self.inflight_ops = 0
         self.inflight_bytes = 0
         self.shed_count = 0  # summed into get_stats.overload
+        # Pipelined outbound stream (all-native serving path, ISSUE
+        # 6): pooled ring entries multiplex in-flight data frames
+        # FIFO on ONE persistent stream instead of lockstep
+        # request/response per pooled stream — RF>1 coordinator
+        # assist overlaps its peer frames the way the native fan-out
+        # engine does, including when that engine is unavailable
+        # (mixed local connections, stream repair in progress,
+        # DBEEL_NO_QF).
+        self.pipeline = pooled and _pipeline_enabled()
+        self._pipe: "Optional[_PipeStream]" = None
+        self._pipe_lock: "Optional[asyncio.Lock]" = None
+        self.pipelined_ops = 0  # frames sent while others in flight
 
     @classmethod
     def from_config(
@@ -248,6 +331,9 @@ class RemoteShardConnection:
         for _r, w in self._pool:
             w.close()
         self._pool.clear()
+        if self._pipe is not None:
+            self._pipe.kill(f"connection to {self.address} closed")
+            self._pipe = None
 
     def _maybe_pool(self, reader, writer) -> None:
         if self._pool_closed or len(self._pool) >= self.MAX_POOL:
@@ -339,11 +425,152 @@ class RemoteShardConnection:
             writer.close()
         return response
 
+    # ---- pipelined stream (all-native serving path) ------------------
+
+    async def _pipe_stream(self) -> _PipeStream:
+        """The live multiplexed stream, connecting (once) if needed.
+        Concurrent ops share one connect attempt via the lock; a
+        failed connect raises to every waiter and the next op
+        retries."""
+        if self._pipe_lock is None:
+            self._pipe_lock = asyncio.Lock()
+        while True:
+            st = self._pipe
+            if st is not None and not st.dead:
+                return st
+            async with self._pipe_lock:
+                if self._pipe is None or self._pipe.dead:
+                    if self._pool_closed:
+                        raise ConnectionError_(
+                            f"connection to {self.address} closed"
+                        )
+                    reader, writer = await self._connect()
+                    st = _PipeStream(reader, writer)
+                    self._pipe = st
+                    st.task = asyncio.get_event_loop().create_task(
+                        self._pipe_read_loop(st)
+                    )
+
+    async def _pipe_read_loop(self, st: _PipeStream) -> None:
+        """Single reader per stream: each response frame resolves the
+        oldest in-flight future (the peer server releases responses
+        strictly in frame-arrival order).  Any read error — EOF from
+        an idle-closed peer, a reset, a malformed length — kills the
+        stream and fails whatever was in flight; senders retry once
+        on a fresh stream (idempotent by design, shards.rs:544)."""
+        try:
+            while not st.dead:
+                payload = await read_frame(st.reader)
+                if not st.inflight:
+                    # A response nothing asked for: protocol desync —
+                    # never guess at FIFO matching again.
+                    raise ProtocolError(
+                        f"unsolicited frame from {self.address}"
+                    )
+                fut = st.inflight.popleft()
+                if not fut.done():
+                    fut.set_result(payload)
+        except Exception as e:
+            st.kill(f"peer stream to {self.address} died: {e}")
+        finally:
+            if self._pipe is st:
+                self._pipe = None
+
+    async def _pipe_rpc(self, framed: bytes) -> bytes:
+        """One frame through the multiplexed stream: write (never
+        interleaved — the whole frame is buffered before any await),
+        then await this op's FIFO slot.  A read timeout kills the
+        stream (a late response would mis-match a newer op); a dead
+        stream fails the slot and the op retries ONCE on a fresh
+        stream — re-sending a possibly-processed request is safe for
+        the same idempotency reason the pooled path already re-sends
+        on stale streams."""
+        if _faults:
+            await _apply_fault(self)
+        last: Optional[BaseException] = None
+        for attempt in (0, 1):
+            st = await self._pipe_stream()
+            fut = asyncio.get_event_loop().create_future()
+            if st.inflight:
+                self.pipelined_ops += 1
+            st.inflight.append(fut)
+            st.writer.write(framed)
+            try:
+                await asyncio.wait_for(
+                    st.writer.drain(), self.write_timeout
+                )
+                return await asyncio.wait_for(
+                    fut, self.read_timeout
+                )
+            except asyncio.TimeoutError as e:
+                # Write-drain timeout: our own future is still
+                # pending in the FIFO — cancel it so kill()'s
+                # set_exception has nothing to attach to an
+                # un-awaited future ("exception was never
+                # retrieved" log spam under slow peers).  After a
+                # fut-wait timeout, wait_for already cancelled it.
+                fut.cancel()
+                st.kill(f"rpc to {self.address} timed out")
+                raise Timeout(f"rpc to {self.address}") from e
+            except ConnectionError_ as e:
+                last = e
+            except (OSError, asyncio.IncompleteReadError) as e:
+                st.kill(f"peer stream to {self.address} died: {e}")
+                last = e
+            except BaseException:
+                # Cancellation mid-flight: the future stays in the
+                # FIFO to absorb its response when it arrives (the
+                # done() guard makes the set_result a no-op), so the
+                # stream stays in sync and later ops keep their
+                # slots.
+                raise
+        raise ConnectionError_(
+            f"rpc to {self.address}: {last}"
+        ) from last
+
     async def send_message(self, message: list) -> list:
-        """Send one message, read one reply."""
+        """Send one message, read one reply.  Quick data verbs on a
+        pipelined pooled connection multiplex FIFO with other
+        in-flight work instead of claiming a pooled stream for a full
+        round trip."""
+        if (
+            self.pipeline
+            and isinstance(message, (list, tuple))
+            and len(message) > 1
+            and message[0] == "request"
+            and message[1] in _PIPE_KINDS
+        ):
+            buf = pack_message(message)
+            if len(buf) <= _PIPE_MAX_FRAME and (
+                message[1] not in _MULTI_KINDS
+                or len(message) < 4
+                or len(message[3]) <= _PIPE_MAX_SUBOPS
+            ):
+
+                async def op() -> list:
+                    return unpack_message(
+                        await self._pipe_rpc(
+                            _LEN.pack(len(buf)) + buf
+                        )
+                    )
+
+                return await self._rpc_accounted(op, len(buf))
         return await self._rpc(
             lambda r, w: self._round_trip(r, w, message)
         )
+
+    async def _rpc_accounted(self, op, nbytes: int):
+        """The _rpc admission/accounting envelope for pipelined ops
+        (which manage their own stream instead of op(reader,
+        writer))."""
+        self._admit(nbytes)
+        self.inflight_ops += 1
+        self.inflight_bytes += nbytes
+        try:
+            return await op()
+        finally:
+            self.inflight_ops -= 1
+            self.inflight_bytes -= nbytes
 
     async def _round_trip_packed(
         self, reader, writer, framed: bytes
@@ -359,7 +586,14 @@ class RemoteShardConnection:
         length prefix — e.g. the native coordinator's peer frame) and
         return the raw response payload bytes (length prefix
         stripped, NOT unpacked).  Callers byte-compare against the
-        expected constant ack and only unpack on mismatch."""
+        expected constant ack and only unpack on mismatch.  On a
+        pipelined connection the frame multiplexes FIFO with other
+        in-flight work — only data verbs travel this path, so
+        eligibility needs no inspection."""
+        if self.pipeline and len(framed) <= _PIPE_MAX_FRAME:
+            return await self._rpc_accounted(
+                lambda: self._pipe_rpc(framed), len(framed)
+            )
         return await self._rpc(
             lambda r, w: self._round_trip_packed(r, w, framed),
             nbytes=len(framed),

@@ -43,6 +43,14 @@ class _TableDesc(ctypes.Structure):
         ("p1", ctypes.c_uint64),
         ("p2", ctypes.c_uint64),
         ("n_samples", ctypes.c_uint64),
+        # CRC sidecar (ISSUE 6): borrowed u32[] page-CRC arrays from
+        # checksums.TableSums; 0 = no sidecar (legacy table, probes
+        # serve unverified — the Python read path's rule).
+        ("data_size", ctypes.c_uint64),
+        ("sums_data", ctypes.c_uint64),
+        ("sums_index", ctypes.c_uint64),
+        ("n_sums_data", ctypes.c_uint64),
+        ("n_sums_index", ctypes.c_uint64),
     ]
 
 # Full wire response for a successful set/delete: u32-LE length +
@@ -106,10 +114,54 @@ class DataPlane:
             ) in ("", "0")
         )
         # DBEEL_DP_NO_COORD=1 disables the native coordinator assist
-        # for RF>1 client writes (A/B benching).
-        self._has_coord = hasattr(
-            lib, "dbeel_dp_handle_coord"
-        ) and os.environ.get("DBEEL_DP_NO_COORD", "0") in ("", "0")
+        # for RF>1 client writes (A/B benching).  The assist's get
+        # trailer grew 17->25 bytes (propagated deadline, ISSUE 6),
+        # so a stale .so that exports dbeel_dp_handle_coord but not
+        # the ISSUE-6 ABI would be misparsed — refuse the assist
+        # entirely (RF>1 ops fall back to the interpreted
+        # coordinator, which is always correct).
+        self._has_coord = (
+            hasattr(lib, "dbeel_dp_handle_coord")
+            and hasattr(lib, "dbeel_dp_set_overload")
+            and os.environ.get("DBEEL_DP_NO_COORD", "0") in ("", "0")
+        )
+        # All-native serving path (ISSUE 6): multi-op frames, native
+        # shed/deadline answers, CRC-verified probes.  One ABI gate —
+        # a stale .so without it keeps the PR-5 behavior (FAST_MISS
+        # under hard overload, multi frames punt).
+        self._has_native6 = hasattr(lib, "dbeel_dp_set_overload")
+        self._shed_armed = False
+        # DBEEL_DP_NO_MULTI=1 punts client MULTI frames to the Python
+        # fallback (A/B gate for the native-floor bench: the
+        # interpreted multi path measured same-session on an
+        # otherwise identical server).
+        if (
+            self._has_native6
+            and hasattr(lib, "dbeel_dp_set_multi")
+            and os.environ.get("DBEEL_DP_NO_MULTI", "0")
+            not in ("", "0")
+        ):
+            lib.dbeel_dp_set_multi.restype = None
+            lib.dbeel_dp_set_multi.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+            ]
+            lib.dbeel_dp_set_multi(self._handle, 0)
+        # CRC sidecar verification in the C table probes
+        # (DBEEL_DP_VERIFY=0 disables; follows the Python read path's
+        # DBEEL_NO_CHECKSUMS master switch otherwise).  Moot where
+        # preadv2/RWF_NOWAIT is absent (every probe punts before
+        # reading); required wherever it exists, or the native read
+        # path would be the one unverified surface.
+        self._verify_crc = False
+        if self._has_native6 and os.environ.get(
+            "DBEEL_DP_VERIFY", "1"
+        ) not in ("0",):
+            from ..storage import checksums
+
+            self._verify_crc = checksums.verification_enabled()
+            if self._verify_crc:
+                lib.dbeel_dp_set_verify(self._handle, 1)
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -237,9 +289,15 @@ class DataPlane:
             # Most write-state notifications (memtable swaps, warm
             # completions of already-registered tables) don't change
             # the registry inputs: skip the dup/close syscall churn
-            # when the (table, index-built) fingerprint is unchanged.
+            # when the (table, index-built, sidecar) fingerprint is
+            # unchanged.
             fp = tuple(
-                (id(t), t._fast is not None, t._sparse is not None)
+                (
+                    id(t),
+                    t._fast is not None,
+                    t._sparse is not None,
+                    t.sums is not None,
+                )
                 for t in tables
             )
             if self._table_fps.get(name) == fp:
@@ -273,7 +331,31 @@ class DataPlane:
                     d.n_samples = len(p1)
                 else:
                     d.stride = 0
-                refs.append((t, bloom, fast, sparse))
+                sums_ref = None
+                sums = getattr(t, "sums", None)
+                if self._verify_crc and sums is not None:
+                    # Borrowed contiguous u32 CRC arrays for the C
+                    # probe verifier (parity with checksums.page_crcs
+                    # — golden-tested via dbeel_crc32_pages).  The
+                    # deserialize path hands array('I') already; the
+                    # write path hands plain lists — normalize once.
+                    import array as _array
+
+                    dc = sums.data_crcs
+                    if not isinstance(dc, _array.array):
+                        dc = _array.array("I", dc)
+                    ic = sums.index_crcs
+                    if not isinstance(ic, _array.array):
+                        ic = _array.array("I", ic)
+                    d.data_size = t.data_size
+                    if len(dc):
+                        d.sums_data = dc.buffer_info()[0]
+                        d.n_sums_data = len(dc)
+                    if len(ic):
+                        d.sums_index = ic.buffer_info()[0]
+                        d.n_sums_index = len(ic)
+                    sums_ref = (dc, ic)
+                refs.append((t, bloom, fast, sparse, sums_ref))
             rc = lib.dbeel_dp_set_tables(
                 self._handle, nm, len(nm), descs, len(tables)
             )
@@ -309,26 +391,93 @@ class DataPlane:
         shard), 2 = cyclic range (lo, hi] for replica_index 0."""
         self._lib.dbeel_dp_set_ownership(self._handle, mode, lo, hi)
 
+    def set_overload(self, level: int) -> None:
+        """Mirror the governor's level into C (ISSUE 6): at hard (2)
+        the client plane answers data verbs with the prebuilt
+        retryable Overloaded response instead of feeding the backlog
+        — shed frames never reach the Python dispatcher."""
+        if self._has_native6:
+            self._lib.dbeel_dp_set_overload(self._handle, level)
+
+    def set_overload_responses(
+        self, shed_resp: bytes, deadline_resp: bytes
+    ) -> None:
+        """Install the COMPLETE wire responses (u32-LE length +
+        payload + type byte) for native sheds and expired-deadline
+        drops, packed by the caller with the Python msgpack encoder
+        so the two paths stay byte-identical."""
+        if self._has_native6:
+            self._lib.dbeel_dp_set_overload_resp(
+                self._handle,
+                shed_resp,
+                len(shed_resp),
+                deadline_resp,
+                len(deadline_resp),
+            )
+            self._shed_armed = True
+
+    @property
+    def shed_armed(self) -> bool:
+        """True once the native hard-overload gate can answer sheds
+        itself (native6 ABI + responses installed): the Python
+        dispatcher may then leave shedding of parseable data verbs
+        entirely to the C side."""
+        return self._shed_armed
+
     # ---- serving -----------------------------------------------------
 
-    def try_handle(
-        self, frame: bytes
-    ) -> Optional[Tuple[bytes, bool, Optional[object], str, object]]:
+    # Verb codes in flags bits 24..26 of a native drop/shed.
+    _VERBS = {1: "set", 2: "get", 3: "delete", 4: "multi_set",
+              5: "multi_get"}
+
+    def try_handle(self, frame: bytes) -> Optional[tuple]:
         """Returns (response_bytes, keepalive, tree_needing_flush, op,
-        defer) when the frame was fully handled natively; None to
-        punt.  ``defer`` is None, or ``(syncer, ticket)`` for
+        defer, extra) when the frame was fully handled natively; None
+        to punt.  ``defer`` is None, or ``(syncer, ticket)`` for
         wal-sync trees — the caller must park the response until the
-        syncer's watermark covers the ticket."""
+        syncer's watermark covers the ticket.  ``extra`` is None for
+        single ops, ``("multi", n)`` for a batched frame of n sub-ops
+        (caller records batch metrics), ``("shed",)`` for a native
+        hard-overload shed, ``("deadline",)`` for an expired-client-
+        deadline drop — the caller mirrors the governor/metrics
+        bookkeeping the Python path would have done."""
         flags = self._call_grow(self._lib.dbeel_dp_handle, frame)
         if flags < 0:
             return None
         keepalive = bool(flags & 1)
-        if flags & 4:  # get served from a memtable
+        cls = (flags >> 6) & 3
+        if cls == 3:
+            # Dropped natively (out holds the prebuilt retryable
+            # Overloaded response): shed at hard overload (bit 27) or
+            # client deadline expired before dispatch.
+            return (
+                self._get_buf[: self._out_len.value],
+                keepalive,
+                None,
+                self._VERBS.get((flags >> 24) & 7, "invalid"),
+                None,
+                ("shed",) if flags & (1 << 27) else ("deadline",),
+            )
+        if cls:
+            # MULTI_SET (1) / MULTI_GET (2): per-sub-op results (or
+            # the whole-frame apply error, bit4) already packed in
+            # the out buffer; sub-op count rides bits 32+.
+            op = "multi_set" if cls == 1 else "multi_get"
+            return (
+                self._get_buf[: self._out_len.value],
+                keepalive,
+                self._flush_tree_from_flags(flags),
+                op,
+                self._sync_defer_from_flags(flags, 0x20),
+                ("multi", (flags >> 32) & 0x3FFF),
+            )
+        if flags & 4:  # get served from a memtable/sstable probe
             return (
                 self._get_buf[: self._out_len.value],
                 keepalive,
                 None,
                 "get",
+                None,
                 None,
             )
         op = "delete" if flags & 8 else "set"
@@ -345,6 +494,7 @@ class DataPlane:
             self._flush_tree_from_flags(flags),
             op,
             self._sync_defer_from_flags(flags, 0x20),
+            None,
         )
 
     def _call_grow(self, fn, frame: bytes) -> int:
@@ -443,7 +593,10 @@ class DataPlane:
         append failed) — send it, skip the fan-out; defer (11th) is
         None or (syncer, ticket): under wal-sync the local ack only
         counts once the watermark covers the ticket, so await it
-        alongside the quorum fan-out."""
+        alongside the quorum fan-out; deadline_ms (12th) is the
+        propagated wall-clock budget the C side stamped on the peer
+        frame (gets only) — the Python-packed digest round must carry
+        the same budget."""
         if not self._has_coord:
             return None
         flags = self._call_grow(
@@ -476,25 +629,34 @@ class DataPlane:
                 None,
                 out[4:],
                 None,
+                None,
             )
         peer_len = 4 + int.from_bytes(out[:4], "little")
         peer_frame = out[:peer_len]
         local_entry = None
         key = None
+        deadline_ms = None
         if flags & 8:
             op = "get"
+            # 25-byte trailer header (ISSUE 6): hit flag, value len,
+            # ts, key len, then the propagated wall-clock deadline
+            # the C side stamped on the peer frame — the digest round
+            # (whose frame Python packs) must carry the SAME budget.
             trailer = out[peer_len:]
             vlen = int.from_bytes(trailer[1:5], "little")
             klen = int.from_bytes(trailer[13:17], "little")
+            deadline_ms = int.from_bytes(
+                trailer[17:25], "little", signed=True
+            )
             if trailer[0]:
                 ts = int.from_bytes(
                     trailer[5:13], "little", signed=True
                 )
-                local_entry = (trailer[17 : 17 + vlen], ts)
+                local_entry = (trailer[25 : 25 + vlen], ts)
             else:
                 local_entry = ("miss",)
                 vlen = 0
-            key = trailer[17 + vlen : 17 + vlen + klen]
+            key = trailer[25 + vlen : 25 + vlen + klen]
         else:
             op = "delete" if flags & 4 else "set"
         cons_p1 = (flags >> 24) & 0xFF
@@ -510,22 +672,24 @@ class DataPlane:
             key,
             None,
             self._sync_defer_from_flags(flags, 0x20),
+            deadline_ms,
         )
 
     def try_handle_shard(
         self, frame: bytes
-    ) -> Optional[
-        Tuple[Optional[bytes], Optional[object], bool, object]
-    ]:
+    ) -> Optional[tuple]:
         """Replica-plane fast path for one remote-shard-protocol
         message (raw msgpack list bytes, no length prefix).  Returns
         (response_frame_or_None, tree_needing_flush, notify_set,
-        defer) when handled natively — the response already carries
-        its 4-byte-LE length prefix; notify_set means the caller
-        fires ITEM_SET_FROM_SHARD_MESSAGE (set writes only, matching
-        the Python handler); defer is None or (syncer, ticket): park
-        the ack (and the notification) until the WAL sync watermark
-        covers the ticket — or None to punt to
+        defer, deadline_dropped) when handled natively — the response
+        already carries its 4-byte-LE length prefix; notify_set means
+        the caller fires ITEM_SET_FROM_SHARD_MESSAGE (set writes
+        only, matching the Python handler); defer is None or
+        (syncer, ticket): park the ack (and the notification) until
+        the WAL sync watermark covers the ticket; deadline_dropped
+        means the frame's propagated budget had expired and the
+        response is the native retryable Overloaded error (the caller
+        counts the replica deadline drop) — or None to punt to
         handle_shard_message."""
         if not self._has_shard_plane:
             return None
@@ -543,6 +707,7 @@ class DataPlane:
             self._flush_tree_from_flags(flags),
             notify_set,
             self._sync_defer_from_flags(flags, 0x40),
+            bool(flags & 0x80),
         )
 
     def stats(self) -> dict:
@@ -569,10 +734,33 @@ class DataPlane:
             out["fast_coord_gets"] = int(
                 self._lib.dbeel_dp_fast_coord_gets(self._handle)
             )
+        if self._has_native6:
+            h = self._handle
+            out["fast_multi_sets"] = int(
+                self._lib.dbeel_dp_fast_multi_sets(h)
+            )
+            out["fast_multi_gets"] = int(
+                self._lib.dbeel_dp_fast_multi_gets(h)
+            )
+            out["native_sheds"] = int(
+                self._lib.dbeel_dp_native_sheds(h)
+            )
+            out["native_deadline_drops"] = int(
+                self._lib.dbeel_dp_native_deadline_drops(h)
+            )
+            out["crc_failures"] = int(
+                self._lib.dbeel_dp_crc_failures(h)
+            )
+            out["verify_crc"] = int(self._verify_crc)
         return out
 
 
 def create_dataplane() -> Optional[DataPlane]:
+    # Master kill switch (A/B gate for the native-floor bench and
+    # fallback drills): the server runs the all-Python serving path
+    # it would use on a host without the .so.
+    if os.environ.get("DBEEL_NO_DATAPLANE", "0") not in ("", "0"):
+        return None
     try:
         from ..storage import native as native_mod
 

@@ -27,6 +27,7 @@ from ..errors import (
     KeyNotFound,
     KeyNotOwnedByShard,
     MissingField,
+    ERROR_CLASS_OVERLOAD,
     Overloaded,
     PeerDead,
     Timeout,
@@ -886,6 +887,31 @@ def _frame_response(buf: bytes) -> bytes:
     return struct.pack("<I", len(buf)) + buf
 
 
+def install_native_overload_responses(my_shard: MyShard) -> None:
+    """Arm the native hard-overload/deadline answers (all-native
+    serving path): the C client plane returns these COMPLETE wire
+    frames for shed and dead-on-arrival data verbs, so a flood being
+    shed never touches the interpreter it is flooding.  Packed here
+    with the same encoder and message text as the interpreted shed
+    path (_dispatch) and the dispatcher's deadline drop
+    (handle_request), so the two paths answer byte-identically."""
+    dp = my_shard.dataplane
+    if dp is None:
+        return
+
+    def pack(msg: str) -> bytes:
+        e = Overloaded(msg)
+        return _frame_response(
+            msgpack.packb(e.to_wire(), use_bin_type=True)
+            + bytes([RESPONSE_ERR])
+        )
+
+    dp.set_overload_responses(
+        pack(f"shard {my_shard.shard_name} shedding load"),
+        pack("client deadline expired before dispatch"),
+    )
+
+
 def _get_timeout_ms(req: dict) -> int:
     """Per-op timeout field, defaulted/sanitized (wire input)."""
     t = req.get("timeout")
@@ -926,6 +952,7 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
         key,
         error_resp,
         defer,
+        deadline_ms,
     ) = coord
     if flush_tree is not None:
         my_shard.spawn(flush_tree.flush())
@@ -955,6 +982,7 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                 key,
                 consistency,
                 timeout_ms or DEFAULT_GET_TIMEOUT_MS,
+                deadline_ms,
             )
         else:
             is_delete = op == "delete"
@@ -1004,6 +1032,7 @@ async def _finish_coord_get(
     key: bytes,
     consistency: int,
     timeout_ms: int,
+    deadline_ms: Optional[int] = None,
 ) -> bytes:
     """Quorum-merge for a coordinator-assisted get: digest round
     first (replicas answer (ts, hash); agreement = C byte-compare in
@@ -1033,6 +1062,7 @@ async def _finish_coord_get(
             col.replication_factor - 1,
             timeout_ms / 1000,
             op_status=op_status,
+            deadline_ms=deadline_ms,
         ):
             if (
                 local_value is None
@@ -1212,20 +1242,29 @@ class _DbProtocol(framed.FramedServerProtocol):
         # task hop, no interpreter dispatch.  Only consulted by
         # data_received when nothing is queued or in flight, so the
         # direct transport.write cannot overtake a parked response.
-        if self.shard.governor.should_shed():
-            # Hard overload: the native plane must not keep feeding
-            # the backlogged memtable/WAL behind the governor's back —
-            # queue the frame so _dispatch parses it and sheds data
-            # ops (admin frames still serve there).
-            return framed.FAST_MISS
         dp = self.shard.dataplane
+        if self.shard.governor.should_shed() and (
+            dp is None or not dp.shed_armed
+        ):
+            # Hard overload without the native shed gate (no .so, or
+            # a stale one): the native plane must not keep feeding
+            # the backlogged memtable/WAL behind the governor's back
+            # — queue the frame so _dispatch parses it and sheds
+            # data ops (admin frames still serve there).  With the
+            # gate armed, the governor's level is already mirrored
+            # into C and try_handle answers data verbs with the
+            # prebuilt retryable Overloaded response — the flood
+            # being shed never reaches the interpreter.
+            return framed.FAST_MISS
         if dp is None:
             return framed.FAST_MISS
         started = time.monotonic()
         fast = dp.try_handle(frame)
         if fast is None:
             return framed.FAST_MISS
-        resp, keepalive, flush_tree, op, defer = fast
+        resp, keepalive, flush_tree, op, defer, extra = fast
+        if extra is not None:
+            self._note_native_extra(op, extra)
         if flush_tree is not None:
             self.shard.spawn(flush_tree.flush())
         if defer is not None:
@@ -1257,6 +1296,26 @@ class _DbProtocol(framed.FramedServerProtocol):
             return framed.FAST_CLOSE
         self._write_out(resp)
         return framed.FAST_HANDLED
+
+    def _note_native_extra(self, op: str, extra: tuple) -> None:
+        """Mirror the governor/metrics bookkeeping the Python path
+        would have performed for a frame the C side answered: sheds
+        and deadline drops count exactly like their interpreted twins
+        (the stats schema cannot depend on which path answered), and
+        multi frames record their batch-size histogram point."""
+        shard = self.shard
+        kind = extra[0]
+        if kind == "multi":
+            shard.metrics.record_batch_size(extra[1])
+            return
+        if kind == "shed":
+            shard.governor.record_shed(op)
+        else:  # "deadline": expired client budget dropped in C
+            shard.governor.deadline_drops += 1
+        shard.native_drops_by_op[op] = (
+            shard.native_drops_by_op.get(op, 0) + 1
+        )
+        shard.metrics.record_error(ERROR_CLASS_OVERLOAD)
 
     # -- pipelined drain --------------------------------------------
 
@@ -1331,19 +1390,36 @@ class _DbProtocol(framed.FramedServerProtocol):
         this connection."""
         gov = self.shard.governor
         shedding = gov.should_shed()
-        dp = None if shedding else self.shard.dataplane
-        if (
-            dp is not None
-            and self.writable.is_set()
-            and len(self.parked) <= self.PENDING_HIGH
+        dp = self.shard.dataplane
+        if shedding and (dp is None or not dp.shed_armed):
+            # Hard overload without the native shed gate: only the
+            # interpreted shed branch below may answer data ops.
+            dp = None
+        if dp is not None and (
+            (
+                self.writable.is_set()
+                and len(self.parked) <= self.PENDING_HIGH
+            )
+            # Shedding with the gate armed bypasses the writability/
+            # parked-depth bounds: every parseable data verb comes
+            # back as the prebuilt tiny Overloaded frame, terminal
+            # and parked in order like any response — the interpreted
+            # branch would park the SAME bytes at ~30x the cost, and
+            # memory stays bounded by the pending-queue watermark
+            # either way.  Real (non-shed) serving keeps the bounds.
+            or (shedding and dp.shed_armed)
         ):
             # Queued-frame native fast path: a cheap memtable get
             # behind a slow quorum op is answered NOW; the parked
-            # slot keeps its response in arrival order.
+            # slot keeps its response in arrival order.  Under hard
+            # overload this is the native shed gate: data verbs come
+            # back as prebuilt Overloaded responses.
             started = time.monotonic()
             fast = dp.try_handle(frame)
             if fast is not None:
-                resp, keepalive, flush_tree, op, defer = fast
+                resp, keepalive, flush_tree, op, defer, extra = fast
+                if extra is not None:
+                    self._note_native_extra(op, extra)
                 if flush_tree is not None:
                     self.shard.spawn(flush_tree.flush())
                 if defer is not None:
@@ -1366,7 +1442,14 @@ class _DbProtocol(framed.FramedServerProtocol):
         # the local write applies in frame-arrival order, so two
         # pipelined writes to one key keep their server-timestamp
         # order; only the fan-out/quorum wait runs concurrently.
-        coord = dp.try_handle_coord(frame) if dp is not None else None
+        # Never while shedding — a frame the shed gate punted (admin,
+        # exotic shape) must not sneak a data op past admission via
+        # the assist; the interpreted branch below sheds it.
+        coord = (
+            dp.try_handle_coord(frame)
+            if dp is not None and not shedding
+            else None
+        )
         req = None
         keepalive = True
         if coord is not None:
@@ -1388,9 +1471,14 @@ class _DbProtocol(framed.FramedServerProtocol):
                 # error NOW instead of adding this op to the backlog
                 # that made the shard overloaded.  The error frame
                 # takes an in-order parked slot like any response;
-                # non-keepalive semantics are preserved.
+                # non-keepalive semantics are preserved.  With the
+                # native shed gate armed only frames the C parser
+                # punted land here — python_sheds measures exactly
+                # that residue (the bench's zero-Python-dispatch
+                # acceptance counter).
                 op = str(req.get("type"))
                 gov.record_shed(op)
+                gov.python_sheds += 1
                 err = Overloaded(
                     f"shard {self.shard.shard_name} shedding load"
                 )
@@ -1450,8 +1538,20 @@ class _DbProtocol(framed.FramedServerProtocol):
     def _batchable_get(self, req: dict) -> bool:
         """Eligible for drain-level get coalescing: a keepalive get
         on an RF=1 collection (quorum gets keep their per-frame
-        fan-out brain)."""
+        fan-out brain).  Dead-on-arrival gets (expired client
+        deadline) are NOT batchable — they fall through to
+        handle_request, whose dispatch check drops them with the
+        counted retryable error; the batch path used to serve them,
+        silently diverging from both the native plane and the
+        unbatched path."""
         if req.get("type") != "get" or not req.get("keepalive"):
+            return False
+        deadline_ms = req.get("deadline_ms")
+        if (
+            isinstance(deadline_ms, int)
+            and deadline_ms > 0
+            and time.time() * 1000.0 > deadline_ms
+        ):
             return False
         col = self.shard.collections.get(req.get("collection"))
         return col is not None and col.replication_factor == 1

@@ -118,10 +118,12 @@ class LoadGovernor:
         "_lag_ewma",
         "_hb_task",
         "_dead_ewma",
+        "_pushed_level",
         "dead_completions",
         # counters (get_stats.overload)
         "shed_ops",
         "shed_by_op",
+        "python_sheds",
         "deadline_drops",
         "replica_deadline_drops",
         "bg_delays",
@@ -144,9 +146,15 @@ class LoadGovernor:
         self._lag_ewma = 0.0
         self._hb_task = None
         self._dead_ewma = 0.0
+        self._pushed_level: Optional[int] = None
         self.dead_completions = 0
         self.shed_ops = 0
         self.shed_by_op: dict = {}
+        # Sheds that had to run through the Python dispatcher (frame
+        # shapes the C parser punts).  With the native shed gate
+        # armed this stays ~0 under a client flood — the measurable
+        # claim of the all-native serving path.
+        self.python_sheds = 0
         self.deadline_drops = 0
         self.replica_deadline_drops = 0
         self.bg_delays = 0
@@ -251,6 +259,7 @@ class LoadGovernor:
 
     def level(self) -> int:
         if self._forced is not None:
+            self._push_level(self._forced)
             return self._forced
         self._ensure_heartbeat()
         now = time.monotonic()
@@ -263,7 +272,21 @@ class LoadGovernor:
                     self.hard_transitions += 1
                 else:
                     self.soft_transitions += 1
+        self._push_level(self._level)
         return self._level
+
+    def _push_level(self, level: int) -> None:
+        """Mirror the level into the native data plane (all-native
+        serving path): at LEVEL_HARD the C client plane answers data
+        verbs with the prebuilt retryable Overloaded response itself,
+        so shed frames never reach the Python dispatcher whose
+        backlog the governor is protecting."""
+        if level == self._pushed_level:
+            return
+        self._pushed_level = level
+        dp = getattr(self.shard, "dataplane", None)
+        if dp is not None:
+            dp.set_overload(level)
 
     # -- decision points ----------------------------------------------
 
@@ -310,6 +333,7 @@ class LoadGovernor:
             "signals": dict(self._signals),
             "shed_ops": self.shed_ops,
             "shed_by_op": dict(self.shed_by_op),
+            "python_sheds": self.python_sheds,
             "deadline_drops": self.deadline_drops,
             "replica_deadline_drops": self.replica_deadline_drops,
             "dead_completions": self.dead_completions,

@@ -125,11 +125,16 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
         fast = dp.try_handle_shard(frame)
         if fast is None:
             return framed.FAST_MISS
-        # Replica-side serving is foreground work (set/delete/get
-        # only on this path; the anti-entropy exemption applies to
-        # RANGE_* messages, which always punt).
+        # Replica-side serving is foreground work (set/delete/get/
+        # multi only on this path; the anti-entropy exemption applies
+        # to RANGE_* messages, which always punt).
         self.shard.scheduler.fg_mark()
-        resp, flush_tree, notify_set, defer = fast
+        resp, flush_tree, notify_set, defer, deadline_dropped = fast
+        if deadline_dropped:
+            # Expired propagated budget answered natively with the
+            # retryable Overloaded frame: count it exactly like the
+            # interpreted drop (handle_shard_request parity).
+            self.shard.governor.replica_deadline_drops += 1
         if flush_tree is not None:
             self.shard.spawn(flush_tree.flush())
         if defer is not None:

@@ -419,6 +419,46 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.dbeel_dp_fast_table_gets.restype = ctypes.c_uint64
             lib.dbeel_dp_fast_table_gets.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "dbeel_dp_set_overload"):
+            # All-native serving path (ISSUE 6): multi-op frames,
+            # native overload/deadline answers, CRC probe
+            # verification.  Gated together: one build ships them
+            # all.
+            lib.dbeel_dp_set_overload.restype = None
+            lib.dbeel_dp_set_overload.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+            ]
+            lib.dbeel_dp_set_overload_resp.restype = None
+            lib.dbeel_dp_set_overload_resp.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+                ctypes.c_uint32,
+            ]
+            lib.dbeel_dp_set_verify.restype = None
+            lib.dbeel_dp_set_verify.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+            ]
+            for fn in (
+                lib.dbeel_dp_fast_multi_sets,
+                lib.dbeel_dp_fast_multi_gets,
+                lib.dbeel_dp_native_sheds,
+                lib.dbeel_dp_native_deadline_drops,
+                lib.dbeel_dp_crc_failures,
+            ):
+                fn.restype = ctypes.c_uint64
+                fn.argtypes = [ctypes.c_void_p]
+            lib.dbeel_crc32_pages.restype = None
+            lib.dbeel_crc32_pages.argtypes = [
+                u8p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint32),
+            ]
+            lib.dbeel_odirect_fallbacks.restype = ctypes.c_uint64
+            lib.dbeel_odirect_fallbacks.argtypes = []
         lib.dbeel_dp_handle.restype = ctypes.c_int64
         lib.dbeel_dp_handle.argtypes = [
             ctypes.c_void_p,
@@ -526,6 +566,33 @@ def load_if_built() -> Optional[ctypes.CDLL]:
     if not os.path.exists(_LIB_PATH):
         return None
     return _load()
+
+
+_odirect_warned = False
+
+
+def odirect_fallbacks() -> int:
+    """Process-wide count of silent O_DIRECT → buffered degradations
+    in the C streamers (unaligned destination buffers, filesystems
+    refusing O_DIRECT).  Previously these fell back with NO signal —
+    the only symptom was a mysterious throughput cliff (ISSUE 6
+    satellite); now the count rides ``get_stats.durability`` and the
+    first occurrence logs a warning."""
+    global _odirect_warned
+    lib = _lib  # never triggers a build: observability must be free
+    if lib is None or not hasattr(lib, "dbeel_odirect_fallbacks"):
+        return 0
+    n = int(lib.dbeel_odirect_fallbacks())
+    if n and not _odirect_warned:
+        _odirect_warned = True
+        log.warning(
+            "O_DIRECT degraded to buffered I/O %d time(s) "
+            "(unaligned buffer or filesystem without O_DIRECT "
+            "support) — large merges/reads lose the page-cache "
+            "bypass",
+            n,
+        )
+    return n
 
 
 def murmur3_32_native(data: bytes, seed: int = 0) -> int:

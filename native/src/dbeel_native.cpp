@@ -270,6 +270,13 @@ namespace {
 constexpr uint64_t KALIGN = 4096;
 constexpr uint64_t KBUF = 8u << 20;  // 8 MiB staging buffers
 
+// Silent-degradation counter (ISSUE 6 satellite): every place the
+// O_DIRECT path quietly falls back to buffered IO — an unaligned
+// destination buffer, or a filesystem/open that refuses O_DIRECT —
+// increments this, so the degradation is visible in get_stats instead
+// of only as a mysterious throughput cliff.
+std::atomic<uint64_t> g_odirect_fallbacks{0};
+
 struct StreamFile {
   int fd = -1;
   uint8_t* buf = nullptr;  // KALIGN-aligned staging buffer
@@ -280,8 +287,11 @@ struct StreamFile {
 
   bool open_for_write(const char* path) {
     fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
-    if (fd < 0)  // filesystem without O_DIRECT: buffered fallback
+    if (fd < 0) {  // filesystem without O_DIRECT: buffered fallback
       fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      if (fd >= 0)
+        g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    }
     if (fd < 0) return false;
     buf = static_cast<uint8_t*>(std::aligned_alloc(KALIGN, KBUF));
     return buf != nullptr;
@@ -383,7 +393,13 @@ int64_t dbeel_read_file(const char* path, uint8_t* dst, uint64_t size) {
         done += (uint64_t)r;
       }
       ::close(fd);
+    } else {
+      g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
     }
+  } else if (body) {
+    // Unaligned destination: the whole read silently degrades to the
+    // buffered path below — count it (ISSUE 6 satellite).
+    g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
   }
   int fd = ::open(path, O_RDONLY);
   if (fd < 0) return -(int64_t)errno;
@@ -427,8 +443,12 @@ int64_t dbeel_read_file_cb(const char* path, uint8_t* dst,
   const bool aligned = (reinterpret_cast<uintptr_t>(dst) % KALIGN) == 0;
   const uint64_t body = size & ~(KALIGN - 1);
   uint64_t done = 0;
+  if (body && !aligned)
+    g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
   if (aligned && body) {
     int fd = ::open(path, O_RDONLY | O_DIRECT);
+    if (fd < 0)
+      g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
     if (fd >= 0) {
       while (done < body) {
         const uint64_t want = std::min(chunk, body - done);
@@ -489,6 +509,13 @@ int64_t dbeel_write_file_cb(const char* path, const uint8_t* data,
     }
   }
   return (f.close_sync() && ok) ? 0 : -1;
+}
+
+// Process-wide count of silent O_DIRECT → buffered degradations
+// (unaligned destination buffers, filesystems refusing O_DIRECT).
+// Surfaced in get_stats.durability so operators see the cliff.
+uint64_t dbeel_odirect_fallbacks(void) {
+  return g_odirect_fallbacks.load(std::memory_order_relaxed);
 }
 
 void* dbeel_writer_open(const char* data_path, const char* index_path) {
@@ -1201,6 +1228,15 @@ static uint32_t crc32z(const uint8_t* p, size_t n) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// zlib-compatible CRC of an n-byte prefix zero-padded to `padded`
+// bytes — exactly storage/checksums.py page_crcs' final-page rule.
+static uint32_t crc32z_pad(const uint8_t* p, size_t n, size_t padded) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = kCrc.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  for (size_t i = n; i < padded; i++) c = kCrc.t[c & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 constexpr uint32_t kWalMagic = 0x77A11065u;
 constexpr uint64_t kWalPage = 4096;
 
@@ -1291,6 +1327,25 @@ static bool mp_skip_n(MpCur& c, uint64_t count, int depth) {
   for (uint64_t i = 0; i < count; i++)
     if (!mp_skip(c, depth)) return false;
   return true;
+}
+
+// Array header limited to the shapes the multi handlers accept
+// (fixarray / array16); anything else makes the caller punt.
+static bool mp_rd_arrhdr16(MpCur& c, uint32_t* n) {
+  if (!mp_need(c, 1)) return false;
+  const uint8_t b = *c.p;
+  if (b >= 0x90 && b <= 0x9f) {
+    *n = b & 0x0f;
+    c.p++;
+    return true;
+  }
+  if (b == 0xdc) {
+    if (!mp_need(c, 3)) return false;
+    *n = ((uint32_t)c.p[1] << 8) | c.p[2];
+    c.p += 3;
+    return true;
+  }
+  return false;
 }
 
 // Skip one msgpack value of any type.
@@ -1537,6 +1592,16 @@ struct FastTable {
   uint64_t p1 = 0;  // sorted u64 big-endian key bytes 0..8
   uint64_t p2 = 0;  // sorted-within-p1-ties u64 key bytes 8..16
   uint64_t n_samples = 0;
+  // CRC sidecar (ISSUE 6 tentpole #3, parity with storage/checksums
+  // .py): per-4KiB-page u32 CRCs for the data and index files,
+  // BORROWED array buffers like the bloom/prefix fields (0 = table
+  // has no sidecar → probes serve unverified, the Python read path's
+  // legacy rule).  data_size bounds the tail page's logical bytes.
+  uint64_t data_size = 0;
+  uint64_t sums_data = 0;   // address of u32[n_sums_data], or 0
+  uint64_t sums_index = 0;  // address of u32[n_sums_index], or 0
+  uint64_t n_sums_data = 0;
+  uint64_t n_sums_index = 0;
 };
 
 struct FastCollection {
@@ -1599,8 +1664,35 @@ struct DataPlane {
   uint64_t fast_sets = 0, fast_gets = 0, fast_table_gets = 0;
   uint64_t fast_replica_ops = 0, fast_coord_writes = 0;
   uint64_t fast_coord_gets = 0;
+  // All-native serving path (ISSUE 6): multi-op counters, native
+  // overload/deadline answers, CRC probe verification.
+  uint64_t fast_multi_sets = 0, fast_multi_gets = 0;
+  uint64_t native_sheds = 0;          // hard-overload answers in C
+  uint64_t native_deadline_drops = 0;  // expired client budgets in C
+  uint64_t crc_failures = 0;           // sidecar mismatches in probes
+  int32_t verify_crc = 0;  // runtime flag (dbeel_dp_set_verify)
+  int32_t overload_level = 0;  // governor level (dbeel_dp_set_overload)
+  int32_t multi_enabled = 1;  // A/B gate (dbeel_dp_set_multi): 0
+                              // punts MULTI frames to the Python
+                              // fallback for same-session baselines
+  // Last CRC-verified page memo (sstable files are immutable):
+  // table_find's binary search preads the SAME index page on most
+  // of its final steps — without this, each step re-CRCs a full
+  // 4 KiB page to read 16 bytes.  Two slots ([0]=data, [1]=index)
+  // because every search step interleaves an index-record read with
+  // a data-file key read — one slot would thrash on exactly the
+  // loop the memo exists for.
+  int last_crc_fd[2] = {-1, -1};
+  uint64_t last_crc_page[2] = {0, 0};
+  // Prebuilt COMPLETE wire responses (u32-LE len + payload + type
+  // byte), packed by Python with its own msgpack encoder so the
+  // native answer is byte-identical to the Python handler's:
+  std::vector<uint8_t> shed_resp;      // ["Overloaded","shard ... shedding load"]
+  std::vector<uint8_t> deadline_resp;  // ["Overloaded","client deadline expired before dispatch"]
   std::vector<uint8_t> keybuf;  // probe scratch (grown on demand)
   std::vector<uint8_t> valbuf;  // table_find value scratch
+  std::vector<uint8_t> multibuf;  // multi-op response staging
+  std::vector<uint8_t> pagebuf;   // CRC-verified page staging
 };
 
 // Collection lookup by wire name slice — heterogeneous string_view
@@ -1614,13 +1706,16 @@ static FastCollection* dp_find_col(DataPlane* dp, const uint8_t* s,
   return &dp->cols[it->second];
 }
 
-static void dp_close_tables(FastCollection& col) {
+static void dp_close_tables(DataPlane* dp, FastCollection& col) {
   for (auto& t : col.tables) {
     if (t.data_fd >= 0) ::close(t.data_fd);
     if (t.index_fd >= 0) ::close(t.index_fd);
   }
   col.tables.clear();
   col.tables_valid = false;
+  // Closing table fds frees their numbers for reuse; a stale memo
+  // hit against a NEW file on the same fd would skip verification.
+  dp->last_crc_fd[0] = dp->last_crc_fd[1] = -1;
 }
 
 // Non-blocking positional read: succeeds only when the page cache can
@@ -1631,6 +1726,59 @@ static bool pread_nw(int fd, void* buf, size_t n, uint64_t off) {
   struct iovec iov{buf, n};
   const ssize_t r = ::preadv2(fd, &iov, 1, (off_t)off, RWF_NOWAIT);
   return r == (ssize_t)n;
+}
+
+constexpr uint64_t kProbePage = 4096;  // checksums.py PAGE_SIZE
+
+// Verified positional read for table probes (CRC sidecar parity with
+// storage/checksums.py, behind the dbeel_dp_set_verify runtime flag):
+// whole 4KiB pages covering [off, off+n) are NOWAIT-pread into
+// dp->pagebuf, each page's CRC compared against the borrowed sidecar
+// array (tail page zero-padded, exactly page_crcs' rule), and the
+// requested range copied out.  Returns 1 ok, 0 punt (cold page /
+// out-of-bounds / sidecar shorter than the file), -3 CRC mismatch
+// (counted; callers punt so the Python read path re-detects the
+// corruption and runs the quarantine machinery).  Tables without a
+// sidecar (legacy) and the flag-off default take the raw pread.
+static int table_pread(DataPlane* dp, const FastTable& t,
+                       bool index_file, void* buf, size_t n,
+                       uint64_t off) {
+  const uint64_t sums = index_file ? t.sums_index : t.sums_data;
+  const uint64_t n_sums = index_file ? t.n_sums_index : t.n_sums_data;
+  const int fd = index_file ? t.index_fd : t.data_fd;
+  if (!dp->verify_crc || sums == 0 || n_sums == 0)
+    return pread_nw(fd, buf, n, off) ? 1 : 0;
+  const uint64_t fsize =
+      index_file ? t.entry_count * 16ull : t.data_size;
+  if (n == 0) return 1;
+  if (off + n > fsize || fsize == 0) return 0;
+  const uint64_t pstart = off & ~(kProbePage - 1);
+  const uint64_t pend = (off + n + kProbePage - 1) & ~(kProbePage - 1);
+  const uint64_t span = pend - pstart;
+  // Only logical bytes exist on disk; the tail page's padding is
+  // zeros by the checksum contract.
+  const uint64_t readable =
+      (pend > fsize ? fsize : pend) - pstart;
+  if (dp->pagebuf.size() < span) dp->pagebuf.resize(span);
+  uint8_t* pb = dp->pagebuf.data();
+  if (!pread_nw(fd, pb, readable, pstart)) return 0;
+  if (readable < span) std::memset(pb + readable, 0, span - readable);
+  const uint32_t* crcs = (const uint32_t*)(uintptr_t)sums;
+  const int slot = index_file ? 1 : 0;
+  for (uint64_t p = pstart / kProbePage; p * kProbePage < pend; p++) {
+    if (p >= n_sums) return 0;  // sidecar/file mismatch: Python judges
+    if (fd == dp->last_crc_fd[slot] && p == dp->last_crc_page[slot])
+      continue;  // just verified this immutable page (memo)
+    if (crc32z(pb + (p * kProbePage - pstart), kProbePage) !=
+        crcs[p]) {
+      dp->crc_failures++;
+      return -3;
+    }
+    dp->last_crc_fd[slot] = fd;
+    dp->last_crc_page[slot] = p;
+  }
+  std::memcpy(buf, pb + (off - pstart), n);
+  return 1;
 }
 
 // Double-hashed bloom check — bit-for-bit the formula in
@@ -1730,7 +1878,7 @@ static int table_find(DataPlane* dp, const FastTable& t,
   uint8_t rec[16];
   while (lo < hi) {
     const uint64_t mid = lo + (hi - lo) / 2;
-    if (!pread_nw(t.index_fd, rec, 16, mid * 16)) return -1;
+    if (table_pread(dp, t, true, rec, 16, mid * 16) <= 0) return -1;
     uint64_t off;
     uint32_t ksz;
     std::memcpy(&off, rec, 8);
@@ -1738,13 +1886,14 @@ static int table_find(DataPlane* dp, const FastTable& t,
     if (ksz > kDpHardMax) return -1;  // exotic: interpreted path
     if (dp->keybuf.size() < ksz) dp->keybuf.resize(ksz);
     uint8_t* keybuf = dp->keybuf.data();
-    if (ksz != 0 && !pread_nw(t.data_fd, keybuf, ksz, off + 16))
+    if (ksz != 0 &&
+        table_pread(dp, t, false, keybuf, ksz, off + 16) <= 0)
       return -1;
     int cmp = std::memcmp(keybuf, key, ksz < kn ? ksz : kn);
     if (cmp == 0) cmp = ksz < kn ? -1 : (ksz > kn ? 1 : 0);
     if (cmp == 0) {
       uint8_t hdr[16];
-      if (!pread_nw(t.data_fd, hdr, 16, off)) return -1;
+      if (table_pread(dp, t, false, hdr, 16, off) <= 0) return -1;
       uint32_t klen, vlen;
       int64_t ts;
       std::memcpy(&klen, hdr, 4);
@@ -1758,7 +1907,7 @@ static int table_find(DataPlane* dp, const FastTable& t,
         return -2;
       }
       if (vlen != 0 &&
-          !pread_nw(t.data_fd, dst, vlen, off + 16 + klen))
+          table_pread(dp, t, false, dst, vlen, off + 16 + klen) <= 0)
         return -1;
       *val_out = dst;
       *vlen_out = vlen;
@@ -2107,7 +2256,7 @@ void* dbeel_dp_new(void) {
 void dbeel_dp_free(void* h) {
   auto* dp = static_cast<DataPlane*>(h);
   if (dp != nullptr)
-    for (auto& col : dp->cols) dp_close_tables(col);
+    for (auto& col : dp->cols) dp_close_tables(dp, col);
   delete dp;
 }
 
@@ -2168,7 +2317,7 @@ void dbeel_dp_unregister(void* h, const uint8_t* name, uint32_t nlen) {
   const auto it = dp->col_map.find(n);
   if (it == dp->col_map.end()) return;
   const size_t i = it->second;
-  dp_close_tables(dp->cols[i]);
+  dp_close_tables(dp, dp->cols[i]);
   dp->cols.erase(dp->cols.begin() + i);
   dp->col_map.erase(it);
   // The erase shifted every later slot down by one.
@@ -2209,9 +2358,10 @@ int32_t dbeel_dp_set_tables(void* h, const uint8_t* name, uint32_t nlen,
       if (t.index_fd >= 0) ::close(t.index_fd);
     }
     col->tables_valid = false;
+    dp->last_crc_fd[0] = dp->last_crc_fd[1] = -1;
     return -1;
   }
-  dp_close_tables(*col);
+  dp_close_tables(dp, *col);
   col->tables = std::move(fresh);
   col->tables_valid = true;
   return 0;
@@ -2237,6 +2387,73 @@ uint64_t dbeel_dp_fast_coord_writes(void* h) {
 uint64_t dbeel_dp_fast_coord_gets(void* h) {
   return static_cast<DataPlane*>(h)->fast_coord_gets;
 }
+uint64_t dbeel_dp_fast_multi_sets(void* h) {
+  return static_cast<DataPlane*>(h)->fast_multi_sets;
+}
+uint64_t dbeel_dp_fast_multi_gets(void* h) {
+  return static_cast<DataPlane*>(h)->fast_multi_gets;
+}
+uint64_t dbeel_dp_native_sheds(void* h) {
+  return static_cast<DataPlane*>(h)->native_sheds;
+}
+uint64_t dbeel_dp_native_deadline_drops(void* h) {
+  return static_cast<DataPlane*>(h)->native_deadline_drops;
+}
+uint64_t dbeel_dp_crc_failures(void* h) {
+  return static_cast<DataPlane*>(h)->crc_failures;
+}
+
+// Runtime flag for CRC sidecar verification in the C table probes
+// (ISSUE 6 tentpole #3).  Moot where preadv2/RWF_NOWAIT is absent
+// (every probe punts before reading); required wherever it exists,
+// or the native read path would be the one unverified surface.
+void dbeel_dp_set_verify(void* h, int32_t on) {
+  static_cast<DataPlane*>(h)->verify_crc = on;
+}
+
+// A/B measurement gate (BENCH native-floor): 0 punts client MULTI
+// frames to the Python fallback they replaced, so the native-vs-
+// interpreted multi throughput split can be measured same-session on
+// an otherwise identical server.
+void dbeel_dp_set_multi(void* h, int32_t on) {
+  static_cast<DataPlane*>(h)->multi_enabled = on;
+}
+
+// Governor level push (ISSUE 6 tentpole #4): the Python LoadGovernor
+// mirrors its sampled level here whenever it changes, so at
+// LEVEL_HARD (2) the client plane answers data verbs with the
+// prebuilt shed response instead of feeding the backlog.
+void dbeel_dp_set_overload(void* h, int32_t level) {
+  static_cast<DataPlane*>(h)->overload_level = level;
+}
+
+// Install the prebuilt COMPLETE wire responses (u32-LE length +
+// msgpack error payload + type byte) for native sheds and deadline
+// drops.  Packed by Python with its own msgpack encoder so the
+// native answer is byte-identical to the Python handler's error
+// frame for the same condition.
+void dbeel_dp_set_overload_resp(void* h, const uint8_t* shed,
+                                uint32_t shed_n, const uint8_t* dl,
+                                uint32_t dl_n) try {
+  auto* dp = static_cast<DataPlane*>(h);
+  dp->shed_resp.assign(shed, shed + shed_n);
+  dp->deadline_resp.assign(dl, dl + dl_n);
+} catch (...) {
+}
+
+// Per-4KiB-page zlib CRCs of a buffer (zero-padded final page) —
+// the exact storage/checksums.page_crcs computation, exported for
+// the golden parity test between the sidecar writer (Python) and
+// the native probe verifier.
+void dbeel_crc32_pages(const uint8_t* buf, uint64_t len,
+                       uint32_t* out) {
+  uint64_t pi = 0;
+  for (uint64_t off = 0; off < len; off += kProbePage) {
+    const uint64_t nb =
+        len - off < kProbePage ? len - off : kProbePage;
+    out[pi++] = crc32z_pad(buf + off, nb, kProbePage);
+  }
+}
 
 // One parsed client-API request frame (db_server.py request map),
 // shared by the RF=1 fast path (dbeel_dp_handle) and the RF>1
@@ -2254,6 +2471,14 @@ struct ClientFrame {
   bool have_consistency = false;
   uint64_t consistency = 0;
   uint64_t timeout_ms = 0;  // 0 = absent/falsy => caller default
+  // Client-propagated absolute wall deadline (overload plane).
+  // 0 = absent; Python honors only positive ints.
+  int64_t deadline_ms = 0;
+  // multi_set/multi_get: the raw msgpack ops array slice + element
+  // count (frames carry key XOR ops).
+  const uint8_t* ops_raw = nullptr;
+  uint32_t ops_n = 0;
+  uint64_t ops_count = 0;
 };
 
 // Parse the msgpack request map.  false => punt to Python (unknown
@@ -2357,26 +2582,102 @@ static bool dp_parse_client_frame(const uint8_t* frame, uint32_t len,
                  f->timeout_ms > 1000000000ull) {
         return false;
       }
+    } else if (slice_eq(ks, kn, "deadline_ms")) {
+      // Python: used only when `isinstance(int) and > 0`; nil counts
+      // as absent.  Canonical positive uints in the int64 range pass
+      // through; anything else (bools, negatives, huge) punts so the
+      // two paths agree on expiry decisions.
+      if (!mp_need(c, 1)) return false;
+      uint64_t dl;
+      if (*c.p == 0xc0) {
+        c.p++;
+      } else if (mp_read_uint(c, &dl) &&
+                 dl <= 0x7fffffffffffffffull) {
+        f->deadline_ms = (int64_t)dl;
+      } else {
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "ops")) {
+      // multi_set/multi_get sub-op list: record the raw array slice
+      // and its element count; sub-ops are decoded by the multi
+      // handler.  Non-arrays punt (Python raises BadFieldType).
+      if (!mp_need(c, 1)) return false;
+      const uint8_t b = *c.p;
+      uint64_t count;
+      if (b >= 0x90 && b <= 0x9f) {
+        count = b & 0x0f;
+        c.p++;
+      } else if (b == 0xdc) {
+        if (!mp_need(c, 3)) return false;
+        count = ((uint64_t)c.p[1] << 8) | c.p[2];
+        c.p += 3;
+      } else if (b == 0xdd) {
+        if (!mp_need(c, 5)) return false;
+        count = ((uint64_t)c.p[1] << 24) | ((uint64_t)c.p[2] << 16) |
+                ((uint64_t)c.p[3] << 8) | c.p[4];
+        c.p += 5;
+      } else {
+        return false;
+      }
+      f->ops_raw = c.p;
+      if (!mp_skip_n(c, count, 1)) return false;
+      f->ops_n = (uint32_t)(c.p - f->ops_raw);
+      f->ops_count = count;
     } else {
       if (!mp_skip(c, 0)) return false;
     }
   }
   if (c.p != c.end) return false;  // trailing bytes: Python judges
   return f->type_s != nullptr && f->coll_s != nullptr &&
-         f->key_raw != nullptr;
+         (f->key_raw != nullptr || f->ops_raw != nullptr);
 }
+
+}  // extern "C"
+
+// Emitters/readers defined in the canonical-msgpack namespace below;
+// forward-declared so the multi handler (same anonymous namespace)
+// can live next to the single-op plane.
+namespace {
+size_t mp_put_int64(uint8_t* o, int64_t v);
+size_t mp_put_binhdr(uint8_t* o, uint32_t n);
+int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
+                        bool is_mset, uint8_t* out, uint32_t out_cap,
+                        uint32_t* out_len);
+
+// Wall-clock check for a propagated client budget (overload plane):
+// a positive deadline_ms already in the past means the client walked
+// away — every cycle spent computing the response would feed nobody.
+inline bool dp_deadline_expired(const ClientFrame& f) {
+  if (f.deadline_ms <= 0) return false;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  const int64_t wall_ms =
+      (int64_t)ts.tv_sec * 1000ll + (int64_t)ts.tv_nsec / 1000000ll;
+  return wall_ms > f.deadline_ms;
+}
+
+// Verb codes reported in flags bits 24..26 for native drops/sheds.
+enum { DP_VERB_SET = 1, DP_VERB_GET = 2, DP_VERB_DELETE = 3,
+       DP_VERB_MULTI_SET = 4, DP_VERB_MULTI_GET = 5 };
+}  // namespace
+
+extern "C" {
 
 // Handle one request frame entirely natively if possible.
 // Returns -1 to punt to the Python handler; otherwise a flags word:
 //   bit0 keepalive, bit1 memtable-now-full (Python spawns the flush),
-//   bit2 this was a get (out buffer holds the response), bit3 delete,
+//   bit2 response present in out, bit3 delete,
 //   bit4 write-path error (entry applied, WAL append failed; out
 //   holds the complete error response — the frame must NOT re-run),
 //   bit5 ack deferred: wal-sync tree, park the OK on the WAL's sync
 //   ticket (dbeel_wal_seq at return time),
-//   bits 8.. collection slot index.
-// For gets, *out (capacity out_cap) receives the complete wire
-// response: u32-LE length + value bytes + type byte.  Sets need no
+//   bits 6-7 frame class: 0 single op, 1 multi_set, 2 multi_get,
+//   3 dropped (expired client deadline; out holds the prebuilt
+//   retryable Overloaded response and bits 24..26 carry the verb),
+//   bits 8..23 collection slot index,
+//   bits 32..45 sub-op count (multi frames, for batch metrics).
+// For gets/multis, *out (capacity out_cap) receives the complete
+// wire response: u32-LE length + payload + type byte.  Sets need no
 // out buffer (the OK response is a constant the caller owns).
 int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
                         uint8_t* out, uint32_t out_cap,
@@ -2392,16 +2693,58 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   const uint64_t hash_v = f.hash_v;
   const bool have_hash = f.have_hash, keepalive = f.keepalive;
   const uint64_t replica_index = f.replica_index;
+  const bool is_set = slice_eq(type_s, type_n, "set");
+  const bool is_del = slice_eq(type_s, type_n, "delete");
+  const bool is_get = slice_eq(type_s, type_n, "get");
+  const bool is_mset = slice_eq(type_s, type_n, "multi_set");
+  const bool is_mget = slice_eq(type_s, type_n, "multi_get");
+  if (!is_set && !is_del && !is_get && !is_mset && !is_mget)
+    return -1;
+  const int64_t verb =
+      is_set ? DP_VERB_SET
+      : is_get ? DP_VERB_GET
+      : is_del ? DP_VERB_DELETE
+      : is_mset ? DP_VERB_MULTI_SET : DP_VERB_MULTI_GET;
+  // Hard-overload shed (ISSUE 6 tentpole #4): the governor pushed
+  // LEVEL_HARD down here, so shed frames are answered with the
+  // prebuilt retryable Overloaded response without ever reaching the
+  // Python dispatcher — previously the governor gated this path to
+  // FAST_MISS and the interpreter it was protecting had to parse and
+  // answer every frame of the flood it was shedding.  Order matches
+  // Python (_dispatch sheds before handle_request's deadline check).
+  // Non-data verbs (admin, get_stats) punted above and always serve.
+  if (dp->overload_level >= 2 && !dp->shed_resp.empty() &&
+      dp->shed_resp.size() <= out_cap) {
+    std::memcpy(out, dp->shed_resp.data(), dp->shed_resp.size());
+    *out_len = (uint32_t)dp->shed_resp.size();
+    dp->native_sheds++;
+    return (keepalive ? 1 : 0) | 0xC0 | 4 | (verb << 24) |
+           (1ll << 27);
+  }
+  // Deadline propagation, coordinator side (parity with Python's
+  // _deadline_dead_on_arrival): the drop happens BEFORE collection /
+  // ownership / RF resolution, exactly like the dispatcher's check,
+  // so even frames the fast path would punt get their native drop.
+  if (dp_deadline_expired(f) && !dp->deadline_resp.empty() &&
+      dp->deadline_resp.size() <= out_cap) {
+    std::memcpy(out, dp->deadline_resp.data(),
+                dp->deadline_resp.size());
+    *out_len = (uint32_t)dp->deadline_resp.size();
+    dp->native_deadline_drops++;
+    return (keepalive ? 1 : 0) | 0xC0 | 4 | (verb << 24);
+  }
+  if (is_mset || is_mget) {
+    if (!dp->multi_enabled) return -1;  // A/B: Python fallback
+    if (f.ops_raw == nullptr) return -1;
+    return dp_handle_multi(dp, f, is_mset, out, out_cap, out_len);
+  }
+  if (key_raw == nullptr) return -1;
   // Key identity parity: the Python path stores keys RE-ENCODED by
   // msgpack-python, the C path the raw wire slice.  Any key whose
   // encoding isn't already canonical must punt (write AND read), or
   // the paths would disagree on identity — worst case a false native
   // KeyNotFound for a key the Python path stored canonically.
   if (!mp_key_canonical(key_raw, key_n)) return -1;
-  const bool is_set = slice_eq(type_s, type_n, "set");
-  const bool is_del = slice_eq(type_s, type_n, "delete");
-  const bool is_get = slice_eq(type_s, type_n, "get");
-  if (!is_set && !is_del && !is_get) return -1;
   if (is_set && val_raw == nullptr) return -1;
   if (replica_index != 0) return -1;
 
@@ -2654,6 +2997,406 @@ bool mp_read_int64(MpCur& c, int64_t* out) {
   return true;
 }
 
+// msgpack array header exactly as msgpack-python packs it (multi-op
+// results are bounded at 4096 sub-ops, well inside array16).
+size_t mp_put_arrhdr(uint8_t* o, uint32_t n) {
+  if (n <= 15) {
+    o[0] = (uint8_t)(0x90 | n);
+    return 1;
+  }
+  o[0] = 0xdc;
+  o[1] = (uint8_t)(n >> 8);
+  o[2] = (uint8_t)n;
+  return 3;
+}
+
+// Peer-plane error frame ["response","error",kind,msg] — canonical
+// msgpack, byte-identical to pack_message(ShardResponse.error(e)).
+// Returns total wire bytes (4B-LE length + payload) or 0 when the
+// buffer is too small.
+size_t shard_error_frame(const char* kind, const char* msg,
+                         uint8_t* out, uint32_t out_cap) {
+  const size_t kl = std::strlen(kind), ml = std::strlen(msg);
+  if ((uint64_t)4 + 1 + 9 + 6 + 5 + kl + 5 + ml > out_cap) return 0;
+  uint8_t* o = out + 4;
+  size_t n = 0;
+  o[n++] = 0x94;
+  o[n++] = 0xa8;
+  std::memcpy(o + n, "response", 8);
+  n += 8;
+  o[n++] = 0xa5;
+  std::memcpy(o + n, "error", 5);
+  n += 5;
+  n += mp_put_strhdr(o + n, (uint32_t)kl);
+  std::memcpy(o + n, kind, kl);
+  n += kl;
+  n += mp_put_strhdr(o + n, (uint32_t)ml);
+  std::memcpy(o + n, msg, ml);
+  n += ml;
+  const uint32_t n32 = (uint32_t)n;
+  std::memcpy(out, &n32, 4);
+  return 4 + n;
+}
+
+// One decoded client-plane multi sub-op ([key, hash(, value)]).
+struct MultiSubOp {
+  const uint8_t* key = nullptr;
+  uint32_t key_n = 0;
+  const uint8_t* val = nullptr;
+  uint32_t val_n = 0;
+  uint32_t hash = 0;
+};
+
+// Client-plane MULTI_SET/MULTI_GET (ISSUE 6 tentpole #1): the whole
+// batched frame served natively for RF=1 collections — per-sub-op
+// results byte-identical to db_server._handle_multi, WAL group commit
+// on the C side (every append rides ONE sync ticket read after the
+// batch).  Any irregular sub-op (non-canonical key, unowned hash,
+// malformed shape, cold probe) punts the WHOLE frame pre-apply, so
+// Python's per-sub-op error formatting stays the only error
+// authority it already was.
+int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
+                        bool is_mset, uint8_t* out, uint32_t out_cap,
+                        uint32_t* out_len) {
+  // Python bound (db_server.MULTI_MAX_OPS): above it the Python
+  // handler raises BadFieldType for the whole frame — punt.
+  if (f.ops_count == 0 || f.ops_count > 4096) return -1;
+  if (f.replica_index != 0) return -1;
+  int32_t col_idx = -1;
+  FastCollection* col = dp_find_col(dp, f.coll_s, f.coll_n, &col_idx);
+  if (col == nullptr) return -1;
+  if (!col->client_ok) return -1;  // RF>1: Python owns the fan-out
+  const uint32_t n = (uint32_t)f.ops_count;
+
+  std::vector<MultiSubOp> ops(n);
+  MpCur c{f.ops_raw, f.ops_raw + f.ops_n};
+  for (uint32_t i = 0; i < n; i++) {
+    uint32_t nelem;
+    if (!mp_rd_arrhdr16(c, &nelem))
+      return -1;  // malformed sub-op: Python's per-op error path
+    const uint32_t want = is_mset ? 3u : 2u;
+    if (nelem < want) return -1;
+    MultiSubOp& op = ops[i];
+    const uint8_t* kstart = c.p;
+    if (!mp_skip(c, 0)) return -1;
+    op.key = kstart;
+    op.key_n = (uint32_t)(c.p - kstart);
+    if (!mp_key_canonical(op.key, op.key_n)) return -1;
+    // hash element: Python uses any int verbatim (bools included —
+    // they're ints there), recomputes for non-ints.  Only canonical
+    // u32-range uints match that here; other INT shapes punt,
+    // non-int shapes (nil etc.) recompute.
+    if (!mp_need(c, 1)) return -1;
+    const uint8_t hb = *c.p;
+    if (hb == 0xc2 || hb == 0xc3) return -1;  // bool: Python truthiness
+    const bool int_shaped =
+        hb <= 0x7f || hb >= 0xe0 || (hb >= 0xcc && hb <= 0xd3);
+    if (int_shaped) {
+      uint64_t hv;
+      if (!mp_read_uint(c, &hv) || hv > 0xFFFFFFFFull) return -1;
+      op.hash = (uint32_t)hv;
+    } else {
+      if (!mp_skip(c, 0)) return -1;
+      op.hash = murmur3_32(op.key, op.key_n, 0);
+    }
+    if (is_mset) {
+      const uint8_t* vstart = c.p;
+      if (!mp_skip(c, 0)) return -1;
+      op.val = vstart;
+      op.val_n = (uint32_t)(c.p - vstart);
+      if (!mp_skip_n(c, nelem - 3, 1)) return -1;
+    } else if (!mp_skip_n(c, nelem - 2, 1)) {
+      return -1;
+    }
+    if (dp->own_mode == 2) {
+      const bool owned =
+          dp->own_lo < dp->own_hi
+              ? (op.hash > dp->own_lo && op.hash <= dp->own_hi)
+              : (op.hash > dp->own_lo || op.hash <= dp->own_hi);
+      if (!owned) return -1;  // Python emits the per-op error result
+    }
+  }
+  if (c.p != f.ops_raw + f.ops_n) return -1;
+
+  if (is_mset) {
+    if (col->wal == nullptr) return -1;
+    // Whole-batch capacity pre-check (the Python batch path performs
+    // ONE capacity check): a mid-batch refusal could not punt —
+    // earlier entries would already be applied.
+    if (dbeel_memtable_len(col->active) + n > col->capacity)
+      return -1;
+    const uint64_t resp_need = 4ull + 3 + 3ull * n + 1;
+    if (resp_need > out_cap || out_cap < 96) {
+      *out_len = (uint32_t)(resp_need < 96 ? 96 : resp_need);
+      return -2;  // pre-apply: grow the buffer and retry safely
+    }
+    struct timespec tsp;
+    clock_gettime(CLOCK_REALTIME, &tsp);
+    const int64_t ts =
+        (int64_t)tsp.tv_sec * 1000000000ll + tsp.tv_nsec;
+    bool fail = false;
+    for (uint32_t i = 0; i < n && !fail; i++) {
+      uint32_t old_len = 0;
+      if (dbeel_memtable_set(col->active, ops[i].key, ops[i].key_n,
+                             ops[i].val, ops[i].val_n, ts,
+                             &old_len) < 0) {
+        fail = true;  // alloc/capacity race: applied-but-incomplete
+        break;
+      }
+      col->appends++;
+      if (dbeel_wal_append(col->wal, ops[i].key, ops[i].key_n,
+                           ops[i].val, ops[i].val_n, ts) == 0)
+        fail = true;
+    }
+    int64_t flags = (f.keepalive ? 1 : 0) | 0x40 | 4 |
+                    ((int64_t)col_idx << 8) | ((int64_t)n << 32);
+    if (dp_col_full(col)) flags |= 2;
+    if (fail) {
+      // Batch partially applied: answer the whole-frame error the
+      // Python batch path produces for an apply failure, natively —
+      // NEVER punt (a re-run would double-apply with a new ts).
+      if (!internal_error_response("wal append failed", out, out_cap,
+                                   out_len))
+        return -1;  // unreachable: out_cap >= 96 checked pre-apply
+      return flags | 0x10;
+    }
+    size_t o = 4;
+    o += mp_put_arrhdr(out + o, n);
+    for (uint32_t i = 0; i < n; i++) {
+      out[o++] = 0x92;  // [0, None]
+      out[o++] = 0x00;
+      out[o++] = 0xc0;
+    }
+    out[o++] = 1;  // RESPONSE_OK
+    const uint32_t body = (uint32_t)(o - 4);
+    std::memcpy(out, &body, 4);
+    *out_len = (uint32_t)o;
+    dp->fast_multi_sets++;
+    if (col->wal->sync_enabled.load(std::memory_order_relaxed))
+      flags |= 0x20;
+    return flags;
+  }
+
+  // multi_get: stage the response payload (values copied out of the
+  // shared probe scratch per sub-op) then emit once sized.
+  std::vector<uint8_t>& mb = dp->multibuf;
+  mb.clear();
+  uint8_t hdr[16];
+  mb.insert(mb.end(), hdr, hdr + mp_put_arrhdr(hdr, n));
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* v = nullptr;
+    uint32_t vn = 0;
+    int64_t ets = 0;
+    const int found = col_find_grown(dp, col, ops[i].key,
+                                     ops[i].key_n, &v, &vn, &ets);
+    if (found < 0) return -1;  // cold page: interpreted path
+    if (found && vn != 0) {
+      mb.push_back(0x92);  // [0, value]
+      mb.push_back(0x00);
+      mb.insert(mb.end(), hdr, hdr + mp_put_binhdr(hdr, vn));
+      mb.insert(mb.end(), v, v + vn);
+    } else {
+      // Tombstone or authoritative absence: [1, ["KeyNotFound",
+      // repr(key)]] — byte parity with the per-sub-op error wire.
+      if (ops[i].key_n > 4096) return -1;  // giant keys: Python formats
+      mb.push_back(0x92);
+      mb.push_back(0x01);
+      mb.push_back(0x92);
+      mb.push_back(0xab);
+      const uint8_t* knf = (const uint8_t*)"KeyNotFound";
+      mb.insert(mb.end(), knf, knf + 11);
+      uint8_t msg[3 + 4 * 4096];
+      const size_t mlen = bytes_repr(ops[i].key, ops[i].key_n, msg);
+      mb.insert(mb.end(), hdr,
+                hdr + mp_put_strhdr(hdr, (uint32_t)mlen));
+      mb.insert(mb.end(), msg, msg + mlen);
+    }
+  }
+  mb.push_back(1);  // RESPONSE_OK
+  const uint64_t total = 4ull + mb.size();
+  if (total > out_cap) {
+    if (total > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
+    *out_len = (uint32_t)total;
+    return -2;  // side-effect-free: grow and retry
+  }
+  const uint32_t body = (uint32_t)mb.size();
+  std::memcpy(out, &body, 4);
+  std::memcpy(out + 4, mb.data(), mb.size());
+  *out_len = (uint32_t)total;
+  dp->fast_multi_gets++;
+  return (f.keepalive ? 1 : 0) | 0x80 | 4 |
+         ((int64_t)col_idx << 8) | ((int64_t)n << 32);
+}
+
+// Replica-plane MULTI_SET/MULTI_GET — the peer half of RF>1 client
+// batches (ShardRequest.multi_set/multi_get): one frame applies N
+// entries with one ack and one WAL sync ticket (group commit), or
+// answers N aligned entries.  Mixed fresh/stale batches and every
+// other irregularity punt to handle_shard_request unchanged.
+int64_t dp_shard_multi(DataPlane* dp, MpCur& c, bool is_mset,
+                       bool has_deadline, const uint8_t* coll_s,
+                       uint32_t coll_n, uint8_t* out,
+                       uint32_t out_cap, uint32_t* out_len) {
+  uint32_t n;
+  if (!mp_rd_arrhdr16(c, &n)) return -1;
+  if (n > 4096) return -1;
+  struct Ent {
+    const uint8_t* k;
+    uint32_t kn;
+    const uint8_t* v;
+    uint32_t vn;
+    int64_t ts;
+  };
+  std::vector<Ent> ents(n);
+  for (uint32_t i = 0; i < n; i++) {
+    Ent& e = ents[i];
+    if (is_mset) {
+      if (!mp_need(c, 1)) return -1;
+      const uint8_t eh = *c.p;
+      uint32_t nelem;
+      if (eh >= 0x90 && eh <= 0x9f) {
+        nelem = eh & 0x0f;
+        c.p++;
+      } else {
+        return -1;
+      }
+      if (nelem < 3) return -1;
+      if (!mp_read_bin(c, &e.k, &e.kn)) return -1;
+      if (!mp_read_bin(c, &e.v, &e.vn)) return -1;
+      if (!mp_read_int64(c, &e.ts)) return -1;
+      if (!mp_skip_n(c, nelem - 3, 1)) return -1;
+    } else {
+      if (!mp_read_bin(c, &e.k, &e.kn)) return -1;
+      e.v = nullptr;
+      e.vn = 0;
+      e.ts = 0;
+    }
+  }
+  if (has_deadline) {
+    int64_t deadline_ms = 0;
+    if (!mp_read_int64(c, &deadline_ms)) return -1;
+    if (deadline_ms > 0) {
+      struct timespec now_ts;
+      clock_gettime(CLOCK_REALTIME, &now_ts);
+      const int64_t wall_ms = (int64_t)now_ts.tv_sec * 1000ll +
+                              (int64_t)now_ts.tv_nsec / 1000000ll;
+      if (wall_ms > deadline_ms) {
+        // Expired propagated budget: answer the retryable error the
+        // Python handler raises, natively (bit7 tells Python to
+        // count the replica deadline drop).
+        const size_t t = shard_error_frame(
+            "Overloaded",
+            "deadline expired before the replica served it", out,
+            out_cap);
+        if (t == 0) return -1;
+        *out_len = (uint32_t)t;
+        return 0x80 | 4;
+      }
+    }
+  }
+  if (c.p != c.end) return -1;
+
+  int32_t col_idx = -1;
+  FastCollection* col = dp_find_col(dp, coll_s, coll_n, &col_idx);
+  if (col == nullptr) return -1;
+
+  if (is_mset) {
+    if (col->wal == nullptr) return -1;
+    if (out_cap < 96) return -1;
+    if (dbeel_memtable_len(col->active) + n > col->capacity)
+      return -1;
+    for (uint32_t i = 0; i < n; i++) {
+      if (ents[i].ts <= col->ts_watermark)
+        return -1;  // stale entries: Python's read-guarded split
+    }
+    bool fail = false;
+    for (uint32_t i = 0; i < n && !fail; i++) {
+      uint32_t old_len = 0;
+      if (dbeel_memtable_set(col->active, ents[i].k, ents[i].kn,
+                             ents[i].v, ents[i].vn, ents[i].ts,
+                             &old_len) < 0) {
+        fail = true;
+        break;
+      }
+      col->appends++;
+      if (dbeel_wal_append(col->wal, ents[i].k, ents[i].kn,
+                           ents[i].v, ents[i].vn, ents[i].ts) == 0)
+        fail = true;
+    }
+    int64_t flags = ((int64_t)col_idx << 8) | 8;
+    if (dp_col_full(col)) flags |= 2;
+    if (fail) {
+      const size_t t = shard_error_frame(
+          "Internal", "wal append failed", out, out_cap);
+      if (t == 0) return -1;  // unreachable: out_cap >= 96
+      *out_len = (uint32_t)t;
+      return flags | 4 | 0x20;
+    }
+    // Ack ["response","multi_set"].
+    uint8_t* o = out + 4;
+    size_t m = 0;
+    o[m++] = 0x92;
+    o[m++] = 0xa8;
+    std::memcpy(o + m, "response", 8);
+    m += 8;
+    o[m++] = 0xa9;
+    std::memcpy(o + m, "multi_set", 9);
+    m += 9;
+    const uint32_t m32 = (uint32_t)m;
+    std::memcpy(out, &m32, 4);
+    *out_len = 4 + m32;
+    flags |= 4;
+    if (n == 0) flags |= 0x20;  // empty batch: Python skips notify
+    if (col->wal->sync_enabled.load(std::memory_order_relaxed))
+      flags |= 0x40;
+    dp->fast_replica_ops++;
+    return flags;
+  }
+
+  // multi_get: ["response","multi_get",[[value,ts]|nil,...]].
+  std::vector<uint8_t>& mb = dp->multibuf;
+  mb.clear();
+  uint8_t hdr[16];
+  mb.push_back(0x93);
+  mb.push_back(0xa8);
+  const uint8_t* rsp = (const uint8_t*)"response";
+  mb.insert(mb.end(), rsp, rsp + 8);
+  mb.push_back(0xa9);
+  const uint8_t* mg = (const uint8_t*)"multi_get";
+  mb.insert(mb.end(), mg, mg + 9);
+  mb.insert(mb.end(), hdr, hdr + mp_put_arrhdr(hdr, n));
+  for (uint32_t i = 0; i < n; i++) {
+    const uint8_t* v = nullptr;
+    uint32_t vn = 0;
+    int64_t ets = 0;
+    const int found = col_find_grown(dp, col, ents[i].k, ents[i].kn,
+                                     &v, &vn, &ets);
+    if (found < 0) return -1;
+    if (found) {
+      // Entries INCLUDING tombstones, with their timestamp — the
+      // coordinator merges by max ts (handle_shard_request parity).
+      mb.push_back(0x92);
+      mb.insert(mb.end(), hdr, hdr + mp_put_binhdr(hdr, vn));
+      if (vn) mb.insert(mb.end(), v, v + vn);
+      mb.insert(mb.end(), hdr, hdr + mp_put_int64(hdr, ets));
+    } else {
+      mb.push_back(0xc0);  // nil: authoritative absence
+    }
+  }
+  const uint64_t total = 4ull + mb.size();
+  if (total > out_cap) {
+    if (total > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
+    *out_len = (uint32_t)total;
+    return -2;  // read path: grow and retry
+  }
+  const uint32_t body = (uint32_t)mb.size();
+  std::memcpy(out, &body, 4);
+  std::memcpy(out + 4, mb.data(), mb.size());
+  *out_len = (uint32_t)total;
+  dp->fast_replica_ops++;
+  return ((int64_t)col_idx << 8) | 4;
+}
+
 }  // namespace
 
 extern "C" {
@@ -2662,8 +3405,14 @@ extern "C" {
 // (4-byte-LE-length framed msgpack list, cluster/messages.py) entirely
 // natively — the peer traffic behind RF>1 quorum ops and migration
 // streams.  Covered: ["request","set",coll,key,value,ts],
-// ["request","delete",coll,key,ts], ["request","get",coll,key], and
-// ["event","set",coll,key,value,ts].  Writes apply the GIVEN
+// ["request","delete",coll,key,ts], ["request","get",coll,key],
+// ["request","multi_set",coll,entries] / ["request","multi_get",
+// coll,keys] (batched replica half of client multi ops: N applies,
+// one ack, one WAL sync ticket), and ["event","set",coll,key,value,
+// ts]; every frame optionally carries the trailing propagated
+// deadline, and an EXPIRED request is answered with the retryable
+// Overloaded error frame natively (flag bit7 counts the drop).
+// Writes apply the GIVEN
 // timestamp (server-assigned by the coordinating shard,
 // shards.rs:695-773 parity); gets return the entry INCLUDING
 // tombstones with its timestamp (max-ts conflict resolution happens
@@ -2701,8 +3450,11 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   const bool k_del = is_req && slice_eq(kind_s, kind_n, "delete");
   const bool k_get = is_req && slice_eq(kind_s, kind_n, "get");
   const bool k_dig = is_req && slice_eq(kind_s, kind_n, "get_digest");
+  const bool k_mset = is_req && slice_eq(kind_s, kind_n, "multi_set");
+  const bool k_mget = is_req && slice_eq(kind_s, kind_n, "multi_get");
   if (is_event && !k_set) return -1;
-  if (!(k_set || k_del || k_get || k_dig)) return -1;
+  if (!(k_set || k_del || k_get || k_dig || k_mset || k_mget))
+    return -1;
   const uint32_t want =
       k_set ? 6u : k_del ? 5u : 4u;
   // Optional trailing wall-clock deadline (ms) — deadline
@@ -2715,6 +3467,9 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   const uint8_t* coll_s;
   uint32_t coll_n;
   if (!mp_read_str(c, &coll_s, &coll_n)) return -1;
+  if (k_mset || k_mget)
+    return dp_shard_multi(dp, c, k_mset, has_deadline, coll_s,
+                          coll_n, out, out_cap, out_len);
   const uint8_t *key_s, *val_s = nullptr;
   uint32_t key_n, val_n = 0;
   if (!mp_read_bin(c, &key_s, &key_n)) return -1;
@@ -2730,7 +3485,20 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
       const int64_t wall_ms =
           (int64_t)now_ts.tv_sec * 1000ll +
           (int64_t)now_ts.tv_nsec / 1000000ll;
-      if (wall_ms > deadline_ms) return -1;  // expired: Python drops
+      if (wall_ms > deadline_ms) {
+        // Expired propagated budget: answer the retryable error the
+        // Python handler raises, without touching the interpreter
+        // (bit7 → Python counts the replica deadline drop).  Events
+        // have no reply channel — those keep punting.
+        if (!is_req) return -1;
+        const size_t t = shard_error_frame(
+            "Overloaded",
+            "deadline expired before the replica served it", out,
+            out_cap);
+        if (t == 0) return -1;
+        *out_len = (uint32_t)t;
+        return 0x80 | 4;
+      }
     }
   }
   if (c.p != c.end) return -1;
@@ -2978,6 +3746,22 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
       ((int64_t)(f.have_consistency ? f.consistency + 1 : 0) << 24) |
       ((int64_t)f.timeout_ms << 32);
 
+  // Deadline-aware peer-frame packing (ISSUE 6 tentpole #5): the
+  // propagated budget rides every peer frame this assist emits —
+  // the client's own deadline_ms when it sent one, else wall-now +
+  // this op's timeout (db_server._wall_deadline_ms parity; 5000 ms
+  // is DEFAULT_SET/GET_TIMEOUT_MS).
+  struct timespec now_tsp;
+  clock_gettime(CLOCK_REALTIME, &now_tsp);
+  const int64_t wall_now_ms =
+      (int64_t)now_tsp.tv_sec * 1000ll +
+      (int64_t)now_tsp.tv_nsec / 1000000ll;
+  const int64_t peer_deadline =
+      f.deadline_ms > 0
+          ? f.deadline_ms
+          : wall_now_ms +
+                (int64_t)(f.timeout_ms ? f.timeout_ms : 5000);
+
   if (is_get) {
     const uint8_t* v = nullptr;
     uint32_t vn = 0;
@@ -2986,10 +3770,11 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
         col_find_grown(dp, col, f.key_raw, f.key_n, &v, &vn, &ets);
     if (found < 0) return -1;  // cold page: Python async read path
     // Worst-case fixed overhead: 1 (array) + 8 ("request") + 7
-    // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9 (int64) = 40; the
-    // trailer carries the value AND the raw key (17B fixed header).
+    // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9+9 (int64s incl. the
+    // deadline) = 49; the trailer carries the value AND the raw key
+    // (25B fixed header incl. the peer deadline).
     const uint64_t need =
-        4ull + 40 + f.coll_n + (uint64_t)f.key_n * 2 + 17ull + vn;
+        4ull + 49 + f.coll_n + (uint64_t)f.key_n * 2 + 25ull + vn;
     if (need > out_cap) {
       if (need > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
       *out_len = need;
@@ -2997,7 +3782,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     }
     uint8_t* o = out + 4;
     size_t n = 0;
-    o[n++] = 0x94;
+    o[n++] = 0x95;  // ["request","get",coll,key,deadline_ms]
     o[n++] = 0xa7;
     std::memcpy(o + n, "request", 7);
     n += 7;
@@ -3010,6 +3795,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     n += mp_put_binhdr(o + n, f.key_n);
     std::memcpy(o + n, f.key_raw, f.key_n);
     n += f.key_n;
+    n += mp_put_int64(o + n, peer_deadline);
     const uint32_t n32 = (uint32_t)n;
     std::memcpy(out, &n32, 4);
     uint8_t* t = out + 4 + n;
@@ -3017,10 +3803,11 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     std::memcpy(t + 1, &vn, 4);
     std::memcpy(t + 5, &ets, 8);
     std::memcpy(t + 13, &f.key_n, 4);
+    std::memcpy(t + 17, &peer_deadline, 8);
     const uint32_t tvn = found ? vn : 0;
-    if (tvn != 0) std::memcpy(t + 17, v, tvn);
-    std::memcpy(t + 17 + tvn, f.key_raw, f.key_n);
-    *out_len = 4 + n32 + 17 + tvn + f.key_n;
+    if (tvn != 0) std::memcpy(t + 25, v, tvn);
+    std::memcpy(t + 25 + tvn, f.key_raw, f.key_n);
+    *out_len = 4 + n32 + 25 + tvn + f.key_n;
     dp->fast_coord_gets++;
     return base_flags | 8;
   }
@@ -3028,8 +3815,9 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   // Peer-frame capacity check BEFORE the write (a post-write punt
   // would re-run the frame through Python and double-apply).  Fixed
   // overhead budgeted at the worst case (see the get branch): the
-  // delete kind ("delete", 7) + 5-byte str/bin headers peak at 35.
-  const uint64_t need = 4ull + 40 + f.coll_n + f.key_n +
+  // delete kind ("delete", 7) + 5-byte str/bin headers + two int64s
+  // (ts + propagated deadline) peak at 49.
+  const uint64_t need = 4ull + 49 + f.coll_n + f.key_n +
                         (is_set ? (uint64_t)f.val_n + 5 : 0);
   if (need > out_cap) {
     if (need <= (uint64_t)kDpHardMax + kDpGrowSlack) {
@@ -3066,7 +3854,9 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
 
   uint8_t* o = out + 4;
   size_t n = 0;
-  o[n++] = is_set ? 0x96 : 0x95;
+  // One trailing element beyond the classic arity: the propagated
+  // wall-clock deadline (ShardRequest._with_deadline parity).
+  o[n++] = is_set ? 0x97 : 0x96;
   o[n++] = 0xa7;
   std::memcpy(o + n, "request", 7);
   n += 7;
@@ -3091,6 +3881,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     n += f.val_n;
   }
   n += mp_put_int64(o + n, ts);
+  n += mp_put_int64(o + n, peer_deadline);
   const uint32_t n32 = (uint32_t)n;
   std::memcpy(out, &n32, 4);
   *out_len = 4 + n32;

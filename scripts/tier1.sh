@@ -9,6 +9,25 @@
 # Exits non-zero on any failure; prints the dot-counted pass total.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Build the native library FIRST and fail the job if the build
+# breaks.  Without this gate a broken .so meant every native-path
+# test silently skipped to the Python fallback and the suite stayed
+# green while the product's fast path was dead (ISSUE 6 satellite).
+if command -v g++ >/dev/null 2>&1; then
+    make -C native || { echo "NATIVE BUILD FAILED" >&2; exit 1; }
+    python - << 'PYEOF' || { echo "NATIVE .so UNLOADABLE" >&2; exit 1; }
+from dbeel_tpu.storage.native import load_if_built
+lib = load_if_built()
+assert lib is not None, "built .so failed to load"
+assert hasattr(lib, "dbeel_dp_handle"), "data plane ABI missing"
+assert hasattr(lib, "dbeel_dp_set_overload"), "native6 ABI missing"
+print("native .so OK")
+PYEOF
+else
+    echo "NATIVE BUILD SKIPPED: no g++ in environment" >&2
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \

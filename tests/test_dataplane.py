@@ -7,6 +7,7 @@ flushes.  Runs the real server over real sockets (SURVEY §4: no mocks).
 import asyncio
 import os
 import struct
+import time
 
 import msgpack
 import pytest
@@ -746,6 +747,12 @@ def test_coordinator_assist_emits_exact_peer_frames(tmp_dir, arun):
                 {"v": 9}, use_bin_type=True
             )
             assert isinstance(msg[5], int) and msg[5] > t0
+            # Propagated deadline rides the peer frame (ISSUE 6):
+            # wall-now + the op's timeout, appended exactly like the
+            # Python coordinator's _with_deadline dialect.
+            wall_ms = int(time.time() * 1000)
+            assert len(msg) == 7 and isinstance(msg[6], int)
+            assert wall_ms - 5_000 < msg[6] < wall_ms + 1234 + 60_000
             # Canonicality: re-packing reproduces the exact bytes.
             assert pack_message(msg) == body_bytes
 
@@ -754,7 +761,8 @@ def test_coordinator_assist_emits_exact_peer_frames(tmp_dir, arun):
             msg = unpack_message(framed[4:])
             assert msg[:3] == ["request", "delete", "co"]
             assert msg[3] == msgpack.packb("ck", use_bin_type=True)
-            assert len(msg) == 5 and isinstance(msg[4], int)
+            assert len(msg) == 6 and isinstance(msg[4], int)
+            assert isinstance(msg[5], int)  # propagated deadline
             assert pack_message(msg) == framed[4:]
 
             # The local write really applied (tombstone wins now).

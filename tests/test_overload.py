@@ -564,3 +564,145 @@ def test_overload_stats_schema(tmp_dir):
             await node.stop()
 
     run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Hard-overload shedding through the C client (all-native path)
+# ----------------------------------------------------------------------
+
+
+def test_hard_shed_through_c_client_pipe(tmp_dir):
+    """A C-client pipelined train against a hard-overloaded shard is
+    answered entirely by the native shed gate: every op surfaces the
+    retryable overload class FAST (no hang, no timeout), ZERO frames
+    reach the Python dispatcher, and after recovery the same train
+    succeeds on the same connections."""
+    from dbeel_tpu.client import native_client
+
+    if not native_client.available():
+        pytest.skip("native client library not built")
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        dp = shard.dataplane
+        if dp is None or not dp.shed_armed:
+            pytest.skip("native shed gate unavailable")
+        ip, port = node.db_address
+        loop = asyncio.get_event_loop()
+        keys = [f"ck{i}" for i in range(32)]
+        vals = [{"v": i} for i in range(32)]
+        # Construct in a worker thread: the bootstrap round trip must
+        # not block the loop thread the server itself runs on.
+        nc = await loop.run_in_executor(
+            None, native_client.NativeDbeelClient, ip, port
+        )
+        try:
+            nc.set_retry(
+                op_deadline_ms=1500,
+                backoff_base_ms=10,
+                backoff_cap_ms=50,
+            )
+
+            def train():
+                return nc.pipe_run("ov", "set", keys, vals, window=8)
+
+            # Healthy baseline: the train pipelines clean.
+            assert await loop.run_in_executor(None, train) == 0
+
+            shard.governor.force_level(LEVEL_HARD)
+            try:
+                s0 = dp.stats()["native_sheds"]
+                p0 = shard.governor.python_sheds
+                t0 = time.monotonic()
+                failures = await loop.run_in_executor(None, train)
+                elapsed = time.monotonic() - t0
+                # Every op shed, surfaced as the retryable overload
+                # class, fast (prebuilt native answers, no backlog).
+                assert failures == len(keys)
+                assert "Overloaded" in nc._err()
+                assert elapsed < 5.0
+                # The measurable all-native claim: shed frames never
+                # touched the interpreter.
+                assert (
+                    dp.stats()["native_sheds"] >= s0 + len(keys)
+                )
+                assert shard.governor.python_sheds == p0
+            finally:
+                shard.governor.force_level(None)
+
+            # Recovery: the same pipelined connections serve again.
+            assert await loop.run_in_executor(None, train) == 0
+        finally:
+            nc.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_c_client_backoff_walk_rides_out_overload(tmp_dir):
+    """The C single-op walk treats a native shed like any retryable
+    failure: it backs off and retries within its deadline budget, so
+    an overload that clears mid-walk ends in SUCCESS — and one that
+    never clears surfaces the Overloaded kind, not a hang."""
+    from dbeel_tpu.client import native_client
+
+    if not native_client.available():
+        pytest.skip("native client library not built")
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        dp = shard.dataplane
+        if dp is None or not dp.shed_armed:
+            pytest.skip("native shed gate unavailable")
+        ip, port = node.db_address
+        loop = asyncio.get_event_loop()
+        nc = await loop.run_in_executor(
+            None, native_client.NativeDbeelClient, ip, port
+        )
+        try:
+            nc.set_retry(
+                op_deadline_ms=4000,
+                backoff_base_ms=20,
+                backoff_cap_ms=100,
+            )
+            shard.governor.force_level(LEVEL_HARD)
+            # Clear the overload while the C walk is mid-backoff: the
+            # walk must ride it out and land the write.
+            loop.call_later(
+                0.5, shard.governor.force_level, None
+            )
+            try:
+                await loop.run_in_executor(
+                    None, nc.set, "ov", "walk-key", {"v": 1}
+                )
+            finally:
+                shard.governor.force_level(None)
+            assert (
+                await loop.run_in_executor(
+                    None, nc.get, "ov", "walk-key"
+                )
+            ) == {"v": 1}
+
+            # Overload that never clears: the walk burns its budget
+            # and surfaces the retryable kind — never a hang.
+            nc.set_retry(op_deadline_ms=600)
+            shard.governor.force_level(LEVEL_HARD)
+            try:
+                t0 = time.monotonic()
+                with pytest.raises(Exception) as ei:
+                    await loop.run_in_executor(
+                        None, nc.set, "ov", "walk-key2", {"v": 2}
+                    )
+                assert "Overloaded" in str(ei.value)
+                assert time.monotonic() - t0 < 5.0
+            finally:
+                shard.governor.force_level(None)
+        finally:
+            nc.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
