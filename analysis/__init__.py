@@ -1,0 +1,31 @@
+"""dbeel-lint: build-enforced invariant checkers for the dual
+Python/C serving plane.
+
+The repo ships two implementations of one wire dialect — the Python
+control plane and the native data plane (native/src/*.cpp) — plus a
+thread-per-core concurrency model whose hazards (blocking the loop,
+stale shadow writes across an ``await``) recur as *patterns*, not
+one-offs.  These checkers encode the invariants that byte-parity
+tests used to catch by luck:
+
+- ``wire_parity``   — verb registries, frame arities, and ABI
+                      trailer sizes must agree across
+                      cluster/messages.py, the server handlers, and
+                      both C sources.
+- ``yield_hazards`` — no blocking calls inside ``async def``; no
+                      replica/coordinator memtable writes without a
+                      stale-abort guard.
+- ``stats_schema``  — every counter incremented in server code is
+                      exported through the ``get_stats`` schema both
+                      clients decode.
+- ``error_taxonomy``— every raised/framed error kind is registered,
+                      classifies into ERROR_CLASSES, and every
+                      retryable kind is handled by both clients'
+                      backoff walks.
+
+Run ``python -m analysis.lint`` (CI gates on it).  Audited
+exceptions carry a ``# lint: allow(<rule>)`` (Python) or
+``// lint: allow(<rule>)`` (C) escape comment on the flagged line or
+the line above.  Stdlib-only by design: ``ast`` for Python sources,
+comment-aware string extraction + regex for the C sources.
+"""

@@ -1,0 +1,220 @@
+"""Shared lint infrastructure: findings, escape comments, C-source
+string/comment handling, and the Repo path map checkers run against.
+
+Everything takes a ``root`` so the same checkers run against the real
+tree (``python -m analysis.lint``) and against fixture copies in
+tests (seed a violation, assert the checker fails).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------
+# Escape comments.  ``lint: allow(rule)`` (or ``allow(rule-a,rule-b)``)
+# suppresses findings for those rules on its own line AND the next
+# line, so it works both trailing a short statement and on its own
+# line above a call that spans several lines.
+# ---------------------------------------------------------------------
+
+_ALLOW_RE = re.compile(r"lint:\s*allow\(([a-z0-9_,\s-]+)\)")
+
+
+def allow_map(source: str) -> Dict[int, Set[str]]:
+    """line number (1-based) -> set of rule names allowed there."""
+    allowed: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        allowed.setdefault(i, set()).update(rules)
+        allowed.setdefault(i + 1, set()).update(rules)
+    return allowed
+
+
+def is_allowed(
+    allowed: Dict[int, Set[str]], line: int, rule: str
+) -> bool:
+    return rule in allowed.get(line, ())
+
+
+# ---------------------------------------------------------------------
+# C source handling: strip comments without disturbing line numbers or
+# string literals, and extract string literals with their lines.
+# ---------------------------------------------------------------------
+
+
+def strip_c_comments(src: str) -> str:
+    """Blank out // and /* */ comments, preserving newlines and
+    string/char literals (so "http://x" is not mangled)."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == '"' or c == "'":
+            quote = c
+            out.append(c)
+            i += 1
+            while i < n:
+                out.append(src[i])
+                if src[i] == "\\" and i + 1 < n:
+                    out.append(src[i + 1])
+                    i += 2
+                    continue
+                if src[i] == quote:
+                    i += 1
+                    break
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            end = n if j < 0 else j + 2
+            out.append(
+                "".join(ch if ch == "\n" else " " for ch in src[i:end])
+            )
+            i = end
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+_C_STR_RE = re.compile(r'"((?:[^"\\\n]|\\.)*)"')
+
+
+def c_string_literals(src: str) -> List[Tuple[int, str]]:
+    """(line, value) for every string literal outside comments."""
+    stripped = strip_c_comments(src)
+    out: List[Tuple[int, str]] = []
+    for m in _C_STR_RE.finditer(stripped):
+        line = stripped.count("\n", 0, m.start()) + 1
+        out.append((line, m.group(1)))
+    return out
+
+
+# ---------------------------------------------------------------------
+# Repo path map.
+# ---------------------------------------------------------------------
+
+
+class Repo:
+    """File locations the checkers read.  ``root`` is the repo root
+    (or a fixture tree mirroring its layout)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+
+    def path(self, *parts: str) -> str:
+        return os.path.join(self.root, *parts)
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root)
+
+    def read(self, *parts: str) -> str:
+        with open(self.path(*parts), "r", encoding="utf-8") as f:
+            return f.read()
+
+    def parse(self, *parts: str) -> ast.AST:
+        return ast.parse(self.read(*parts), filename=self.path(*parts))
+
+    # Named anchors (one place to update if files move).
+    @property
+    def messages_py(self) -> str:
+        return self.path("dbeel_tpu", "cluster", "messages.py")
+
+    @property
+    def errors_py(self) -> str:
+        return self.path("dbeel_tpu", "errors.py")
+
+    @property
+    def shard_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "shard.py")
+
+    @property
+    def db_server_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "db_server.py")
+
+    @property
+    def dataplane_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "dataplane.py")
+
+    @property
+    def metrics_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "metrics.py")
+
+    @property
+    def client_py(self) -> str:
+        return self.path("dbeel_tpu", "client", "__init__.py")
+
+    @property
+    def native_cpp(self) -> str:
+        return self.path("native", "src", "dbeel_native.cpp")
+
+    @property
+    def client_cpp(self) -> str:
+        return self.path("native", "src", "dbeel_client.cpp")
+
+    @property
+    def server_dir(self) -> str:
+        return self.path("dbeel_tpu", "server")
+
+    @property
+    def storage_dir(self) -> str:
+        return self.path("dbeel_tpu", "storage")
+
+    def py_files(self, directory: str) -> List[str]:
+        return sorted(
+            os.path.join(directory, f)
+            for f in os.listdir(directory)
+            if f.endswith(".py")
+        )
+
+
+def read_file(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'time.sleep' for Attribute(Name('time'),'sleep'); 'open' for
+    Name('open'); None for anything deeper/dynamic."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ):
+        return f"{node.value.id}.{node.attr}"
+    return None

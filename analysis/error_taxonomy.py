@@ -1,0 +1,165 @@
+"""Checker 4 — error-taxonomy coverage.
+
+The failure taxonomy (errors.ERROR_CLASSES) is the contract between
+the server's error frames, its per-class metrics, and both smart
+clients' replica-walk/backoff logic.  Four invariants:
+
+- every error KIND framed by the C sources ("KeyNotFound",
+  "Overloaded", ...) is a registered DbeelError kind — an
+  unregistered C string would reach clients as an unclassifiable
+  error and fall out of every backoff/metrics bucket;
+- every registered kind classifies into ERROR_CLASSES (or the benign
+  None) — executed against the imported module, not pattern-matched;
+- the Python client's walk stays centralized on
+  classify_error + is_retryable_class (one taxonomy, no shadow
+  copies of the retry list);
+- the C client's walk special-cases exactly the kinds that need
+  non-default handling — resync on KeyNotOwnedByShard, final-vs-walk
+  on KeyNotFound, backoff rounds on Overloaded — and every kind
+  literal it compares is registered.  (All other registered kinds
+  ride its record-and-advance default, which needs no per-kind
+  code.)
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+from typing import List, Set
+
+from .common import (
+    Finding,
+    Repo,
+    allow_map,
+    c_string_literals,
+    is_allowed,
+    read_file,
+)
+
+RULE = "error-taxonomy"
+
+# Kinds the C client MUST special-case by name for its walk to be
+# correct (everything else is record-and-advance by default).
+_C_CLIENT_REQUIRED_KINDS = (
+    "KeyNotOwnedByShard",
+    "KeyNotFound",
+    "Overloaded",
+)
+
+_CAMEL = re.compile(r"^[A-Z][A-Za-z]+$")
+
+
+def _load_errors_module(repo: Repo):
+    spec = importlib.util.spec_from_file_location(
+        "_lint_errors", repo.errors_py
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def check(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(path: str, line: int, message: str) -> None:
+        findings.append(Finding(RULE, repo.rel(path), line, message))
+
+    errors = _load_errors_module(repo)
+    kinds: Set[str] = set(errors._BY_KIND)
+    classes = set(errors.ERROR_CLASSES)
+
+    # -- every registered kind classifies into the taxonomy ----------
+    for kind, cls in errors._BY_KIND.items():
+        got = errors.classify_error(cls("lint probe"))
+        if got is not None and got not in classes:
+            add(
+                repo.errors_py,
+                1,
+                f"classify_error({kind}) returned {got!r}, which is "
+                "not in ERROR_CLASSES",
+            )
+
+    # -- C error strings must be registered kinds --------------------
+    for path in (repo.native_cpp, repo.client_cpp):
+        src = read_file(path)
+        allowed = allow_map(src)
+        for line, value in c_string_literals(src):
+            if not _CAMEL.match(value):
+                continue
+            if value in kinds:
+                continue
+            if is_allowed(allowed, line, RULE):
+                continue
+            add(
+                path,
+                line,
+                f"C error kind {value!r} is not registered in "
+                "errors.py — clients cannot classify it",
+            )
+
+    # -- Python client: centralized retry decision -------------------
+    client_tree = ast.parse(read_file(repo.client_py))
+    called = {
+        node.func.id
+        for node in ast.walk(client_tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+    }
+    for required in ("classify_error", "is_retryable_class"):
+        if required not in called:
+            add(
+                repo.client_py,
+                1,
+                f"Python client walk no longer calls {required}() — "
+                "the retry decision must stay on the shared "
+                "taxonomy, not a local kind list",
+            )
+
+    # -- C client: required special cases present, all kinds known ---
+    c_src = read_file(repo.client_cpp)
+    c_literals = c_string_literals(c_src)
+    c_values = {v for _ln, v in c_literals}
+    for kind in _C_CLIENT_REQUIRED_KINDS:
+        if kind not in c_values:
+            add(
+                repo.client_cpp,
+                1,
+                f"C client walk lost its {kind!r} special case — "
+                "resync/backoff behavior for that kind is gone",
+            )
+
+    # -- server metrics count by the same class list -----------------
+    metrics_src = read_file(repo.metrics_py)
+    if "ERROR_CLASSES" not in metrics_src:
+        add(
+            repo.metrics_py,
+            1,
+            "server metrics no longer key error counters by "
+            "errors.ERROR_CLASSES",
+        )
+
+    # -- retryable classes: every one must originate from a kind or
+    # transport condition classify_error can actually produce (a
+    # class nothing maps to is dead taxonomy).
+    produced: Set[str] = set()
+    for kind, cls in errors._BY_KIND.items():
+        got = errors.classify_error(cls("lint probe"))
+        if got is not None:
+            produced.add(got)
+    produced.add(errors.classify_error(OSError("probe")))
+    import asyncio
+
+    produced.add(errors.classify_error(asyncio.TimeoutError()))
+    for cls_name in classes:
+        if errors.is_retryable_class(cls_name) and (
+            cls_name not in produced
+        ):
+            add(
+                repo.errors_py,
+                1,
+                f"retryable class {cls_name!r} is produced by no "
+                "error kind — dead taxonomy entry",
+            )
+
+    return findings

@@ -1,0 +1,100 @@
+"""dbeel-lint runner: ``python -m analysis.lint``.
+
+Runs every invariant checker over the tree and exits nonzero on any
+finding — the CI gate.  ``--root`` points the suite at an alternate
+tree (fixture tests use this to prove each rule still fires);
+``--rules`` narrows to a comma-separated subset; ``--list-rules``
+prints the registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Callable, Dict, List
+
+from . import error_taxonomy, stats_schema, wire_parity, yield_hazards
+from .common import Finding, Repo
+
+# rule-set name -> checker entry point.  yield_hazards owns two rule
+# ids (async-blocking, stale-write-guard) behind one entry.
+CHECKERS: Dict[str, Callable[[Repo], List[Finding]]] = {
+    "wire-parity": wire_parity.check,
+    "yield-hazards": yield_hazards.check,
+    "stats-schema": stats_schema.check,
+    "error-taxonomy": error_taxonomy.check,
+}
+
+_DEFAULT_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+
+
+def run(
+    root: str = _DEFAULT_ROOT, rules: "List[str] | None" = None
+) -> List[Finding]:
+    repo = Repo(root)
+    findings: List[Finding] = []
+    for name, checker in CHECKERS.items():
+        if rules and name not in rules:
+            continue
+        findings.extend(checker(repo))
+    return findings
+
+
+def main(argv: "List[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m analysis.lint",
+        description=__doc__,
+    )
+    parser.add_argument("--root", default=_DEFAULT_ROOT)
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated checker subset "
+        f"(default: all of {', '.join(CHECKERS)})",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", dest="list_rules"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, checker in CHECKERS.items():
+            doc = (checker.__module__ or "").rsplit(".", 1)[-1]
+            print(f"{name:<16} analysis/{doc}.py")
+        return 0
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    if rules:
+        unknown = [r for r in rules if r not in CHECKERS]
+        if unknown:
+            print(
+                f"unknown rule set(s): {', '.join(unknown)} "
+                f"(known: {', '.join(CHECKERS)})",
+                file=sys.stderr,
+            )
+            return 2
+
+    findings = run(args.root, rules)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(
+            f"\ndbeel-lint: {len(findings)} finding(s). "
+            "Fix the invariant or escape-audit the site with "
+            "'# lint: allow(<rule>)'.",
+            file=sys.stderr,
+        )
+        return 1
+    print("dbeel-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

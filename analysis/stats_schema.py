@@ -1,0 +1,251 @@
+"""Checker 3 — stats-schema drift.
+
+Every counter the server increments must be visible through the
+``get_stats`` snapshot both clients decode (the Python client's
+``get_stats()`` and the C client's ``dbeel_cli_get_stats`` both pass
+the server's msgpack map through verbatim, so the server-side schema
+IS the contract).  A counter that is incremented but never exported
+is dead observability: the next operator debugging an incident
+cannot see it, and the next bench cannot gate on it.
+
+Mechanics: an increment is ``self.<name> += ...`` (or
+``self.<name>[k] += ...`` for per-key counter dicts) anywhere under
+``dbeel_tpu/server/``, attributed to its enclosing class.  A counter
+passes when, INSIDE a stats-assembly function (``get_stats``/
+``stats``/``snapshot``/helpers) of the server package or the storage
+modules get_stats aggregates (wal.py, lsm_tree.py):
+
+- its name appears as a string dict key, ``.update()`` keyword, or
+  subscript-assign key (schema keys are a global namespace), or
+- the SAME class's stats function reads it as ``self.<name>`` (a
+  different class reading its own same-named attribute must not
+  vacuously excuse this one), or
+- any dotted read (``self.hint_log.recorded``, ``_default.launches``)
+  terminates in the name — cross-object exports cannot be
+  class-resolved without type inference, so these stay global.
+
+Known precision limit: a counter whose NAME collides with an
+existing schema key (e.g. a new ``self.count``) passes vacuously —
+name-level matching cannot tell two same-named counters apart.
+Deliberately-internal state carries ``# lint: allow(stats-schema)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import (
+    Finding,
+    Repo,
+    allow_map,
+    const_str,
+    is_allowed,
+    read_file,
+)
+
+RULE = "stats-schema"
+
+# Functions whose bodies assemble stats payloads: reads/keys inside
+# them export names.
+_STATS_FUNCS = {
+    "get_stats",
+    "stats",
+    "snapshot",
+    "_native_path_stats",
+    "queued_by_node",
+    "queued_total",
+    "group_commit_stats",
+}
+
+
+class _ClassWalker(ast.NodeVisitor):
+    """Tracks the enclosing ClassDef name while visiting."""
+
+    def __init__(self) -> None:
+        self._class: Optional[str] = None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        saved, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = saved
+
+
+class _IncrementCollector(_ClassWalker):
+    """(class, name, line) for every ``self.X += n`` /
+    ``self.X[k] += n`` with a public X."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.found: List[Tuple[Optional[str], str, int]] = []
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, ast.Add):
+            target = node.target
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and not target.attr.startswith("_")
+            ):
+                self.found.append(
+                    (self._class, target.attr, node.lineno)
+                )
+        self.generic_visit(node)
+
+
+class _ExportCollector(_ClassWalker):
+    """Harvests the export universe from stats-assembly functions:
+    global schema keys, per-class self.<attr> reads, and global
+    dotted-read terminals."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.keys: Set[str] = set()
+        self.dotted: Set[str] = set()
+        self.self_reads: Dict[str, Set[str]] = {}
+        self._in_stats = 0
+
+    def _visit_fn(self, node) -> None:
+        is_stats = node.name in _STATS_FUNCS
+        if is_stats:
+            self._in_stats += 1
+        self.generic_visit(node)
+        if is_stats:
+            self._in_stats -= 1
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        if self._in_stats:
+            for k in node.keys:
+                if k is not None:
+                    val = const_str(k)
+                    if val is not None:
+                        self.keys.add(val)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            self._in_stats
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "update"
+        ):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    self.keys.add(kw.arg)
+        self.generic_visit(node)
+
+    def _subscript_keys(self, targets) -> None:
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                val = const_str(t.slice)
+                if val is not None:
+                    self.keys.add(val)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._in_stats:
+            self._subscript_keys(node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self._in_stats:
+            self._subscript_keys([node.target])
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self._in_stats and isinstance(node.ctx, ast.Load):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                if self._class is not None:
+                    self.self_reads.setdefault(
+                        self._class, set()
+                    ).add(node.attr)
+                else:  # pragma: no cover - self outside a class
+                    self.dotted.add(node.attr)
+            else:
+                # self.hint_log.recorded, _default.launches, dp.get:
+                # cross-object chains are un-resolvable statically —
+                # their terminal names count globally.
+                self.dotted.add(node.attr)
+        self.generic_visit(node)
+
+
+def check(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    server_files = (
+        repo.py_files(repo.server_dir)
+        if os.path.isdir(repo.server_dir)
+        else []
+    )
+    # Storage modules whose counters shard.get_stats aggregates.
+    extra = [
+        p
+        for p in (
+            repo.path("dbeel_tpu", "storage", "wal.py"),
+            repo.path("dbeel_tpu", "storage", "lsm_tree.py"),
+        )
+        if os.path.exists(p)
+    ]
+
+    exports = _ExportCollector()
+    increments: List[Tuple[str, str, Optional[str], str, int]] = []
+    for path in server_files + extra:
+        src = read_file(path)
+        tree = ast.parse(src, filename=path)
+        exports.visit(tree)
+        if path in server_files:
+            inc = _IncrementCollector()
+            inc.visit(tree)
+            for cls, name, line in inc.found:
+                increments.append((path, src, cls, name, line))
+
+    for path, src, cls, name, line in increments:
+        if name in exports.keys or name in exports.dotted:
+            continue
+        if cls is not None and name in exports.self_reads.get(
+            cls, ()
+        ):
+            continue
+        if is_allowed(allow_map(src), line, RULE):
+            continue
+        findings.append(
+            Finding(
+                RULE,
+                repo.rel(path),
+                line,
+                f"counter self.{name} is incremented but never "
+                "exported through the get_stats schema — add it "
+                "to the snapshot (or escape-audit internal state)",
+            )
+        )
+
+    # Both clients must expose the passthrough decoder the schema
+    # rides on.
+    client_src = read_file(repo.client_py)
+    if "def get_stats" not in client_src:
+        findings.append(
+            Finding(
+                RULE,
+                repo.rel(repo.client_py),
+                1,
+                "Python client lost its get_stats() decoder",
+            )
+        )
+    c_client_src = read_file(repo.client_cpp)
+    if "dbeel_cli_get_stats" not in c_client_src:
+        findings.append(
+            Finding(
+                RULE,
+                repo.rel(repo.client_cpp),
+                1,
+                "C client lost its dbeel_cli_get_stats entry point",
+            )
+        )
+    return findings

@@ -1,0 +1,509 @@
+"""Checker 1 — wire-dialect parity across the dual Python/C planes.
+
+One wire dialect, four implementations: the Python encoders
+(cluster/messages.py), the Python peer/client handlers
+(server/shard.py, server/db_server.py), and the two C sources
+(native/src/dbeel_native.cpp parses + emits peer and client frames,
+native/src/dbeel_client.cpp emits client frames).  PR 6 caught a
+17B-vs-25B trailer misparse and a missed deadline drop only because
+hand-written byte-parity tests happened to cover those frames; this
+checker makes the whole dialect drift-proof:
+
+- every ShardRequest verb has a Python encoder AND a
+  handle_shard_request branch; request/response registries stay
+  symmetric (ping->pong, error is response-only);
+- every wire-token string literal in the C sources is a member of a
+  Python-side registry (peer verbs, client op types, request map
+  fields) — a C typo or a verb added on one plane only fails here;
+- peer-frame arities agree three ways: the encoder list lengths, the
+  server's _PEER_DEADLINE_INDEX (deadline = element AFTER the base
+  arity), and the C parser's ``want`` expression;
+- named ABI constants agree: the coordinator-assist get trailer
+  header (the exact 17->25 stale-ABI class PR 6 had to gate at
+  runtime) and the client-dialect status byte.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .common import (
+    Finding,
+    Repo,
+    allow_map,
+    c_string_literals,
+    const_str,
+    is_allowed,
+    read_file,
+    strip_c_comments,
+)
+
+RULE = "wire-parity"
+
+# msgpack document tags shared by every frame shape.
+_TAGS = {"request", "response", "event", "error"}
+
+# Storage-plane file kinds that appear as C literals but are not wire
+# vocabulary (compaction triplet extensions / stat labels).
+_NON_WIRE_C_STRINGS = {"data", "index", "bloom"}
+
+# The C client additionally emits these request-map fields that the
+# PYTHON client does not use (C-only conveniences the server decodes
+# via the same request.get path).
+_VERBISH = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _class_str_attrs(tree: ast.AST, cls_name: str) -> Dict[str, str]:
+    """UPPER_NAME -> "wire-string" assignments of a class body."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    val = const_str(stmt.value)
+                    if val is not None:
+                        out[stmt.targets[0].id] = val
+    return out
+
+
+def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
+    """Base element count of the list literal each encoder
+    staticmethod returns, keyed by verb attribute name.  Handles the
+    ``_with_deadline([...], deadline_ms)`` wrapper (the optional
+    trailing deadline is NOT part of the base arity)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.ClassDef) and node.name == cls_name
+        ):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for ret in ast.walk(fn):
+                if not isinstance(ret, ast.Return) or ret.value is None:
+                    continue
+                value = ret.value
+                if (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr == "_with_deadline"
+                    and value.args
+                ):
+                    value = value.args[0]
+                if not isinstance(value, ast.List) or not value.elts:
+                    continue
+                if const_str(value.elts[0]) != "request":
+                    continue
+                verb = value.elts[1]
+                if (
+                    isinstance(verb, ast.Attribute)
+                    and isinstance(verb.value, ast.Name)
+                    and verb.value.id == cls_name
+                ):
+                    out[verb.attr] = len(value.elts)
+    return out
+
+
+def _peer_deadline_index(tree: ast.AST) -> Dict[str, int]:
+    """shard.py's _PEER_DEADLINE_INDEX: ShardRequest.VERB -> index."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_PEER_DEADLINE_INDEX"
+            and isinstance(node.value, ast.Dict)
+        ):
+            out: Dict[str, int] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (
+                    isinstance(k, ast.Attribute)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                ):
+                    out[k.attr] = v.value
+            return out
+    return {}
+
+
+def _handled_request_verbs(tree: ast.AST) -> Set[str]:
+    """ShardRequest.X attribute names referenced anywhere inside
+    handle_shard_request (comparisons and membership tests)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.AsyncFunctionDef)
+            and node.name == "handle_shard_request"
+        ):
+            for n in ast.walk(node):
+                if (
+                    isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "ShardRequest"
+                ):
+                    out.add(n.attr)
+    return out
+
+
+def _client_op_types(db_server_tree: ast.AST) -> Set[str]:
+    """String literals the client-plane dispatcher compares ``rtype``
+    against — the server-decoded client op registry."""
+    out: Set[str] = set()
+    for node in ast.walk(db_server_tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        names = [
+            n.id for n in ast.walk(node.left) if isinstance(n, ast.Name)
+        ]
+        if "rtype" not in names:
+            continue
+        for comp in node.comparators:
+            for sub in ast.walk(comp):
+                val = const_str(sub)
+                if val is not None:
+                    out.add(val)
+    # Names held in op-set constants referenced by rtype membership
+    # tests (e.g. _SHEDDABLE_OPS) resolve through module-level
+    # assignments of set/tuple literals.
+    for node in ast.walk(db_server_tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.endswith("_OPS")
+        ):
+            for sub in ast.walk(node.value):
+                val = const_str(sub)
+                if val is not None:
+                    out.add(val)
+    return out
+
+
+def _request_fields(
+    db_server_tree: ast.AST, client_tree: ast.AST
+) -> Set[str]:
+    """Client-dialect request map fields: what the server reads
+    (``request.get("x")`` / ``_extract(request, "x")``) plus every
+    plain-string dict key the Python client packs."""
+    fields: Set[str] = {"type"}
+    for node in ast.walk(db_server_tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "get"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "request"
+            and node.args
+        ):
+            val = const_str(node.args[0])
+            if val is not None:
+                fields.add(val)
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("_extract", "extract_key")
+            and len(node.args) >= 2
+        ):
+            val = const_str(node.args[1])
+            if val is not None:
+                fields.add(val)
+    for node in ast.walk(client_tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                val = const_str(k) if k is not None else None
+                if val is not None and _VERBISH.match(val):
+                    fields.add(val)
+        # request["field"] = ... (post-construction stamps like
+        # hash / replica_index / deadline_ms / timeout).
+        if isinstance(node, ast.Subscript):
+            val = const_str(node.slice)
+            if val is not None and _VERBISH.match(val):
+                fields.add(val)
+    return fields
+
+
+def _client_emitted_types(client_tree: ast.AST) -> Set[str]:
+    """Values the Python client puts under the "type" key."""
+    out: Set[str] = set()
+    for node in ast.walk(client_tree):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is not None and const_str(k) == "type":
+                    val = const_str(v)
+                    if val is not None:
+                        out.add(val)
+    return out
+
+
+def _module_int_constant(
+    tree: ast.AST, name: str
+) -> Optional[int]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+        ):
+            return node.value.value
+    return None
+
+
+def _c_constexpr(src: str, name: str) -> Optional[int]:
+    m = re.search(
+        r"constexpr\s+\w+\s+" + re.escape(name) + r"\s*=\s*(\d+)",
+        strip_c_comments(src),
+    )
+    return int(m.group(1)) if m else None
+
+
+_WANT_RE = re.compile(
+    r"want\s*=\s*k_set\s*\?\s*(\d+)u?\s*:\s*k_del\s*\?\s*(\d+)u?"
+    r"\s*:\s*(\d+)u?"
+)
+
+
+def check(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(path: str, line: int, message: str) -> None:
+        findings.append(Finding(RULE, repo.rel(path), line, message))
+
+    messages = ast.parse(read_file(repo.messages_py))
+    shard = ast.parse(read_file(repo.shard_py))
+    db_server = ast.parse(read_file(repo.db_server_py))
+    client = ast.parse(read_file(repo.client_py))
+    native_src = read_file(repo.native_cpp)
+    client_src = read_file(repo.client_cpp)
+
+    req = _class_str_attrs(messages, "ShardRequest")
+    resp = _class_str_attrs(messages, "ShardResponse")
+    events = _class_str_attrs(messages, "ShardEvent")
+    gossip = _class_str_attrs(messages, "GossipEvent")
+    if not req or not resp:
+        add(
+            repo.messages_py,
+            1,
+            "could not extract ShardRequest/ShardResponse registries "
+            "— messages.py restructured? update analysis/wire_parity",
+        )
+        return findings
+
+    # -- registry symmetry -------------------------------------------
+    for name, verb in req.items():
+        if name == "PING":
+            continue
+        if verb not in resp.values():
+            add(
+                repo.messages_py,
+                1,
+                f"request verb {verb!r} has no ShardResponse "
+                "counterpart",
+            )
+    for name, verb in resp.items():
+        if name in ("PONG", "ERROR"):
+            continue
+        if verb not in req.values():
+            add(
+                repo.messages_py,
+                1,
+                f"response verb {verb!r} has no ShardRequest "
+                "counterpart",
+            )
+
+    # -- every request verb has an encoder and a server handler ------
+    arities = _encoder_arities(messages, "ShardRequest")
+    for name in req:
+        if name not in arities:
+            add(
+                repo.messages_py,
+                1,
+                f"ShardRequest.{name} has no encoder staticmethod "
+                "returning a [\"request\", ...] frame",
+            )
+    handled = _handled_request_verbs(shard)
+    for name in req:
+        if name not in handled:
+            add(
+                repo.shard_py,
+                1,
+                f"ShardRequest.{name} not handled in "
+                "handle_shard_request — a peer frame for it would "
+                "fall through",
+            )
+
+    # -- arity agreement: encoders vs deadline table vs C parser -----
+    deadline_index = _peer_deadline_index(shard)
+    if not deadline_index:
+        add(
+            repo.shard_py,
+            1,
+            "_PEER_DEADLINE_INDEX not found — shard.py restructured? "
+            "update analysis/wire_parity",
+        )
+    for name, idx in deadline_index.items():
+        enc = arities.get(name)
+        if enc is not None and enc != idx:
+            add(
+                repo.shard_py,
+                1,
+                f"peer-frame arity drift for {req.get(name, name)!r}: "
+                f"encoder emits {enc} elements but "
+                f"_PEER_DEADLINE_INDEX expects the deadline at "
+                f"index {idx}",
+            )
+    m = _WANT_RE.search(strip_c_comments(native_src))
+    if m is None:
+        add(
+            repo.native_cpp,
+            1,
+            "C shard-plane arity expression "
+            "(want = k_set ? .. : k_del ? .. : ..) not found — "
+            "parser restructured? update analysis/wire_parity",
+        )
+    else:
+        c_arity = {
+            "SET": int(m.group(1)),
+            "DELETE": int(m.group(2)),
+            "GET": int(m.group(3)),
+            "GET_DIGEST": int(m.group(3)),
+            "MULTI_SET": int(m.group(3)),
+            "MULTI_GET": int(m.group(3)),
+        }
+        line = (
+            strip_c_comments(native_src).count("\n", 0, m.start()) + 1
+        )
+        for name, want in c_arity.items():
+            idx = deadline_index.get(name)
+            if idx is not None and idx != want:
+                add(
+                    repo.native_cpp,
+                    line,
+                    f"C parser expects {want} base elements for "
+                    f"{req.get(name, name)!r} but the Python plane "
+                    f"uses {idx} — peer-frame arity drift",
+                )
+
+    # -- every C wire-token literal is in a Python registry ----------
+    peer_verbs = (
+        set(req.values())
+        | set(resp.values())
+        | set(events.values())
+        | set(gossip.values())
+    )
+    client_ops = _client_op_types(db_server)
+    fields = _request_fields(db_server, client)
+    known = (
+        _TAGS
+        | peer_verbs
+        | client_ops
+        | fields
+        | _NON_WIRE_C_STRINGS
+    )
+    for path, src in (
+        (repo.native_cpp, native_src),
+        (repo.client_cpp, client_src),
+    ):
+        allowed = allow_map(src)
+        for line, value in c_string_literals(src):
+            if not _VERBISH.match(value):
+                continue  # messages, paths, format strings
+            if value in known:
+                continue
+            if is_allowed(allowed, line, RULE):
+                continue
+            add(
+                path,
+                line,
+                f"C wire string {value!r} is in no Python registry "
+                "(ShardRequest/ShardResponse verbs, client op types, "
+                "request fields) — dialect drift or typo",
+            )
+
+    # -- Python client op types must be server-decoded ---------------
+    for op in sorted(_client_emitted_types(client)):
+        if op not in client_ops:
+            add(
+                repo.client_py,
+                1,
+                f"Python client emits op type {op!r} that "
+                "db_server.py never dispatches",
+            )
+
+    # -- named ABI constants -----------------------------------------
+    dataplane_tree = ast.parse(read_file(repo.dataplane_py))
+    py_trailer = _module_int_constant(
+        dataplane_tree, "COORD_GET_TRAILER_HDR"
+    )
+    c_trailer = _c_constexpr(native_src, "kCoordGetTrailerHdr")
+    if py_trailer is None:
+        add(
+            repo.dataplane_py,
+            1,
+            "COORD_GET_TRAILER_HDR constant missing — the coord-get "
+            "trailer layout must be a named, lint-compared constant "
+            "(the 17->25B misparse class, PR 6)",
+        )
+    if c_trailer is None:
+        add(
+            repo.native_cpp,
+            1,
+            "kCoordGetTrailerHdr constexpr missing — the coord-get "
+            "trailer layout must be a named, lint-compared constant",
+        )
+    if (
+        py_trailer is not None
+        and c_trailer is not None
+        and py_trailer != c_trailer
+    ):
+        add(
+            repo.dataplane_py,
+            1,
+            f"coord-get trailer header size drift: Python parses "
+            f"{py_trailer}B, C emits {c_trailer}B — the exact "
+            "stale-ABI class PR 6 guarded at runtime",
+        )
+
+    py_ok = _module_int_constant(client, "RESPONSE_OK")
+    py_err = _module_int_constant(client, "RESPONSE_ERR")
+    for path, src in (
+        (repo.native_cpp, native_src),
+        (repo.client_cpp, client_src),
+    ):
+        c_ok = _c_constexpr(src, "kResponseOk")
+        c_err = _c_constexpr(src, "kResponseErr")
+        if c_ok is None or c_err is None:
+            add(
+                path,
+                1,
+                "kResponseOk/kResponseErr constexpr missing — the "
+                "client-dialect status byte must be a named, "
+                "lint-compared constant",
+            )
+            continue
+        if py_ok is not None and c_ok != py_ok:
+            add(
+                path,
+                1,
+                f"status-byte drift: kResponseOk={c_ok} but Python "
+                f"client RESPONSE_OK={py_ok}",
+            )
+        if py_err is not None and c_err != py_err:
+            add(
+                path,
+                1,
+                f"status-byte drift: kResponseErr={c_err} but Python "
+                f"client RESPONSE_ERR={py_err}",
+            )
+
+    return findings

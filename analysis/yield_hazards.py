@@ -1,0 +1,179 @@
+"""Checker 2 — yield-point hazards in the thread-per-core planes.
+
+Two rules over ``dbeel_tpu/server/`` and ``dbeel_tpu/storage/``:
+
+- ``async-blocking``: a blocking call (``time.sleep``,
+  ``subprocess.*``, sync file I/O) whose nearest enclosing function
+  is ``async def`` stalls EVERY connection on the shard's event loop.
+  Audited sync-I/O sites (tiny metadata writes on rare control paths)
+  carry a ``# lint: allow(async-blocking)`` escape.
+
+- ``stale-write-guard``: in server code, a memtable write
+  (``set_with_timestamp`` / ``set_batch_with_timestamp``) without a
+  ``stale_abort``/``stale_abort_from`` keyword re-opens the
+  stale-shadow window: the pre-write probe goes stale when a
+  capacity wait inside the insert spans a flush swap, and an older
+  timestamp lands in a layer ABOVE a flushed newer value — the class
+  ADVICE kept re-finding (apply_if_newer, handle_shard_set_message,
+  and PR 7 found the coordinator write paths).  Sites whose
+  timestamps cannot race (none survived the audit) would carry
+  ``# lint: allow(stale-write-guard)``.
+
+Nested SYNC defs and lambdas inside an async function are skipped:
+they are executor targets/callbacks, and flagging them would force
+escapes on the exact off-loop pattern the rule wants to encourage.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from .common import (
+    Finding,
+    Repo,
+    allow_map,
+    dotted_name,
+    is_allowed,
+    read_file,
+)
+
+RULE_BLOCKING = "async-blocking"
+RULE_STALE = "stale-write-guard"
+RULES = (RULE_BLOCKING, RULE_STALE)
+
+# Call names that block the loop.  Deliberately explicit — inference
+# on arbitrary objects would drown the signal; extend the set when a
+# new blocking idiom appears.
+BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "os.system",
+    # Sync file I/O: metadata-size writes are sometimes deliberate on
+    # rare control paths (escape-audited); data-path usage is a bug.
+    "open",
+    "io.open",
+    "os.open",
+    "os.replace",
+    "os.rename",
+    "os.fsync",
+    "os.fdatasync",
+    "os.makedirs",
+    "os.remove",
+    "os.unlink",
+    "os.truncate",
+    "shutil.rmtree",
+    "shutil.move",
+    "shutil.copy",
+    "shutil.copyfile",
+}
+
+_WRITE_CALLS = {"set_with_timestamp", "set_batch_with_timestamp"}
+_GUARD_KWARGS = {"stale_abort", "stale_abort_from"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(
+        self, path: str, source: str, check_stale: bool
+    ) -> None:
+        self.path = path
+        self.allowed = allow_map(source)
+        self.check_stale = check_stale
+        self.findings: List[Finding] = []
+        self._async_depth = 0
+
+    # -- scope tracking ------------------------------------------------
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # A sync def nested inside an async def is an executor
+        # target/callback: its body runs off-loop, so suspend the
+        # async-blocking context while visiting it.
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    def visit_Lambda(self, node: ast.Lambda):
+        saved, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = saved
+
+    # -- rules ---------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted_name(node.func)
+        if (
+            self._async_depth > 0
+            and name in BLOCKING_CALLS
+            and not is_allowed(self.allowed, node.lineno, RULE_BLOCKING)
+        ):
+            self.findings.append(
+                Finding(
+                    RULE_BLOCKING,
+                    self.path,
+                    node.lineno,
+                    f"blocking call {name}() inside async def — "
+                    "stalls every connection on this shard's loop; "
+                    "use the executor/aio wrapper or escape-audit it",
+                )
+            )
+        if (
+            self.check_stale
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _WRITE_CALLS
+            and not any(
+                kw.arg in _GUARD_KWARGS for kw in node.keywords
+            )
+            and not is_allowed(self.allowed, node.lineno, RULE_STALE)
+        ):
+            self.findings.append(
+                Finding(
+                    RULE_STALE,
+                    self.path,
+                    node.lineno,
+                    f"{node.func.attr}() without a stale_abort/"
+                    "stale_abort_from guard: a capacity wait spanning "
+                    "a flush swap can land an older ts above a "
+                    "flushed newer value (stale-shadow class) — pass "
+                    "the guard and apply rejects via apply_if_newer",
+                )
+            )
+        self.generic_visit(node)
+
+
+def check_source(
+    source: str, path: str, check_stale: bool = True
+) -> List[Finding]:
+    """Run both rules over one file's source (fixture-testable)."""
+    visitor = _Visitor(path, source, check_stale)
+    visitor.visit(ast.parse(source, filename=path))
+    return visitor.findings
+
+
+def check(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for directory, check_stale in (
+        # stale-write-guard applies to SERVER write paths; the
+        # storage layer's own set()/delete() wrappers are the
+        # definitional call sites the guard kwargs live on.
+        (repo.server_dir, True),
+        (repo.storage_dir, False),
+    ):
+        if not os.path.isdir(directory):
+            continue
+        for path in repo.py_files(directory):
+            findings.extend(
+                check_source(
+                    read_file(path), repo.rel(path), check_stale
+                )
+            )
+    return findings

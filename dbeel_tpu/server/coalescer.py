@@ -134,6 +134,17 @@ def default_coalescer() -> CompactionCoalescer:
     return _default
 
 
+def stats() -> "dict | None":
+    """Process-wide coalescer counters for ``get_stats`` (None until
+    the first device merge constructs the singleton)."""
+    if _default is None:
+        return None
+    return {
+        "launches": _default.launches,
+        "jobs_coalesced": _default.jobs_coalesced,
+    }
+
+
 class CoalescedDeviceMergeStrategy:
     """CompactionStrategy whose sort rides the shared coalescer.
     Exposes ``merge_async`` (the LSM tree prefers it when present) so

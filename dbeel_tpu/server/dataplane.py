@@ -57,6 +57,29 @@ class _TableDesc(ctypes.Structure):
 # msgpack "OK" + RESPONSE_BYTES trailing byte (db_server.rs:405-428).
 OK_RESPONSE = b"\x04\x00\x00\x00\xa2OK\x02"
 
+# Field widths of the coordinator-assist get trailer header
+# dbeel_dp_handle_coord appends after the peer frame.  The parse
+# derives its offsets FROM these widths, so a layout change that
+# forgets to move an offset cannot exist on this side; the C emitter
+# static_asserts the same sum next to its literal offsets, and the
+# wire-parity lint compares the totals — a one-sided change is
+# exactly the 17->25B stale-ABI misparse PR 6 had to guard at
+# runtime.
+_TRAILER_HIT = 1  # u8 hit flag
+_TRAILER_VLEN = 4  # u32 value length
+_TRAILER_TS = 8  # i64 entry timestamp
+_TRAILER_KLEN = 4  # u32 key length
+_TRAILER_DEADLINE = 8  # i64 propagated deadline_ms
+_OFF_VLEN = _TRAILER_HIT
+_OFF_TS = _OFF_VLEN + _TRAILER_VLEN
+_OFF_KLEN = _OFF_TS + _TRAILER_TS
+_OFF_DEADLINE = _OFF_KLEN + _TRAILER_KLEN
+# Literal (the wire-parity lint compares it against the C constexpr
+# textually); the assert ties it to the widths above so it cannot
+# drift from the offsets the parse actually uses.
+COORD_GET_TRAILER_HDR = 25
+assert COORD_GET_TRAILER_HDR == _OFF_DEADLINE + _TRAILER_DEADLINE
+
 _GET_BUF_CAP = 256 << 10
 # The native planes return -2 with *out_len = required bytes when a
 # (side-effect-free) frame only failed for buffer room — grow and
@@ -638,25 +661,38 @@ class DataPlane:
         deadline_ms = None
         if flags & 8:
             op = "get"
-            # 25-byte trailer header (ISSUE 6): hit flag, value len,
-            # ts, key len, then the propagated wall-clock deadline
-            # the C side stamped on the peer frame — the digest round
-            # (whose frame Python packs) must carry the SAME budget.
+            # COORD_GET_TRAILER_HDR-byte trailer header (ISSUE 6):
+            # hit flag, value len, ts, key len, then the propagated
+            # wall-clock deadline the C side stamped on the peer
+            # frame — the digest round (whose frame Python packs)
+            # must carry the SAME budget.  Layout changes bump the
+            # constant IN LOCKSTEP with kCoordGetTrailerHdr in
+            # dbeel_native.cpp (wire-parity lint compares them — the
+            # 17->25B stale-ABI misparse class).
+            hdr_end = COORD_GET_TRAILER_HDR
             trailer = out[peer_len:]
-            vlen = int.from_bytes(trailer[1:5], "little")
-            klen = int.from_bytes(trailer[13:17], "little")
+            vlen = int.from_bytes(
+                trailer[_OFF_VLEN : _OFF_VLEN + _TRAILER_VLEN],
+                "little",
+            )
+            klen = int.from_bytes(
+                trailer[_OFF_KLEN : _OFF_KLEN + _TRAILER_KLEN],
+                "little",
+            )
             deadline_ms = int.from_bytes(
-                trailer[17:25], "little", signed=True
+                trailer[_OFF_DEADLINE:hdr_end], "little", signed=True
             )
             if trailer[0]:
                 ts = int.from_bytes(
-                    trailer[5:13], "little", signed=True
+                    trailer[_OFF_TS : _OFF_TS + _TRAILER_TS],
+                    "little",
+                    signed=True,
                 )
-                local_entry = (trailer[25 : 25 + vlen], ts)
+                local_entry = (trailer[hdr_end : hdr_end + vlen], ts)
             else:
                 local_entry = ("miss",)
                 vlen = 0
-            key = trailer[25 + vlen : 25 + vlen + klen]
+            key = trailer[hdr_end + vlen : hdr_end + vlen + klen]
         else:
             op = "delete" if flags & 4 else "set"
         cons_p1 = (flags >> 24) & 0xFF

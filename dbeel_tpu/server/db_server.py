@@ -263,7 +263,18 @@ async def handle_request(
         consistency = min(consistency, rf)
 
         async def local_write():
-            await col.tree.set_with_timestamp(key, value, timestamp)
+            # stale_abort: if our capacity wait spans a flush swap
+            # that lands a NEWER write for this key, a blind insert
+            # would put our older ts in a layer above it and
+            # first-match reads would serve it — apply read-guarded
+            # instead (LWW: whichever ts is newer wins), the same
+            # contract as the replica-side handle_shard_set_message.
+            if not await col.tree.set_with_timestamp(
+                key, value, timestamp, stale_abort=True
+            ):
+                await my_shard.apply_if_newer(
+                    col.tree, key, value, timestamp
+                )
 
         if rf > 1:
             peer_deadline = _wall_deadline_ms(request, timeout_ms)
@@ -571,8 +582,20 @@ async def _multi_set_keyed(
 ) -> None:
     entries = [(key, value, timestamp) for _i, key, value in keyed]
     op_status: dict = {}
+
+    async def local_batch():
+        # stale_abort mirrors the single-set coordinator path: a
+        # capacity wait spanning a flush swap must not land our
+        # older ts above a flushed newer value — rejected entries
+        # apply read-guarded (LWW).
+        rejected = await col.tree.set_batch_with_timestamp(
+            entries, stale_abort=True
+        )
+        for k, v, ts in rejected:
+            await my_shard.apply_if_newer(col.tree, k, v, ts)
+
     try:
-        local = col.tree.set_batch_with_timestamp(entries)
+        local = local_batch()
         if rf > 1:
             remote = my_shard.send_request_to_replicas(
                 ShardRequest.multi_set(

@@ -293,6 +293,7 @@ class FramedServerProtocol(asyncio.Protocol):
     # -- framing ----------------------------------------------------
 
     def data_received(self, data: bytes) -> None:
+        # lint: allow(stats-schema) — bytearray append, not a counter
         self.buf += data
         self._on_data()
         parsed = False

@@ -36,6 +36,8 @@ class LatencyHistogram:
 
     def record_us(self, us: int) -> None:
         b = min(_BUCKETS - 1, max(0, int(us).bit_length() - 1))
+        # raw buckets are internal; snapshot() exports them as
+        # percentiles/mean/max.  lint: allow(stats-schema)
         self.counts[b] += 1
         self.count += 1
         self.total_us += us

@@ -59,6 +59,17 @@ log = logging.getLogger(__name__)
 NEW_NODE_MIGRATION_DELAY_S = 0.5
 
 
+def _coalescer_stats():
+    """Device-compaction coalescer counters for get_stats.  Peeks
+    sys.modules instead of importing: the coalescer pulls in the
+    jax kernel stack (~2 s cold), and get_stats runs on the serving
+    loop — a server that never device-merged reports None for free."""
+    import sys
+
+    mod = sys.modules.get("dbeel_tpu.server.coalescer")
+    return mod.stats() if mod is not None else None
+
+
 def is_between(item: int, start: int, end: int) -> bool:
     """Half-open wrap-around ring range [start, end)
     (shards.rs:103-109)."""
@@ -797,6 +808,7 @@ class MyShard:
                 "entries_fetched": self.ae_entries_fetched,
             },
             "metrics": self.metrics.snapshot(),
+            "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
                 if self.dataplane is not None
@@ -881,10 +893,15 @@ class MyShard:
     ) -> None:
         if name in self.collections:
             raise CollectionAlreadyExists(name)
-        os.makedirs(self.config.dir, exist_ok=True)
+        # Audited sync I/O: DDL is rare (operator-rate, gossiped once)
+        # and the metadata file is tens of bytes — an executor hop
+        # would cost more than the write.  The fsync CAN stall the
+        # loop ~ms-scale on a slow disk; acceptable on this path.
+        os.makedirs(self.config.dir, exist_ok=True)  # lint: allow(async-blocking)
         tree = self._create_lsm_tree(name)
         path = self._collection_metadata_path(name)
         if not os.path.exists(path):
+            # lint: allow(async-blocking)
             with open(path, "wb") as f:
                 f.write(
                     msgpack.packb(
@@ -892,7 +909,7 @@ class MyShard:
                     )
                 )
                 f.flush()
-                os.fsync(f.fileno())
+                os.fsync(f.fileno())  # lint: allow(async-blocking)
         self.collections[name] = Collection(tree, replication_factor)
         if self.dataplane is not None:
             # RF=1: full client-plane fast path.  RF>1: replica plane
@@ -908,7 +925,9 @@ class MyShard:
 
     async def drop_collection(self, name: str) -> None:
         try:
-            os.unlink(self._collection_metadata_path(name))
+            # Audited sync I/O: one unlink on the operator-rate DDL
+            # path (see create_collection).
+            os.unlink(self._collection_metadata_path(name))  # lint: allow(async-blocking)
         except OSError:
             pass
         col = self.collections.pop(name, None)

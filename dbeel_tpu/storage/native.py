@@ -42,7 +42,16 @@ ODIRECT_MIN_BYTES = 64 << 20
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
 )
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libdbeel_native.so")
+_DEFAULT_LIB_PATH = os.path.join(
+    _NATIVE_DIR, "build", "libdbeel_native.so"
+)
+# DBEEL_NATIVE_SO selects an alternate prebuilt library — the
+# sanitizer workflow loads build/libdbeel_native_asan.so (made via
+# `make SANITIZE=asan`) this way.  An explicit override is loaded
+# as-is: no staleness check, no rebuild (rebuilding would clobber an
+# instrumented binary with a plain one mid-run).
+_LIB_PATH = os.environ.get("DBEEL_NATIVE_SO") or _DEFAULT_LIB_PATH
+_LIB_OVERRIDDEN = _LIB_PATH != _DEFAULT_LIB_PATH
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -63,6 +72,17 @@ _IO_CHUNK_BYTES = 16 << 20
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
+        if _lib is None and _LIB_OVERRIDDEN:
+            # The override's loud-failure contract must hold for
+            # EVERY caller, not just the first: with DBEEL_NATIVE_SO
+            # set, all failure paths below raise, so a latched
+            # (_tried, no lib) state can only mean a prior failure —
+            # re-raising keeps later tests in the same process from
+            # silently degrading to the Python paths.
+            raise RuntimeError(
+                f"DBEEL_NATIVE_SO={_LIB_PATH} failed to load "
+                "earlier in this process"
+            )
         return _lib
     _tried = True
     def _src_mtime() -> float:
@@ -78,10 +98,13 @@ def _load() -> Optional[ctypes.CDLL]:
             return 0.0
 
     stale = (
-        os.path.exists(_LIB_PATH)
+        not _LIB_OVERRIDDEN
+        and os.path.exists(_LIB_PATH)
         and os.path.getmtime(_LIB_PATH) < _src_mtime()
     )
-    if not os.path.exists(_LIB_PATH) or stale:
+    if not _LIB_OVERRIDDEN and (
+        not os.path.exists(_LIB_PATH) or stale
+    ):
         # Rebuild BEFORE the first dlopen: ctypes.CDLL caches by path,
         # so a stale library loaded once cannot be swapped in-process.
         # Serialized under an flock: with --processes every shard
@@ -118,11 +141,29 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as e:
+        if _LIB_OVERRIDDEN:
+            # An explicit DBEEL_NATIVE_SO that does not load is an
+            # operator error: degrading silently would run a
+            # "sanitized" suite against no native code at all (the
+            # broken-.so-means-green failure tier1.sh exists to
+            # prevent).
+            raise RuntimeError(
+                f"DBEEL_NATIVE_SO={_LIB_PATH} failed to load: {e}"
+            ) from e
         log.info("native lib load failed: %s", e)
         return None
     if not hasattr(lib, "dbeel_writer_open") or not hasattr(
         lib, "dbeel_write_file"
     ):
+        if _LIB_OVERRIDDEN:
+            # Same loud-failure contract as the dlopen branch above:
+            # an explicit override that loads but predates the ABI
+            # would silently run "native" suites against pure Python.
+            raise RuntimeError(
+                f"DBEEL_NATIVE_SO={_LIB_PATH} loaded but lacks the "
+                "pipeline ABI (dbeel_writer_open/dbeel_write_file) — "
+                "stale or wrong-branch build"
+            )
         # Still stale (rebuild failed / old binary pinned): degrade to
         # the pure-Python paths rather than crash on registration.
         log.warning(
@@ -560,10 +601,15 @@ def native_available() -> bool:
 
 def load_if_built() -> Optional[ctypes.CDLL]:
     """Return the lib only if already built — never runs make (safe to
-    call from latency-sensitive / event-loop contexts)."""
+    call from latency-sensitive / event-loop contexts).  An explicit
+    DBEEL_NATIVE_SO override skips the exists-check and goes through
+    _load(), which raises loudly on ANY override failure (a typo'd
+    path silently degrading to Python would green-light a "sanitized"
+    run that tested no native code); _load() never runs make for
+    overrides, so the latency contract holds."""
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if not _LIB_OVERRIDDEN and not os.path.exists(_LIB_PATH):
         return None
     return _load()
 

@@ -368,6 +368,13 @@ bool read_all_deadline(int fd, uint8_t* p, size_t n,
   return true;
 }
 
+// Client-dialect status byte trailing every response frame (third
+// value 2 = plain OK without payload).  MUST equal the Python
+// client's RESPONSE_OK/RESPONSE_ERR — the wire-parity lint compares
+// the constants across all three sources.
+constexpr uint8_t kResponseErr = 0;
+constexpr uint8_t kResponseOk = 1;
+
 // One round trip: u16-LE length-prefixed request; u32-LE
 // length-prefixed response whose length INCLUDES the trailing type
 // byte (0=Err, 1=Ok payload, 2=plain OK).  Returns false on transport
@@ -450,7 +457,7 @@ int sync_metadata_from(Client* c, const std::string& ip,
   if (!round_trip(c, ip, port, m, &body, &rtype)) {
     return -1;  // last_error already carries the transport cause
   }
-  if (rtype == 0) {
+  if (rtype == kResponseErr) {
     std::string msg;
     c->last_error =
         "metadata request failed: " + error_kind(body, &msg) + ": " +
@@ -645,7 +652,7 @@ int keyed_request(Client* c, const char* type,
         last_rc = -2;
         continue;  // next replica
       }
-      if (rtype != 0) {
+      if (rtype != kResponseErr) {
         if (out_body) *out_body = std::move(body);
         return 0;
       }
@@ -748,7 +755,7 @@ int drain_one_response(Client* c, const std::pair<std::string, uint16_t>& key) {
   pending--;
   uint8_t rtype = body.back();
   body.pop_back();
-  if (rtype == 0) {
+  if (rtype == kResponseErr) {
     std::string msg;
     c->pipe_failures++;
     c->last_error = error_kind(body, &msg) + ": " + msg;
@@ -891,7 +898,7 @@ int multi_round_trip(Client* c, const char* type,
   if (!round_trip(c, target->ip, target->db_port, m, &body, &rtype)) {
     return -2;
   }
-  if (rtype == 0) {
+  if (rtype == kResponseErr) {
     std::string msg;
     c->last_error = error_kind(body, &msg) + ": " + msg;
     return -2;
@@ -1068,7 +1075,7 @@ int64_t dbeel_cli_get_stats(void* h, const char* ip, uint16_t port,
   if (!round_trip(c, target_ip, target_port, m, &body, &rtype)) {
     return -2;
   }
-  if (rtype == 0) {
+  if (rtype == kResponseErr) {
     std::string msg;
     c->last_error = error_kind(body, &msg) + ": " + msg;
     return -2;
@@ -1096,7 +1103,7 @@ int dbeel_cli_create_collection(void* h, const char* name,
   if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype)) {
     return -2;
   }
-  if (rtype == 0) {
+  if (rtype == kResponseErr) {
     std::string msg;
     c->last_error = error_kind(body, &msg) + ": " + msg;
     return -2;

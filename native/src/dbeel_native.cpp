@@ -822,7 +822,9 @@ struct ArenaMemtable {
 
   uint64_t append_bytes(const uint8_t* data, uint32_t len) {
     const uint64_t off = bytes.size();
-    bytes.insert(bytes.end(), data, data + len);
+    // len==0 arrives with data==nullptr (tombstone values): forming
+    // data+0 from null is UB (UBSan halt, found by the ASan suite).
+    if (len != 0) bytes.insert(bytes.end(), data, data + len);
     return off;
   }
 };
@@ -879,7 +881,10 @@ int32_t dbeel_memtable_set(void* h, const uint8_t* key, uint32_t klen,
       *old_val_len = n.val_len;
       if (vlen <= n.val_len) {
         // In-place overwrite (the common fixed-size-update case).
-        std::memcpy(t->bytes.data() + n.val_off, value, vlen);
+        // vlen==0 overwrites (tombstones) pass value==nullptr, and
+        // memcpy from null is UB even for zero bytes (UBSan).
+        if (vlen != 0)
+          std::memcpy(t->bytes.data() + n.val_off, value, vlen);
         t->live_bytes -= n.val_len - vlen;
       } else {
         // Counter updates only AFTER the throwing append: a bad_alloc
@@ -1854,6 +1859,30 @@ static const uint32_t kDpValMax = 255u << 10;  // staging floor
 // serves the request.  The reference's compiled path takes any u32
 // size (entry_writer.rs:72-74); 16 MiB keeps hostile inputs from
 // ballooning per-shard scratch while covering every realistic entry.
+// Client-dialect status byte trailing every response frame.  MUST
+// equal the Python client's RESPONSE_OK/RESPONSE_ERR (the wire-parity
+// lint compares the constants across all three sources).
+constexpr uint8_t kResponseOk = 1;
+constexpr uint8_t kResponseErr = 0;
+
+// Fixed header size of the coordinator-assist get trailer
+// dbeel_dp_handle_coord appends after the peer frame: u8 hit flag,
+// u32 value len, i64 ts, u32 key len, i64 propagated deadline_ms.
+// MUST equal dataplane.COORD_GET_TRAILER_HDR — a one-sided layout
+// change is the 17->25B stale-ABI misparse class (ISSUE 6), and the
+// wire-parity lint fails until both sides move together.  The
+// static_assert pins the constant to the per-field widths the emit
+// offsets below (t+1, t+5, t+13, t+17) are derived from: widening
+// or inserting a field forces whoever bumps the total to re-derive
+// every offset, not just the sum.
+constexpr uint32_t kCoordGetTrailerHdr = 25;
+static_assert(kCoordGetTrailerHdr ==
+                  1 /*hit u8*/ + 4 /*vlen u32*/ + 8 /*ts i64*/ +
+                      4 /*klen u32*/ + 8 /*deadline i64*/,
+              "coord-get trailer: field widths changed — re-derive "
+              "the t+N emit offsets in dbeel_dp_handle_coord AND "
+              "dataplane.py's _OFF_* parse offsets");
+
 static const uint32_t kDpHardMax = 16u << 20;
 
 // Envelope slack on top of kDpHardMax for grow-and-retry (-2) size
@@ -2058,7 +2087,7 @@ static bool keynotfound_response(const uint8_t* key, uint32_t kn,
   o += mp_put_strhdr(out + o, mlen);
   std::memcpy(out + o, msg, mlen);
   o += mlen;
-  out[o++] = 0;  // RESPONSE_ERR
+  out[o++] = kResponseErr;
   const uint32_t body = (uint32_t)(o - 4);
   std::memcpy(out, &body, 4);
   *out_len = (uint32_t)o;
@@ -2087,7 +2116,7 @@ static bool internal_error_response(const char* msg, uint8_t* out,
   o += mp_put_strhdr(out + o, (uint32_t)mlen);
   std::memcpy(out + o, msg, mlen);
   o += mlen;
-  out[o++] = 0;  // RESPONSE_ERR
+  out[o++] = kResponseErr;
   const uint32_t body = (uint32_t)(o - 4);
   std::memcpy(out, &body, 4);
   *out_len = (uint32_t)o;
@@ -2205,7 +2234,9 @@ uint64_t dbeel_wal_append(void* h, const uint8_t* key, uint32_t klen,
   std::memcpy(e + 4, &vlen, 4);
   std::memcpy(e + 8, &ts, 8);
   std::memcpy(e + 16, key, klen);
-  std::memcpy(e + 16 + klen, value, vlen);
+  // Tombstones pass value==nullptr with vlen==0; memcpy from null
+  // is UB even for zero bytes (UBSan halt, ASan suite).
+  if (vlen != 0) std::memcpy(e + 16 + klen, value, vlen);
   const uint32_t magic = kWalMagic;
   const uint32_t elen32 = (uint32_t)entry_len;
   const uint32_t crc = crc32z(e, entry_len);
@@ -2810,7 +2841,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       std::memcpy(out, &resp_len, 4);
       if (v != out + 4)  // memtable hit: value still in the memtable
         std::memcpy(out + 4, v, vn);
-      out[4 + vn] = 1;  // RESPONSE_OK
+      out[4 + vn] = kResponseOk;
       *out_len = 4 + resp_len;
     } else {
       // Tombstone or authoritative absence: KeyNotFound, natively.
@@ -3167,7 +3198,7 @@ int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
       out[o++] = 0x00;
       out[o++] = 0xc0;
     }
-    out[o++] = 1;  // RESPONSE_OK
+    out[o++] = kResponseOk;
     const uint32_t body = (uint32_t)(o - 4);
     std::memcpy(out, &body, 4);
     *out_len = (uint32_t)o;
@@ -3212,7 +3243,7 @@ int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
       mb.insert(mb.end(), msg, msg + mlen);
     }
   }
-  mb.push_back(1);  // RESPONSE_OK
+  mb.push_back(kResponseOk);
   const uint64_t total = 4ull + mb.size();
   if (total > out_cap) {
     if (total > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
@@ -3773,8 +3804,9 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     // (kind) + 5 (str hdr) + 5+5 (bin hdrs) + 9+9 (int64s incl. the
     // deadline) = 49; the trailer carries the value AND the raw key
     // (25B fixed header incl. the peer deadline).
-    const uint64_t need =
-        4ull + 49 + f.coll_n + (uint64_t)f.key_n * 2 + 25ull + vn;
+    const uint64_t need = 4ull + 49 + f.coll_n +
+                          (uint64_t)f.key_n * 2 +
+                          kCoordGetTrailerHdr + vn;
     if (need > out_cap) {
       if (need > (uint64_t)kDpHardMax + kDpGrowSlack) return -1;
       *out_len = need;
@@ -3805,9 +3837,9 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     std::memcpy(t + 13, &f.key_n, 4);
     std::memcpy(t + 17, &peer_deadline, 8);
     const uint32_t tvn = found ? vn : 0;
-    if (tvn != 0) std::memcpy(t + 25, v, tvn);
-    std::memcpy(t + 25 + tvn, f.key_raw, f.key_n);
-    *out_len = 4 + n32 + 25 + tvn + f.key_n;
+    if (tvn != 0) std::memcpy(t + kCoordGetTrailerHdr, v, tvn);
+    std::memcpy(t + kCoordGetTrailerHdr + tvn, f.key_raw, f.key_n);
+    *out_len = 4 + n32 + kCoordGetTrailerHdr + tvn + f.key_n;
     dp->fast_coord_gets++;
     return base_flags | 8;
   }

@@ -4,11 +4,50 @@
 # randomization/xdist off so ordering bugs can't masquerade as
 # flakes).  Used by .github/workflows/ci.yml and by hand:
 #
-#   ./scripts/tier1.sh
+#   ./scripts/tier1.sh              # lint + native build + tier-1
+#   ./scripts/tier1.sh --sanitize   # ASan+UBSan native-plane subset
+#
+# --sanitize builds libdbeel_native_asan.so (make SANITIZE=asan),
+# LD_PRELOADs libasan into python (ASan must init before the first
+# malloc; libubsan resolves itself at dlopen), points the runtime at
+# the instrumented library via DBEEL_NATIVE_SO, and runs the
+# native-plane test subset with halt-on-error — any ASan/UBSan
+# report fails the job.  detect_leaks=0: CPython "leaks" by ASan's
+# accounting (interned objects, arenas); leak checking an interpreter
+# is all noise.
 #
 # Exits non-zero on any failure; prints the dot-counted pass total.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--sanitize" ]; then
+    command -v g++ >/dev/null 2>&1 || {
+        echo "SANITIZE RUN IMPOSSIBLE: no g++" >&2; exit 1; }
+    make -C native SANITIZE=asan || {
+        echo "ASAN NATIVE BUILD FAILED" >&2; exit 1; }
+    ASAN_LIB="$(g++ -print-file-name=libasan.so)"
+    [ -e "$ASAN_LIB" ] || {
+        echo "libasan.so not found" >&2; exit 1; }
+    exec env \
+        LD_PRELOAD="$ASAN_LIB" \
+        ASAN_OPTIONS="detect_leaks=0:halt_on_error=1:abort_on_error=1" \
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1" \
+        DBEEL_NATIVE_SO="$PWD/native/build/libdbeel_native_asan.so" \
+        JAX_PLATFORMS=cpu \
+        timeout -k 10 870 \
+        python -m pytest \
+            tests/test_native_multi.py \
+            tests/test_dataplane.py \
+            tests/test_wal_sync_native.py \
+            tests/test_native_client.py \
+            -q -m 'not slow' \
+            -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+# Invariant lint gate (analysis/): wire-dialect parity, yield-point
+# hazards, stats-schema drift, error-taxonomy coverage.  Cheap (~1s),
+# runs first so a dialect drift fails before the 6-minute suite.
+python -m analysis.lint || { echo "DBEEL-LINT FAILED" >&2; exit 1; }
 
 # Build the native library FIRST and fail the job if the build
 # breaks.  Without this gate a broken .so meant every native-path

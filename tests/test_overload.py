@@ -171,9 +171,17 @@ def test_window_shrinks_under_soft_and_recovers(tmp_dir):
             assert shard.governor.window_decreases >= 1
             shrunk = conn.window
             # Backlog drained: additive recovery to the FULL window.
+            # Completions that the loop happens to batch into one
+            # tick cycle recover less than +1/w each, so the op
+            # count needed varies with host weather — drive until
+            # recovered, bounded well above the ~50-op fair-weather
+            # cost (a capped loop keeps the "recovers FULLY" claim
+            # without the flaky fixed-count timing assumption).
             shard.governor.force_level(None)
-            for i in range(80):
+            for i in range(400):
                 await pcol.set(f"r{i}", {"v": i})
+                if conn.window == 8.0:
+                    break
             assert conn.window == 8.0, (shrunk, conn.window)
             stats = await client.get_stats(*node.db_address)
             assert stats["overload"]["window_max"] == 8
