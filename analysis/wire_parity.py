@@ -111,14 +111,15 @@ def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
     return out
 
 
-def _peer_deadline_index(tree: ast.AST) -> Dict[str, int]:
-    """shard.py's _PEER_DEADLINE_INDEX: ShardRequest.VERB -> index."""
+def _peer_index_table(tree: ast.AST, table_name: str) -> Dict[str, int]:
+    """A shard.py index table (``_PEER_DEADLINE_INDEX`` /
+    ``_PEER_TRACE_INDEX``): ShardRequest.VERB -> element index."""
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Assign)
             and len(node.targets) == 1
             and isinstance(node.targets[0], ast.Name)
-            and node.targets[0].id == "_PEER_DEADLINE_INDEX"
+            and node.targets[0].id == table_name
             and isinstance(node.value, ast.Dict)
         ):
             out: Dict[str, int] = {}
@@ -131,6 +132,10 @@ def _peer_deadline_index(tree: ast.AST) -> Dict[str, int]:
                     out[k.attr] = v.value
             return out
     return {}
+
+
+def _peer_deadline_index(tree: ast.AST) -> Dict[str, int]:
+    return _peer_index_table(tree, "_PEER_DEADLINE_INDEX")
 
 
 def _handled_request_verbs(tree: ast.AST) -> Set[str]:
@@ -272,6 +277,18 @@ _WANT_RE = re.compile(
     r"\s*:\s*(\d+)u?"
 )
 
+# The C shard parser's trace-dialect recognition (tracing plane):
+# ``nelem == want + N`` where N MUST be 2 (deadline + trace id) —
+# and the dialect must PUNT (the very next statement returns -1) so
+# Python owns sampled frames and the replica span piggyback.
+_TRACE_DIALECT_RE = re.compile(
+    r"has_trace\s*=\s*nelem\s*==\s*want\s*\+\s*(\d+)u?"
+)
+_TRACE_PUNT_RE = re.compile(
+    r"has_trace\s*=\s*nelem\s*==\s*want\s*\+\s*\d+u?\s*;\s*"
+    r"if\s*\(\s*has_trace\s*\)\s*return\s*-1\s*;"
+)
+
 
 def check(repo: Repo) -> List[Finding]:
     findings: List[Finding] = []
@@ -393,6 +410,77 @@ def check(repo: Repo) -> List[Finding]:
                     f"{req.get(name, name)!r} but the Python plane "
                     f"uses {idx} — peer-frame arity drift",
                 )
+
+    # -- trace-element arity (tracing plane) -------------------------
+    # The trailing trace id must sit EXACTLY one slot past the
+    # deadline on every data verb — three-way agreement: the encoder
+    # wrapper appends (deadline-or-0, trace) in order, shard.py's
+    # _PEER_TRACE_INDEX is where replicas read it, and the C parser
+    # recognizes (and punts) the want+2 dialect.
+    trace_index = _peer_index_table(shard, "_PEER_TRACE_INDEX")
+    if not trace_index:
+        add(
+            repo.shard_py,
+            1,
+            "_PEER_TRACE_INDEX not found — shard.py restructured? "
+            "update analysis/wire_parity",
+        )
+    for name, idx in deadline_index.items():
+        t_idx = trace_index.get(name)
+        if t_idx is None:
+            add(
+                repo.shard_py,
+                1,
+                f"verb {req.get(name, name)!r} has a deadline slot "
+                "but no _PEER_TRACE_INDEX entry — a traced frame's "
+                "replica span would never piggyback",
+            )
+        elif t_idx != idx + 1:
+            add(
+                repo.shard_py,
+                1,
+                f"trace-field arity drift for {req.get(name, name)!r}"
+                f": _PEER_TRACE_INDEX={t_idx} but the trace element "
+                f"rides exactly one past the deadline (index "
+                f"{idx + 1})",
+            )
+    for name in trace_index:
+        if name not in deadline_index:
+            add(
+                repo.shard_py,
+                1,
+                f"_PEER_TRACE_INDEX names {name} which has no "
+                "deadline slot — the trace element only ever rides "
+                "after a (possibly 0) deadline",
+            )
+    stripped_native = strip_c_comments(native_src)
+    tm = _TRACE_DIALECT_RE.search(stripped_native)
+    if tm is None:
+        add(
+            repo.native_cpp,
+            1,
+            "C shard-plane trace-dialect expression "
+            "(has_trace = nelem == want + 2) not found — a traced "
+            "peer frame would be rejected instead of punted",
+        )
+    else:
+        line = stripped_native.count("\n", 0, tm.start()) + 1
+        if int(tm.group(1)) != 2:
+            add(
+                repo.native_cpp,
+                line,
+                f"trace-field arity drift: C recognizes the trace "
+                f"dialect at want + {tm.group(1)} but the Python "
+                "plane appends (deadline, trace) — want + 2",
+            )
+        if _TRACE_PUNT_RE.search(stripped_native) is None:
+            add(
+                repo.native_cpp,
+                line,
+                "C trace dialect must PUNT (return -1 right after "
+                "has_trace) — Python owns sampled frames and the "
+                "replica span piggyback",
+            )
 
     # -- every C wire-token literal is in a Python registry ----------
     peer_verbs = (

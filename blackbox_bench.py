@@ -565,6 +565,117 @@ async def main_knee_worker(args):
     )
 
 
+def _us_pct(samples, q):
+    if not samples:
+        return 0
+    samples = sorted(samples)
+    return samples[min(len(samples) - 1, int(q * len(samples)))]
+
+
+async def main_attribute(args):
+    """--attribute (tracing plane, ISSUE 9): run a short mixed
+    set/get load against an RF>=2 collection on a server started
+    with --trace-sample, then print a per-op per-stage p50/p99
+    breakdown assembled from every shard's flight recorder — where
+    the time went, not just how much there was.  Run the same
+    command against a --trace-sample 0 server for the same-session
+    tracing-off baseline (throughput printed per phase either way)."""
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)],
+        pipeline_window=args.pipeline or None,
+    )
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    rf = args.replication_factor or 2
+    try:
+        await client.create_collection(args.collection, rf)
+    except CollectionAlreadyExists:
+        pass
+    keys = [f"key-{i:08}" for i in range(args.clients * args.requests)]
+    rng = random.Random(args.seed)
+    rng.shuffle(keys)
+    value = {"blob": "x" * args.value_size}
+    for op in ("set", "get"):
+        total, lat = await run_phase(
+            client, args.collection, op, keys, args.clients, value
+        )
+        print(
+            f"{op}: total {total:.3f}s "
+            f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
+        )
+        rng.shuffle(keys)
+
+    # Every shard's recorder (the client ring knows all listeners).
+    addrs = sorted({(s.ip, s.db_port) for s in client._ring})
+    spans, rtts, rep_stages = [], [], []
+    sample_every = None
+    for a in addrs:
+        try:
+            dump = await client.trace_dump(*a)
+        except Exception as e:
+            print(f"trace_dump from {a} failed: {e!r}")
+            continue
+        sample_every = dump.get("sample_every")
+        for e in dump["entries"]:
+            if not e.get("sampled"):
+                continue
+            spans.append(e)
+            for r in e.get("replicas") or ():
+                rtts.append(r["rtt_us"])
+                if r.get("stages"):
+                    rep_stages.append(r["stages"])
+    if not spans:
+        print(
+            "no sampled spans recorded — start the server with "
+            "--trace-sample N for the attribution table"
+        )
+        client.close()
+        return
+    print(
+        f"\nstage attribution from {len(spans)} sampled spans "
+        f"(server sample_every={sample_every}, {len(addrs)} shards):"
+    )
+    by_op = {}
+    for e in spans:
+        stages = by_op.setdefault(e["op"], {})
+        for stage, us in e["stages"]:
+            stages.setdefault(stage, []).append(us)
+        stages.setdefault("TOTAL", []).append(e["total_us"])
+    for op in sorted(by_op):
+        stages = by_op[op]
+        n = len(stages["TOTAL"])
+        total_sum = sum(stages["TOTAL"]) or 1
+        print(f"  {op} (n={n}):")
+        order = sorted(
+            (s for s in stages if s != "TOTAL"),
+            key=lambda s: -sum(stages[s]),
+        ) + ["TOTAL"]
+        for stage in order:
+            xs = stages[stage]
+            share = (
+                sum(xs) / total_sum if stage != "TOTAL" else 1.0
+            )
+            print(
+                f"    {stage:<10} p50 {_us_pct(xs, 0.5):>8}us  "
+                f"p99 {_us_pct(xs, 0.99):>8}us  "
+                f"share {share:>5.1%}"
+            )
+    if rtts:
+        print(
+            f"  replica rtt (n={len(rtts)}): "
+            f"p50 {_us_pct(rtts, 0.5)}us p99 {_us_pct(rtts, 0.99)}us"
+        )
+    if rep_stages:
+        q = [s[0] for s in rep_stages]
+        w = [s[1] for s in rep_stages]
+        print(
+            f"  replica stages: queue p50 {_us_pct(q, 0.5)}us "
+            f"p99 {_us_pct(q, 0.99)}us | serve p50 "
+            f"{_us_pct(w, 0.5)}us p99 {_us_pct(w, 0.99)}us"
+        )
+    client.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -615,6 +726,15 @@ def main():
         "the same-session Python-path baseline)",
     )
     ap.add_argument(
+        "--attribute",
+        action="store_true",
+        help="tracing-plane phase: short RF>=2 mixed load, then a "
+        "per-op per-stage p50/p99 breakdown from the shards' flight "
+        "recorders (server must run with --trace-sample N; run "
+        "again vs a --trace-sample 0 server for the tracing-off "
+        "baseline)",
+    )
+    ap.add_argument(
         "--overload-knee",
         action="store_true",
         help="offered-load sweep (open loop, multiples of the "
@@ -640,6 +760,8 @@ def main():
         ap.error("--pipeline and --batch are separate phases")
     if args.overload_knee_worker:
         asyncio.run(main_knee_worker(args))
+    elif args.attribute:
+        asyncio.run(main_attribute(args))
     elif args.native_floor:
         asyncio.run(main_native_floor(args))
     elif args.overload_knee:

@@ -60,6 +60,10 @@ N_NODES = 3
 SHARDS = 2
 RF = 3
 COLLECTION = "soak"
+# Tracing plane (ISSUE 9): soak nodes run with modest span sampling
+# so the final report can attribute WHERE slow-tail time went (and
+# the per-phase trace_dump files land as CI artifacts).
+TRACE_SAMPLE = 256
 
 
 def log(*a):
@@ -107,6 +111,7 @@ class Node:
             "--default-replication-factor", str(RF),
             "--failure-detection-interval", "500",
             "--anti-entropy-interval", "5000",
+            "--trace-sample", str(TRACE_SAMPLE),
         ]
         if seeds:
             argv += ["--seed-nodes", *seeds]
@@ -300,6 +305,71 @@ async def monitor(nodes, stop, samples):
             await asyncio.wait_for(stop.wait(), 20)
         except asyncio.TimeoutError:
             pass
+
+
+async def collect_traces(nodes, label, dump_dir=None):
+    """Fetch every alive node's flight-recorder dump (shard-0 port).
+    With ``dump_dir``, persist each as trace_<label>_<node>.json —
+    the nightly soak uploads these as build artifacts so a tail
+    regression is diagnosable post-hoc.  Returns {node: dump}."""
+    dumps = {}
+    for n in nodes:
+        if not n.alive():
+            continue
+        cl = None
+        try:
+            cl = await DbeelClient.from_seed_nodes(
+                [("127.0.0.1", n.db_port)], op_deadline_s=5.0
+            )
+            dumps[n.name] = await cl.trace_dump()
+        except Exception as e:
+            log(f"trace_dump from {n.name} failed: {e!r}")
+        finally:
+            if cl is not None:
+                cl.close()
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        for name, dump in dumps.items():
+            path = os.path.join(
+                dump_dir, f"trace_{label}_{name}.json"
+            )
+            with open(path, "w") as f:
+                json.dump(dump, f, indent=1, default=repr)
+    return dumps
+
+
+def trace_report_block(dumps):
+    """The report's ``trace`` block: recorder totals plus the top-3
+    dominant stages among SLOW ops (staged spans weighted by stage
+    µs; minimal slow records count toward slow_entries but carry no
+    attribution)."""
+    stage_us = {}
+    slow_entries = 0
+    sampled = 0
+    captured = 0
+    for dump in dumps.values():
+        captured += len(dump.get("entries", ()))
+        for e in dump.get("entries", ()):
+            if e.get("sampled"):
+                sampled += 1
+            if not e.get("slow"):
+                continue
+            slow_entries += 1
+            for stage, us in e.get("stages") or ():
+                stage_us[stage] = stage_us.get(stage, 0) + us
+    top = sorted(
+        stage_us.items(), key=lambda kv: kv[1], reverse=True
+    )[:3]
+    total = sum(stage_us.values()) or 1
+    return {
+        "nodes_dumped": len(dumps),
+        "entries": captured,
+        "sampled_entries": sampled,
+        "slow_entries": slow_entries,
+        "dominant_stages": [
+            [stage, round(us / total, 3)] for stage, us in top
+        ],
+    }
 
 
 async def final_checks(nodes, acks, report):
@@ -998,6 +1068,12 @@ async def main():
         "get_stats overload block",
     )
     ap.add_argument(
+        "--trace-dump-dir", default="",
+        help="persist each phase's final trace_dump per node as "
+        "trace_<phase>_<node>.json here (nightly CI uploads them as "
+        "build artifacts)",
+    )
+    ap.add_argument(
         "--quick", action="store_true",
         help="~60s smoke mode (reduced churn cadence): exercises the "
         "full report schema incl. the per-class error breakdown "
@@ -1104,18 +1180,27 @@ async def main():
         # Let quarantine repair + anti-entropy re-converge the
         # bit-flipped replica before the divergence scan.
         await asyncio.sleep(min(args.quiet_window, 15.0))
+        await collect_traces(nodes, "disk_faults",
+                             args.trace_dump_dir)
     if args.partition:
         ok = (
             await partition_phase(nodes, seeds, report, args.quick)
         ) and ok
+        await collect_traces(nodes, "partition", args.trace_dump_dir)
     if args.overload:
         ok = (
             await overload_phase(nodes, report, args.quick)
         ) and ok
+        await collect_traces(nodes, "overload", args.trace_dump_dir)
         # Let the shed/backlogged writes' hints drain and windows
         # recover before the byte-equality scan.
         await asyncio.sleep(min(args.quiet_window, 15.0))
     ok = (await final_checks(nodes, acks, report)) and ok
+    # Tracing plane (ISSUE 9): where did the slow tail's time go?
+    final_dumps = await collect_traces(
+        nodes, "final", args.trace_dump_dir
+    )
+    report["trace"] = trace_report_block(final_dumps)
     if not args.quick:
         # Quick mode waives the rate gate: one unlucky op in a tiny
         # sample would dominate the percentage.

@@ -565,6 +565,7 @@ class DbeelClient:
         keys: list,
         values: list,
         consistency: Optional[int],
+        trace_id: Optional[int] = None,
     ) -> list:
         """Group sub-ops by owning coordinator via the ring, send ONE
         multi frame per node (chunked under the u16 frame bound), and
@@ -611,6 +612,11 @@ class DbeelClient:
             }
             if consistency is not None:
                 request["consistency"] = consistency
+            if isinstance(trace_id, int) and trace_id > 0:
+                # Tracing plane: the whole batch frame records one
+                # per-stage span (replica spans piggyback on the
+                # MULTI_* peer responses).
+                request["trace"] = trace_id
             try:
                 try:
                     # Deadline-bound like every single op (a black-
@@ -770,6 +776,19 @@ class DbeelClient:
         raw = await self._send_to(host, port, {"type": "get_stats"})
         return msgpack.unpackb(raw, raw=False)
 
+    async def trace_dump(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> dict:
+        """One shard's flight-recorder dump (tracing plane): sampled
+        per-stage spans — coordinator stages plus per-replica RTT and
+        piggybacked replica stage summaries — and a minimal record
+        for every slow/error op.  Always served, even at hard
+        overload (like get_stats)."""
+        if host is None or port is None:
+            host, port = self._seeds[0]
+        raw = await self._send_to(host, port, {"type": "trace_dump"})
+        return msgpack.unpackb(raw, raw=False)
+
     async def rearm(
         self, host: Optional[str] = None, port: Optional[int] = None
     ) -> None:
@@ -791,8 +810,12 @@ class DbeelCollection:
         self.replication_factor = rf
 
     async def set(
-        self, key: Any, value: Any, consistency=None
+        self, key: Any, value: Any, consistency=None,
+        trace_id: Optional[int] = None,
     ) -> None:
+        """``trace_id`` (tracing plane): stamp the request so the
+        server records a full per-stage span for this op, queryable
+        via trace_dump."""
         request = {
             "type": "set",
             "collection": self.name,
@@ -803,11 +826,16 @@ class DbeelCollection:
             request["consistency"] = Consistency.resolve(
                 consistency, self.replication_factor
             )
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
         await self.client._sharded_request(
             key, request, self.replication_factor
         )
 
-    async def get(self, key: Any, consistency=None) -> Any:
+    async def get(
+        self, key: Any, consistency=None,
+        trace_id: Optional[int] = None,
+    ) -> Any:
         request = {
             "type": "get",
             "collection": self.name,
@@ -817,13 +845,16 @@ class DbeelCollection:
             request["consistency"] = Consistency.resolve(
                 consistency, self.replication_factor
             )
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
         raw = await self.client._sharded_request(
             key, request, self.replication_factor
         )
         return msgpack.unpackb(raw, raw=False)
 
     async def multi_set(
-        self, items, consistency=None
+        self, items, consistency=None,
+        trace_id: Optional[int] = None,
     ) -> None:
         """Batched set: ``items`` is a dict or an iterable of
         (key, value) pairs.  Keys are grouped by owning coordinator
@@ -849,13 +880,15 @@ class DbeelCollection:
             [k for k, _v in pairs],
             [v for _k, v in pairs],
             resolved,
+            trace_id=trace_id,
         )
         for kind, payload in outcomes:
             if kind == "err":
                 raise payload
 
     async def multi_get(
-        self, keys: Sequence[Any], consistency=None
+        self, keys: Sequence[Any], consistency=None,
+        trace_id: Optional[int] = None,
     ) -> list:
         """Batched get: returns values aligned with ``keys`` (None
         for missing keys).  One frame per owning node; failed sub-ops
@@ -876,6 +909,7 @@ class DbeelCollection:
             keys,
             [None] * len(keys),
             resolved,
+            trace_id=trace_id,
         )
         out = []
         for kind, payload in outcomes:
@@ -887,12 +921,17 @@ class DbeelCollection:
                 raise payload
         return out
 
-    async def delete(self, key: Any, consistency=None) -> None:
+    async def delete(
+        self, key: Any, consistency=None,
+        trace_id: Optional[int] = None,
+    ) -> None:
         request = {
             "type": "delete",
             "collection": self.name,
             "key": key,
         }
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
         if consistency is not None:
             request["consistency"] = Consistency.resolve(
                 consistency, self.replication_factor

@@ -133,6 +133,20 @@ def _bind(lib) -> None:
             u8p,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_cli_trace_dump"):  # tracing plane (PR 9)
+        lib.dbeel_cli_trace_dump.restype = ctypes.c_int64
+        lib.dbeel_cli_trace_dump.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+            u8p,
+            ctypes.c_uint64,
+        ]
+        lib.dbeel_cli_set_trace.restype = None
+        lib.dbeel_cli_set_trace.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+        ]
     if hasattr(lib, "dbeel_cli_multi_set"):
         lib.dbeel_cli_multi_set.restype = ctypes.c_int64
         lib.dbeel_cli_multi_set.argtypes = [
@@ -243,6 +257,39 @@ class NativeDbeelClient:
         for _ in range(2):
             buf = (ctypes.c_uint8 * cap)()
             n = self._lib.dbeel_cli_get_stats(
+                self._h, ip.encode(), port, buf, cap
+            )
+            if n <= -10:
+                cap = -int(n) - 10
+                continue
+            break
+        if n < 0:
+            raise DbeelError(self._err())
+        return msgpack.unpackb(bytes(buf[: int(n)]), raw=False)
+
+    def set_trace(self, base_trace_id: int) -> bool:
+        """Arm per-op trace stamping in the C walk: every single-op
+        request carries an auto-incrementing ``trace`` id starting at
+        ``base_trace_id`` (0 disarms) — the server serves those
+        interpreted and records full per-stage spans.  Returns False
+        on a stale .so without the tracing ABI."""
+        if not hasattr(self._lib, "dbeel_cli_set_trace"):
+            return False
+        self._lib.dbeel_cli_set_trace(self._h, base_trace_id)
+        return True
+
+    def trace_dump(self, ip: str = "", port: int = 0) -> dict:
+        """One server's flight-recorder dump (the bootstrap seed by
+        default), unpacked — same schema as the Python client's
+        trace_dump().  Raises on a stale .so without the ABI."""
+        if not hasattr(self._lib, "dbeel_cli_trace_dump"):
+            raise DbeelError(
+                "native library predates dbeel_cli_trace_dump"
+            )
+        cap = 1 << 20
+        for _ in range(2):
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.dbeel_cli_trace_dump(
                 self._h, ip.encode(), port, buf, cap
             )
             if n <= -10:

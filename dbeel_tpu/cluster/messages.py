@@ -131,16 +131,30 @@ class ShardRequest:
     def drop_collection(name: str) -> list:
         return ["request", ShardRequest.DROP_COLLECTION, name]
 
-    # Data-op peer frames optionally carry ONE trailing element: the
-    # coordinator's absolute wall-clock deadline in ms (overload
-    # plane, PR 5).  A replica drops expired work with a retryable
-    # Overloaded error instead of computing a dead response; old-
-    # dialect frames simply lack the element (every consumer indexes
-    # from the front, and the native parser accepts both arities).
+    # Data-op peer frames optionally carry trailing elements beyond
+    # the base arity: (1) the coordinator's absolute wall-clock
+    # deadline in ms (overload plane, PR 5) — a replica drops expired
+    # work with a retryable Overloaded error instead of computing a
+    # dead response; (2) the trace id of a sampled op (tracing plane,
+    # PR 9) — a replica serving a traced frame piggybacks its own
+    # stage summary on the response.  The trace element only ever
+    # appears AFTER the deadline slot (a 0 deadline placeholder is
+    # appended when no real budget exists; both planes treat
+    # non-positive deadlines as absent), so the three dialects are
+    # base / base+1 (deadline) / base+2 (deadline+trace).  Old-
+    # dialect consumers index from the front and simply ignore the
+    # tail; the native parsers accept base and base+1 and punt base+2
+    # to Python, which owns sampled frames.
 
     @staticmethod
-    def _with_deadline(frame: list, deadline_ms) -> list:
-        if isinstance(deadline_ms, int) and deadline_ms > 0:
+    def _with_deadline(
+        frame: list, deadline_ms, trace_id=None
+    ) -> list:
+        has_deadline = isinstance(deadline_ms, int) and deadline_ms > 0
+        if isinstance(trace_id, int) and trace_id > 0:
+            frame.append(deadline_ms if has_deadline else 0)
+            frame.append(trace_id)
+        elif has_deadline:
             frame.append(deadline_ms)
         return frame
 
@@ -148,36 +162,43 @@ class ShardRequest:
     def set(
         collection: str, key: bytes, value: bytes, ts: int,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.SET, collection, key, value, ts],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod
     def delete(
         collection: str, key: bytes, ts: int,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.DELETE, collection, key, ts],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod
     def get(
         collection: str, key: bytes,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.GET, collection, key],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod
     def get_digest(
         collection: str, key: bytes,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         """Digest read (quorum-get fast path, beyond the reference —
         db_server.rs:318-370 ships RF full entries): the replica
@@ -186,12 +207,14 @@ class ShardRequest:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.GET_DIGEST, collection, key],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod
     def multi_set(
         collection: str, entries: list,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         """Batched replica mutation: ``entries`` is
         [[key, value, ts], ...] (tombstone value = delete).  ONE
@@ -201,18 +224,21 @@ class ShardRequest:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.MULTI_SET, collection, entries],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod
     def multi_get(
         collection: str, keys: list,
         deadline_ms: "int | None" = None,
+        trace_id: "int | None" = None,
     ) -> list:
         """Batched replica read: the response carries one entry (or
         nil) per key, aligned with ``keys``."""
         return ShardRequest._with_deadline(
             ["request", ShardRequest.MULTI_GET, collection, keys],
             deadline_ms,
+            trace_id,
         )
 
     @staticmethod

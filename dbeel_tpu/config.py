@@ -92,6 +92,21 @@ class Config:
     # hint path.  0 disables.
     peer_queue_max_ops: int = 128
     peer_queue_max_bytes: int = 8 << 20
+    # ---- Tracing / observability plane (PR 9) ------------------------
+    # Server-side span sampling: every Nth client frame dispatched by
+    # a shard gets a full per-stage span in the flight recorder (and
+    # its peer fan-out frames carry the trace id so replicas piggyback
+    # their own stage summary).  0 disables sampling — client-stamped
+    # traces (a `trace` id on the request frame) still record, and
+    # slow/error ops are always captured regardless.
+    trace_sample: int = 0
+    # Ops slower than this (µs) are always captured in the flight
+    # recorder and counted/logged as slow (the log line itself is
+    # rate-limited to 1/s per op type).
+    slow_op_us: int = 100_000
+    # Flight-recorder ring capacity per shard (oldest entries evict).
+    trace_ring: int = 512
+
     # Tombstone GC grace (the delete-resurrection hazard): compaction
     # refuses to drop a tombstone younger than this, so a replica that
     # missed the delete cannot resurrect the old value through hint
@@ -301,6 +316,28 @@ def build_parser() -> argparse.ArgumentParser:
         "frames (0 disables)",
     )
     p.add_argument(
+        "--trace-sample",
+        type=int,
+        default=d.trace_sample,
+        help="full-span sampling rate: every Nth client frame gets a "
+        "per-stage trace in the flight recorder (0 disables; "
+        "slow/error ops are always captured)",
+    )
+    p.add_argument(
+        "--slow-op-us",
+        type=int,
+        dest="slow_op_us",
+        default=d.slow_op_us,
+        help="ops slower than this (µs) always land in the flight "
+        "recorder and count as slow",
+    )
+    p.add_argument(
+        "--trace-ring",
+        type=int,
+        default=d.trace_ring,
+        help="flight-recorder ring capacity per shard",
+    )
+    p.add_argument(
         "--gc-grace",
         type=int,
         dest="gc_grace_ms",
@@ -389,6 +426,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         overload_window_min=ns.overload_window_min,
         peer_queue_max_ops=ns.peer_queue_max_ops,
         peer_queue_max_bytes=ns.peer_queue_max_bytes,
+        trace_sample=ns.trace_sample,
+        slow_op_us=ns.slow_op_us,
+        trace_ring=ns.trace_ring,
         gc_grace_ms=ns.gc_grace_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,

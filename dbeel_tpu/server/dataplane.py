@@ -176,6 +176,13 @@ class DataPlane:
         # preadv2/RWF_NOWAIT is absent (every probe punts before
         # reading); required wherever it exists, or the native read
         # path would be the one unverified surface.
+        # Native-plane timing (tracing plane, PR 9): coarse per-verb
+        # stage counters (parse / storage work / reply, monotonic ns)
+        # stamped inside the C handlers when armed — the latency
+        # accounting for ops that never touch Python.  Requires the
+        # PR-9 ABI; a stale .so simply reports no native trace block.
+        self._has_trace = hasattr(lib, "dbeel_dp_trace_snapshot")
+        self._trace_armed = False
         self._verify_crc = False
         if self._has_native6 and os.environ.get(
             "DBEEL_DP_VERIFY", "1"
@@ -438,6 +445,42 @@ class DataPlane:
                 len(deadline_resp),
             )
             self._shed_armed = True
+
+    def set_trace(self, on: bool) -> None:
+        """Arm/disarm the native per-verb stage counters.  Off (the
+        default) costs literally nothing on the serving path; armed,
+        each natively-served op pays a few vDSO clock reads."""
+        if self._has_trace:
+            self._lib.dbeel_dp_set_trace(
+                self._handle, 1 if on else 0
+            )
+            self._trace_armed = bool(on)
+
+    # Snapshot layout: 4 verb classes x (ops, parse_ns, work_ns,
+    # reply_ns) — keep in lockstep with kTraceClasses/kTraceSlots in
+    # dbeel_native.cpp.
+    _TRACE_CLASSES = ("write", "get", "multi", "shard")
+
+    def trace_stats(self) -> Optional[dict]:
+        """Per-verb-class native stage attribution (µs totals + op
+        counts), or None when the .so predates the trace ABI."""
+        if not self._has_trace:
+            return None
+        n = len(self._TRACE_CLASSES) * 4
+        buf = (ctypes.c_uint64 * n)()
+        got = self._lib.dbeel_dp_trace_snapshot(self._handle, buf, n)
+        if got < n:
+            return None
+        out = {"armed": int(self._trace_armed)}
+        for i, cls in enumerate(self._TRACE_CLASSES):
+            ops, parse_ns, work_ns, reply_ns = buf[i * 4 : i * 4 + 4]
+            out[cls] = {
+                "ops": int(ops),
+                "parse_us": int(parse_ns) // 1000,
+                "work_us": int(work_ns) // 1000,
+                "reply_us": int(reply_ns) // 1000,
+            }
+        return out
 
     @property
     def shed_armed(self) -> bool:

@@ -27,6 +27,7 @@ from ..errors import (
     KeyNotFound,
     KeyNotOwnedByShard,
     MissingField,
+    ERROR_CLASS_OTHER,
     ERROR_CLASS_OVERLOAD,
     Overloaded,
     PeerDead,
@@ -41,9 +42,10 @@ from ..cluster.messages import (
     pack_message,
 )
 from ..storage.entry import TOMBSTONE
-from ..utils.murmur import hash_bytes
+from ..utils.murmur import hash_bytes, murmur3_32
 from ..utils.timestamps import now_nanos
 from . import framed
+from . import trace as trace_mod
 from .shard import MyShard
 
 log = logging.getLogger(__name__)
@@ -147,6 +149,23 @@ def _extract(map_: dict, field: str):
     return map_[field]
 
 
+def _client_trace_id(request: dict) -> Optional[int]:
+    """Client-stamped trace id on the request frame (tracing plane):
+    a positive int under the ``trace`` key forces a full span for
+    this op — the C parsers punt such frames so the interpreted path
+    (which owns the stage marks) always serves them."""
+    tid = request.get("trace")
+    if isinstance(tid, int) and tid > 0:
+        return tid
+    return None
+
+
+def _trace_id_for_peers(ctx) -> Optional[int]:
+    """Trace id to stamp on fan-out peer frames: replicas serving a
+    traced frame piggyback their own stage summary on the response."""
+    return ctx.trace_id if ctx is not None else None
+
+
 def _encode_field(value) -> bytes:
     """Keys/values are stored as their msgpack encoding
     (db_server.rs:93-104)."""
@@ -190,6 +209,15 @@ async def handle_request(
     if rtype == "get_stats":
         # Observability extension (no reference analog).
         return msgpack.packb(my_shard.get_stats(), use_bin_type=True)
+
+    if rtype == "trace_dump":
+        # Tracing plane (PR 9): the flight recorder's ring — sampled
+        # spans plus every slow/error op.  Always served, like
+        # get_stats: the slow tail of an overload must be readable
+        # DURING the overload (not in _SHEDDABLE_OPS).
+        return msgpack.packb(
+            my_shard.trace_recorder.dump(), use_bin_type=True
+        )
 
     if rtype == "rearm":
         # Admin: exit sticky degraded read-only mode after disk
@@ -245,6 +273,7 @@ async def handle_request(
         return None
 
     if rtype in ("set", "delete"):
+        ctx = trace_mod.current()
         collection_name = _extract(request, "collection")
         timeout_ms = request.get("timeout") or DEFAULT_SET_TIMEOUT_MS
         replica_index = request.get("replica_index") or 0
@@ -261,6 +290,9 @@ async def handle_request(
         if not isinstance(consistency, int):
             consistency = rf
         consistency = min(consistency, rf)
+        if ctx is not None:
+            # Ownership check + key/value encode + admission.
+            ctx.mark("prep")
 
         async def local_write():
             # stale_abort: if our capacity wait spans a flush swap
@@ -269,11 +301,20 @@ async def handle_request(
             # first-match reads would serve it — apply read-guarded
             # instead (LWW: whichever ts is newer wins), the same
             # contract as the replica-side handle_shard_set_message.
+            t_local = time.monotonic()
             if not await col.tree.set_with_timestamp(
                 key, value, timestamp, stale_abort=True
             ):
                 await my_shard.apply_if_newer(
                     col.tree, key, value, timestamp
+                )
+            if ctx is not None:
+                # Overlapping detail: the local memtable+WAL write
+                # runs concurrently with the quorum fan-out, so it is
+                # attributed beside the stages, not among them.
+                ctx.note(
+                    "local_write_us",
+                    (time.monotonic() - t_local) * 1e6,
                 )
 
         if rf > 1:
@@ -282,11 +323,13 @@ async def handle_request(
                 ShardRequest.set(
                     collection_name, key, value, timestamp,
                     deadline_ms=peer_deadline,
+                    trace_id=_trace_id_for_peers(ctx),
                 )
                 if rtype == "set"
                 else ShardRequest.delete(
                     collection_name, key, timestamp,
                     deadline_ms=peer_deadline,
+                    trace_id=_trace_id_for_peers(ctx),
                 )
             )
             expected = (
@@ -311,17 +354,26 @@ async def handle_request(
                 raise _quorum_error(
                     my_shard, rtype, op_status
                 ) from e
+            finally:
+                if ctx is not None:
+                    # Wall time of the overlapped local write +
+                    # replica fan-out up to the consistency-th ack.
+                    ctx.mark("quorum")
         else:
             try:
                 await asyncio.wait_for(local_write(), timeout_ms / 1000)
             except asyncio.TimeoutError as e:
                 raise Timeout(rtype) from e
+            finally:
+                if ctx is not None:
+                    ctx.mark("local")
         return None
 
     if rtype in ("multi_set", "multi_get"):
         return await _handle_multi(my_shard, request, timestamp, rtype)
 
     if rtype == "get":
+        ctx = trace_mod.current()
         collection_name = _extract(request, "collection")
         timeout_ms = request.get("timeout") or DEFAULT_GET_TIMEOUT_MS
         replica_index = request.get("replica_index") or 0
@@ -333,6 +385,8 @@ async def handle_request(
         if not isinstance(consistency, int):
             consistency = rf
         consistency = min(consistency, rf)
+        if ctx is not None:
+            ctx.mark("prep")
 
         if rf > 1:
             deadline = (
@@ -357,7 +411,12 @@ async def handle_request(
                     )
                 except asyncio.TimeoutError as e:
                     raise Timeout("get") from e
-                if await _digest_quorum_round(
+                finally:
+                    if ctx is not None:
+                        # Local memtable/table probe anchoring the
+                        # predicted digest bytes.
+                        ctx.mark("probe")
+                digest_agreed = await _digest_quorum_round(
                     my_shard,
                     collection_name,
                     col,
@@ -373,7 +432,11 @@ async def handle_request(
                     deadline_ms=_wall_deadline_ms(
                         request, timeout_ms
                     ),
-                ):
+                    trace_id=_trace_id_for_peers(ctx),
+                )
+                if ctx is not None:
+                    ctx.mark("digest")
+                if digest_agreed:
                     if (
                         local_value is None
                         or bytes(local_value[0]) == TOMBSTONE
@@ -400,6 +463,7 @@ async def handle_request(
                     deadline_ms=_wall_deadline_ms(
                         request, timeout_ms
                     ),
+                    trace_id=_trace_id_for_peers(ctx),
                 ),
                 consistency - 1,
                 rf - replica_index - 1,
@@ -434,6 +498,9 @@ async def handle_request(
                     )
             except asyncio.TimeoutError as e:
                 raise _quorum_error(my_shard, "get", op_status) from e
+            finally:
+                if ctx is not None:
+                    ctx.mark("quorum")
             return _merge_quorum_get(
                 my_shard,
                 collection_name,
@@ -450,6 +517,9 @@ async def handle_request(
             )
         except asyncio.TimeoutError as e:
             raise Timeout("get") from e
+        finally:
+            if ctx is not None:
+                ctx.mark("probe")
         if entry is not None and bytes(entry[0]) != TOMBSTONE:
             return bytes(entry[0])
         if entry is None and col.tree.reads_suspect:
@@ -582,6 +652,9 @@ async def _multi_set_keyed(
 ) -> None:
     entries = [(key, value, timestamp) for _i, key, value in keyed]
     op_status: dict = {}
+    ctx = trace_mod.current()
+    if ctx is not None:
+        ctx.mark("prep")
 
     async def local_batch():
         # stale_abort mirrors the single-set coordinator path: a
@@ -602,6 +675,7 @@ async def _multi_set_keyed(
                     collection_name,
                     [[k, v, ts] for k, v, ts in entries],
                     deadline_ms=int(time.time() * 1000) + timeout_ms,
+                    trace_id=_trace_id_for_peers(ctx),
                 ),
                 consistency - 1,
                 rf - replica_index - 1,
@@ -620,6 +694,12 @@ async def _multi_set_keyed(
         for i, *_rest in keyed:
             results[i] = [1, wire]
         return
+    finally:
+        # In a finally like the single-op paths: the timed-out multi
+        # ops are exactly the ones whose quorum wait must not be
+        # misattributed to "respond".
+        if ctx is not None:
+            ctx.mark("quorum" if rf > 1 else "local")
     for i, *_rest in keyed:
         results[i] = [0, None]
 
@@ -638,6 +718,9 @@ async def _multi_get_keyed(
     keys = [key for _i, key in keyed]
     op_status: dict = {}
     number_of_nodes = rf - replica_index - 1
+    ctx = trace_mod.current()
+    if ctx is not None:
+        ctx.mark("prep")
     try:
         # suspect_guard whenever the local read may be the ONLY
         # evidence (consistency=1 — including RF>1 with 0 remote acks
@@ -655,6 +738,7 @@ async def _multi_get_keyed(
                     collection_name,
                     keys,
                     deadline_ms=int(time.time() * 1000) + timeout_ms,
+                    trace_id=_trace_id_for_peers(ctx),
                 ),
                 consistency - 1,
                 number_of_nodes,
@@ -676,6 +760,9 @@ async def _multi_get_keyed(
         for i, _key in keyed:
             results[i] = [1, wire]
         return
+    finally:
+        if ctx is not None:
+            ctx.mark("quorum" if rf > 1 else "local")
     aligned = [
         r
         for r in replica_lists
@@ -739,6 +826,7 @@ async def _digest_quorum_round(
     timeout_s: float,
     op_status: Optional[dict] = None,
     deadline_ms: Optional[int] = None,
+    trace_id: Optional[int] = None,
 ):
     """Digest-read round for an RF>1 get (beyond the reference, which
     ships RF full entries — db_server.rs:318-370): replicas answer
@@ -756,7 +844,8 @@ async def _digest_quorum_round(
     as before.  Raises Timeout like the full round would."""
     digest = pack_message(
         ShardRequest.get_digest(
-            collection_name, key, deadline_ms=deadline_ms
+            collection_name, key, deadline_ms=deadline_ms,
+            trace_id=trace_id,
         )
     )
     framed = struct.pack("<I", len(digest)) + digest
@@ -780,6 +869,10 @@ async def _digest_quorum_round(
         raise _quorum_error(my_shard, "get", op_status) from e
     newer = False
     stale = 0
+    # Lazy, computed at most once: only needed when a ts-equal
+    # digest arrives UNPACKED (traced frames piggyback, so their
+    # agreement misses the byte-compare).
+    local_hash = None
     for r in results:
         if r is None:
             continue  # byte-matched ack: replica agrees exactly
@@ -793,6 +886,15 @@ async def _digest_quorum_round(
         elif r_ts < local_ts:
             stale += 1
         else:
+            if len(r) > 1 and local_value is not None:
+                if local_hash is None:
+                    local_hash = murmur3_32(bytes(local_value[0]))
+                if r[1] == local_hash:
+                    # Same (ts, hash) but the bytes didn't compare
+                    # equal — traced frames piggyback a replica
+                    # span, so agreement arrives unpacked instead
+                    # of as the predicted ack.
+                    continue
             # Same timestamp, different value hash: divergence the
             # LWW model says cannot happen — resolve via the full
             # round rather than guessing.
@@ -886,6 +988,12 @@ async def _read_repair(
 ) -> None:
     from ..flow_events import FlowEvent
 
+    # Spawned from inside a (possibly traced) get: the task copied
+    # that op's context, and without this reset the repair's own
+    # replica fan-out would absorb its SET acks into the GET's span
+    # as phantom replicas.  Background work is never part of the
+    # requesting op's latency.
+    trace_mod.CURRENT.set(None)
     try:
         # Read-guarded local apply: win_ts came from layer-ordered
         # quorum reads and can be OLDER than a flushed version — a
@@ -981,11 +1089,17 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
         my_shard.spawn(flush_tree.flush())
     if error_resp is not None:
         # Entry applied but the WAL append failed: the C side built
-        # the error response; no fan-out, no re-run.
+        # the error response ("Internal", taxonomy class "other");
+        # no fan-out, no re-run.  Count + capture it like the
+        # interpreted path's errors — a bad disk under the coord
+        # assist must be visible in trace_dump too.
         log.error(
             "native coord %s on %r: wal append failed", op, col_name
         )
-        my_shard.metrics.record_request(op, started)
+        my_shard.metrics.record_error(ERROR_CLASS_OTHER)
+        my_shard.metrics.record_request(
+            op, started, error_kind=ERROR_CLASS_OTHER
+        )
         return error_resp, keepalive
     try:
         col = my_shard.collections.get(col_name)
@@ -1039,8 +1153,14 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                 raise _quorum_error(my_shard, op, op_status) from e
             buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
     except Exception as e:  # defensive: never kill the connection task
-        my_shard.metrics.record_error(classify_error(e))
+        err_kind = classify_error(e)
+        my_shard.metrics.record_error(err_kind)
         buf = _error_response(e)
+        my_shard.metrics.record_request(
+            op, started, error_kind=err_kind
+        )
+        _note_completion(my_shard, op, started, timeout_ms, None)
+        return buf, keepalive
     my_shard.metrics.record_request(op, started)
     _note_completion(my_shard, op, started, timeout_ms, None)
     return buf, keepalive
@@ -1121,14 +1241,25 @@ async def _finish_coord_get(
 
 
 async def _serve_frame(
-    my_shard: MyShard, request_buf: bytes, req: Optional[dict] = None
+    my_shard: MyShard,
+    request_buf: bytes,
+    req: Optional[dict] = None,
+    ctx=None,
 ):
     """One request frame → (response bytes incl. trailing type byte,
     keepalive?).  ``req`` may carry the already-unpacked request map
-    (the pipelined dispatcher parses frames once for batching)."""
+    (the pipelined dispatcher parses frames once for batching);
+    ``ctx`` an active trace span (sampled / client-stamped op, its
+    t0 already set to the frame's arrival stamp) — installed as the
+    task-tree current trace so the storage and fan-out layers can
+    attribute their stages to it."""
     started = time.monotonic()
     op = "invalid"
     keepalive = False
+    err_kind = None
+    token = (
+        trace_mod.CURRENT.set(ctx) if ctx is not None else None
+    )
     try:
         if req is None:
             try:
@@ -1139,15 +1270,33 @@ async def _serve_frame(
             raise BadFieldType("document")
         op = str(req.get("type", "invalid"))
         keepalive = bool(req.get("keepalive"))
+        if ctx is not None:
+            ctx.op = op
+            col = req.get("collection")
+            ctx.collection = col if isinstance(col, str) else None
+            # Queue wait + unpack + the spawn hop to this task.
+            ctx.mark("dispatch")
         payload = await handle_request(my_shard, req)
         if payload is None:
             buf = msgpack.packb("OK") + bytes([RESPONSE_BYTES])
         else:
             buf = payload + bytes([RESPONSE_OK])
     except Exception as e:  # defensive: never kill the connection task
-        my_shard.metrics.record_error(classify_error(e))
+        err_kind = classify_error(e)
+        my_shard.metrics.record_error(err_kind)
         buf = _error_response(e)
-    my_shard.metrics.record_request(op, started)
+    finally:
+        if token is not None:
+            trace_mod.CURRENT.reset(token)
+    if ctx is not None:
+        # Merge + response pack since the last stage mark; the span
+        # then covers arrival → response bytes ready (the coalesced
+        # transport write happens on the next loop tick).
+        ctx.mark("respond")
+        my_shard.trace_recorder.record_span(ctx, err_kind)
+    my_shard.metrics.record_request(
+        op, started, error_kind=err_kind, traced=ctx is not None
+    )
     if isinstance(req, dict):
         _note_completion(
             my_shard,
@@ -1223,6 +1372,8 @@ class _DbProtocol(framed.FramedServerProtocol):
         "_slot_free",
         "_get_batch",
         "_get_batch_col",
+        "_sampled_next",
+        "_ticked_next",
     )
 
     def __init__(self, my_shard: MyShard) -> None:
@@ -1232,6 +1383,14 @@ class _DbProtocol(framed.FramedServerProtocol):
         self._slot_free: "asyncio.Event | None" = None
         self._get_batch: list = []  # (park entry, request map, t0)
         self._get_batch_col: Optional[str] = None
+        # Tracing plane: _try_fast drew the sampling tick for the
+        # frame it just declined — _dispatch (which pops that same
+        # frame first: the fast path is only consulted on an empty
+        # queue) routes a fired sample through the interpreted path
+        # with a span, and skips its own tick for a frame whose tick
+        # was already drawn (_ticked_next) so no frame counts twice.
+        self._sampled_next = False
+        self._ticked_next = False
         # AIMD pipeline window (overload plane): starts at the max —
         # an idle shard gives new connections full pipelining; the
         # governor shrinks it the moment backlog builds.
@@ -1261,6 +1420,29 @@ class _DbProtocol(framed.FramedServerProtocol):
         self.shard.scheduler.fg_mark()
 
     def _try_fast(self, frame: bytes) -> int:
+        rec = self.shard.trace_recorder
+        if rec.sampling:
+            # One sampling tick per client frame, drawn HERE for
+            # frames the fast path sees.  On every FAST_MISS path
+            # this exact frame is the next _dispatch pop (the fast
+            # path is only consulted on an empty queue), so the
+            # flags map one-to-one; a frame the fast path HANDLES
+            # spends its tick (cleared below) — _dispatch ticks only
+            # frames that queued without passing through here, so no
+            # frame ever draws two ticks.
+            self._ticked_next = True
+            if rec.tick():
+                # The 1-in-N trace sample: decline the fast path so
+                # the interpreted dispatcher serves it with real
+                # stage marks.
+                self._sampled_next = True
+                return framed.FAST_MISS
+        verdict = self._try_fast_inner(frame)
+        if verdict != framed.FAST_MISS:
+            self._ticked_next = False
+        return verdict
+
+    def _try_fast_inner(self, frame: bytes) -> int:
         # A handled frame is answered synchronously right here — no
         # task hop, no interpreter dispatch.  Only consulted by
         # data_received when nothing is queued or in flight, so the
@@ -1339,6 +1521,10 @@ class _DbProtocol(framed.FramedServerProtocol):
             shard.native_drops_by_op.get(op, 0) + 1
         )
         shard.metrics.record_error(ERROR_CLASS_OVERLOAD)
+        # Flight recorder: native drops are error completions like
+        # their interpreted twins (latency ~0 — the drop IS the
+        # point; the ring records that it happened and why).
+        shard.trace_recorder.note_op(op, 0, ERROR_CLASS_OVERLOAD)
 
     # -- pipelined drain --------------------------------------------
 
@@ -1377,7 +1563,7 @@ class _DbProtocol(framed.FramedServerProtocol):
                     except asyncio.TimeoutError:
                         pass
                     continue
-                frame = self.pending.popleft()
+                frame, arrived = self.pending.popleft()
                 if (
                     self.paused_reading
                     and len(self.pending) < self.PENDING_LOW
@@ -1385,7 +1571,7 @@ class _DbProtocol(framed.FramedServerProtocol):
                 ):
                     self.paused_reading = False
                     self.transport.resume_reading()
-                if not self._dispatch(frame):
+                if not self._dispatch(frame, arrived):
                     return
         except asyncio.CancelledError:
             # Shard shutdown (or client disconnect) cancelled us:
@@ -1404,16 +1590,33 @@ class _DbProtocol(framed.FramedServerProtocol):
             if self.pending and not self.closing:
                 self.task = self.shard.spawn(self._drain())
 
-    def _dispatch(self, frame: bytes) -> bool:
+    def _dispatch(self, frame: bytes, arrived: float = 0.0) -> bool:
         """Start serving one queued frame without awaiting its result:
         natively-handled frames answer synchronously into an in-order
         parked slot; consecutive RF=1 gets coalesce into one internal
         multi_get task; everything else reserves its slot and runs as
         a windowed concurrent task.  Returns False to stop draining
-        this connection."""
+        this connection.  ``arrived``: frame receipt stamp (queue-wait
+        attribution for traced ops)."""
         gov = self.shard.governor
         shedding = gov.should_shed()
+        rec = self.shard.trace_recorder
+        sampled = self._sampled_next
+        ticked = self._ticked_next or sampled
+        self._sampled_next = False
+        self._ticked_next = False
+        if not sampled and not ticked and rec.sampling and rec.tick():
+            # Frames that queued behind others never consulted
+            # _try_fast — the 1-in-N sample is drawn here instead
+            # (frames _try_fast declined already drew theirs).
+            sampled = True
         dp = self.shard.dataplane
+        if sampled:
+            # Sampled frame: the interpreted path end to end, so the
+            # span gets real stage marks and the peer frames carry
+            # the trace id.  1-in-N by construction — the slower path
+            # for sampled ops IS the design.
+            dp = None
         if shedding and (dp is None or not dp.shed_armed):
             # Hard overload without the native shed gate: only the
             # interpreted shed branch below may answer data ops.
@@ -1475,6 +1678,7 @@ class _DbProtocol(framed.FramedServerProtocol):
         )
         req = None
         keepalive = True
+        ctx = None
         if coord is not None:
             keepalive = bool(coord[2])
         else:
@@ -1485,6 +1689,23 @@ class _DbProtocol(framed.FramedServerProtocol):
             keepalive = isinstance(req, dict) and bool(
                 req.get("keepalive")
             )
+            tid = (
+                _client_trace_id(req)
+                if isinstance(req, dict)
+                else None
+            )
+            if tid is not None or sampled:
+                # Span for this op: client-stamped ids force one;
+                # server sampling assigns one.  t0 = frame arrival,
+                # so queue wait is the first attributed stage.
+                ctx = trace_mod.TraceCtx(
+                    tid
+                    if tid is not None
+                    else trace_mod.new_trace_id(),
+                    t0=arrived or time.monotonic(),
+                    client_stamped=tid is not None,
+                )
+                ctx.mark("queue")
             if (
                 shedding
                 and isinstance(req, dict)
@@ -1505,7 +1726,26 @@ class _DbProtocol(framed.FramedServerProtocol):
                 err = Overloaded(
                     f"shard {self.shard.shard_name} shedding load"
                 )
-                self.shard.metrics.record_error(classify_error(err))
+                err_kind = classify_error(err)
+                self.shard.metrics.record_error(err_kind)
+                # Flight recorder: sheds ARE the interesting tail —
+                # always captured (full span when sampled).
+                if ctx is not None:
+                    ctx.op = op
+                    ctx.mark("shed")
+                    rec.record_span(ctx, err_kind)
+                else:
+                    rec.note_op(
+                        op,
+                        int(
+                            (
+                                time.monotonic()
+                                - (arrived or time.monotonic())
+                            )
+                            * 1e6
+                        ),
+                        err_kind,
+                    )
                 self.park_response(
                     _frame_response(_error_response(err)),
                     keepalive,
@@ -1519,9 +1759,13 @@ class _DbProtocol(framed.FramedServerProtocol):
                 return True
             if (
                 keepalive
+                and ctx is None
                 and isinstance(req, dict)
                 and self._batchable_get(req)
             ):
+                # (Traced gets skip coalescing: the span belongs to
+                # ONE frame, and sampling is rare enough that losing
+                # one batch slot is noise.)
                 if (
                     self._get_batch
                     and self._get_batch_col != req.get("collection")
@@ -1543,7 +1787,7 @@ class _DbProtocol(framed.FramedServerProtocol):
             len(self.inflight) + 1
         )
         task = self.shard.spawn(
-            self._serve_pipelined(frame, coord, entry, req)
+            self._serve_pipelined(frame, coord, entry, req, ctx)
         )
         self.inflight.add(task)
         task.add_done_callback(self._pipelined_done)
@@ -1606,9 +1850,12 @@ class _DbProtocol(framed.FramedServerProtocol):
         try:
             col = my_shard.get_collection(col_name)
         except DbeelError as e:
+            kind = classify_error(e)
             for entry, _req, started in items:
-                my_shard.metrics.record_error(classify_error(e))
-                my_shard.metrics.record_request("get", started)
+                my_shard.metrics.record_error(kind)
+                my_shard.metrics.record_request(
+                    "get", started, error_kind=kind
+                )
                 self.finish_park(
                     entry, _frame_response(_error_response(e))
                 )
@@ -1626,8 +1873,11 @@ class _DbProtocol(framed.FramedServerProtocol):
                 )
                 keyed.append((entry, key, started))
             except DbeelError as e:
-                my_shard.metrics.record_error(classify_error(e))
-                my_shard.metrics.record_request("get", started)
+                kind = classify_error(e)
+                my_shard.metrics.record_error(kind)
+                my_shard.metrics.record_request(
+                    "get", started, error_kind=kind
+                )
                 self.finish_park(
                     entry, _frame_response(_error_response(e))
                 )
@@ -1648,8 +1898,10 @@ class _DbProtocol(framed.FramedServerProtocol):
             err = DbeelError(f"Internal: {e}")
         for entry, key, started in keyed:
             hit = found.get(key)
+            kind = None
             if err is not None:
-                my_shard.metrics.record_error(classify_error(err))
+                kind = classify_error(err)
+                my_shard.metrics.record_error(kind)
                 buf = _error_response(err)
             elif hit is None and col.tree.reads_suspect:
                 # Quarantine pending repair: a miss is unproven —
@@ -1658,13 +1910,16 @@ class _DbProtocol(framed.FramedServerProtocol):
                     "local miss is suspect: quarantined table "
                     "pending repair"
                 )
-                my_shard.metrics.record_error(classify_error(bad))
+                kind = classify_error(bad)
+                my_shard.metrics.record_error(kind)
                 buf = _error_response(bad)
             elif hit is None or bytes(hit[0]) == TOMBSTONE:
                 buf = _error_response(KeyNotFound(repr(key)))
             else:
                 buf = bytes(hit[0]) + bytes([RESPONSE_OK])
-            my_shard.metrics.record_request("get", started)
+            my_shard.metrics.record_request(
+                "get", started, error_kind=kind
+            )
             self.finish_park(entry, _frame_response(buf))
 
     def _pipelined_done(self, task) -> None:
@@ -1681,13 +1936,18 @@ class _DbProtocol(framed.FramedServerProtocol):
             self._slot_free.set()
 
     async def _serve_pipelined(
-        self, frame: bytes, coord, entry, req: Optional[dict] = None
+        self,
+        frame: bytes,
+        coord,
+        entry,
+        req: Optional[dict] = None,
+        ctx=None,
     ) -> None:
         if coord is not None:
             buf, keepalive = await _serve_coord(self.shard, coord)
         else:
             buf, keepalive = await _serve_frame(
-                self.shard, frame, req
+                self.shard, frame, req, ctx
             )
         if not keepalive:
             # Reference behavior: one request per connection unless

@@ -17,6 +17,7 @@ after a fire-and-forget sender's FIN).
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 
 # _try_fast verdicts.
@@ -213,7 +214,9 @@ class FramedServerProtocol(asyncio.Protocol):
     def _try_fast(self, frame: bytes) -> int:
         return FAST_MISS
 
-    async def _serve_one(self, frame: bytes) -> bool:
+    async def _serve_one(self, frame: bytes, arrived: float = 0.0) -> bool:
+        """``arrived``: time.monotonic() at frame receipt (queue-wait
+        attribution for the tracing plane)."""
         raise NotImplementedError
 
     # -- deferred (sync-parked) responses ---------------------------
@@ -338,7 +341,10 @@ class FramedServerProtocol(asyncio.Protocol):
                     return
                 if verdict:
                     continue
-            self.pending.append(frame)
+            # Arrival stamp rides with the frame: queue-wait (arrival
+            # to dispatch) is the first span stage of a traced op, and
+            # one monotonic read per frame is noise next to the parse.
+            self.pending.append((frame, time.monotonic()))
             parsed = True
         if (
             len(self.pending) > self._pending_high()
@@ -352,7 +358,7 @@ class FramedServerProtocol(asyncio.Protocol):
     async def _drain(self) -> None:
         try:
             while self.pending and not self.closing:
-                frame = self.pending.popleft()
+                frame, arrived = self.pending.popleft()
                 if (
                     self.paused_reading
                     and len(self.pending) < self.PENDING_LOW
@@ -360,7 +366,7 @@ class FramedServerProtocol(asyncio.Protocol):
                 ):
                     self.paused_reading = False
                     self.transport.resume_reading()
-                if not await self._serve_one(frame):
+                if not await self._serve_one(frame, arrived):
                     return
         except asyncio.CancelledError:
             # Shard shutdown (or client disconnect) cancelled us:

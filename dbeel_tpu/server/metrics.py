@@ -78,7 +78,13 @@ class ShardMetrics:
     """Per-shard metrics hub: request histograms by op type, a slow-op
     threshold log, and background-stage counters."""
 
-    SLOW_OP_US = 100_000  # ops slower than 100ms get one log line
+    SLOW_OP_US = 100_000  # default slow threshold (--slow-op-us)
+    # Slow-op WARNING lines are rate-limited per op type: under
+    # overload every op can cross the threshold and one line per op
+    # floods (and further slows) the serving loop.  The structured
+    # record still lands in the flight recorder for every slow op —
+    # the log line is a human tap, not the evidence.
+    SLOW_LOG_PERIOD_S = 1.0
     # Histograms are keyed by the CLIENT-supplied request type: cap the
     # key set so garbage types can't grow shard memory / stats output.
     KNOWN_OPS = frozenset(
@@ -100,6 +106,14 @@ class ShardMetrics:
     def __init__(self) -> None:
         self.requests: Dict[str, LatencyHistogram] = {}
         self.slow_ops = 0
+        self.slow_op_us = self.SLOW_OP_US
+        # Flight recorder (tracing plane, PR 9): every slow/error op
+        # is captured there; sampled ops record full spans at the
+        # serving layer and pass traced=True so they are not
+        # double-recorded here.  None until MyShard wires it.
+        self.recorder = None
+        self._slow_logged_at: Dict[str, float] = {}
+        self._slow_suppressed: Dict[str, int] = {}
         # Pipelined-plane shape counters.  The two histograms reuse
         # the log-bucketed LatencyHistogram with a COUNT (not µs) as
         # the recorded value — bucket b covers [2^b, 2^{b+1}) items:
@@ -138,8 +152,17 @@ class ShardMetrics:
     def record_hol_wait(self) -> None:
         self.hol_waits += 1
 
-    def record_request(self, op: str, started: float) -> None:
-        """``started`` from time.monotonic() at frame receipt."""
+    def record_request(
+        self,
+        op: str,
+        started: float,
+        error_kind: "Optional[str]" = None,
+        traced: bool = False,
+    ) -> None:
+        """``started`` from time.monotonic() at frame receipt.
+        ``error_kind`` (taxonomy class, when the caller knows it) and
+        ``traced`` (a full span was already recorded) feed the flight
+        recorder's slow/error capture."""
         us = int((time.monotonic() - started) * 1e6)
         if op not in self.KNOWN_OPS:
             op = "other"
@@ -147,9 +170,29 @@ class ShardMetrics:
         if hist is None:
             hist = self.requests[op] = LatencyHistogram()
         hist.record_us(us)
-        if us >= self.SLOW_OP_US:
+        if self.recorder is not None and not traced:
+            self.recorder.note_op(op, us, error_kind)
+        if us >= self.slow_op_us:
             self.slow_ops += 1
-            log.warning("slow %s: %.1f ms", op, us / 1e3)
+            now = time.monotonic()
+            last = self._slow_logged_at.get(op, 0.0)
+            if now - last >= self.SLOW_LOG_PERIOD_S:
+                self._slow_logged_at[op] = now
+                muted = self._slow_suppressed.pop(op, 0)
+                if muted:
+                    log.warning(
+                        "slow %s: %.1f ms (+%d slow %s in the last "
+                        "%.0fs not logged; see trace_dump)",
+                        op, us / 1e3, muted, op, now - last,
+                    )
+                else:
+                    log.warning("slow %s: %.1f ms", op, us / 1e3)
+            else:
+                # lint: allow(stats-schema) — log suppression state,
+                # not an operator counter.
+                self._slow_suppressed[op] = (
+                    self._slow_suppressed.get(op, 0) + 1
+                )
 
     def snapshot(self) -> dict:
         return {

@@ -18,6 +18,7 @@ import re
 import secrets
 import socket
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -51,6 +52,7 @@ from ..cluster.messages import (
     ShardResponse,
 )
 from ..cluster.remote_comm import RemoteShardConnection
+from . import trace as trace_mod
 
 log = logging.getLogger(__name__)
 
@@ -194,6 +196,20 @@ class MyShard:
         from .metrics import ShardMetrics
 
         self.metrics = ShardMetrics()
+        # Tracing plane (PR 9): the per-shard flight recorder.  Full
+        # spans for sampled / client-stamped ops, minimal records for
+        # every slow or errored op; queried via the admin trace_dump
+        # verb.  The metrics hub holds a reference so its completion
+        # points feed the slow/error capture.
+        from .trace import FlightRecorder
+
+        self.trace_recorder = FlightRecorder(
+            sample_every=config.trace_sample,
+            slow_op_us=config.slow_op_us,
+            capacity=config.trace_ring,
+        )
+        self.metrics.recorder = self.trace_recorder
+        self.metrics.slow_op_us = self.trace_recorder.slow_op_us
         # Overload-control plane (PR 5): one governor per shard folds
         # the backlog signals (admitted work, memtable fill, flush/
         # compaction debt) into an OK/soft/hard level.  Soft delays
@@ -241,6 +257,15 @@ class MyShard:
             from .db_server import install_native_overload_responses
 
             install_native_overload_responses(self)
+            if config.trace_sample > 0:
+                # Native-plane timing (tracing plane): arm the coarse
+                # per-verb stage counters (parse/work/reply monotonic
+                # deltas) so natively-served ops stay visible to
+                # latency accounting.  Off by default — the clock
+                # reads cost ~0 but the acceptance bar is "within
+                # noise", so unsampled deployments pay literally
+                # nothing.
+                self.dataplane.set_trace(True)
         # Native quorum fan-out engine (VERDICT r3 #2): the packed
         # peer frame goes out on persistent raw sockets and acks are
         # byte-compared in C; Python keeps quorum counting/merge/
@@ -808,6 +833,20 @@ class MyShard:
                 "entries_fetched": self.ae_entries_fetched,
             },
             "metrics": self.metrics.snapshot(),
+            # Tracing plane (PR 9): flight-recorder counters + the
+            # native plane's coarse per-verb stage attribution, so
+            # C-served ops are no longer invisible to latency
+            # accounting.  Ring CONTENTS come back via trace_dump.
+            "trace": {
+                "sample_every": self.trace_recorder.sample_every,
+                "slow_op_us": self.trace_recorder.slow_op_us,
+                **self.trace_recorder.stats(),
+                "native": (
+                    self.dataplane.trace_stats()
+                    if self.dataplane is not None
+                    else None
+                ),
+            },
             "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
@@ -1238,9 +1277,18 @@ class MyShard:
             # here so the native fan-out path carries them too.
             op_status["targets"] = [n for n, _c in connections]
         qf = self.quorum_fanout
-        if qf is not None and all(
-            not isinstance(c, LocalShardConnection)
-            for _n, c in connections
+        if (
+            qf is not None
+            # Traced ops keep the asyncio fan-out: the span needs
+            # per-replica RTTs and the piggybacked replica stage
+            # summaries, which the C engine's byte-compare path
+            # doesn't surface.  Sampling is 1-in-N — the slow path
+            # for sampled ops is the design, not a regression.
+            and trace_mod.current() is None
+            and all(
+                not isinstance(c, LocalShardConnection)
+                for _n, c in connections
+            )
         ):
             fut = qf.try_submit(
                 framed,
@@ -1253,12 +1301,15 @@ class MyShard:
             if fut is not None:
                 return await fut
 
-        def interpret(payload: bytes):
-            if payload == expected_ack:
-                return None
-            return msgs.response_to_result(
-                msgs.unpack_message(payload), expected_kind
-            )
+        def interpret(payload):
+            # Traced fan-outs absorb the replica's piggybacked span
+            # before interpretation and hand back an already-unpacked
+            # list — accept both forms.
+            if isinstance(payload, (bytes, bytearray)):
+                if payload == expected_ack:
+                    return None
+                payload = msgs.unpack_message(payload)
+            return msgs.response_to_result(payload, expected_kind)
 
         return await self._fan_out_to_replicas(
             lambda c: c.send_packed(framed),
@@ -1376,6 +1427,10 @@ class MyShard:
             op_status.setdefault(
                 "targets", [name for name, _c in connections]
             )
+        # Tracing plane: captured HERE (the caller's context) — the
+        # fan-out body runs as a spawned task and must attribute its
+        # per-replica RTTs / piggybacked spans to the op that asked.
+        trace_ctx = trace_mod.current()
 
         result_future: asyncio.Future = (
             asyncio.get_event_loop().create_future()
@@ -1400,9 +1455,11 @@ class MyShard:
                 else:
                     live.append((name, c))
             fut_node = {}
+            fut_sent = {}
             for name, c in live:
                 fut = asyncio.ensure_future(send_fn(c))
                 fut_node[fut] = name
+                fut_sent[fut] = time.monotonic()
                 self._register_inflight(name, fut)
             pending = set(fut_node)
 
@@ -1411,7 +1468,21 @@ class MyShard:
                 name = fut_node[fut]
                 self._unregister_inflight(name, fut)
                 try:
-                    results.append(interpret_fn(fut.result()))
+                    payload = fut.result()
+                    if trace_ctx is not None:
+                        # Per-replica attribution: send→settle RTT
+                        # plus the stage summary the replica
+                        # piggybacked (stripped before interpret so
+                        # the quorum brain sees the base frame).
+                        payload = trace_ctx.absorb_peer(
+                            name,
+                            int(
+                                (time.monotonic() - fut_sent[fut])
+                                * 1e6
+                            ),
+                            payload,
+                        )
+                    results.append(interpret_fn(payload))
                     return True
                 except asyncio.CancelledError:
                     # Cancelled by a mid-flight death mark
@@ -1546,6 +1617,41 @@ class MyShard:
         ShardRequest.MULTI_SET: 4,
         ShardRequest.MULTI_GET: 4,
     }
+
+    # Position of the OPTIONAL trailing trace id (tracing plane,
+    # PR 9): always exactly one slot past the deadline (a sampled
+    # frame with no real budget carries a 0 deadline placeholder, so
+    # the trace slot never shifts).  The wire-parity lint pins each
+    # entry to deadline_index + 1 and checks the C parser's
+    # trace-dialect (`want + 2`) handling in lockstep.
+    _PEER_TRACE_INDEX = {
+        ShardRequest.SET: 7,
+        ShardRequest.DELETE: 6,
+        ShardRequest.GET: 5,
+        ShardRequest.GET_DIGEST: 5,
+        ShardRequest.MULTI_SET: 5,
+        ShardRequest.MULTI_GET: 5,
+    }
+
+    @classmethod
+    def peer_trace_id(cls, request) -> Optional[int]:
+        """Trace id a coordinator stamped on this peer frame, or None.
+        A replica serving a traced frame piggybacks its own stage
+        summary (a few u32 micros) on the response so the
+        coordinator's span decomposes end to end."""
+        if (
+            not isinstance(request, (list, tuple))
+            or len(request) < 2
+            or request[0] != "request"
+        ):
+            return None
+        idx = cls._PEER_TRACE_INDEX.get(request[1])
+        if idx is None or len(request) <= idx:
+            return None
+        tid = request[idx]
+        if isinstance(tid, int) and tid > 0:
+            return tid
+        return None
 
     def _peer_deadline_expired(self, request: list) -> bool:
         """True when the frame carries a propagated deadline that has

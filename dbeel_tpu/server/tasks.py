@@ -13,6 +13,7 @@ import asyncio
 import logging
 import os
 import random
+import time
 
 from ..errors import DbeelError, ShardStopped
 from ..flow_events import FlowEvent
@@ -166,7 +167,7 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             )
         return framed.FAST_HANDLED
 
-    async def _serve_one(self, frame: bytes) -> bool:
+    async def _serve_one(self, frame: bytes, arrived: float = 0.0) -> bool:
         my_shard = self.shard
         try:
             message = unpack_message(frame)
@@ -194,6 +195,14 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
             )
         ):
             my_shard.scheduler.fg_mark()
+        # Tracing plane: a coordinator stamped a trace id on this
+        # peer frame — measure our own stages and piggyback the
+        # summary on the response, so an RF>1 op's span decomposes
+        # into coordinator + per-replica time.  The native replica
+        # plane punts traced frames (want+2 dialect), so every
+        # sampled frame lands here.
+        trace_id = MyShard.peer_trace_id(message)
+        t_serve = time.monotonic()
         try:
             response = await my_shard.handle_shard_message(message)
         except DbeelError as e:
@@ -205,6 +214,25 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
                 ShardResponse.ERROR,
                 "Internal",
                 str(e),
+            ]
+        if (
+            trace_id is not None
+            and isinstance(response, list)
+            and len(response) >= 2
+            and response[0] == "response"
+            and response[1] != ShardResponse.ERROR
+        ):
+            # Replica stage summary (u32 micros): [queue_us,
+            # serve_us] — frame receipt → dispatch, and the storage
+            # work itself.  One extra trailing element past the base
+            # arity; the coordinator's fan-out strips it before the
+            # quorum interpret (trace.split_peer_span).
+            now = time.monotonic()
+            queue_us = int(
+                max(0.0, t_serve - (arrived or t_serve)) * 1e6
+            )
+            response = response + [
+                [queue_us, int((now - t_serve) * 1e6)]
             ]
         if (
             response is not None

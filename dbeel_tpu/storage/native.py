@@ -500,6 +500,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.dbeel_odirect_fallbacks.restype = ctypes.c_uint64
             lib.dbeel_odirect_fallbacks.argtypes = []
+        if hasattr(lib, "dbeel_dp_trace_snapshot"):
+            # Tracing plane (PR 9): coarse per-verb native stage
+            # counters.  Gated separately — stale .so tolerance.
+            lib.dbeel_dp_set_trace.restype = None
+            lib.dbeel_dp_set_trace.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+            ]
+            lib.dbeel_dp_trace_snapshot.restype = ctypes.c_int32
+            lib.dbeel_dp_trace_snapshot.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int32,
+            ]
         lib.dbeel_dp_handle.restype = ctypes.c_int64
         lib.dbeel_dp_handle.argtypes = [
             ctypes.c_void_p,

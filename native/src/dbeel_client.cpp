@@ -233,6 +233,11 @@ struct Client {
   uint32_t backoff_base_ms = 20;
   uint32_t backoff_cap_ms = 500;
   unsigned rng_state = 0x5eed5eed;
+  // Tracing plane (PR 9): when nonzero, single-op walk requests
+  // carry this id under the "trace" key (auto-incremented per op so
+  // each stamped op gets a distinct, correlatable id) — the server
+  // records a full per-stage span for them.
+  uint64_t trace_id = 0;
 
   ~Client() {
     for (auto& kv : conns) {
@@ -619,9 +624,11 @@ int keyed_request(Client* c, const char* type,
       }
       MpBuf m;
       // type, collection, keepalive, key, hash, replica_index,
-      // deadline_ms (+ value on set, + consistency when requested).
+      // deadline_ms (+ value on set, + consistency when requested,
+      // + trace id when armed via dbeel_cli_set_trace).
       uint32_t fields = 7 + (is_set ? 1 : 0) +
-                        (consistency > 0 ? 1 : 0);
+                        (consistency > 0 ? 1 : 0) +
+                        (c->trace_id ? 1 : 0);
       m.map_header(fields);
       common_fields(&m, type, collection, true);
       m.str("key");
@@ -640,6 +647,12 @@ int keyed_request(Client* c, const char* type,
       m.uint((uint64_t)ri);
       m.str("deadline_ms");
       m.uint(wall_deadline);
+      if (c->trace_id) {
+        // Tracing plane: a stamped op takes the server's interpreted
+        // path and records a full per-stage span (trace_dump).
+        m.str("trace");
+        m.uint(c->trace_id++);
+      }
       std::vector<uint8_t> body;
       uint8_t rtype = 0;
       if (!round_trip(c, replicas[ri]->ip, replicas[ri]->db_port, m,
@@ -1082,6 +1095,44 @@ int64_t dbeel_cli_get_stats(void* h, const char* ip, uint16_t port,
   }
   if (body.size() > cap) {
     c->last_error = "stats exceed caller buffer";
+    return -((int64_t)body.size()) - 10;
+  }
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
+// Arm per-op trace stamping (tracing plane, PR 9): every single-op
+// walk request carries an auto-incrementing trace id starting at
+// ``base`` — the server serves it interpreted and records a full
+// per-stage span.  0 disarms.
+void dbeel_cli_set_trace(void* h, uint64_t base) {
+  static_cast<Client*>(h)->trace_id = base;
+}
+
+// Fetch one server's flight-recorder dump (raw msgpack map — the
+// schema is shared with the Python client's trace_dump()): sampled
+// per-stage spans plus every slow/error op.  Same target/buffer
+// contract as dbeel_cli_get_stats.
+int64_t dbeel_cli_trace_dump(void* h, const char* ip, uint16_t port,
+                             uint8_t* out, uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string target_ip = (ip && *ip) ? ip : c->seed_ip;
+  uint16_t target_port = port ? port : c->seed_port;
+  MpBuf m;
+  m.map_header(2);
+  common_fields(&m, "trace_dump", "", true);
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, target_ip, target_port, m, &body, &rtype)) {
+    return -2;
+  }
+  if (rtype == kResponseErr) {
+    std::string msg;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+    return -2;
+  }
+  if (body.size() > cap) {
+    c->last_error = "trace dump exceeds caller buffer";
     return -((int64_t)body.size()) - 10;
   }
   std::memcpy(out, body.data(), body.size());

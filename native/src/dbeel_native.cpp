@@ -1698,7 +1698,42 @@ struct DataPlane {
   std::vector<uint8_t> valbuf;  // table_find value scratch
   std::vector<uint8_t> multibuf;  // multi-op response staging
   std::vector<uint8_t> pagebuf;   // CRC-verified page staging
+  // Tracing plane (PR 9): coarse per-verb-class stage attribution
+  // for natively-served ops, so the fast path is no longer invisible
+  // to latency accounting.  Armed by dbeel_dp_set_trace (off by
+  // default: zero clock reads on the unsampled serving path);
+  // snapshot layout kTraceClasses x kTraceSlots, mirrored by
+  // DataPlane._TRACE_CLASSES in server/dataplane.py.
+  int32_t trace_enabled = 0;
+  uint64_t trace_ops[4] = {0, 0, 0, 0};       // write/get/multi/shard
+  uint64_t trace_parse_ns[4] = {0, 0, 0, 0};  // frame decode
+  uint64_t trace_work_ns[4] = {0, 0, 0, 0};   // memtable+WAL / probe
+  uint64_t trace_reply_ns[4] = {0, 0, 0, 0};  // response build
 };
+
+// Trace verb classes (snapshot row order).
+enum { TR_WRITE = 0, TR_GET = 1, TR_MULTI = 2, TR_SHARD = 3 };
+constexpr int32_t kTraceClasses = 4;
+constexpr int32_t kTraceSlots = 4;  // ops, parse, work, reply
+
+static inline uint64_t dp_now_ns(const DataPlane* dp) {
+  if (!dp->trace_enabled) return 0;
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+// One served op's stage deltas: t0 entry, t1 after parse, t2 after
+// the storage work, t3 response ready.  No-op while disarmed (every
+// stamp is 0).
+static inline void dp_trace_op(DataPlane* dp, int cls, uint64_t t0,
+                               uint64_t t1, uint64_t t2, uint64_t t3) {
+  if (!dp->trace_enabled || t0 == 0) return;
+  dp->trace_ops[cls]++;
+  if (t1 >= t0) dp->trace_parse_ns[cls] += t1 - t0;
+  if (t2 >= t1 && t1) dp->trace_work_ns[cls] += t2 - t1;
+  if (t3 >= t2 && t2) dp->trace_reply_ns[cls] += t3 - t2;
+}
 
 // Collection lookup by wire name slice — heterogeneous string_view
 // probe, allocation-free for any name length.
@@ -2442,6 +2477,30 @@ void dbeel_dp_set_verify(void* h, int32_t on) {
   static_cast<DataPlane*>(h)->verify_crc = on;
 }
 
+// Tracing plane (PR 9): arm/disarm the coarse per-verb-class stage
+// counters.  Disarmed (the default) every stamp short-circuits to 0
+// — the unsampled serving path pays one predictable branch.
+void dbeel_dp_set_trace(void* h, int32_t on) {
+  static_cast<DataPlane*>(h)->trace_enabled = on;
+}
+
+// Snapshot the stage counters: kTraceClasses rows (write, get,
+// multi, shard — the order server/dataplane.py::_TRACE_CLASSES
+// mirrors) of kTraceSlots u64s (ops, parse_ns, work_ns, reply_ns).
+// Returns the number of slots written, 0 when cap is too small.
+int32_t dbeel_dp_trace_snapshot(void* h, uint64_t* out, int32_t cap) {
+  auto* dp = static_cast<DataPlane*>(h);
+  const int32_t need = kTraceClasses * kTraceSlots;
+  if (cap < need) return 0;
+  for (int i = 0; i < kTraceClasses; i++) {
+    out[i * kTraceSlots + 0] = dp->trace_ops[i];
+    out[i * kTraceSlots + 1] = dp->trace_parse_ns[i];
+    out[i * kTraceSlots + 2] = dp->trace_work_ns[i];
+    out[i * kTraceSlots + 3] = dp->trace_reply_ns[i];
+  }
+  return need;
+}
+
 // A/B measurement gate (BENCH native-floor): 0 punts client MULTI
 // frames to the Python fallback they replaced, so the native-vs-
 // interpreted multi throughput split can be measured same-session on
@@ -2654,6 +2713,13 @@ static bool dp_parse_client_frame(const uint8_t* frame, uint32_t len,
       if (!mp_skip_n(c, count, 1)) return false;
       f->ops_n = (uint32_t)(c.p - f->ops_raw);
       f->ops_count = count;
+    } else if (slice_eq(ks, kn, "trace")) {
+      // Tracing plane (PR 9): a client-stamped trace id forces a
+      // full per-stage span, which only the interpreted path can
+      // record (and whose peer fan-out must carry the id) — punt the
+      // whole frame to Python.  Sampling is rare by design; the
+      // unsampled flood keeps the fast path.
+      return false;
     } else {
       if (!mp_skip(c, 0)) return false;
     }
@@ -2715,8 +2781,11 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
                         uint32_t* out_len) try {
   auto* dp = static_cast<DataPlane*>(h);
   if (dp->own_mode == 0) return -1;
+  // Tracing plane: coarse stage stamps (0-cost while disarmed).
+  const uint64_t tr0 = dp_now_ns(dp);
   ClientFrame f;
   if (!dp_parse_client_frame(frame, len, &f)) return -1;
+  const uint64_t tr1 = dp_now_ns(dp);
   const uint8_t *type_s = f.type_s, *coll_s = f.coll_s;
   const uint32_t type_n = f.type_n, coll_n = f.coll_n;
   const uint8_t *key_raw = f.key_raw, *val_raw = f.val_raw;
@@ -2767,7 +2836,15 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   if (is_mset || is_mget) {
     if (!dp->multi_enabled) return -1;  // A/B: Python fallback
     if (f.ops_raw == nullptr) return -1;
-    return dp_handle_multi(dp, f, is_mset, out, out_cap, out_len);
+    const int64_t mrc =
+        dp_handle_multi(dp, f, is_mset, out, out_cap, out_len);
+    if (mrc >= 0) {
+      // Whole batch attributed as "work" (the multi handler
+      // interleaves applies/probes with its response build).
+      const uint64_t trm = dp_now_ns(dp);
+      dp_trace_op(dp, TR_MULTI, tr0, tr1, trm, trm);
+    }
+    return mrc;
   }
   if (key_raw == nullptr) return -1;
   // Key identity parity: the Python path stores keys RE-ENCODED by
@@ -2829,6 +2906,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       }
       if (found < 0) return -1;
     }
+    const uint64_t tr2 = dp_now_ns(dp);  // probe done
     if (found && vn != 0) {
       const uint32_t resp_len = vn + 1;  // value + type byte
       if ((uint64_t)out_cap < (uint64_t)4 + resp_len) {
@@ -2852,6 +2930,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       dp->fast_gets++;
     else
       dp->fast_table_gets++;
+    dp_trace_op(dp, TR_GET, tr0, tr1, tr2, dp_now_ns(dp));
     return get_flags;
   }
 
@@ -2891,6 +2970,12 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   // ticket (bit5).
   if (col->wal->sync_enabled.load(std::memory_order_relaxed))
     flags |= 0x20;
+  {
+    // Writes: memtable insert + WAL append are the "work" stage; the
+    // OK response is a caller-owned constant (reply ~ 0).
+    const uint64_t trw = dp_now_ns(dp);
+    dp_trace_op(dp, TR_WRITE, tr0, tr1, trw, trw);
+  }
   return flags;
 } catch (...) {
   return -1;
@@ -3464,6 +3549,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
                               uint32_t* out_len) try {
   auto* dp = static_cast<DataPlane*>(h);
   *out_len = 0;
+  const uint64_t tr0 = dp_now_ns(dp);  // tracing plane stage stamps
   MpCur c{frame, frame + len};
   if (!mp_need(c, 1)) return -1;
   const uint8_t ah = *c.p;
@@ -3493,14 +3579,29 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   // which answers the retryable Overloaded error and counts the
   // drop; an unexpired one serves natively as before.
   const bool has_deadline = nelem == want + 1u;
+  // Trace dialect (tracing plane, PR 9): deadline + trace id.  A
+  // sampled frame deliberately punts — Python serves it, measures
+  // its own stages, and piggybacks the replica span on the response;
+  // this arity decision is lint-pinned against _PEER_TRACE_INDEX
+  // (deadline index + 1) in server/shard.py.
+  const bool has_trace = nelem == want + 2u;
+  if (has_trace) return -1;
   if (nelem != want && !has_deadline) return -1;
 
   const uint8_t* coll_s;
   uint32_t coll_n;
   if (!mp_read_str(c, &coll_s, &coll_n)) return -1;
-  if (k_mset || k_mget)
-    return dp_shard_multi(dp, c, k_mset, has_deadline, coll_s,
-                          coll_n, out, out_cap, out_len);
+  const uint64_t tr1 = dp_now_ns(dp);  // header+verb+coll decoded
+  if (k_mset || k_mget) {
+    const int64_t mrc = dp_shard_multi(dp, c, k_mset, has_deadline,
+                                       coll_s, coll_n, out, out_cap,
+                                       out_len);
+    if (mrc >= 0) {
+      const uint64_t t = dp_now_ns(dp);
+      dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
+    }
+    return mrc;
+  }
   const uint8_t *key_s, *val_s = nullptr;
   uint32_t key_n, val_n = 0;
   if (!mp_read_bin(c, &key_s, &key_n)) return -1;
@@ -3574,6 +3675,10 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     std::memcpy(out + 4, hdr, o);
     *out_len = 4 + t32;
     dp->fast_replica_ops++;
+    {
+      const uint64_t t = dp_now_ns(dp);
+      dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
+    }
     return ((int64_t)col_idx << 8) | 4;
   }
 
@@ -3622,6 +3727,10 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     std::memcpy(out, &t32, 4);
     *out_len = 4 + t32;
     dp->fast_replica_ops++;
+    {
+      const uint64_t t = dp_now_ns(dp);
+      dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
+    }
     return ((int64_t)col_idx << 8) | 4;
   }
 
@@ -3705,6 +3814,10 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (col->wal->sync_enabled.load(std::memory_order_relaxed))
     flags |= 0x40;
   dp->fast_replica_ops++;
+  {
+    const uint64_t t = dp_now_ns(dp);
+    dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
+  }
   return flags;
 } catch (...) {
   return -1;

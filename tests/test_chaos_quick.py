@@ -32,7 +32,17 @@ REPORT_KEYS = {
     "acked_writes_lost",
     "divergent_keys",
     "resources",
+    "trace",
     "pass",
+}
+
+# Tracing plane (ISSUE 9): the report's slow-tail attribution block.
+TRACE_KEYS = {
+    "nodes_dumped",
+    "entries",
+    "sampled_entries",
+    "slow_entries",
+    "dominant_stages",
 }
 
 PARTITION_KEYS = {
@@ -135,6 +145,15 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert ov["stats_overload_block_py"] is True
     assert ov["stats_overload_block_native"] is True
     assert "overload" in ov["errors_by_class"] or ov["ok"] > 0
+    # Tracing plane (ISSUE 9): the trace block must be present with
+    # dumps from the (still alive) nodes; dominant_stages is a list
+    # of [stage, share] pairs (may be empty when nothing was slow).
+    tr = report["trace"]
+    missing = TRACE_KEYS - set(tr)
+    assert not missing, missing
+    assert tr["nodes_dumped"] >= 1
+    for stage, share in tr["dominant_stages"]:
+        assert isinstance(stage, str) and 0 <= share <= 1
     assert report["quick"] is True
     # The quick mode must still uphold the hard invariants (loss /
     # divergence), even though the error-rate gate is waived.

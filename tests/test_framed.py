@@ -64,7 +64,7 @@ class ScriptedProtocol(framed.FramedServerProtocol):
     def _registry(self):
         return self.registry
 
-    async def _serve_one(self, frame):
+    async def _serve_one(self, frame, arrived=0.0):
         await self.gate.wait()
         self.served.append(frame)
         return True

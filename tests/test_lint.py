@@ -165,6 +165,53 @@ def test_parity_flags_arity_drift(tmp_path):
     assert any("arity drift" in f.message for f in findings), findings
 
 
+def test_parity_flags_trace_index_drift(tmp_path):
+    # Tracing plane (PR 9): the trace id rides exactly one slot past
+    # the deadline on every data verb — a seeded Python-side table
+    # drift must fail the lint.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "        ShardRequest.GET: 5,\n"
+        "        ShardRequest.GET_DIGEST: 5,\n"
+        "        ShardRequest.MULTI_SET: 5,\n"
+        "        ShardRequest.MULTI_GET: 5,\n"
+        "    }\n\n"
+        "    @classmethod\n"
+        "    def peer_trace_id",
+        "        ShardRequest.GET: 6,\n"
+        "        ShardRequest.GET_DIGEST: 5,\n"
+        "        ShardRequest.MULTI_SET: 5,\n"
+        "        ShardRequest.MULTI_GET: 5,\n"
+        "    }\n\n"
+        "    @classmethod\n"
+        "    def peer_trace_id",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "trace-field arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_trace_dialect_drift_in_c(tmp_path):
+    # The C parser must recognize the want+2 trace dialect (and punt
+    # it); seeding it to want+3 is wire drift.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        "const bool has_trace = nelem == want + 2u;",
+        "const bool has_trace = nelem == want + 3u;",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "trace-field arity drift" in f.message
+        or "trace-dialect" in f.message
+        for f in findings
+    ), findings
+
+
 def test_parity_flags_status_byte_drift(tmp_path):
     root = _copy_fixture(tmp_path)
     _edit(

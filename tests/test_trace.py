@@ -1,0 +1,460 @@
+"""Tracing plane (ISSUE 9 tentpole): per-request spans, trace-id
+propagation onto peer frames with replica span piggyback, the bounded
+flight recorder, and the always-served ``trace_dump`` admin verb.
+
+The acceptance drill: a sampled RF=3 write's trace_dump entry
+decomposes the op into coordinator stages (which sum to the span
+total by construction — the marks partition it) plus one entry per
+replica with RTT and the replica's own piggybacked stage summary;
+trace_dump keeps answering at hard overload; slow/error ops are
+captured even at sample=0.
+"""
+
+import asyncio
+
+import pytest
+
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu.errors import DbeelError, Overloaded
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.server.trace import (
+    FlightRecorder,
+    TraceCtx,
+    split_peer_span,
+)
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+
+# ----------------------------------------------------------------------
+# Flight recorder unit behavior: ring bounds, eviction, capture rules
+# ----------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounds_and_eviction():
+    rec = FlightRecorder(sample_every=0, slow_op_us=1000, capacity=8)
+    for i in range(20):
+        rec.note_op("set", 5000, None)  # all slow -> all captured
+    dump = rec.dump()
+    assert len(dump["entries"]) == 8  # bounded
+    assert dump["recorded"] == 20
+    assert dump["evicted"] == 12
+    assert dump["slow_captured"] == 20
+    # Ring keeps the NEWEST entries (oldest evict first).
+    assert all(e["slow"] for e in dump["entries"])
+
+
+def test_flight_recorder_capture_rules_at_sample_zero():
+    rec = FlightRecorder(sample_every=0, slow_op_us=1000)
+    rec.note_op("get", 10, None)  # fast + clean: not captured
+    assert rec.recorded == 0
+    rec.note_op("get", 10, "overload")  # error: always captured
+    rec.note_op("get", 5000, None)  # slow: always captured
+    assert rec.recorded == 2
+    assert rec.error_captured == 1
+    assert rec.slow_captured == 1
+    assert not rec.sampling
+    assert rec.tick() is False  # sampling disabled: never samples
+
+
+def test_flight_recorder_sampling_tick():
+    rec = FlightRecorder(sample_every=4, slow_op_us=10**9)
+    picks = [rec.tick() for _ in range(12)]
+    assert picks.count(True) == 3
+    assert picks[3] and picks[7] and picks[11]
+
+
+def test_trace_ctx_stages_partition_total():
+    ctx = TraceCtx(7, op="set")
+    ctx.mark("queue")
+    ctx.mark("prep")
+    ctx.note("local_write_us", 123)
+    ctx.replica("n2", 456, [1, 2])
+    span = ctx.finish()
+    assert span["trace_id"] == 7
+    # Sequential marks partition [t0, last mark); "respond" etc. would
+    # close the rest — the recorded stages must never exceed total.
+    assert sum(us for _n, us in span["stages"]) <= span["total_us"]
+    assert span["detail"]["local_write_us"] == 123
+    assert span["replicas"][0] == {
+        "node": "n2", "rtt_us": 456, "stages": [1, 2],
+    }
+
+
+def test_split_peer_span():
+    # Piggybacked ack: stripped.
+    resp, span = split_peer_span(["response", "set", [10, 20]])
+    assert resp == ["response", "set"] and span == [10, 20]
+    # Old-dialect ack: untouched.
+    resp, span = split_peer_span(["response", "set"])
+    assert resp == ["response", "set"] and span is None
+    # GET with an entry + piggyback: entry survives, span strips.
+    resp, span = split_peer_span(
+        ["response", "get", [b"v", 5], [1, 2]]
+    )
+    assert resp == ["response", "get", [b"v", 5]] and span == [1, 2]
+    # GET without piggyback: the entry is NOT mistaken for a span.
+    resp, span = split_peer_span(["response", "get", [7, 9]])
+    assert resp == ["response", "get", [7, 9]] and span is None
+    # Errors never strip.
+    resp, span = split_peer_span(
+        ["response", "error", "Internal", "boom"]
+    )
+    assert span is None
+
+
+# ----------------------------------------------------------------------
+# Single-node: capture rules end to end + trace_dump via the client
+# ----------------------------------------------------------------------
+
+
+def test_slow_and_error_ops_always_captured(tmp_dir):
+    """sample=0 (tracing off): a shard still rings every op that
+    finishes slow (>--slow-op-us) or with a taxonomy error."""
+
+    async def main():
+        # slow_op_us=1: every op counts as slow.
+        cfg = make_config(tmp_dir, trace_sample=0, slow_op_us=1)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=5.0
+            )
+            col = await client.create_collection("tr", 1)
+            await col.set("k", {"v": 1})
+            dump = await client.trace_dump()
+            assert dump["sample_every"] == 0
+            assert dump["slow_op_us"] == 1
+            assert dump["slow_captured"] >= 1
+            assert any(
+                e["slow"] and not e["sampled"]
+                for e in dump["entries"]
+            )
+            # Error capture: an unsupported verb is a taxonomy-class
+            # failure ("other") — benign outcomes like KeyNotFound /
+            # CollectionNotFound deliberately stay out of the ring.
+            with pytest.raises(DbeelError):
+                await client._send_to(
+                    *node.db_address, {"type": "bogus_verb"}
+                )
+            dump = await client.trace_dump()
+            assert dump["error_captured"] >= 1
+            assert any(e["error"] for e in dump["entries"])
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_benign_miss_not_captured(tmp_dir):
+    """KeyNotFound is an application outcome, not an error — at
+    sample=0 with a sane slow bar the ring stays empty."""
+
+    async def main():
+        cfg = make_config(tmp_dir, trace_sample=0)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=5.0
+            )
+            col = await client.create_collection("tr", 1)
+            await col.set("k", 1)
+            assert await col.get("k") == 1
+            with pytest.raises(DbeelError):
+                await col.get("missing")
+            dump = await client.trace_dump()
+            assert dump["error_captured"] == 0
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_server_side_sampling_records_spans(tmp_dir):
+    """--trace-sample 1: every frame gets a full span with stage
+    marks, even ops the native plane would otherwise serve."""
+
+    async def main():
+        cfg = make_config(tmp_dir, trace_sample=1)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=5.0
+            )
+            col = await client.create_collection("tr", 1)
+            await col.set("k", {"v": 1})
+            assert await col.get("k") == {"v": 1}
+            dump = await client.trace_dump()
+            spans = [
+                e
+                for e in dump["entries"]
+                if e["sampled"] and e["op"] in ("set", "get")
+            ]
+            assert spans, dump["entries"]
+            for span in spans:
+                stages = dict(span["stages"])
+                assert "respond" in stages
+                assert ("local" in stages) or ("probe" in stages)
+                # Sequential marks partition the span: stage sum
+                # within 10% of (and never exceeding fuzz beyond)
+                # the total.
+                total = span["total_us"]
+                ssum = sum(us for _s, us in span["stages"])
+                assert abs(ssum - total) <= max(200, 0.1 * total)
+            assert dump["sampled"] >= 2
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_sampling_rate_not_doubled(tmp_dir):
+    """Regression (review r10): a frame the native fast path ticks
+    and then declines must NOT draw a second tick at dispatch — the
+    effective rate stays ~1/N, not 2/N."""
+
+    async def main():
+        cfg = make_config(tmp_dir, trace_sample=4)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=5.0
+            )
+            col = await client.create_collection("tr", 1)
+            for i in range(40):
+                await col.set(f"k{i}", i)
+            dump = await client.trace_dump()
+            # ~44 client frames at 1-in-4 => ~11 samples; the doubled
+            # rate would give ~22.
+            assert 7 <= dump["sampled"] <= 15, dump["sampled"]
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_trace_dump_answers_at_hard_overload(tmp_dir):
+    """trace_dump is admin-plane: it must answer while data ops shed
+    — and the sheds themselves land in the ring as error records."""
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=2.0
+            )
+            col = await client.create_collection("tr", 1)
+            await col.set("k", 1)
+            node.shards[0].governor.force_level(2)  # LEVEL_HARD
+            with pytest.raises(Overloaded):
+                await col.set("k2", 2)
+            dump = await client.trace_dump()  # still served
+            assert dump["error_captured"] >= 1
+            assert any(
+                e.get("error") == "overload" for e in dump["entries"]
+            )
+            node.shards[0].governor.force_level(None)
+            client.close()
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# RF=3: trace-id propagation + replica span piggyback
+# ----------------------------------------------------------------------
+
+
+async def _three_node_cluster(tmp_dir, rf=3, **kw):
+    kw.setdefault("failure_detection_interval_ms", 50)
+    cfg = make_config(tmp_dir, **kw)
+    nodes = [await ClusterNode(cfg).start()]
+    for i in (1, 2):
+        c = next_node_config(cfg, i, tmp_dir).replace(
+            seed_nodes=[nodes[0].seed_address], **kw
+        )
+        alive = nodes[0].flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        nodes.append(await ClusterNode(c).start())
+        await alive
+    client = await DbeelClient.from_seed_nodes(
+        [nodes[0].db_address], op_deadline_s=8.0
+    )
+    created = [
+        n.flow_event(0, FlowEvent.COLLECTION_CREATED) for n in nodes
+    ]
+    col = await client.create_collection("tr", rf)
+    await asyncio.wait_for(asyncio.gather(*created), 10)
+    return nodes, client, col
+
+
+async def _find_span(client, nodes, trace_id):
+    for node in nodes:
+        dump = await client.trace_dump(*node.db_address)
+        for e in dump["entries"]:
+            if e.get("trace_id") == trace_id:
+                return e
+    return None
+
+
+def test_rf3_write_trace_decomposes_end_to_end(tmp_dir):
+    """The acceptance criterion: a client-stamped RF=3 write's span
+    carries coordinator stages that sum to ~the span total, plus one
+    replica entry per peer with RTT and the replica's piggybacked
+    stage summary, all under the client's trace id."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(tmp_dir)
+        try:
+            await col.set("traced-key", {"v": "x" * 64},
+                          trace_id=777001)
+            span = await _find_span(client, nodes, 777001)
+            assert span is not None, "span not found on any node"
+            assert span["op"] == "set"
+            assert span["client_stamped"] is True
+            stages = dict(span["stages"])
+            assert "quorum" in stages
+            total = span["total_us"]
+            ssum = sum(us for _s, us in span["stages"])
+            assert abs(ssum - total) <= max(200, 0.1 * total)
+            # The overlapped local write is attributed as detail.
+            assert span["detail"].get("local_write_us", 0) >= 0
+            # RF=3 => 2 peer replicas, each with an RTT and the
+            # piggybacked [queue_us, serve_us] summary.
+            assert len(span["replicas"]) == 2
+            names = {r["node"] for r in span["replicas"]}
+            assert len(names) == 2
+            for r in span["replicas"]:
+                assert r["rtt_us"] >= 0
+                assert isinstance(r["stages"], list)
+                assert len(r["stages"]) == 2
+                assert all(
+                    isinstance(x, int) and x >= 0
+                    for x in r["stages"]
+                )
+            client.close()
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_rf3_multi_get_trace_propagates(tmp_dir):
+    """MULTI_GET batch: one span for the batch frame, replica spans
+    piggybacked on the MULTI_GET peer responses, ids matching."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(tmp_dir)
+        try:
+            keys = [f"mk{i}" for i in range(6)]
+            await col.multi_set({k: {"i": k} for k in keys})
+            got = await col.multi_get(keys, trace_id=777002)
+            assert got == [{"i": k} for k in keys]
+            # The client chunks per owning node: every chunk records
+            # a span under the same stamped id — find at least one
+            # with replica evidence.
+            spans = []
+            for node in nodes:
+                dump = await client.trace_dump(*node.db_address)
+                spans += [
+                    e
+                    for e in dump["entries"]
+                    if e.get("trace_id") == 777002
+                ]
+            assert spans, "no multi_get span found"
+            assert all(s["op"] == "multi_get" for s in spans)
+            with_reps = [s for s in spans if s["replicas"]]
+            assert with_reps, "no replica spans piggybacked"
+            for r in with_reps[0]["replicas"]:
+                assert len(r["stages"]) == 2
+            client.close()
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+def test_rf3_traced_get_full_round(tmp_dir):
+    """A traced quorum GET: the digest round runs with the trace id
+    on the wire (replicas answer unpacked digests + piggyback), and
+    the span still resolves the value correctly."""
+
+    async def main():
+        nodes, client, col = await _three_node_cluster(tmp_dir)
+        try:
+            await col.set("g", {"v": 42})
+            assert await col.get("g", trace_id=777003) == {"v": 42}
+            span = await _find_span(client, nodes, 777003)
+            assert span is not None
+            assert span["op"] == "get"
+            stages = dict(span["stages"])
+            assert ("digest" in stages) or ("quorum" in stages)
+            assert span["replicas"], "no replica RTTs recorded"
+            client.close()
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Both clients can fetch the dump (satellite: BOTH clients)
+# ----------------------------------------------------------------------
+
+
+def test_trace_dump_via_native_client(tmp_dir):
+    from dbeel_tpu.client import native_client
+
+    if not native_client.available():
+        pytest.skip("native client library not built")
+
+    async def main():
+        cfg = make_config(tmp_dir, trace_sample=0)
+        node = await ClusterNode(cfg).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=5.0
+            )
+            await client.create_collection("tr", 1)
+            client.close()
+        finally:
+            pass
+        ip, port = node.db_address
+
+        def native_part():
+            with native_client.NativeDbeelClient(ip, port) as nc:
+                # C walk stamps trace ids: the op takes the
+                # interpreted path and records a full span.
+                assert nc.set_trace(888001)
+                nc.set("tr", "ck", {"v": 9}, rf=1)
+                dump = nc.trace_dump()
+                assert dump["capacity"] > 0
+                assert "entries" in dump
+                ids = {
+                    e.get("trace_id") for e in dump["entries"]
+                }
+                assert 888001 in ids
+                span = next(
+                    e
+                    for e in dump["entries"]
+                    if e.get("trace_id") == 888001
+                )
+                assert span["client_stamped"] is True
+                assert span["op"] == "set"
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, native_part
+            )
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
