@@ -28,11 +28,22 @@ Known precision limit: a counter whose NAME collides with an
 existing schema key (e.g. a new ``self.count``) passes vacuously —
 name-level matching cannot tell two same-named counters apart.
 Deliberately-internal state carries ``# lint: allow(stats-schema)``.
+
+Prometheus name-flattening (telemetry plane, PR 11): the /metrics
+endpoint exports every schema leaf through
+``telemetry.prom_name(path)``.  The map must stay INJECTIVE over the
+schema-key namespace — two distinct keys sanitizing to one metric
+token ("loop_lag.ms" vs "loop_lag_ms") would silently merge two
+series — and every sanitized name must be a valid Prometheus token.
+The checker imports telemetry.py standalone (stdlib-only module by
+contract) and executes the REAL function over every harvested schema
+key, so drift in either the keys or the sanitizer exits nonzero.
 """
 
 from __future__ import annotations
 
 import ast
+import importlib.util
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -57,6 +68,15 @@ _STATS_FUNCS = {
     "queued_by_node",
     "queued_total",
     "group_commit_stats",
+    # Telemetry plane (PR 11): the telemetry/health/cluster_stats
+    # blocks and the dump/digest payload builders.
+    "stats_block",
+    "health_block",
+    "cluster_stats",
+    "shard_digest",
+    "merge_digests",
+    "rates",
+    "dump",
 }
 
 
@@ -248,4 +268,76 @@ def check(repo: Repo) -> List[Finding]:
                 "C client lost its dbeel_cli_get_stats entry point",
             )
         )
+
+    findings.extend(_prom_flattening(repo, exports.keys))
+    return findings
+
+
+def _prom_flattening(
+    repo: Repo, keys: Set[str]
+) -> List[Finding]:
+    """Prometheus name-flattening drift (telemetry plane): run every
+    harvested schema key through the REAL telemetry.prom_name and
+    fail on invalid tokens or two keys merging into one metric name.
+    Skipped when the tree has no telemetry module (synthetic fixture
+    trees)."""
+    path = repo.path("dbeel_tpu", "server", "telemetry.py")
+    if not os.path.exists(path):
+        return []
+    findings: List[Finding] = []
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_lint_telemetry", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    except Exception as e:
+        return [
+            Finding(
+                RULE,
+                repo.rel(path),
+                1,
+                f"telemetry.py failed standalone import ({e}) — it "
+                "must stay stdlib-only at module scope so this "
+                "checker can execute the Prometheus flattening map",
+            )
+        ]
+    prom_name = getattr(mod, "prom_name", None)
+    prom_ok = getattr(mod, "prom_ok", None)
+    if not callable(prom_name) or not callable(prom_ok):
+        return [
+            Finding(
+                RULE,
+                repo.rel(path),
+                1,
+                "telemetry.py lost prom_name()/prom_ok() — the "
+                "/metrics exposition has no lint-checked naming map",
+            )
+        ]
+    by_name: Dict[str, List[str]] = {}
+    for key in sorted(keys):
+        name = prom_name(key)
+        if not prom_ok(name):
+            findings.append(
+                Finding(
+                    RULE,
+                    repo.rel(path),
+                    1,
+                    f"schema key {key!r} flattens to invalid "
+                    f"Prometheus token {name!r}",
+                )
+            )
+        by_name.setdefault(name, []).append(key)
+    for name, ks in sorted(by_name.items()):
+        if len(ks) > 1:
+            findings.append(
+                Finding(
+                    RULE,
+                    repo.rel(path),
+                    1,
+                    f"Prometheus name collision: schema keys {ks} "
+                    f"all flatten to {name!r} — every exported "
+                    "counter must map to exactly one metric name",
+                )
+            )
     return findings

@@ -676,6 +676,58 @@ async def main_attribute(args):
     client.close()
 
 
+async def main_telemetry_overhead(args):
+    """--telemetry-overhead (telemetry plane, ISSUE 11): the
+    zero-cost-when-off gate.  Runs the standard lockstep set/get
+    phases and prints throughput plus the server's telemetry state
+    (enabled/interval/samples over the run) read from get_stats.  Run
+    it once against a --telemetry-interval 0 server and once against
+    a telemetry-on server in the SAME session (BENCH convention: this
+    host's CPU budget swings ~10x between rounds, so only same-
+    session pairs mean anything) — the off-run throughput is the
+    baseline the on-run must match within noise."""
+    client = await DbeelClient.from_seed_nodes([(args.host, args.port)])
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    try:
+        await client.create_collection(
+            args.collection, args.replication_factor or 1
+        )
+    except CollectionAlreadyExists:
+        pass
+    before = await client.get_stats()
+    t = before["telemetry"]
+    print(
+        f"server telemetry: enabled={t['enabled']} "
+        f"interval_ms={t['interval_ms']} "
+        f"ring={t['ring']['len']}/{t['ring']['capacity']}"
+    )
+    keys = [f"key-{i:08}" for i in range(args.clients * args.requests)]
+    rng = random.Random(args.seed)
+    rng.shuffle(keys)
+    value = {"blob": "x" * args.value_size}
+    for op in ("set", "get"):
+        total, lat = await run_phase(
+            client, args.collection, op, keys, args.clients, value
+        )
+        print(
+            f"{op}: total {total:.3f}s "
+            f"({len(keys)/total:,.0f} ops/s)  {percentiles(lat)}"
+        )
+        rng.shuffle(keys)
+    after = await client.get_stats()
+    taken = (
+        after["telemetry"]["ring"]["samples_taken"]
+        - t["ring"]["samples_taken"]
+    )
+    print(
+        f"telemetry samples during the run: {taken} "
+        f"(health findings now: "
+        f"{[f['kind'] for f in after['health']['findings']]})"
+    )
+    client.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -735,6 +787,16 @@ def main():
         "baseline)",
     )
     ap.add_argument(
+        "--telemetry-overhead",
+        action="store_true",
+        help="telemetry-plane A/B phase: lockstep set/get throughput "
+        "plus the server's telemetry state — run once against a "
+        "--telemetry-interval 0 server and once against a "
+        "telemetry-on server in the same session; the pair bounds "
+        "the plane's serving-path cost (acceptance: no measurable "
+        "regression)",
+    )
+    ap.add_argument(
         "--overload-knee",
         action="store_true",
         help="offered-load sweep (open loop, multiples of the "
@@ -760,6 +822,8 @@ def main():
         ap.error("--pipeline and --batch are separate phases")
     if args.overload_knee_worker:
         asyncio.run(main_knee_worker(args))
+    elif args.telemetry_overhead:
+        asyncio.run(main_telemetry_overhead(args))
     elif args.attribute:
         asyncio.run(main_attribute(args))
     elif args.native_floor:

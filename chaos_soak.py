@@ -64,6 +64,11 @@ COLLECTION = "soak"
 # so the final report can attribute WHERE slow-tail time went (and
 # the per-phase trace_dump files land as CI artifacts).
 TRACE_SAMPLE = 256
+# Telemetry plane (ISSUE 11): continuous time-series sampling on
+# every soak node, so each phase's report block carries the health
+# watchdog's verdict and the cluster_stats rollup (and the per-phase
+# telemetry ring dumps land as CI artifacts beside the trace dumps).
+TELEMETRY_INTERVAL_MS = 2000
 
 
 def log(*a):
@@ -112,6 +117,7 @@ class Node:
             "--failure-detection-interval", "500",
             "--anti-entropy-interval", "5000",
             "--trace-sample", str(TRACE_SAMPLE),
+            "--telemetry-interval", str(TELEMETRY_INTERVAL_MS),
         ]
         if seeds:
             argv += ["--seed-nodes", *seeds]
@@ -336,6 +342,62 @@ async def collect_traces(nodes, label, dump_dir=None):
             with open(path, "w") as f:
                 json.dump(dump, f, indent=1, default=repr)
     return dumps
+
+
+async def collect_health(nodes, label, dump_dir=None):
+    """Telemetry plane (ISSUE 11): one phase's health evidence — the
+    gossip-aggregated cluster_stats rollup from the first alive node
+    plus each alive node's own watchdog findings; with ``dump_dir``,
+    each node's full telemetry ring persists as
+    telemetry_<label>_<node>.json beside the trace dumps (nightly CI
+    uploads both)."""
+    block = {
+        "cluster_nodes_seen": 0,
+        "nodes_reporting": 0,
+        "cluster_missing": [],
+        "findings_by_kind": {},
+        "per_node": {},
+    }
+    dumps = {}
+    rollup_done = False
+    for n in nodes:
+        if not n.alive():
+            continue
+        cl = None
+        try:
+            cl = await DbeelClient.from_seed_nodes(
+                [("127.0.0.1", n.db_port)], op_deadline_s=5.0
+            )
+            if not rollup_done:
+                cs = await cl.cluster_stats()
+                block["cluster_nodes_seen"] = len(cs["nodes"])
+                block["cluster_missing"] = cs["missing"]
+                for name, digest in cs["nodes"].items():
+                    for kind in digest.get("findings") or ():
+                        block["findings_by_kind"][kind] = (
+                            block["findings_by_kind"].get(kind, 0) + 1
+                        )
+                rollup_done = True
+            health = (await cl.get_stats())["health"]
+            block["nodes_reporting"] += 1
+            block["per_node"][n.name] = sorted(
+                {f["kind"] for f in health["findings"]}
+            )
+            dumps[n.name] = await cl.telemetry_dump()
+        except Exception as e:
+            log(f"health from {n.name} failed: {e!r}")
+        finally:
+            if cl is not None:
+                cl.close()
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        for name, dump in dumps.items():
+            path = os.path.join(
+                dump_dir, f"telemetry_{label}_{name}.json"
+            )
+            with open(path, "w") as f:
+                json.dump(dump, f, indent=1, default=repr)
+    return block
 
 
 def trace_report_block(dumps):
@@ -1175,6 +1237,13 @@ async def main():
         "restart_failures": stats["restart_failures"],
     }
     ok = True
+    # Telemetry plane (ISSUE 11): per-phase watchdog findings +
+    # cluster_stats rollup at each phase end (and telemetry ring
+    # dumps as artifacts beside the trace dumps).
+    health_phases = {}
+    health_phases["churn"] = await collect_health(
+        nodes, "churn", args.trace_dump_dir
+    )
     if args.disk_faults:
         ok = await disk_fault_phase(nodes, acks, seeds, report)
         # Let quarantine repair + anti-entropy re-converge the
@@ -1182,16 +1251,25 @@ async def main():
         await asyncio.sleep(min(args.quiet_window, 15.0))
         await collect_traces(nodes, "disk_faults",
                              args.trace_dump_dir)
+        health_phases["disk_faults"] = await collect_health(
+            nodes, "disk_faults", args.trace_dump_dir
+        )
     if args.partition:
         ok = (
             await partition_phase(nodes, seeds, report, args.quick)
         ) and ok
         await collect_traces(nodes, "partition", args.trace_dump_dir)
+        health_phases["partition"] = await collect_health(
+            nodes, "partition", args.trace_dump_dir
+        )
     if args.overload:
         ok = (
             await overload_phase(nodes, report, args.quick)
         ) and ok
         await collect_traces(nodes, "overload", args.trace_dump_dir)
+        health_phases["overload"] = await collect_health(
+            nodes, "overload", args.trace_dump_dir
+        )
         # Let the shed/backlogged writes' hints drain and windows
         # recover before the byte-equality scan.
         await asyncio.sleep(min(args.quiet_window, 15.0))
@@ -1201,6 +1279,12 @@ async def main():
         nodes, "final", args.trace_dump_dir
     )
     report["trace"] = trace_report_block(final_dumps)
+    report["health"] = {
+        "phases": health_phases,
+        "final": await collect_health(
+            nodes, "final", args.trace_dump_dir
+        ),
+    }
     if not args.quick:
         # Quick mode waives the rate gate: one unlucky op in a tiny
         # sample would dominate the percentage.

@@ -789,6 +789,36 @@ class DbeelClient:
         raw = await self._send_to(host, port, {"type": "trace_dump"})
         return msgpack.unpackb(raw, raw=False)
 
+    async def cluster_stats(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> dict:
+        """The gossip-aggregated cluster health view from one node
+        (the first seed by default): per-node digests (level, ops/s,
+        error/shed rates, degraded flag, hint backlog, watchdog
+        finding kinds) under ``nodes``, plus ``missing`` for ring
+        members not yet heard from.  Always served, even at hard
+        overload — ask ANY node, see the whole cluster."""
+        if host is None or port is None:
+            host, port = self._seeds[0]
+        raw = await self._send_to(
+            host, port, {"type": "cluster_stats"}
+        )
+        return msgpack.unpackb(raw, raw=False)
+
+    async def telemetry_dump(
+        self, host: Optional[str] = None, port: Optional[int] = None
+    ) -> dict:
+        """One shard's full telemetry time-series ring (flattened
+        get_stats samples stamped with seq/ts_ms/uptime_s), derived
+        rates, and the health watchdog's verdict.  Always served,
+        like get_stats/trace_dump."""
+        if host is None or port is None:
+            host, port = self._seeds[0]
+        raw = await self._send_to(
+            host, port, {"type": "telemetry_dump"}
+        )
+        return msgpack.unpackb(raw, raw=False)
+
     async def rearm(
         self, host: Optional[str] = None, port: Optional[int] = None
     ) -> None:
@@ -971,6 +1001,12 @@ class DbeelClientSync:
 
     def get_stats(self, host=None, port=None):
         return self._run(self._client.get_stats(host, port))
+
+    def cluster_stats(self, host=None, port=None):
+        return self._run(self._client.cluster_stats(host, port))
+
+    def telemetry_dump(self, host=None, port=None):
+        return self._run(self._client.telemetry_dump(host, port))
 
     def rearm(self, host=None, port=None):
         self._run(self._client.rearm(host, port))

@@ -133,6 +133,15 @@ def _bind(lib) -> None:
             u8p,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_cli_cluster_stats"):  # telemetry (PR 11)
+        lib.dbeel_cli_cluster_stats.restype = ctypes.c_int64
+        lib.dbeel_cli_cluster_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+            u8p,
+            ctypes.c_uint64,
+        ]
     if hasattr(lib, "dbeel_cli_trace_dump"):  # tracing plane (PR 9)
         lib.dbeel_cli_trace_dump.restype = ctypes.c_int64
         lib.dbeel_cli_trace_dump.argtypes = [
@@ -257,6 +266,29 @@ class NativeDbeelClient:
         for _ in range(2):
             buf = (ctypes.c_uint8 * cap)()
             n = self._lib.dbeel_cli_get_stats(
+                self._h, ip.encode(), port, buf, cap
+            )
+            if n <= -10:
+                cap = -int(n) - 10
+                continue
+            break
+        if n < 0:
+            raise DbeelError(self._err())
+        return msgpack.unpackb(bytes(buf[: int(n)]), raw=False)
+
+    def cluster_stats(self, ip: str = "", port: int = 0) -> dict:
+        """One node's gossip-aggregated cluster health view (the
+        bootstrap seed by default), unpacked — same schema as the
+        Python client's cluster_stats().  Raises on a stale .so
+        without the ABI."""
+        if not hasattr(self._lib, "dbeel_cli_cluster_stats"):
+            raise DbeelError(
+                "native library predates dbeel_cli_cluster_stats"
+            )
+        cap = 1 << 20
+        for _ in range(2):
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.dbeel_cli_cluster_stats(
                 self._h, ip.encode(), port, buf, cap
             )
             if n <= -10:

@@ -102,10 +102,19 @@ class ShardRequest:
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
     REARM = "rearm"
+    TELEMETRY_DIGEST = "telemetry_digest"
 
     @staticmethod
     def ping() -> list:
         return ["request", ShardRequest.PING]
+
+    @staticmethod
+    def telemetry_digest() -> list:
+        """Intra-node telemetry aggregation (PR 11): the node-managing
+        shard collects each sibling shard's compact health digest
+        every telemetry interval and folds them into the per-node
+        digest it gossips."""
+        return ["request", ShardRequest.TELEMETRY_DIGEST]
 
     @staticmethod
     def rearm() -> list:
@@ -311,11 +320,17 @@ class ShardResponse:
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
     REARM = "rearm"
+    TELEMETRY_DIGEST = "telemetry_digest"
     ERROR = "error"
 
     @staticmethod
     def pong() -> list:
         return ["response", ShardResponse.PONG]
+
+    @staticmethod
+    def telemetry_digest(digest: dict) -> list:
+        # One shard's compact health digest (telemetry plane).
+        return ["response", ShardResponse.TELEMETRY_DIGEST, digest]
 
     @staticmethod
     def get_metadata(nodes: List[NodeMetadata]) -> list:
@@ -415,10 +430,21 @@ class GossipEvent:
     DEAD = "dead"
     CREATE_COLLECTION = "create_collection"
     DROP_COLLECTION = "drop_collection"
+    HEALTH = "health"
 
     @staticmethod
     def alive(node: NodeMetadata) -> list:
         return [GossipEvent.ALIVE, node.to_wire()]
+
+    @staticmethod
+    def health(node_name: str, seq: int, digest: dict) -> list:
+        """Periodic per-node health digest (telemetry plane, PR 11):
+        re-announced every telemetry interval by the node-managing
+        shard and propagated epidemically like every other event, so
+        any node's ``cluster_stats`` view stays fresh.  ``seq`` salts
+        the gossip dedup key — each interval's digest is a FRESH
+        epidemic, not a re-seen copy of the last one."""
+        return [GossipEvent.HEALTH, node_name, int(seq), digest]
 
     @staticmethod
     def dead(node_name: str) -> list:
@@ -433,13 +459,26 @@ class GossipEvent:
         return [GossipEvent.DROP_COLLECTION, name]
 
 
-def serialize_gossip_message(source: str, event: list) -> bytes:
-    return msgpack.packb([source, event], use_bin_type=True)
+def serialize_gossip_message(
+    source: str, event: list, digest: Optional[dict] = None
+) -> bytes:
+    """Gossip datagram: [source, event] — plus, when the sending node
+    has one, its compact health digest piggybacked as a third element
+    (telemetry plane, PR 11).  Old receivers index [0]/[1] and ignore
+    the tail; old senders simply lack it."""
+    msg: list = [source, event]
+    if digest is not None:
+        msg.append(digest)
+    return msgpack.packb(msg, use_bin_type=True)
 
 
-def deserialize_gossip_message(buf: bytes) -> Tuple[str, list]:
+def deserialize_gossip_message(
+    buf: bytes,
+) -> Tuple[str, list, Optional[dict]]:
+    """(source, event, piggybacked health digest | None)."""
     msg = msgpack.unpackb(buf, raw=False)
-    return msg[0], msg[1]
+    digest = msg[2] if len(msg) > 2 and isinstance(msg[2], dict) else None
+    return msg[0], msg[1], digest
 
 
 def pack_message(message: list) -> bytes:

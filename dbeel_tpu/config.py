@@ -107,6 +107,22 @@ class Config:
     # Flight-recorder ring capacity per shard (oldest entries evict).
     trace_ring: int = 512
 
+    # ---- Continuous telemetry plane (PR 11) --------------------------
+    # Per-shard time-series sampling interval in ms: every interval
+    # the governor-heartbeat hook walks get_stats into the telemetry
+    # ring (rates, health watchdog, gossip health digests).  0
+    # disables the entire plane — the heartbeat hook is never
+    # installed and the serving path executes zero telemetry code.
+    telemetry_interval_ms: int = 0
+    # Telemetry ring capacity per shard (flattened samples; oldest
+    # evict).  360 samples at the 5s production interval = 30 min of
+    # history.
+    telemetry_ring: int = 360
+    # Prometheus text-exposition listener base port (per-shard:
+    # metrics_port + shard_id, the db/remote/gossip port arithmetic).
+    # 0 disables the endpoint.
+    metrics_port: int = 0
+
     # Tombstone GC grace (the delete-resurrection hazard): compaction
     # refuses to drop a tombstone younger than this, so a replica that
     # missed the delete cannot resurrect the old value through hint
@@ -338,6 +354,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="flight-recorder ring capacity per shard",
     )
     p.add_argument(
+        "--telemetry-interval",
+        type=int,
+        dest="telemetry_interval_ms",
+        default=d.telemetry_interval_ms,
+        help="telemetry time-series sampling interval in ms (0 "
+        "disables the plane entirely — zero serving-path cost)",
+    )
+    p.add_argument(
+        "--telemetry-ring",
+        type=int,
+        default=d.telemetry_ring,
+        help="telemetry ring capacity per shard (samples; oldest "
+        "evict)",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=d.metrics_port,
+        help="Prometheus /metrics base port (per-shard listener at "
+        "metrics_port + shard_id; 0 disables)",
+    )
+    p.add_argument(
         "--gc-grace",
         type=int,
         dest="gc_grace_ms",
@@ -429,6 +467,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         trace_sample=ns.trace_sample,
         slow_op_us=ns.slow_op_us,
         trace_ring=ns.trace_ring,
+        telemetry_interval_ms=ns.telemetry_interval_ms,
+        telemetry_ring=ns.telemetry_ring,
+        metrics_port=ns.metrics_port,
         gc_grace_ms=ns.gc_grace_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,

@@ -219,6 +219,23 @@ async def handle_request(
             my_shard.trace_recorder.dump(), use_bin_type=True
         )
 
+    if rtype == "cluster_stats":
+        # Telemetry plane (PR 11): the gossip-aggregated per-node
+        # health view — ask ANY node, see the whole cluster.  Always
+        # served (an overloaded or degraded cluster is exactly when
+        # the operator needs the rollup).
+        return msgpack.packb(
+            my_shard.cluster_stats(), use_bin_type=True
+        )
+
+    if rtype == "telemetry_dump":
+        # Telemetry plane (PR 11): this shard's full time-series ring
+        # + derived rates + health verdict.  Always served, like
+        # get_stats/trace_dump.
+        return msgpack.packb(
+            my_shard.telemetry.dump(), use_bin_type=True
+        )
+
     if rtype == "rearm":
         # Admin: exit sticky degraded read-only mode after disk
         # replacement, no restart — re-runs the free-space/WAL-append

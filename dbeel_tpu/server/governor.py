@@ -119,6 +119,7 @@ class LoadGovernor:
         "_hb_task",
         "_dead_ewma",
         "_pushed_level",
+        "telemetry_hook",
         "dead_completions",
         # counters (get_stats.overload)
         "shed_ops",
@@ -147,6 +148,10 @@ class LoadGovernor:
         self._hb_task = None
         self._dead_ewma = 0.0
         self._pushed_level: Optional[int] = None
+        # Telemetry plane (PR 11): the continuous sampler rides THIS
+        # heartbeat — one callable check per beat when armed, nothing
+        # at all when --telemetry-interval is 0 (the hook stays None).
+        self.telemetry_hook = None
         self.dead_completions = 0
         self.shed_ops = 0
         self.shed_by_op: dict = {}
@@ -209,6 +214,13 @@ class LoadGovernor:
             self._lag_ewma = (
                 lag if e == 0.0 else e + LAG_EWMA_ALPHA * (lag - e)
             )
+            hook = self.telemetry_hook
+            if hook is not None:
+                # Telemetry sampling point: a monotonic compare per
+                # beat; the due samples (one get_stats walk per
+                # --telemetry-interval) happen here, never on the
+                # serving path.  The hook swallows its own errors.
+                hook()
 
     # -- sampling ------------------------------------------------------
 

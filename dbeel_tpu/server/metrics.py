@@ -99,6 +99,9 @@ class ShardMetrics:
             "get_collection",
             "get_cluster_metadata",
             "get_stats",
+            "cluster_stats",
+            "telemetry_dump",
+            "trace_dump",
             "invalid",
         }
     )

@@ -217,6 +217,16 @@ async def run_shard(
         and my_shard.config.hint_drain_interval_ms > 0
     ):
         coros.append(tasks.run_hint_drain(my_shard))
+    # Continuous telemetry plane (PR 11): sampling rides the governor
+    # heartbeat (start() installs the hook and ensures the beat);
+    # the Prometheus endpoint is its own listener task.  Both fully
+    # absent when their knobs are 0.
+    if my_shard.config.telemetry_interval_ms > 0:
+        my_shard.telemetry.start(my_shard)
+    if my_shard.config.metrics_port > 0:
+        from .telemetry import run_metrics_server
+
+        coros.append(run_metrics_server(my_shard))
     if is_node_managing:
         coros.append(tasks.run_gossip_server(my_shard))
         coros.append(tasks.run_failure_detector(my_shard))

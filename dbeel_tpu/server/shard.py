@@ -30,6 +30,7 @@ from ..errors import (
     CollectionAlreadyExists,
     CollectionNotFound,
     ConnectionError_,
+    CorruptedFile,
     DbeelError,
     Overloaded,
     Timeout,
@@ -220,6 +221,27 @@ class MyShard:
 
         self.governor = LoadGovernor(self, config)
         self.scheduler.overload_gate = self.governor.bg_gate
+        # Continuous telemetry plane (PR 11): per-shard time-series
+        # ring + health watchdog.  Constructed unconditionally so the
+        # get_stats schema never depends on the knob; sampling only
+        # arms (riding the governor heartbeat) when
+        # --telemetry-interval > 0.
+        from .telemetry import ShardTelemetry
+
+        self.telemetry = ShardTelemetry(config)
+        # Cluster health view: node name -> freshest per-node health
+        # digest (gossip piggybacks + periodic `health` events).
+        # Served by the always-on cluster_stats admin verb.
+        self.cluster_view: Dict[str, dict] = {}
+        # This node's own folded digest (set by the node-managing
+        # shard's announce; piggybacked on outgoing gossip frames).
+        self.last_node_digest: Optional[dict] = None
+        # Snapshot stamps (offline rate derivation from dump pairs):
+        # wall/monotonic start anchors + a per-shard monotonic
+        # get_stats sequence.
+        self.started_at_ms = int(time.time() * 1000)
+        self._started_mono = time.monotonic()
+        self.stats_seq = 0
         # Anti-entropy transfer counters (observability + the
         # sub-range proportionality test: one diverged key must move
         # ~range/buckets entries, not the whole range).
@@ -794,8 +816,19 @@ class MyShard:
             round(sum(windows) / len(windows), 2) if windows else None
         )
 
+        self.stats_seq += 1
         return {
             "shard": self.shard_name,
+            # Snapshot stamps (telemetry plane): wall time, process
+            # uptime and a monotonic per-shard sequence, so offline
+            # tooling can derive rates from any dump PAIR without
+            # guessing wall-clock or ordering.
+            "ts_ms": int(time.time() * 1000),
+            "uptime_s": round(
+                time.monotonic() - self._started_mono, 1
+            ),
+            "stats_seq": self.stats_seq,
+            "started_at_ms": self.started_at_ms,
             "durability": durability,
             "overload": overload,
             "nodes_known": len(self.nodes),
@@ -840,6 +873,7 @@ class MyShard:
             "trace": {
                 "sample_every": self.trace_recorder.sample_every,
                 "slow_op_us": self.trace_recorder.slow_op_us,
+                "capacity": self.trace_recorder.capacity,
                 **self.trace_recorder.stats(),
                 "native": (
                     self.dataplane.trace_stats()
@@ -862,7 +896,84 @@ class MyShard:
                 if self.quorum_fanout is not None
                 else None
             ),
+            # Continuous telemetry plane (PR 11): ring/rate summary +
+            # the watchdog's machine-readable health verdict.  Ring
+            # CONTENTS come back via the telemetry_dump verb; the
+            # cluster-wide rollup via cluster_stats.
+            "telemetry": self.telemetry.stats_block(),
+            "health": self.telemetry.health_block(),
             "collections": collections,
+        }
+
+    def absorb_health_digest(self, digest) -> None:
+        """Fold one per-node health digest (gossip piggyback, the
+        periodic ``health`` event, or our own announce) into the
+        cluster view — freshest (ts_ms, seq) wins, so re-propagated
+        epidemic copies can never roll a node's entry backward."""
+        if not isinstance(digest, dict):
+            return
+        node = digest.get("node")
+        if not isinstance(node, str) or not node:
+            return
+        cur = self.cluster_view.get(node)
+        if cur is not None:
+            if cur.get("boot") and cur.get("boot") == digest.get(
+                "boot"
+            ):
+                # Same incarnation: order by announce seq — a wall
+                # clock stepping backwards on the sender must not pin
+                # its stale digest cluster-wide until time catches up.
+                if (cur.get("seq") or 0) >= (digest.get("seq") or 0):
+                    return
+            elif (cur.get("ts_ms") or 0, cur.get("seq") or 0) >= (
+                (digest.get("ts_ms") or 0, digest.get("seq") or 0)
+            ):
+                # Cross-boot (restart): wall clock is the only shared
+                # ordering left.
+                return
+        self.cluster_view[node] = digest
+        if node == self.config.name:
+            # Our own node's folded digest arriving via the local
+            # gossip broadcast: sibling shards adopt it, so THEIR
+            # cluster_stats (and their outgoing gossip piggybacks)
+            # report the whole node, not just themselves.
+            self.last_node_digest = digest
+
+    def cluster_stats(self) -> dict:
+        """The always-served ``cluster_stats`` admin verb: this
+        node's view of every node's health digest (gossip-aggregated)
+        — one call to ANY node answers for the whole cluster.  Nodes
+        known to the ring but not yet heard from are listed under
+        ``missing`` (telemetry off, old version, or just booted)."""
+        view = dict(self.cluster_view)
+        own = self.last_node_digest
+        if own is not None:
+            if (self.config.name not in view) or (
+                (own.get("ts_ms") or 0)
+                > (view[self.config.name].get("ts_ms") or 0)
+            ):
+                view[self.config.name] = own
+        elif self.config.name not in view:
+            # Telemetry disabled, or the first announce hasn't
+            # reached this shard yet: answer with THIS shard's
+            # on-demand digest so the caller always sees at least the
+            # node it asked.  Never shadows an absorbed NODE digest —
+            # an on-demand single-shard view would under-report the
+            # node's other shards with an always-fresher ts_ms.
+            view[self.config.name] = self.telemetry.merge_digests(
+                self.config.name,
+                [self.telemetry.shard_digest(self)],
+                boot=self.boot_id,
+            )
+        known = {self.config.name} | set(self.nodes)
+        return {
+            "source": self.shard_name,
+            "ts_ms": int(time.time() * 1000),
+            "nodes": view,
+            "nodes_known": len(known),
+            "nodes_reporting": len(view),
+            "missing": sorted(known - set(view)),
+            "dead_nodes": sorted(self.dead_nodes),
         }
 
     def _native_path_stats(self) -> Optional[dict]:
@@ -1686,6 +1797,13 @@ class MyShard:
             )
         if kind == ShardRequest.PING:
             return ShardResponse.pong()
+        if kind == ShardRequest.TELEMETRY_DIGEST:
+            # Telemetry plane: intra-node aggregation — the managing
+            # shard folds sibling digests into the per-node digest it
+            # gossips.  Cheap (ring reads only), never sheds.
+            return ShardResponse.telemetry_digest(
+                self.telemetry.shard_digest(self)
+            )
         if kind == ShardRequest.REARM:
             await self.rearm()
             return ShardResponse.empty(ShardResponse.REARM)
@@ -2025,6 +2143,13 @@ class MyShard:
                     end,
                     nbuckets,
                 )
+            except CorruptedFile as e:
+                # Bulk-scan corruption: quarantine the source table
+                # (the .path-attribution pattern of the compaction
+                # merge) so repair starts NOW, then re-raise — the
+                # AE loop skips this arc for the round.
+                tree.quarantine_by_exception(e, snap.tables)
+                raise
             finally:
                 snap.release()
             if res is not None:
@@ -2115,7 +2240,12 @@ class MyShard:
             ShardEvent.gossip(event)
         )
         buf = msgs.serialize_gossip_message(
-            f"{self.config.name}#{self.boot_id}", event
+            f"{self.config.name}#{self.boot_id}",
+            event,
+            # Telemetry plane: every outgoing gossip frame carries
+            # this node's freshest health digest — membership/DDL
+            # traffic keeps remote cluster_stats views warm for free.
+            self.last_node_digest,
         )
         await self.gossip_buffer(buf)
 
@@ -2202,6 +2332,13 @@ class MyShard:
                 await self.drop_collection(event[1])
             except CollectionNotFound:
                 pass
+        elif kind == GossipEvent.HEALTH:
+            # Telemetry plane: absorb the node's periodic health
+            # digest into this shard's cluster view (freshest wins)
+            # and keep propagating — the epidemic is what makes
+            # cluster_stats answer from ANY node.
+            if len(event) > 3:
+                self.absorb_health_digest(event[3])
         return not another_gossip_sent
 
     def _reset_gossip_counters(self, node_name: str, kind: str) -> None:

@@ -497,43 +497,61 @@ async def run_anti_entropy(my_shard: MyShard) -> None:
             for start, end, peers in my_shard.replica_arcs(rf):
                 if not peers:
                     continue
-                # One digest scan per arc fills ALL sub-range
-                # buckets, shared by that arc's peer comparisons.
-                async with my_shard.scheduler.bg_slice():
-                    counts, digests = (
-                        await my_shard.compute_range_digests(
-                            col.tree, start, end, nb
+                try:
+                    # One digest scan per arc fills ALL sub-range
+                    # buckets, shared by that arc's peer comparisons.
+                    # The LOCAL scans sit inside the same guard as
+                    # the peer exchanges: a corrupted page raises
+                    # CorruptedFile right here (quarantining the
+                    # table as a side effect), and before this guard
+                    # that exception escaped the task set and took
+                    # the whole shard down (observed in the chaos
+                    # soak when the disk-fault bit-flip landed on the
+                    # partition victim) — quarantine repair owns the
+                    # heal; AE just skips the arc this round.
+                    async with my_shard.scheduler.bg_slice():
+                        counts, digests = (
+                            await my_shard.compute_range_digests(
+                                col.tree, start, end, nb
+                            )
                         )
-                    )
-                for peer in peers:
-                    try:
-                        pulled_any = await _sync_range_with_peer(
-                            my_shard,
-                            name,
-                            col.tree,
-                            peer,
-                            start,
-                            end,
-                            counts,
-                            digests,
-                        )
-                        if pulled_any:
-                            # A pull changed our range: later peers
-                            # must compare against the CURRENT
-                            # digests or every one of them re-syncs.
-                            async with my_shard.scheduler.bg_slice():
-                                counts, digests = (
-                                    await my_shard.compute_range_digests(
-                                        col.tree, start, end, nb
+                    for peer in peers:
+                        try:
+                            pulled_any = await _sync_range_with_peer(
+                                my_shard,
+                                name,
+                                col.tree,
+                                peer,
+                                start,
+                                end,
+                                counts,
+                                digests,
+                            )
+                            if pulled_any:
+                                # A pull changed our range: later
+                                # peers must compare against the
+                                # CURRENT digests or every one of
+                                # them re-syncs.
+                                async with my_shard.scheduler.bg_slice():
+                                    counts, digests = (
+                                        await my_shard.compute_range_digests(
+                                            col.tree, start, end, nb
+                                        )
                                     )
-                                )
-                    except (DbeelError, OSError) as e:
-                        log.warning(
-                            "anti-entropy %s with %s failed: %s",
-                            name,
-                            peer.name,
-                            e,
-                        )
+                        except (DbeelError, OSError) as e:
+                            log.warning(
+                                "anti-entropy %s with %s failed: %s",
+                                name,
+                                peer.name,
+                                e,
+                            )
+                except (DbeelError, OSError) as e:
+                    log.warning(
+                        "anti-entropy %s local digest scan failed "
+                        "(skipping arc this round): %s",
+                        name,
+                        e,
+                    )
         my_shard.ae_rounds += 1
         my_shard.flow.notify(FlowEvent.ANTI_ENTROPY_DONE)
 
@@ -893,12 +911,23 @@ class _GossipProtocol(asyncio.DatagramProtocol):
 
 async def handle_gossip_packet(my_shard: MyShard, buf: bytes) -> None:
     try:
-        source, event = msgs.deserialize_gossip_message(buf)
+        source, event, digest = msgs.deserialize_gossip_message(buf)
     except Exception as e:
         log.error("bad gossip packet: %s", e)
         return
+    if digest is not None:
+        # Telemetry plane (PR 11): the sender piggybacked its node
+        # health digest — absorb it regardless of the event's dedup
+        # fate (a re-seen event can still carry a fresher digest).
+        my_shard.absorb_health_digest(digest)
 
-    key = (source, event[0])
+    kind = event[0]
+    if kind == msgs.GossipEvent.HEALTH and len(event) > 2:
+        # Each interval's health digest is a FRESH epidemic: salt the
+        # dedup key with the announce seq so the seen-count dedup
+        # suppresses copies of ONE announce, not all future ones.
+        kind = f"{kind}#{event[2]}"
+    key = (source, kind)
     seen = my_shard.gossip_requests.get(key, 0)
     if seen == 0:
         # Every key expires eventually (not only ones that reach the

@@ -546,6 +546,26 @@ class LSMTree:
         self.durability["checksum_failures"] += 1
         self.quarantine_table(table, str(exc))
 
+    def quarantine_by_exception(self, exc, tables) -> bool:
+        """Attribute a bulk-read CorruptedFile to its source table by
+        the ``.path`` the verifier stamped (the compaction-merge
+        pattern) and quarantine it.  Used by the scan paths
+        (anti-entropy digests, range collection) whose readers are
+        table-agnostic: without this, a corrupt page found by a SCAN
+        raised without quarantining — repair never started, and every
+        later scan re-tripped on the same page.  Returns True when a
+        victim was identified and quarantined."""
+        bad = self._table_index_from_path(getattr(exc, "path", None))
+        if bad is None:
+            return False
+        victim = next(
+            (t for t in tables if t.index == bad), None
+        )
+        if victim is None:
+            return False
+        self._handle_table_corruption(victim, exc)
+        return True
+
     async def _retire_quarantined_files(self, old_list, table) -> None:
         # Reader drain first (same contract as compaction input
         # deletion): probes already inside the old snapshot may still
@@ -1389,12 +1409,25 @@ class LSMTree:
         try:
             for table in snapshot.tables:
                 count = 0
-                for key, value, ts in table.entries():
-                    if filter_fn is None or filter_fn(key, value, ts):
-                        yield key, value, ts
-                    count += 1
-                    if count % 256 == 0:
-                        await asyncio.sleep(0)
+                try:
+                    for key, value, ts in table.entries():
+                        if filter_fn is None or filter_fn(
+                            key, value, ts
+                        ):
+                            yield key, value, ts
+                        count += 1
+                        if count % 256 == 0:
+                            await asyncio.sleep(0)
+                except CorruptedFile as e:
+                    # Scan-path corruption: quarantine the source
+                    # table (repair owns the heal) and re-raise — a
+                    # partial scan must not masquerade as a complete
+                    # one (AE digests would claim authority over
+                    # entries the scan never saw).
+                    self.quarantine_by_exception(
+                        e, snapshot.tables
+                    )
+                    raise
             for key, value, ts in memtable_items:
                 if filter_fn is None or filter_fn(key, value, ts):
                     yield key, value, ts

@@ -1101,6 +1101,39 @@ int64_t dbeel_cli_get_stats(void* h, const char* ip, uint16_t port,
   return (int64_t)body.size();
 }
 
+// Fetch one node's gossip-aggregated cluster health view (raw
+// msgpack map — the schema is shared with the Python client's
+// cluster_stats(); telemetry plane, PR 11): per-node digests (level,
+// ops/s, error/shed rates, degraded flag, hint backlog, watchdog
+// finding kinds) keyed by node name, plus the ring members not yet
+// heard from.  Always served by the node, even at hard overload.
+// Same target/buffer contract as dbeel_cli_get_stats.
+int64_t dbeel_cli_cluster_stats(void* h, const char* ip, uint16_t port,
+                                uint8_t* out, uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string target_ip = (ip && *ip) ? ip : c->seed_ip;
+  uint16_t target_port = port ? port : c->seed_port;
+  MpBuf m;
+  m.map_header(2);
+  common_fields(&m, "cluster_stats", "", true);
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, target_ip, target_port, m, &body, &rtype)) {
+    return -2;
+  }
+  if (rtype == kResponseErr) {
+    std::string msg;
+    c->last_error = error_kind(body, &msg) + ": " + msg;
+    return -2;
+  }
+  if (body.size() > cap) {
+    c->last_error = "cluster stats exceed caller buffer";
+    return -((int64_t)body.size()) - 10;
+  }
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
 // Arm per-op trace stamping (tracing plane, PR 9): every single-op
 // walk request carries an auto-incrementing trace id starting at
 // ``base`` — the server serves it interpreted and records a full

@@ -287,3 +287,85 @@ def test_single_key_divergence_syncs_sub_range_only(tmp_dir):
             await node2.stop()
 
     run(main(), timeout=90)
+
+
+def test_corrupted_page_does_not_kill_the_ae_loop(tmp_dir):
+    """A CRC failure during the AE loop's LOCAL digest scan must
+    quarantine the table and skip the arc — not escape the task set
+    and take the shard down (observed in the chaos soak when the
+    disk-fault bit-flip landed on the partition victim: the
+    CorruptedFile rode run_anti_entropy into FIRST_EXCEPTION
+    teardown)."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ),
+    )
+    from corrupt import flip_bytes
+
+    from dbeel_tpu.storage.entry import DATA_FILE_EXT
+
+    async def main():
+        cfg = make_config(tmp_dir, anti_entropy_interval_ms=300)
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[f"{cfg.ip}:{cfg.remote_shard_port}"]
+        )
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        client = await DbeelClient.from_seed_nodes([node1.db_address])
+        try:
+            created = [
+                n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+                for n in (node1, node2)
+            ]
+            col = await client.create_collection(
+                "aeq", replication_factor=2
+            )
+            await asyncio.wait_for(asyncio.gather(*created), 10)
+            for i in range(40):
+                await col.set(
+                    f"k{i}", {"v": i}, consistency=Consistency.ALL
+                )
+            tree = node1.shards[0].collections["aeq"].tree
+            await tree.flush()
+            for _ in range(50):
+                tables = list(tree._sstables.tables)
+                if tables:
+                    break
+                await asyncio.sleep(0.1)
+            assert tables, "flush produced no sstable"
+            table = tables[0]
+            flip_bytes(
+                table.data_path,
+                os.path.getsize(table.data_path) // 2,
+            )
+            # Cached pages would mask the on-disk flip from the next
+            # digest scan — drop them, like a cold restart would.
+            tree.cache.invalidate_file((DATA_FILE_EXT, table.index))
+
+            # Quarantine fires on the TREE's notifier (storage layer).
+            quarantined = tree.flow.subscribe(
+                FlowEvent.TABLE_QUARANTINED
+            )
+            await asyncio.wait_for(quarantined, 15)
+            # The loop survived the arc: a LATER full AE round still
+            # completes on the corrupted node.
+            ae_done = node1.flow_event(0, FlowEvent.ANTI_ENTROPY_DONE)
+            await asyncio.wait_for(ae_done, 15)
+            # And the shard still serves (healthy replica covers the
+            # quarantined range via the normal walk).
+            got = await col.get("k1", consistency=Consistency.fixed(1))
+            assert got == {"v": 1}
+        finally:
+            client.close()
+            await node1.stop()
+            await node2.stop()
+
+    run(main(), timeout=60)

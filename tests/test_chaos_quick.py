@@ -33,6 +33,7 @@ REPORT_KEYS = {
     "divergent_keys",
     "resources",
     "trace",
+    "health",
     "pass",
 }
 
@@ -43,6 +44,16 @@ TRACE_KEYS = {
     "sampled_entries",
     "slow_entries",
     "dominant_stages",
+}
+
+# Telemetry plane (ISSUE 11): one health block per phase end plus a
+# final one — watchdog findings per node and the cluster_stats rollup.
+HEALTH_BLOCK_KEYS = {
+    "cluster_nodes_seen",
+    "nodes_reporting",
+    "cluster_missing",
+    "findings_by_kind",
+    "per_node",
 }
 
 PARTITION_KEYS = {
@@ -154,6 +165,19 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert tr["nodes_dumped"] >= 1
     for stage, share in tr["dominant_stages"]:
         assert isinstance(stage, str) and 0 <= share <= 1
+    # Telemetry plane (ISSUE 11): the health block must carry the
+    # per-phase watchdog findings and the final cluster_stats rollup
+    # covering the (restarted, all-alive) cluster.
+    hb = report["health"]
+    assert set(hb) == {"phases", "final"}
+    assert "churn" in hb["phases"]
+    for label, block in {**hb["phases"], "final": hb["final"]}.items():
+        missing = HEALTH_BLOCK_KEYS - set(block)
+        assert not missing, (label, missing)
+        for _node, kinds in block["per_node"].items():
+            assert isinstance(kinds, list)
+    assert hb["final"]["nodes_reporting"] >= 1
+    assert hb["final"]["cluster_nodes_seen"] >= 1
     assert report["quick"] is True
     # The quick mode must still uphold the hard invariants (loss /
     # divergence), even though the error-rate gate is waived.

@@ -485,6 +485,93 @@ def test_stats_schema_escape_comment(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# Prometheus name-flattening drift (telemetry plane, PR 11).
+# ---------------------------------------------------------------------
+
+
+def _prom_tree(tmp_path, server_source: str) -> str:
+    """A _stats_tree plus the REAL telemetry.py, so the flattening
+    check executes the real prom_name over the seeded schema keys."""
+    root = _stats_tree(tmp_path, server_source)
+    shutil.copyfile(
+        os.path.join(REPO_ROOT, "dbeel_tpu/server/telemetry.py"),
+        os.path.join(root, "dbeel_tpu/server/telemetry.py"),
+    )
+    return root
+
+
+def test_prom_flattening_clean_on_disjoint_keys(tmp_path):
+    root = _prom_tree(
+        tmp_path,
+        _src(
+            """
+            class Plane:
+                def stats(self):
+                    return {"ops_total": 1, "sheds_total": 2}
+            """
+        ),
+    )
+    assert stats_schema.check(Repo(root)) == []
+
+
+def test_prom_flattening_flags_name_collision(tmp_path):
+    # Two DISTINCT schema keys sanitizing to one metric token would
+    # silently merge two series on /metrics.
+    root = _prom_tree(
+        tmp_path,
+        _src(
+            """
+            class Plane:
+                def stats(self):
+                    return {"loop_lag.ms": 1, "loop_lag_ms": 2}
+            """
+        ),
+    )
+    findings = stats_schema.check(Repo(root))
+    assert any(
+        "collision" in f.message and "loop_lag" in f.message
+        for f in findings
+    ), findings
+
+
+def test_prom_flattening_flags_lost_map(tmp_path):
+    # telemetry.py without prom_name means the /metrics naming is no
+    # longer lint-checked at all — that itself is drift.
+    root = _prom_tree(
+        tmp_path,
+        _src(
+            """
+            class Plane:
+                def stats(self):
+                    return {"ok": 1}
+            """
+        ),
+    )
+    path = os.path.join(root, "dbeel_tpu/server/telemetry.py")
+    with open(path) as f:
+        src = f.read()
+    assert "def prom_name" in src
+    with open(path, "w") as f:
+        f.write(src.replace("def prom_name", "def prom_name_gone"))
+    findings = stats_schema.check(Repo(root))
+    assert any(
+        "prom_name" in f.message for f in findings
+    ), findings
+
+
+def test_prom_flattening_real_tree_keys_are_injective():
+    # The real tree's full schema-key namespace must flatten cleanly
+    # (this is what the CI lint gate enforces; pinned here so a local
+    # edit sees the failure as a named test, not just a lint exit).
+    findings = [
+        f
+        for f in stats_schema.check(Repo(REPO_ROOT))
+        if "Prometheus" in f.message or "flatten" in f.message
+    ]
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------------
 # Error taxonomy: seeded unknown kind / lost special case.
 # ---------------------------------------------------------------------
 

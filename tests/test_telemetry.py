@@ -1,0 +1,700 @@
+"""Continuous telemetry plane (ISSUE 11): ring bounds + rate
+derivation, gossip digest round-trip, cluster_stats through BOTH
+clients on a 3-node cluster, the Prometheus endpoint's strict line
+format, the health watchdog's rule table, and the zero-cost-when-off
+contract."""
+
+import asyncio
+import logging
+import re
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from conftest import run  # noqa: E402
+from harness import (  # noqa: E402
+    ClusterNode,
+    make_config,
+    next_node_config,
+)
+
+from dbeel_tpu.client import DbeelClient  # noqa: E402
+from dbeel_tpu.cluster import messages as msgs  # noqa: E402
+from dbeel_tpu.server import telemetry as tm  # noqa: E402
+
+
+# ----------------------------------------------------------------------
+# Ring: bounds, eviction, series access
+# ----------------------------------------------------------------------
+
+
+def test_ring_bounds_and_eviction():
+    ring = tm.TelemetryRing(capacity=4)
+    for i in range(10):
+        ring.add_sample({"x": i}, ts_ms=i * 1000, mono=float(i))
+    assert len(ring) == 4
+    assert ring.evicted == 6
+    assert ring.samples_taken == 10
+    assert ring.seq == 10
+    # Oldest evicted: the series holds only the newest 4.
+    assert ring.series("x") == [6, 7, 8, 9]
+    assert ring.series("x", 2) == [8, 9]
+    assert ring.stats()["len"] == 4
+
+
+def test_ring_capacity_floor():
+    # Degenerate capacities clamp (the ring must always hold enough
+    # samples for the multi-window watchdog rules).
+    assert tm.TelemetryRing(capacity=0).capacity >= 4
+
+
+# ----------------------------------------------------------------------
+# Rate derivation against synthetic counter sequences
+# ----------------------------------------------------------------------
+
+
+def _sample(ring, mono, **values):
+    ring.add_sample(dict(values), ts_ms=int(mono * 1000), mono=mono)
+
+
+def test_rate_derivation_synthetic_counters():
+    ring = tm.TelemetryRing(capacity=8)
+    _sample(
+        ring, 0.0,
+        **{
+            "metrics.requests.get.count": 100,
+            "metrics.requests.set.count": 50,
+            "metrics.errors.overload": 0,
+            "overload.shed_ops": 0,
+            "convergence.hints_queued": 10,
+            "overload.signals.loop_lag_ms": 1.5,
+        },
+    )
+    _sample(
+        ring, 2.0,
+        **{
+            "metrics.requests.get.count": 300,
+            "metrics.requests.set.count": 150,
+            "metrics.errors.overload": 20,
+            "overload.shed_ops": 40,
+            "convergence.hints_queued": 50,
+            "overload.signals.loop_lag_ms": 3.0,
+        },
+    )
+    rates = ring.rates()
+    # (300-100 + 150-50) / 2s
+    assert rates["ops_per_s"] == 150.0
+    assert rates["errors_per_s"] == 10.0
+    assert rates["sheds_per_s"] == 20.0
+    assert rates["hint_backlog"] == 50
+    assert rates["hint_backlog_slope_per_s"] == 20.0
+    # Gauges read the NEWEST sample directly.
+    assert rates["loop_lag_ms"] == 3.0
+
+
+def test_rate_derivation_restart_clamps_negative():
+    # A counter going backwards (process restart) must clamp to 0,
+    # not report a negative rate.
+    ring = tm.TelemetryRing(capacity=8)
+    _sample(ring, 0.0, **{"overload.shed_ops": 1000})
+    _sample(ring, 1.0, **{"overload.shed_ops": 5})
+    assert ring.delta_per_s("overload.shed_ops") == 0.0
+
+
+def test_rates_need_two_samples():
+    ring = tm.TelemetryRing(capacity=8)
+    assert ring.rates()["ops_per_s"] is None
+    _sample(ring, 0.0, **{"metrics.requests.get.count": 1})
+    assert ring.rates()["ops_per_s"] is None
+    assert ring.delta_per_s("anything") is None
+
+
+def test_flatten_stats_shapes():
+    flat = tm.flatten_stats(
+        {
+            "a": {"b": 2, "flag": True, "skip": "str", "lst": [1]},
+            "top": 7,
+            "none": None,
+            "telemetry": {"x": 1},
+        },
+        skip=tm.RING_SKIP_BLOCKS,
+    )
+    assert flat == {"a.b": 2, "a.flag": 1, "top": 7}
+
+
+# ----------------------------------------------------------------------
+# Gossip digest round-trip + merge
+# ----------------------------------------------------------------------
+
+
+def test_gossip_digest_roundtrip_and_backcompat():
+    digest = {"node": "n1", "ts_ms": 123, "seq": 7, "level": 1}
+    buf = msgs.serialize_gossip_message(
+        "n1#abcd", msgs.GossipEvent.dead("n9"), digest
+    )
+    source, event, got = msgs.deserialize_gossip_message(buf)
+    assert source == "n1#abcd"
+    assert event == ["dead", "n9"]
+    assert got == digest
+    # Old-dialect frame (no piggyback) still parses.
+    old = msgs.serialize_gossip_message(
+        "n1#abcd", msgs.GossipEvent.dead("n9")
+    )
+    _s, _e, none = msgs.deserialize_gossip_message(old)
+    assert none is None
+    # The health event carries (name, seq, digest) after the kind.
+    ev = msgs.GossipEvent.health("n1", 7, digest)
+    assert ev[0] == msgs.GossipEvent.HEALTH
+    assert ev[1] == "n1" and ev[2] == 7 and ev[3] == digest
+
+
+def test_merge_digests_folds_shards():
+    merged = tm.ShardTelemetry.merge_digests(
+        "node-a",
+        [
+            {
+                "seq": 3, "level": 0, "ops_per_s": 10.0,
+                "errors_per_s": 1.0, "sheds_per_s": 0.0,
+                "degraded": False, "hint_backlog": 5,
+                "findings": ["odirect_fallback"],
+            },
+            {
+                "seq": 5, "level": 2, "ops_per_s": 20.0,
+                "errors_per_s": 0.5, "sheds_per_s": 2.0,
+                "degraded": True, "hint_backlog": 7,
+                "findings": ["shed_storm"],
+            },
+        ],
+    )
+    assert merged["node"] == "node-a"
+    assert merged["seq"] == 5  # max
+    assert merged["level"] == 2  # worst
+    assert merged["ops_per_s"] == 30.0  # sum
+    assert merged["degraded"] is True  # any
+    assert merged["hint_backlog"] == 12  # sum
+    assert merged["findings"] == ["odirect_fallback", "shed_storm"]
+    assert merged["shards"] == 2
+
+
+def test_absorb_health_digest_freshest_wins(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        try:
+            shard = node.shards[0]
+            shard.absorb_health_digest(
+                {"node": "x", "ts_ms": 100, "seq": 1, "level": 0}
+            )
+            # Older copy (epidemic re-propagation) must not roll back.
+            shard.absorb_health_digest(
+                {"node": "x", "ts_ms": 50, "seq": 0, "level": 2}
+            )
+            assert shard.cluster_view["x"]["level"] == 0
+            shard.absorb_health_digest(
+                {"node": "x", "ts_ms": 200, "seq": 2, "level": 1}
+            )
+            assert shard.cluster_view["x"]["level"] == 1
+            # Same-boot digests order by SEQ: a sender whose wall
+            # clock stepped backwards must not be pinned stale
+            # (review finding).
+            shard.absorb_health_digest(
+                {"node": "y", "boot": "b1", "ts_ms": 900,
+                 "seq": 5, "level": 0}
+            )
+            shard.absorb_health_digest(
+                {"node": "y", "boot": "b1", "ts_ms": 100,
+                 "seq": 6, "level": 2}
+            )
+            assert shard.cluster_view["y"]["level"] == 2
+            # Cross-boot (restart) falls back to wall clock.
+            shard.absorb_health_digest(
+                {"node": "y", "boot": "b2", "ts_ms": 50,
+                 "seq": 1, "level": 1}
+            )
+            assert shard.cluster_view["y"]["level"] == 2
+            shard.absorb_health_digest(
+                {"node": "y", "boot": "b2", "ts_ms": 901,
+                 "seq": 1, "level": 1}
+            )
+            assert shard.cluster_view["y"]["level"] == 1
+            # Garbage shapes are ignored.
+            shard.absorb_health_digest(["not", "a", "dict"])
+            shard.absorb_health_digest({"ts_ms": 1})
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Health watchdog rule table (synthetic time-series)
+# ----------------------------------------------------------------------
+
+
+def _kinds(findings):
+    return {f["kind"] for f in findings}
+
+
+def test_watchdog_hint_backlog_ramp_fires():
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    for i, q in enumerate((10, 20, 35, 80)):
+        _sample(ring, float(i), **{"convergence.hints_queued": q})
+    kinds = _kinds(dog.evaluate(ring))
+    assert "hint_backlog_growing" in kinds
+    # A plateau breaks the strictly-growing run.
+    _sample(ring, 4.0, **{"convergence.hints_queued": 80})
+    assert "hint_backlog_growing" not in _kinds(dog.evaluate(ring))
+
+
+def test_watchdog_sticky_degraded_and_wal_and_odirect():
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    _sample(
+        ring, 0.0,
+        **{
+            "durability.degraded_mode": 1,
+            "durability.odirect_fallbacks": 2,
+            "wal_fsync_errors": 1,
+        },
+    )
+    kinds = _kinds(dog.evaluate(ring))
+    # One degraded sample is the EIO itself, not yet "sticky".
+    assert "sticky_degraded" not in kinds
+    assert "odirect_fallback" in kinds
+    assert "wal_sync_errors" in kinds
+    _sample(
+        ring, 1.0,
+        **{
+            "durability.degraded_mode": 1,
+            "durability.odirect_fallbacks": 2,
+            "wal_fsync_errors": 1,
+        },
+    )
+    findings = dog.evaluate(ring)
+    assert "sticky_degraded" in _kinds(findings)
+    # crit findings sort first and flip the health verdict.
+    assert findings[0]["severity"] == "crit"
+
+
+def test_watchdog_shed_storm_dead_climb_trace_churn():
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    base = {
+        "overload.shed_ops": 0,
+        "overload.signals.dead_completion_frac": 0.05,
+        "trace.evicted": 0,
+        "trace.capacity": 100,
+    }
+    _sample(ring, 0.0, **base)
+    _sample(
+        ring, 1.0,
+        **{
+            "overload.shed_ops": 50,
+            "overload.signals.dead_completion_frac": 0.15,
+            "trace.evicted": 0,
+            "trace.capacity": 100,
+        },
+    )
+    _sample(
+        ring, 2.0,
+        **{
+            "overload.shed_ops": 150,
+            "overload.signals.dead_completion_frac": 0.30,
+            # 500 evictions in a 1s window >> the 100-slot ring.
+            "trace.evicted": 500,
+            "trace.capacity": 100,
+        },
+    )
+    kinds = _kinds(dog.evaluate(ring))
+    assert "shed_storm" in kinds
+    assert "dead_completion_climb" in kinds
+    assert "trace_ring_churn" in kinds
+    # evaluate() is PURE — only observe() (one call per telemetry
+    # sample) advances the counters, so scrape frequency can never
+    # inflate findings_total.
+    assert dog.stats()["findings_total"] == 0
+    dog.observe(ring)
+    assert dog.stats()["findings_by_kind"]["shed_storm"] == 1
+
+
+def test_watchdog_log_rate_limited(caplog):
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    _sample(ring, 0.0, **{"wal_fsync_errors": 3})
+    with caplog.at_level(logging.WARNING, logger=tm.__name__):
+        for _ in range(5):
+            dog.observe(ring)
+    lines = [
+        r for r in caplog.records if "wal_sync_errors" in r.message
+    ]
+    # 5 observations inside one second: exactly one log line; the
+    # rest are suppressed (and counted for the next line's rollup).
+    assert len(lines) == 1
+    assert dog._suppressed["wal_sync_errors"] == 4
+    assert dog.stats()["findings_total"] == 5
+
+
+# ----------------------------------------------------------------------
+# Live cluster: sampling, cluster_stats via BOTH clients, dumps
+# ----------------------------------------------------------------------
+
+
+def test_stats_stamps_and_sampling_live(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, telemetry_interval_ms=100)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            await client.create_collection("t")
+            col = client.collection("t")
+            for i in range(30):
+                await col.set(f"k{i}", {"v": i})
+            s1 = await client.get_stats()
+            s2 = await client.get_stats()
+            # Satellite: every snapshot is stamped for offline rate
+            # derivation from dump PAIRS.
+            for s in (s1, s2):
+                assert s["ts_ms"] > 0
+                assert s["uptime_s"] >= 0
+                assert s["started_at_ms"] > 0
+            assert s2["stats_seq"] > s1["stats_seq"]
+            # Sampling rode the heartbeat into the ring.
+            await asyncio.sleep(0.35)
+            s3 = await client.get_stats()
+            t = s3["telemetry"]
+            assert t["enabled"] is True
+            assert t["ring"]["len"] >= 2
+            assert t["interval_ms"] == 100
+            assert "ops_per_s" in t["rates"]
+            assert s3["health"]["enabled"] is True
+            assert isinstance(s3["health"]["findings"], list)
+            # telemetry_dump: ring entries carry the offline-tooling
+            # stamps, and a dump PAIR derives rates without guessing.
+            dump = await client.telemetry_dump()
+            assert dump["enabled"] is True
+            entries = dump["entries"]
+            assert len(entries) >= 2
+            for e in entries:
+                assert e["seq"] > 0 and e["ts_ms"] > 0
+                assert "values" in e
+            seqs = [e["seq"] for e in entries]
+            assert seqs == sorted(seqs)
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
+
+def test_cluster_stats_three_nodes_both_clients(tmp_dir):
+    """The acceptance gate: cluster_stats from ONE node reports all
+    3 nodes of the cluster, through the Python AND the C client."""
+
+    async def main():
+        kw = dict(telemetry_interval_ms=150)
+        cfg = make_config(tmp_dir, **kw)
+        nodes = [await ClusterNode(cfg).start()]
+        for i in (1, 2):
+            c = next_node_config(cfg, i, tmp_dir).replace(
+                seed_nodes=[nodes[0].seed_address], **kw
+            )
+            nodes.append(await ClusterNode(c).start())
+        client = await DbeelClient.from_seed_nodes(
+            [nodes[0].db_address]
+        )
+        try:
+            names = {n.config.name for n in nodes}
+            cs = None
+            for _ in range(100):
+                cs = await client.cluster_stats()
+                if names <= set(cs["nodes"]):
+                    break
+                await asyncio.sleep(0.2)
+            assert cs is not None and names <= set(cs["nodes"]), cs
+            assert cs["nodes_known"] == 3
+            assert cs["missing"] == []
+            for name in names:
+                d = cs["nodes"][name]
+                assert d["node"] == name
+                assert d["ts_ms"] > 0 and d["seq"] >= 1
+                assert isinstance(d["findings"], list)
+                assert d["shards"] >= 1
+            # Ask a DIFFERENT node: same cluster-wide answer shape.
+            host, port = nodes[2].db_address
+            cs2 = await client.cluster_stats(host, port)
+            assert names <= set(cs2["nodes"])
+
+            # C client (skipped portion when the .so is absent).
+            from dbeel_tpu.client import native_client
+
+            if native_client.available():
+                ip, port = nodes[1].db_address
+
+                def fetch():
+                    c = native_client.NativeDbeelClient(ip, port)
+                    try:
+                        return c.cluster_stats()
+                    finally:
+                        c.close()
+
+                ncs = await asyncio.get_event_loop().run_in_executor(
+                    None, fetch
+                )
+                assert names <= set(ncs["nodes"]), ncs
+        finally:
+            client.close()
+            for n in nodes:
+                await n.stop()
+
+    run(main(), timeout=90)
+
+
+def test_cluster_stats_serves_with_telemetry_off(tmp_dir):
+    # Always-served admin verb: even with the plane disabled the
+    # asked node answers with its own on-demand digest — and that
+    # digest reads LIVE shard state (an empty ring must not report a
+    # degraded shard as healthy; review finding).
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            cs = await client.cluster_stats()
+            assert node.config.name in cs["nodes"]
+            assert cs["missing"] == []
+            assert cs["nodes"][node.config.name]["degraded"] is False
+            node.shards[0].degraded = True
+            cs = await client.cluster_stats()
+            assert cs["nodes"][node.config.name]["degraded"] is True
+        finally:
+            node.shards[0].degraded = False
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_sibling_shard_reports_whole_node_digest(tmp_dir):
+    """A multi-shard node: cluster_stats asked on the NON-managing
+    shard must report the folded per-node digest (shards=2), not an
+    on-demand single-shard view shadowing it (review finding: the
+    fallback's fresh ts_ms always won the freshness compare)."""
+
+    async def main():
+        cfg = make_config(tmp_dir, telemetry_interval_ms=100)
+        node = await ClusterNode(cfg, num_shards=2).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            name = node.config.name
+            host, _ = node.db_address
+            d = None
+            for _ in range(100):
+                # Ask shard 1 (db port + 1), which never announces.
+                cs = await client.cluster_stats(host, cfg.port + 1)
+                d = cs["nodes"].get(name)
+                if d and d.get("shards") == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert d is not None and d["shards"] == 2, d
+            # Sibling shards also adopt the node digest for their own
+            # gossip piggybacks.
+            assert node.shards[1].last_node_digest is not None
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
+
+def test_announce_tolerates_one_bad_sibling(tmp_dir):
+    """One sibling failing its TELEMETRY_DIGEST round must not drop
+    the OTHER siblings from the node rollup (review finding: the
+    all-or-nothing gather muted exactly the unhealthy state)."""
+
+    async def main():
+        cfg = make_config(tmp_dir, telemetry_interval_ms=100)
+        node = await ClusterNode(cfg, num_shards=3).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            # Shard 2's digest round raises; shard 1 keeps answering.
+            def boom():
+                raise RuntimeError("sibling mid-restart")
+
+            node.shards[2].telemetry.shard_digest = boom
+            name = node.config.name
+            d = None
+            for _ in range(100):
+                cs = await client.cluster_stats()
+                d = cs["nodes"].get(name)
+                # 2 healthy shard digests folded (0 and 1).
+                if d and d.get("shards") == 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert d is not None and d["shards"] == 2, d
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
+
+# ----------------------------------------------------------------------
+# Prometheus endpoint
+# ----------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* gauge"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*\{shard=\"[^\"]+\"\} "
+    r"-?[0-9]+(\.[0-9]+)?([eE][-+]?[0-9]+)?)$"
+)
+
+
+async def _http_get(host, port, path):
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(
+        f"GET {path} HTTP/1.0\r\nHost: {host}\r\n\r\n".encode()
+    )
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    return status, head.decode("latin-1"), body.decode()
+
+
+def test_prometheus_endpoint_strict_format(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, telemetry_interval_ms=100)
+        cfg = cfg.replace(metrics_port=cfg.port + 180)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            await client.create_collection("t")
+            col = client.collection("t")
+            for i in range(20):
+                await col.set(f"k{i}", {"v": i})
+            status, head, body = await _http_get(
+                "127.0.0.1", cfg.metrics_port, "/metrics"
+            )
+            assert status == 200
+            assert "text/plain; version=0.0.4" in head
+            lines = [ln for ln in body.split("\n") if ln]
+            assert len(lines) > 100
+            for ln in lines:
+                assert _PROM_LINE.match(ln), f"bad line: {ln!r}"
+            # Every lint-walked schema counter reaches the scrape
+            # under its flattened dbeel_* name (spot the planes).
+            for metric in (
+                "dbeel_overload_shed_ops",
+                "dbeel_metrics_slow_ops",
+                "dbeel_convergence_hints_queued",
+                "dbeel_wal_fsync_errors",
+                "dbeel_durability_odirect_fallbacks",
+                "dbeel_trace_recorded",
+                "dbeel_telemetry_ring_len",
+                "dbeel_health_ok",
+                "dbeel_stats_seq",
+                "dbeel_metrics_requests_set_count",
+            ):
+                assert f'{metric}{{shard="' in body, metric
+            # One metric name per flattened path (the lint-pinned
+            # injectivity, observed at the exposition level).
+            sample_names = [
+                ln.split("{", 1)[0]
+                for ln in lines
+                if not ln.startswith("#")
+            ]
+            assert len(sample_names) == len(set(sample_names))
+            # Anything else 404s.
+            status, _h, _b = await _http_get(
+                "127.0.0.1", cfg.metrics_port, "/other"
+            )
+            assert status == 404
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
+
+# ----------------------------------------------------------------------
+# Zero-cost-when-off contract
+# ----------------------------------------------------------------------
+
+
+def test_zero_interval_executes_zero_telemetry_code(tmp_dir):
+    async def main():
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            await client.create_collection("t")
+            col = client.collection("t")
+            for i in range(50):
+                await col.set(f"k{i}", {"v": i})
+                await col.get(f"k{i}")
+            await asyncio.sleep(0.3)
+            shard = node.shards[0]
+            # The heartbeat hook was never installed: no telemetry
+            # callable exists anywhere on the serving or heartbeat
+            # path, and the ring never saw a sample.
+            assert shard.governor.telemetry_hook is None
+            assert shard.telemetry.ring.samples_taken == 0
+            assert len(shard.telemetry.ring) == 0
+            # The schema stays stable for clients regardless.
+            stats = await client.get_stats()
+            assert stats["telemetry"]["enabled"] is False
+            assert stats["health"]["enabled"] is False
+            assert stats["health"]["ok"] is True
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
+
+# ----------------------------------------------------------------------
+# Watchdog on a live forced-degraded shard (integration)
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_surfaces_forced_degraded_live(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, telemetry_interval_ms=80)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes([node.db_address])
+        try:
+            shard = node.shards[0]
+            shard.degraded = True
+            shard.degraded_reason = "test: forced"
+            finding = None
+            for _ in range(50):
+                await asyncio.sleep(0.1)
+                health = (await client.get_stats())["health"]
+                hits = [
+                    f
+                    for f in health["findings"]
+                    if f["kind"] == "sticky_degraded"
+                ]
+                if hits:
+                    finding = hits[0]
+                    break
+            assert finding is not None
+            assert finding["severity"] == "crit"
+            health = (await client.get_stats())["health"]
+            assert health["ok"] is False
+            # The node digest (and so cluster_stats) carries it too.
+            cs = None
+            for _ in range(50):
+                cs = await client.cluster_stats()
+                d = cs["nodes"].get(node.config.name)
+                if d and "sticky_degraded" in d["findings"]:
+                    break
+                await asyncio.sleep(0.1)
+            d = cs["nodes"][node.config.name]
+            assert "sticky_degraded" in d["findings"], cs
+            assert d["degraded"] is True
+        finally:
+            shard.degraded = False
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=45)
+
