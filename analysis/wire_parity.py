@@ -482,6 +482,43 @@ def check(repo: Repo) -> List[Finding]:
                 "replica span piggyback",
             )
 
+    # -- scan plane (PR 12): peer-page arity + C client coverage -----
+    # The SCAN peer frame has a FIXED arity (no deadline/trace
+    # dialects): the encoder's element count must equal shard.py's
+    # _SCAN_PEER_ARITY (what the handler indexes), and the C client
+    # must keep emitting both scan op tokens (feature parity — a C
+    # client that silently loses the verb strands half the fleet
+    # without scans).
+    scan_arity = _module_int_constant(shard, "_SCAN_PEER_ARITY")
+    if scan_arity is None:
+        add(
+            repo.shard_py,
+            1,
+            "_SCAN_PEER_ARITY constant missing — the scan peer-frame "
+            "arity must be a named, lint-compared constant",
+        )
+    else:
+        enc = arities.get("SCAN")
+        if enc is not None and enc != scan_arity:
+            add(
+                repo.messages_py,
+                1,
+                f"scan peer-frame arity drift: encoder emits {enc} "
+                f"elements but shard.py's _SCAN_PEER_ARITY is "
+                f"{scan_arity}",
+            )
+    client_c_tokens = {
+        v for _line, v in c_string_literals(client_src)
+    }
+    for tok in ("scan", "scan_next"):
+        if tok not in client_c_tokens:
+            add(
+                repo.client_cpp,
+                1,
+                f"C client no longer emits the {tok!r} op — the scan "
+                "plane must stay reachable from BOTH clients",
+            )
+
     # -- every C wire-token literal is in a Python registry ----------
     peer_verbs = (
         set(req.values())

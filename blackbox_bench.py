@@ -676,6 +676,184 @@ async def main_attribute(args):
     client.close()
 
 
+async def main_scan(args):
+    """--scan (streaming scan plane, ISSUE 12): the two acceptance
+    gates, same-session.  (1) Throughput: stream the whole keyspace
+    through the scan plane vs fetching the SAME keys via batched
+    multi_get — the scan must win on keys/s (its pages come off the
+    vectorized columnar stage; multi_get pays per-key probes), and
+    its view must byte-agree with the multi_get view.  (2) Isolation:
+    point-get p99 with one concurrent full-collection scan looping
+    must stay bounded vs the same-session scan-off baseline — the
+    governor pacing gate (byte-budgeted, individually-admitted
+    chunks), not an assertion."""
+    import time as _time
+
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)],
+        pipeline_window=args.pipeline or 32,
+    )
+    rf = args.replication_factor or 1
+    try:
+        await client.create_collection(args.collection, rf)
+    except CollectionAlreadyExists:
+        pass
+    col = client.collection(args.collection)
+    n = args.clients * args.requests
+    keys = [f"key-{i:08}" for i in range(n)]
+    value = {"blob": "x" * args.value_size}
+    rng = random.Random(args.seed)
+
+    # Load the keyspace (batched writes; not part of any gate).
+    t0 = time.perf_counter()
+    total, _lat = await run_phase(
+        client, args.collection, "set", keys, args.clients, value,
+        None, batch=args.batch or 64,
+    )
+    print(f"load: {n} keys in {total:.2f}s")
+
+    # Gate 1a: batched multi_get of the whole (sorted) keyspace in
+    # the analytics-client shape — ONE consumer pulling every key
+    # (what a scan replaces).  The args.clients-worker concurrent
+    # sweep is printed for context; the gate compares like for like
+    # (one scan stream is one consumer).
+    total_mg, _lat = await run_phase(
+        client, args.collection, "get", sorted(keys), 1,
+        value, None, batch=args.batch or 64,
+    )
+    mg_rate = n / total_mg
+    print(
+        f"multi_get sweep (1 consumer): total {total_mg:.3f}s "
+        f"({mg_rate:,.0f} keys/s, batch={args.batch or 64})"
+    )
+    total_mgn, _lat = await run_phase(
+        client, args.collection, "get", sorted(keys), args.clients,
+        value, None, batch=args.batch or 64,
+    )
+    print(
+        f"multi_get sweep ({args.clients} workers): total "
+        f"{total_mgn:.3f}s ({n / total_mgn:,.0f} keys/s)"
+    )
+
+    # Gate 1b: one streaming scan of the same keyspace.  Let the
+    # share-pacing window from the multi_get sweep expire first: the
+    # throughput gate measures a scan on an otherwise idle server
+    # (the isolation gate below measures the paced case).
+    await asyncio.sleep(0.5)
+    t0 = time.perf_counter()
+    scanned = []
+    async for k, _v in col.scan():
+        scanned.append(k)
+    total_scan = time.perf_counter() - t0
+    scan_rate = len(scanned) / total_scan
+    agree = scanned == sorted(keys)
+    print(
+        f"scan sweep: total {total_scan:.3f}s "
+        f"({scan_rate:,.0f} keys/s)  "
+        f"speedup vs multi_get: {scan_rate / mg_rate:.2f}x  "
+        f"byte-agree: {agree}"
+    )
+    t0 = time.perf_counter()
+    cnt = await col.count()
+    print(
+        f"count pushdown: {cnt} keys in "
+        f"{time.perf_counter() - t0:.3f}s (no values moved)"
+    )
+
+    # Gate 2: point-get p99, scan OFF vs scan ON (same session).
+    # ONE closed-loop prober: the gate is per-request latency under a
+    # concurrent scan, and on this single-core host class a multi-
+    # worker prober measures its own client-side queueing, not the
+    # server's pacing.
+    async def point_get_p99(dur_s: float) -> tuple:
+        lat: list = []
+        stop_at = asyncio.get_event_loop().time() + dur_s
+        r = random.Random(1)
+        while asyncio.get_event_loop().time() < stop_at:
+            k = keys[r.randrange(n)]
+            t1 = _time.perf_counter()
+            await col.get(k)
+            lat.append(_time.perf_counter() - t1)
+        lat.sort()
+        p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+        return len(lat) / dur_s, p99
+
+    dur = 6.0
+    off_rate, off_p99 = await point_get_p99(dur)
+    print(
+        f"point gets, scan OFF: {off_rate:,.0f} ops/s  "
+        f"p99 {off_p99 * 1000:.2f}ms"
+    )
+
+    # The concurrent scanner runs in its OWN process: a same-loop
+    # scanner would park the prober behind every chunk's client-side
+    # decode (cooperative scheduling), billing client CPU to the
+    # server's pacing.  A separate process gets OS-preemptive
+    # timeslices instead — on a single-core host the measured p99
+    # still includes genuine CPU sharing with the scanner's decode
+    # (host constraint, not server queueing: the server's loop_lag
+    # printed below is the direct pacing signal).
+    import subprocess as _sp
+    import sys as _sys
+
+    scanner = _sp.Popen(
+        [
+            _sys.executable,
+            "-c",
+            (
+                "import asyncio,sys\n"
+                "sys.path.insert(0, %r)\n"
+                "from dbeel_tpu.client import DbeelClient\n"
+                "async def main():\n"
+                "    cl = await DbeelClient.from_seed_nodes([(%r, %d)])\n"
+                "    col = cl.collection(%r)\n"
+                "    n = 0\n"
+                "    while True:\n"
+                "        async for _kv in col.scan():\n"
+                "            pass\n"
+                "        n += 1\n"
+                "        print(n, flush=True)\n"
+                "asyncio.run(main())\n"
+            )
+            % (
+                os.path.dirname(os.path.abspath(__file__)),
+                args.host,
+                args.port,
+                args.collection,
+            ),
+        ],
+        stdout=_sp.PIPE,
+        text=True,
+    )
+    await asyncio.sleep(0.3)  # scanner boot + first chunks in flight
+    try:
+        on_rate, on_p99 = await point_get_p99(dur)
+    finally:
+        scanner.terminate()
+        out, _ = scanner.communicate(timeout=20)
+    loops = out.strip().splitlines()
+    print(
+        "concurrent full scans completed during window: "
+        f"{loops[-1] if loops else 0}"
+    )
+    ratio = on_p99 / max(1e-9, off_p99)
+    print(
+        f"point gets, scan ON:  {on_rate:,.0f} ops/s  "
+        f"p99 {on_p99 * 1000:.2f}ms  (x{ratio:.2f} vs scan-off)"
+    )
+    stats = await client.get_stats(args.host, args.port)
+    sig = (stats.get("overload") or {}).get("signals") or {}
+    print(
+        f"server during window: loop_lag_ms={sig.get('loop_lag_ms')} "
+        f"level={(stats.get('overload') or {}).get('level')}"
+    )
+    print(f"server scan block: {stats.get('scan')}")
+    rng.shuffle(keys)
+    client.close()
+
+
 async def main_telemetry_overhead(args):
     """--telemetry-overhead (telemetry plane, ISSUE 11): the
     zero-cost-when-off gate.  Runs the standard lockstep set/get
@@ -787,6 +965,15 @@ def main():
         "baseline)",
     )
     ap.add_argument(
+        "--scan",
+        action="store_true",
+        help="streaming-scan phase (scan plane): full-keyspace scan "
+        "throughput vs batched multi_get of the same keys "
+        "(byte-agreement checked), count pushdown, and point-get p99 "
+        "with a concurrent full-collection scan ON vs OFF — the "
+        "governor pacing gate, all same-session",
+    )
+    ap.add_argument(
         "--telemetry-overhead",
         action="store_true",
         help="telemetry-plane A/B phase: lockstep set/get throughput "
@@ -824,6 +1011,8 @@ def main():
         asyncio.run(main_knee_worker(args))
     elif args.telemetry_overhead:
         asyncio.run(main_telemetry_overhead(args))
+    elif args.scan:
+        asyncio.run(main_scan(args))
     elif args.attribute:
         asyncio.run(main_attribute(args))
     elif args.native_floor:

@@ -1088,6 +1088,109 @@ async def overload_phase(nodes, report, quick):
     return ok_gate
 
 
+async def scan_phase(nodes, seeds, acks, report, quick):
+    """--scan (streaming scan plane, ISSUE 12): full-collection scans
+    WHILE a node churns (SIGKILL + restart mid-stream).  Gates:
+    (1) scans keep completing through the outage — the cursor walk
+    retries retryable chunks and every completed stream is sorted and
+    duplicate-free; (2) after the heal + a short quiet window, the
+    scan's view byte-agrees with quorum multi_gets of the journal's
+    acked keys (merge correctness under replica divergence); (3) the
+    scan stats block (chunks/cursor_resumes/sheds) is visible through
+    the client."""
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)], op_deadline_s=12.0
+    )
+    col = client.collection(COLLECTION)
+    victim = nodes[1]
+    window_s = 20.0 if quick else 60.0
+    down_s = 6.0 if quick else 15.0
+    scans_completed = 0
+    scan_errors = 0
+    order_violations = 0
+    last_entries = 0
+
+    async def churner():
+        await asyncio.sleep(2.0)
+        log("SCAN: killing victim mid-scan")
+        victim.kill()
+        await asyncio.sleep(down_s)
+        victim.start(seeds)
+        await wait_port(victim.db_port)
+
+    churn_task = asyncio.create_task(churner())
+    t0 = time.time()
+    while time.time() - t0 < window_s:
+        try:
+            keys = []
+            async for k, _v in col.scan():
+                keys.append(k)
+            if keys != sorted(keys) or len(keys) != len(set(keys)):
+                order_violations += 1
+            scans_completed += 1
+            last_entries = len(keys)
+        except Exception as e:
+            scan_errors += 1
+            log(f"SCAN: stream failed ({classify_error(e)}): {e!r}")
+            await asyncio.sleep(1.0)
+    await churn_task
+    await asyncio.sleep(5.0 if quick else 15.0)  # heal window
+
+    # Merge correctness under (possibly still-healing) divergence:
+    # the scan and a quorum multi_get must tell the same story for
+    # the journal's keys.
+    final = {}
+    async for k, v in col.scan():
+        final[k] = v
+    journal_keys = sorted(acks.last)[:400]
+    got = await col.multi_get(journal_keys)
+    disagree = []
+    for k, v in zip(journal_keys, got):
+        if v is None:
+            if k in final:
+                disagree.append(k)
+        elif final.get(k) != v:
+            disagree.append(k)
+    stats = await client.get_stats(
+        "127.0.0.1", nodes[0].db_port
+    )
+    block = stats.get("scan") or {}
+    client.close()
+    alive = all(n_.alive() for n_ in nodes)
+    ok_gate = (
+        alive
+        and scans_completed >= 1
+        and order_violations == 0
+        and not disagree
+        and block.get("chunks", 0) > 0
+    )
+    phase = {
+        "window_s": window_s,
+        "scans_completed": scans_completed,
+        "scan_errors_during_churn": scan_errors,
+        "order_violations": order_violations,
+        "final_scan_entries": last_entries,
+        "journal_keys_compared": len(journal_keys),
+        "scan_vs_multiget_disagreements": disagree[:10],
+        "stats_scan_block": {
+            k: block.get(k)
+            for k in (
+                "scans_started",
+                "chunks",
+                "bytes_streamed",
+                "cursor_resumes",
+                "sheds",
+                "replica_errors",
+            )
+        },
+        "nodes_alive": alive,
+        "pass": ok_gate,
+    }
+    report["scan"] = phase
+    log(f"SCAN: {phase}")
+    return ok_gate
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -1128,6 +1231,14 @@ async def main():
         "70%% of sustainable (or the node is honestly shedding with "
         "admitted p99 still bounded), and both clients surface the "
         "get_stats overload block",
+    )
+    ap.add_argument(
+        "--scan", action="store_true",
+        help="after churn: full-collection streaming scans while one "
+        "node SIGKILLs and heals mid-stream — scans must keep "
+        "completing (sorted, duplicate-free), and after the heal the "
+        "scan view must agree with quorum multi_gets of the acked "
+        "journal keys",
     )
     ap.add_argument(
         "--trace-dump-dir", default="",
@@ -1273,6 +1384,14 @@ async def main():
         # Let the shed/backlogged writes' hints drain and windows
         # recover before the byte-equality scan.
         await asyncio.sleep(min(args.quiet_window, 15.0))
+    if args.scan:
+        ok = (
+            await scan_phase(nodes, seeds, acks, report, args.quick)
+        ) and ok
+        await collect_traces(nodes, "scan", args.trace_dump_dir)
+        health_phases["scan"] = await collect_health(
+            nodes, "scan", args.trace_dump_dir
+        )
     ok = (await final_checks(nodes, acks, report)) and ok
     # Tracing plane (ISSUE 9): where did the slow tail's time go?
     final_dumps = await collect_traces(

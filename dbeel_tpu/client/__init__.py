@@ -549,6 +549,90 @@ class DbeelClient:
             "no replica reachable"
         )
 
+    # -- streaming scans ----------------------------------------------
+
+    async def _scan_chunk_request(self, request: dict) -> dict:
+        """One scan/scan_next chunk with the full failure discipline:
+        the chunk can run on ANY node (the cursor is self-contained),
+        so a dead or Overloaded coordinator walks to the next ring
+        member after capped backoff, resyncing the ring on transport
+        errors — a scan survives a coordinator restart mid-stream."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self._op_deadline_s
+        request = dict(request)
+        request["deadline_ms"] = int(
+            (time.time() + self._op_deadline_s) * 1000
+        )
+        attempt = 0
+        last_error: Optional[Exception] = None
+        while True:
+            targets = [
+                (s.ip, s.db_port) for s in self._ring
+            ] or list(self._seeds)
+            if len(targets) > 1:
+                # Rotate for load spread: scans have no owning key,
+                # any coordinator merges the same stream.
+                rot = self._rng.randrange(len(targets))
+                targets = targets[rot:] + targets[:rot]
+            for host, port in targets:
+                budget = deadline - loop.time()
+                if budget <= 0:
+                    break
+                request["timeout"] = max(
+                    100, min(5000, int(budget * 1000))
+                )
+                try:
+                    raw = await asyncio.wait_for(
+                        self._send_to(host, port, request), budget
+                    )
+                    return msgpack.unpackb(raw, raw=False)
+                except asyncio.TimeoutError:
+                    last_error = Timeout(
+                        f"scan chunk deadline "
+                        f"({self._op_deadline_s:.1f}s) exhausted"
+                    )
+                    break
+                except (
+                    DbeelError,
+                    OSError,
+                    asyncio.IncompleteReadError,
+                ) as e:
+                    last_error = e
+                    if isinstance(
+                        e, DbeelError
+                    ) and not is_retryable_class(classify_error(e)):
+                        raise  # benign/final (bad cursor, no such collection)
+                    continue
+            if loop.time() >= deadline:
+                break
+            if not isinstance(last_error, DbeelError):
+                try:
+                    await asyncio.wait_for(
+                        self.sync_metadata(),
+                        max(0.05, deadline - loop.time()),
+                    )
+                except (DbeelError, OSError, asyncio.TimeoutError):
+                    pass
+            backoff_attempt = attempt
+            if (
+                last_error is not None
+                and classify_error(last_error)
+                == ERROR_CLASS_OVERLOAD
+            ):
+                # The shard shed the chunk: the cursor survives —
+                # back off harder before resuming.
+                backoff_attempt += 2
+            pause = min(
+                self._backoff_s(backoff_attempt, self._rng),
+                max(0.0, deadline - loop.time()),
+            )
+            if pause > 0:
+                await asyncio.sleep(pause)
+            attempt += 1
+        raise last_error if last_error else ConnectionError_(
+            "no node reachable for scan"
+        )
+
     # -- batched multi-ops --------------------------------------------
 
     # Per-frame bounds: the request framing is u16-LE, so a batch
@@ -951,6 +1035,76 @@ class DbeelCollection:
                 raise payload
         return out
 
+    async def scan(
+        self,
+        prefix: Optional[bytes] = None,
+        limit: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+        trace_id: Optional[int] = None,
+    ):
+        """Streaming full/range scan (scan plane, PR 12): an async
+        generator yielding (key, value) pairs — decoded documents —
+        in raw encoded-key byte order (the storage order), merged
+        newest-wins across every replica of every ring arc with
+        tombstones excluded.  One governor-paced chunk per server
+        round trip; the resumable cursor rides inside, so the stream
+        survives Overloaded sheds and coordinator restarts.
+
+        ``prefix`` filters on the msgpack-ENCODED key bytes (pushed
+        down to the vectorized storage stage).  ``limit`` caps total
+        yielded entries; ``max_bytes`` lowers the per-chunk byte
+        budget below the server's ``--scan-bytes-per-slice``."""
+        request: dict = {"type": "scan", "collection": self.name}
+        if prefix:
+            request["prefix"] = bytes(prefix)
+        if limit:
+            request["limit"] = int(limit)
+        if max_bytes:
+            request["max_bytes"] = int(max_bytes)
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
+        while True:
+            chunk = await self.client._scan_chunk_request(request)
+            # Entries arrive as DECODED (key, value) documents: the
+            # server splices the stored encodings into the chunk
+            # payload, so the chunk's one unpack decoded everything.
+            for key, value in chunk.get("entries") or ():
+                yield key, value
+            cursor = chunk.get("cursor")
+            if not cursor:
+                return
+            request = {"type": "scan_next", "cursor": cursor}
+            if isinstance(trace_id, int) and trace_id > 0:
+                request["trace"] = trace_id
+
+    async def count(
+        self,
+        prefix: Optional[bytes] = None,
+        limit: Optional[int] = None,
+    ) -> int:
+        """Count live documents (optionally under an encoded-key
+        prefix) WITHOUT materializing a single value: replicas stream
+        keys-only pages (vectorized count pushdown), the coordinator
+        merge dedups/count them, and only the running total crosses
+        back per chunk."""
+        request: dict = {
+            "type": "scan",
+            "collection": self.name,
+            "count": True,
+        }
+        if prefix:
+            request["prefix"] = bytes(prefix)
+        if limit:
+            request["limit"] = int(limit)
+        total = 0
+        while True:
+            chunk = await self.client._scan_chunk_request(request)
+            total = int(chunk.get("count") or 0)
+            cursor = chunk.get("cursor")
+            if not cursor:
+                return total
+            request = {"type": "scan_next", "cursor": cursor}
+
     async def delete(
         self, key: Any, consistency=None,
         trace_id: Optional[int] = None,
@@ -1025,6 +1179,18 @@ class SyncCollection:
 
     def get(self, key, consistency=None):
         return self._c._run(self._col.get(key, consistency))
+
+    def scan(self, prefix=None, limit=None, max_bytes=None):
+        async def collect():
+            out = []
+            async for kv in self._col.scan(prefix, limit, max_bytes):
+                out.append(kv)
+            return out
+
+        return self._c._run(collect())
+
+    def count(self, prefix=None, limit=None):
+        return self._c._run(self._col.count(prefix, limit))
 
     def delete(self, key, consistency=None):
         self._c._run(self._col.delete(key, consistency))

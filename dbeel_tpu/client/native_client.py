@@ -156,6 +156,23 @@ def _bind(lib) -> None:
             ctypes.c_void_p,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_cli_scan_chunk"):  # scan plane (PR 12)
+        lib.dbeel_cli_scan_chunk.restype = ctypes.c_int64
+        lib.dbeel_cli_scan_chunk.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint16,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint64,
+        ]
     if hasattr(lib, "dbeel_cli_multi_set"):
         lib.dbeel_cli_multi_set.restype = ctypes.c_int64
         lib.dbeel_cli_multi_set.argtypes = [
@@ -331,6 +348,113 @@ class NativeDbeelClient:
         if n < 0:
             raise DbeelError(self._err())
         return msgpack.unpackb(bytes(buf[: int(n)]), raw=False)
+
+    def _scan_chunk(
+        self,
+        collection: str,
+        cursor: Optional[bytes],
+        count_only: bool,
+        prefix: Optional[bytes],
+        limit: int,
+        max_bytes: int,
+        ip: str = "",
+        port: int = 0,
+    ) -> dict:
+        """One raw scan chunk through the C client (retryable server
+        sheds back off and resume — the cursor is client-held)."""
+        if not hasattr(self._lib, "dbeel_cli_scan_chunk"):
+            raise DbeelError(
+                "native library predates dbeel_cli_scan_chunk"
+            )
+        cur = (
+            (ctypes.c_uint8 * len(cursor)).from_buffer_copy(cursor)
+            if cursor
+            else None
+        )
+        pfx = (
+            (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
+            if prefix
+            else None
+        )
+        cap = 1 << 20
+        backoff = 0.02
+        for attempt in range(64):
+            buf = (ctypes.c_uint8 * cap)()
+            n = self._lib.dbeel_cli_scan_chunk(
+                self._h,
+                ip.encode(),
+                port,
+                collection.encode(),
+                cur,
+                len(cursor) if cursor else 0,
+                1 if count_only else 0,
+                pfx,
+                len(prefix) if prefix else 0,
+                limit,
+                max_bytes,
+                buf,
+                cap,
+            )
+            if n <= -10:
+                cap = -int(n) - 10
+                continue
+            if n == -3 and attempt < 63:
+                # Retryable (Overloaded shed / transport): back off
+                # with the walk's jittered cap, then resume.
+                import random as _random
+                import time as _time
+
+                _time.sleep(backoff * (0.5 + 0.5 * _random.random()))
+                backoff = min(0.5, backoff * 2)
+                continue
+            break
+        if n < 0:
+            raise DbeelError(self._err())
+        return msgpack.unpackb(bytes(buf[: int(n)]), raw=False)
+
+    def scan(
+        self,
+        collection: str,
+        prefix: Optional[bytes] = None,
+        limit: int = 0,
+        max_bytes: int = 0,
+    ) -> list:
+        """Full/range streaming scan through the C client: decoded
+        (key, value) pairs in encoded-key byte order, chunked and
+        cursor-resumed under the hood (same stream semantics as the
+        Python client's ``DbeelCollection.scan``)."""
+        out: list = []
+        cursor: Optional[bytes] = None
+        while True:
+            chunk = self._scan_chunk(
+                collection, cursor, False, prefix, limit, max_bytes
+            )
+            # Entries decode with the chunk itself (spliced stored
+            # encodings — one unpack per chunk).
+            for key, value in chunk.get("entries") or ():
+                out.append((key, value))
+            cursor = chunk.get("cursor")
+            if not cursor:
+                return out
+
+    def count(
+        self,
+        collection: str,
+        prefix: Optional[bytes] = None,
+        limit: int = 0,
+    ) -> int:
+        """Live-document count via the keys-only pushdown — no value
+        bytes cross any wire."""
+        cursor: Optional[bytes] = None
+        total = 0
+        while True:
+            chunk = self._scan_chunk(
+                collection, cursor, True, prefix, limit, 0
+            )
+            total = int(chunk.get("count") or 0)
+            cursor = chunk.get("cursor")
+            if not cursor:
+                return total
 
     def create_collection(
         self, name: str, replication_factor: int = 1

@@ -101,6 +101,7 @@ class ShardRequest:
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
+    SCAN = "scan"
     REARM = "rearm"
     TELEMETRY_DIGEST = "telemetry_digest"
 
@@ -295,6 +296,42 @@ class ShardRequest:
         ]
 
     @staticmethod
+    def scan(
+        collection: str,
+        start: int,
+        end: int,
+        start_after: Optional[bytes],
+        prefix: Optional[bytes],
+        limit: int,
+        max_bytes: int,
+        with_values: bool,
+    ) -> list:
+        """Streaming scan page (scan plane, PR 12): up to ``limit``
+        entries / ``max_bytes`` emitted bytes of [key, value, ts]
+        triples whose hash falls in the half-open wrap range
+        [start, end), keys strictly > ``start_after`` (and starting
+        with ``prefix`` when given), ascending by key.  Tombstones ARE
+        included (value = b"") — the coordinator's newest-wins merge
+        needs them to suppress older live values on other replicas.
+        ``with_values=False`` elides live values as nil (count /
+        keys-only pushdown: values never cross the wire).  The
+        response's trailing ``more`` flag tells the coordinator
+        whether this replica's stream has entries beyond the page.
+        Arity is lint-pinned (shard._SCAN_PEER_ARITY)."""
+        return [
+            "request",
+            ShardRequest.SCAN,
+            collection,
+            start,
+            end,
+            start_after,
+            prefix,
+            limit,
+            max_bytes,
+            with_values,
+        ]
+
+    @staticmethod
     def range_push(collection: str, entries: list) -> list:
         """Anti-entropy batch apply: the receiver applies each
         (key, value, ts) ONLY when newer than its own newest for that
@@ -319,6 +356,7 @@ class ShardResponse:
     RANGE_DIGEST = "range_digest"
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
+    SCAN = "scan"
     REARM = "rearm"
     TELEMETRY_DIGEST = "telemetry_digest"
     ERROR = "error"
@@ -402,6 +440,12 @@ class ShardResponse:
     def range_pull(entries: list) -> list:
         # entries: [[key, value, ts], ...] sorted by key
         return ["response", ShardResponse.RANGE_PULL, entries]
+
+    @staticmethod
+    def scan(entries: list, more: bool) -> list:
+        # One scan page: [[key, value|nil, ts], ...] ascending by
+        # key; ``more`` = entries remain beyond the page's last key.
+        return ["response", ShardResponse.SCAN, entries, more]
 
     @staticmethod
     def error(err: DbeelError) -> list:

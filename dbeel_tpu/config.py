@@ -123,6 +123,18 @@ class Config:
     # 0 disables the endpoint.
     metrics_port: int = 0
 
+    # ---- Streaming scan/range query plane (PR 12) --------------------
+    # Byte budget per scan chunk (one SCAN/SCAN_NEXT response frame):
+    # the governor-paced slice size.  A client may ask for LESS via
+    # max_bytes on the scan op but never for more — one analytics
+    # scan drains the keyspace in byte-bounded, individually-admitted
+    # slices instead of one unbounded burst.
+    scan_bytes_per_slice: int = 256 << 10
+    # Concurrent scan chunks in flight per shard; beyond it new scan
+    # chunks shed with the retryable Overloaded error (the cursor
+    # survives, the client backs off and resumes).  0 disables the cap.
+    scan_max_concurrent: int = 4
+
     # Tombstone GC grace (the delete-resurrection hazard): compaction
     # refuses to drop a tombstone younger than this, so a replica that
     # missed the delete cannot resurrect the old value through hint
@@ -376,6 +388,20 @@ def build_parser() -> argparse.ArgumentParser:
         "metrics_port + shard_id; 0 disables)",
     )
     p.add_argument(
+        "--scan-bytes-per-slice",
+        type=int,
+        default=d.scan_bytes_per_slice,
+        help="byte budget per streaming-scan chunk (one response "
+        "frame; the governor-paced slice size)",
+    )
+    p.add_argument(
+        "--scan-max-concurrent",
+        type=int,
+        default=d.scan_max_concurrent,
+        help="concurrent scan chunks per shard before new ones shed "
+        "with the retryable Overloaded error (0 disables the cap)",
+    )
+    p.add_argument(
         "--gc-grace",
         type=int,
         dest="gc_grace_ms",
@@ -470,6 +496,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         telemetry_interval_ms=ns.telemetry_interval_ms,
         telemetry_ring=ns.telemetry_ring,
         metrics_port=ns.metrics_port,
+        scan_bytes_per_slice=ns.scan_bytes_per_slice,
+        scan_max_concurrent=ns.scan_max_concurrent,
         gc_grace_ms=ns.gc_grace_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,

@@ -389,6 +389,16 @@ async def handle_request(
     if rtype in ("multi_set", "multi_get"):
         return await _handle_multi(my_shard, request, timestamp, rtype)
 
+    if rtype in ("scan", "scan_next"):
+        # Streaming scan plane (PR 12): one governor-admitted chunk
+        # per frame — byte-budgeted, merged across every ring arc's
+        # replicas, resumable via the opaque cursor in the payload.
+        # Shedding/pacing and the scan stats block live in the plane;
+        # a shed surfaces as the retryable Overloaded and the CURSOR
+        # SURVIVES (it is client-held state), so the client backs off
+        # and resumes where it left.
+        return await my_shard.scan_plane.handle(request, rtype)
+
     if rtype == "get":
         ctx = trace_mod.current()
         collection_name = _extract(request, "collection")

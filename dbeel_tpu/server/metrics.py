@@ -74,6 +74,13 @@ class LatencyHistogram:
         }
 
 
+# Point data ops whose completions count as foreground activity for
+# the scan plane's chunk pacing (scan/scan_next deliberately absent).
+_POINT_DATA_OPS = frozenset(
+    {"set", "get", "delete", "multi_set", "multi_get"}
+)
+
+
 class ShardMetrics:
     """Per-shard metrics hub: request histograms by op type, a slow-op
     threshold log, and background-stage counters."""
@@ -87,6 +94,7 @@ class ShardMetrics:
     SLOW_LOG_PERIOD_S = 1.0
     # Histograms are keyed by the CLIENT-supplied request type: cap the
     # key set so garbage types can't grow shard memory / stats output.
+    # (Module-level twin lives below the class: _POINT_DATA_OPS.)
     KNOWN_OPS = frozenset(
         {
             "set",
@@ -94,6 +102,8 @@ class ShardMetrics:
             "delete",
             "multi_set",
             "multi_get",
+            "scan",
+            "scan_next",
             "create_collection",
             "drop_collection",
             "get_collection",
@@ -136,6 +146,12 @@ class ShardMetrics:
         from ..errors import ERROR_CLASSES
 
         self.errors: Dict[str, int] = {c: 0 for c in ERROR_CLASSES}
+        # Scan plane (PR 12): when this shard last completed a POINT
+        # data op — the foreground-activity signal the scan plane's
+        # chunk pacing keys off (the scan's own frames must NOT count
+        # as foreground, or scans would throttle themselves on an
+        # otherwise idle shard).
+        self.last_point_op_mono = 0.0
 
     def record_error(self, error_class: Optional[str]) -> None:
         """Count one client-visible failure by taxonomy class (None =
@@ -169,6 +185,8 @@ class ShardMetrics:
         us = int((time.monotonic() - started) * 1e6)
         if op not in self.KNOWN_OPS:
             op = "other"
+        if op in _POINT_DATA_OPS:
+            self.last_point_op_mono = time.monotonic()
         hist = self.requests.get(op)
         if hist is None:
             hist = self.requests[op] = LatencyHistogram()

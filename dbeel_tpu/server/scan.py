@@ -1,0 +1,738 @@
+"""Streaming scan/range query plane — coordinator side (PR 12).
+
+The storage layer has had ordered iteration and exact range machinery
+since the anti-entropy plane, but the only client-visible reads were
+point/multi gets: an analytics-shaped workload paid one request round
+trip per key.  This module turns the range machinery into a public,
+governed, resumable streaming query:
+
+* ``scan`` / ``scan_next`` client verbs produce CHUNKED responses —
+  one byte-budgeted chunk per request frame, with an opaque resumable
+  cursor token in the trailer (nil cursor = scan complete).  The
+  cursor is fully self-contained (collection, position, filters,
+  remaining limit), so it survives a coordinator restart, an
+  ``Overloaded`` shed, and a client fail-over to a different node.
+* The coordinator merges per-arc replica streams: for every ring arc
+  (``MyShard.all_arcs``) it pages SCAN peer frames from EVERY replica
+  of that arc (RANGE_PULL-style stateless pages, served storage-side
+  by the vectorized ScanStage), dedups equal keys newest-timestamp-
+  wins — so a healed-but-stale replica can never resurrect an old
+  value into the stream — and drops tombstone winners.  Peer pages
+  ride the pooled round-trip streams, NOT the pipelined per-op stream
+  (the same head-of-line exclusion RANGE_* has: a 256 KiB page parked
+  in front of quorum acks would stall point ops).
+* Every chunk is admitted through the governor: shed with the
+  retryable ``Overloaded`` at the hard level or past
+  ``--scan-max-concurrent``, parked (bounded) at the soft level
+  before any byte moves, and capped at ``--scan-bytes-per-slice``
+  emitted bytes — one analytics scan cannot starve point ops.
+* ``count`` / key-prefix pushdown: keys-only peer pages (live values
+  elided replica-side) mean a count or filtered key listing never
+  materializes a value anywhere.
+
+Ordering is raw encoded-key byte order (the storage order).  Chunks
+are independently-admitted point-in-time pages, not one global
+snapshot: a scan concurrent with writes sees each key's newest value
+as of the chunk that covered it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from bisect import bisect_left as _bisect_left
+from itertools import accumulate as _accumulate
+from operator import itemgetter
+from typing import List, Optional
+
+import msgpack
+
+from ..cluster.local_comm import LocalShardConnection
+from ..cluster.messages import ShardRequest, ShardResponse
+from ..errors import (
+    BadFieldType,
+    DbeelError,
+    Overloaded,
+    PeerDead,
+    ProtocolError,
+    from_wire,
+)
+from . import trace as trace_mod
+
+_key0 = itemgetter(0)
+
+CURSOR_VERSION = "s1"
+
+# Per-stream page bounds: entries per SCAN peer frame, and the floor
+# of the per-stream byte budget (the chunk budget splits across arcs;
+# tiny splits would turn one chunk into dozens of round trips).
+PAGE_MAX_ENTRIES = 4096
+PAGE_MIN_BYTES = 16 << 10
+
+# Soft-level pacing: scans park in these slices (bounded) while the
+# governor reads soft overload — point ops drain first, the scan
+# resumes the moment pressure lifts (bg_gate's discipline with
+# scan-plane accounting).
+PACE_SLICE_S = 0.05
+PACE_MAX_S = 2.0
+
+# Share pacing (the bg_slice discipline at CHUNK granularity): while
+# POINT data ops completed within this window, each served chunk pays
+# back ``elapsed * fg/bg`` of idle before the next chunk is admitted
+# — scans get the background share of the CPU while point traffic is
+# live and the whole CPU when the shard is otherwise idle.  Keyed off
+# metrics.last_point_op_mono, NOT the scheduler's fg window: the
+# scan's own chunk frames mark that window, and using it would make
+# scans throttle themselves on an idle shard (measured 4-5x).
+PACE_POINT_WINDOW_S = 0.25
+PACE_PAYBACK_MAX_S = 0.5
+
+# Wire overhead charged per emitted entry (mirrors the storage-side
+# budget accounting).
+ENTRY_OVERHEAD = 16
+
+_NO_LIMIT = -1
+
+
+def _mp_array_header(n: int) -> bytes:
+    if n <= 15:
+        return bytes([0x90 | n])
+    if n <= 0xFFFF:
+        return b"\xdc" + n.to_bytes(2, "big")
+    return b"\xdd" + n.to_bytes(4, "big")
+
+
+def pack_chunk(
+    entry_parts: list, n_entries: int, cursor, count: int
+) -> bytes:
+    """The chunk payload {"entries": [[key, value], ...], "cursor":
+    bin|nil, "count": n} — built by SPLICING the stored key/value
+    encodings directly into the stream (they already ARE msgpack
+    documents), so the client's single unpack of the chunk decodes
+    every document in one C call instead of paying two per-entry
+    unpackb round trips.  ``entry_parts`` arrives as the merge
+    loop's pre-built fragment list (fixarray(2) marker + key bytes +
+    value bytes per entry) so packing is one join, not a second
+    per-entry pass.  Byte-identical to what packb would produce for
+    the decoded structure."""
+    parts = [
+        b"\x83",  # fixmap(3)
+        b"\xa7entries",
+        _mp_array_header(n_entries),
+    ]
+    parts += entry_parts
+    parts.append(b"\xa6cursor")
+    parts.append(msgpack.packb(cursor, use_bin_type=True))
+    parts.append(b"\xa5count")
+    parts.append(msgpack.packb(int(count)))
+    return b"".join(parts)
+
+
+def encode_cursor(
+    collection: str,
+    last_key: Optional[bytes],
+    prefix: Optional[bytes],
+    remaining: int,
+    count_mode: bool,
+    acc_count: int,
+    max_bytes: int,
+) -> bytes:
+    """Opaque resumable cursor: self-contained, so ANY node can
+    continue the scan — across coordinator restarts and Overloaded
+    retries."""
+    return msgpack.packb(
+        [
+            CURSOR_VERSION,
+            collection,
+            last_key,
+            prefix,
+            remaining,
+            count_mode,
+            acc_count,
+            max_bytes,
+        ],
+        use_bin_type=True,
+    )
+
+
+def decode_cursor(raw) -> dict:
+    if not isinstance(raw, (bytes, bytearray)):
+        raise BadFieldType("cursor")
+    try:
+        w = msgpack.unpackb(bytes(raw), raw=False)
+    except Exception as e:
+        raise BadFieldType(f"cursor: {e}") from e
+    if (
+        not isinstance(w, list)
+        or len(w) != 8
+        or w[0] != CURSOR_VERSION
+        or not isinstance(w[1], str)
+    ):
+        raise BadFieldType("cursor: unknown version or shape")
+    return {
+        "collection": w[1],
+        "last_key": bytes(w[2]) if w[2] is not None else None,
+        "prefix": bytes(w[3]) if w[3] else None,
+        "remaining": int(w[4]),
+        "count": bool(w[5]),
+        "acc": int(w[6]),
+        "max_bytes": int(w[7]),
+    }
+
+
+class _ArcStream:
+    """One replica's paged stream over one ring arc."""
+
+    __slots__ = (
+        "arc_id",
+        "start",
+        "end",
+        "shard",
+        "node_name",
+        "buffer",
+        "more",
+        "cover",
+        "start_after",
+        "dead",
+        "error",
+    )
+
+    def __init__(self, arc_id, start, end, shard, start_after):
+        self.arc_id = arc_id
+        self.start = start
+        self.end = end
+        self.shard = shard  # Shard ring entry; None = serve locally
+        self.node_name = shard.node_name if shard is not None else None
+        self.buffer: list = []
+        self.more = True
+        self.cover: Optional[bytes] = None
+        self.start_after = start_after
+        self.dead = False
+        self.error: Optional[Exception] = None
+
+
+def _scan_result(resp) -> tuple:
+    """(entries, more) out of a SCAN peer response list."""
+    if (
+        not isinstance(resp, (list, tuple))
+        or len(resp) < 2
+        or resp[0] != "response"
+    ):
+        raise ProtocolError(f"not a response: {resp!r}")
+    if resp[1] == ShardResponse.ERROR:
+        raise from_wire(resp[2:4])
+    if resp[1] != ShardResponse.SCAN or len(resp) < 4:
+        raise ProtocolError(f"expected scan response, got {resp[1]!r}")
+    entries = resp[2] if isinstance(resp[2], (list, tuple)) else []
+    return entries, bool(resp[3])
+
+
+class ScanPlane:
+    """Per-shard scan admission, pacing, merge, and counters
+    (exported as ``get_stats.scan``)."""
+
+    def __init__(self, shard, config) -> None:
+        self.shard = shard
+        self.config = config
+        self.scans_started = 0
+        self.chunks = 0
+        self.entries_streamed = 0
+        self.bytes_streamed = 0
+        self.cursor_resumes = 0
+        self.sheds = 0
+        self.paced = 0
+        self.paced_s = 0.0
+        self.active_scans = 0
+        self.replica_errors = 0
+        self.pages_pulled = 0
+        self.counts_served = 0
+
+    def stats(self) -> dict:
+        return {
+            "scans_started": self.scans_started,
+            "chunks": self.chunks,
+            "entries_streamed": self.entries_streamed,
+            "bytes_streamed": self.bytes_streamed,
+            "cursor_resumes": self.cursor_resumes,
+            "sheds": self.sheds,
+            "paced": self.paced,
+            "paced_s": round(self.paced_s, 3),
+            "active_scans": self.active_scans,
+            "replica_errors": self.replica_errors,
+            "pages_pulled": self.pages_pulled,
+            "counts_served": self.counts_served,
+            "max_concurrent": self.config.scan_max_concurrent,
+            "bytes_per_slice": self.config.scan_bytes_per_slice,
+        }
+
+    # -- admission -----------------------------------------------------
+
+    def _shed(self, why: str):
+        self.sheds += 1
+        return Overloaded(f"scan chunk shed: {why}")
+
+    async def _admit(self, ctx) -> None:
+        gov = self.shard.governor
+        if gov.should_shed():
+            raise self._shed(
+                f"shard {self.shard.shard_name} at hard overload"
+            )
+        cap = self.config.scan_max_concurrent
+        # The caller already incremented active_scans (so chunks
+        # PARKED in the pacing wait below still hold a slot — a soft
+        # window must not let an unbounded backlog of chunks through
+        # the cap when pressure lifts): shed when we are the cap+1th.
+        if cap > 0 and self.active_scans > cap:
+            raise self._shed(
+                f"{self.active_scans - 1} scan chunks already in "
+                "flight"
+            )
+        if gov.soft_overloaded():
+            # Park first: scans are the lowest lane.  Bounded — the
+            # scan resumes (slower) under sustained soft pressure
+            # rather than starving outright.
+            self.paced += 1
+            waited = 0.0
+            while waited < PACE_MAX_S and gov.soft_overloaded():
+                if gov.should_shed():
+                    raise self._shed(
+                        "hard overload during scan pacing"
+                    )
+                await asyncio.sleep(PACE_SLICE_S)
+                waited += PACE_SLICE_S
+            self.paced_s += waited
+        if ctx is not None:
+            ctx.mark("pace")
+
+    # -- entry point ---------------------------------------------------
+
+    async def handle(self, request: dict, rtype: str) -> bytes:
+        """One scan/scan_next client frame → one chunk payload."""
+        my_shard = self.shard
+        deadline_ms = request.get("deadline_ms")
+        if (
+            isinstance(deadline_ms, int)
+            and deadline_ms > 0
+            and time.time() * 1000.0 > deadline_ms
+        ):
+            my_shard.governor.deadline_drops += 1
+            raise Overloaded(
+                "client deadline expired before the scan chunk ran"
+            )
+        if rtype == "scan":
+            collection = request.get("collection")
+            if not isinstance(collection, str):
+                raise BadFieldType("collection")
+            prefix = request.get("prefix")
+            prefix = bytes(prefix) if prefix else None
+            limit = request.get("limit")
+            remaining = (
+                int(limit)
+                if isinstance(limit, int) and limit > 0
+                else _NO_LIMIT
+            )
+            count_mode = bool(request.get("count"))
+            mb = request.get("max_bytes")
+            max_bytes = int(mb) if isinstance(mb, int) and mb > 0 else 0
+            last_key = None
+            acc = 0
+            self.scans_started += 1
+        else:  # scan_next
+            cur = decode_cursor(request.get("cursor"))
+            collection = cur["collection"]
+            prefix = cur["prefix"]
+            remaining = cur["remaining"]
+            count_mode = cur["count"]
+            max_bytes = cur["max_bytes"]
+            last_key = cur["last_key"]
+            acc = cur["acc"]
+            self.cursor_resumes += 1
+
+        ctx = trace_mod.current()
+        col = my_shard.get_collection(collection)
+        # Hold the concurrency slot across BOTH admission (incl. the
+        # soft-level park) and the chunk itself: _admit's cap check
+        # counts this increment, so parked chunks cannot pile past
+        # the cap and stampede when pressure lifts.
+        self.active_scans += 1
+        try:
+            await self._admit(ctx)
+            return await self._chunk(
+                col,
+                collection,
+                last_key,
+                prefix,
+                remaining,
+                count_mode,
+                acc,
+                max_bytes,
+                ctx,
+            )
+        finally:
+            # Pacing happens per merge round inside _chunk.
+            self.active_scans -= 1
+
+    async def _pay_share(self, elapsed: float, ctx) -> None:
+        """Share payback at merge-ROUND granularity (the bg_slice
+        discipline): while point ops are live, each round of scan
+        work idles ``elapsed * fg/bg`` before the next — scans get
+        the background CPU share under point traffic and the whole
+        CPU when the shard is otherwise idle, and the loop occupancy
+        between paybacks stays one round (~a page), not one chunk,
+        so queued point ops interleave at page cadence."""
+        sched = self.shard.scheduler
+        if (
+            time.monotonic()
+            - self.shard.metrics.last_point_op_mono
+            > PACE_POINT_WINDOW_S
+        ):
+            return
+        pause = min(
+            elapsed * (sched.fg_shares / sched.bg_shares),
+            PACE_PAYBACK_MAX_S,
+        )
+        if pause <= 0:
+            return
+        self.paced += 1
+        self.paced_s += pause
+        await asyncio.sleep(pause)
+        if ctx is not None:
+            ctx.mark("pace")
+
+    # -- peer paging ---------------------------------------------------
+
+    async def _fetch_page(
+        self,
+        s: _ArcStream,
+        collection: str,
+        page_bytes: int,
+        prefix,
+        with_values,
+    ) -> None:
+        my_shard = self.shard
+        req = ShardRequest.scan(
+            collection,
+            s.start,
+            s.end,
+            s.start_after,
+            prefix,
+            PAGE_MAX_ENTRIES,
+            page_bytes,
+            with_values,
+        )
+        if s.shard is None:
+            resp = await my_shard.handle_shard_request(req)
+        elif isinstance(s.shard.connection, LocalShardConnection):
+            resp = await s.shard.connection.send_request(
+                my_shard.id, req
+            )
+        else:
+            resp = await s.shard.connection.send_request(req)
+        entries, more = _scan_result(resp)
+        self.pages_pulled += 1
+        # Entries arrive as [key, value|nil, ts] lists with bytes
+        # keys/values both over the wire (msgpack bin) and from the
+        # in-process local path — no per-entry normalization.
+        s.buffer = (
+            entries if isinstance(entries, list) else list(entries)
+        )
+        s.more = more and bool(s.buffer)
+        if s.buffer:
+            s.cover = s.buffer[-1][0]
+            s.start_after = s.cover
+        if not s.buffer:
+            s.more = False
+
+    # -- chunk assembly ------------------------------------------------
+
+    async def _chunk(
+        self,
+        col,
+        collection: str,
+        last_key: Optional[bytes],
+        prefix: Optional[bytes],
+        remaining: int,
+        count_mode: bool,
+        acc: int,
+        max_bytes: int,
+        ctx,
+    ) -> bytes:
+        my_shard = self.shard
+        cfg = self.config
+        budget = cfg.scan_bytes_per_slice
+        if max_bytes > 0:
+            budget = min(budget, max_bytes)
+        with_values = not count_mode
+
+        arcs = my_shard.all_arcs(col.replication_factor)
+        streams: List[_ArcStream] = []
+        for arc_id, (start, end, selected) in enumerate(arcs):
+            for shard in selected:
+                s = _ArcStream(
+                    arc_id,
+                    start,
+                    end,
+                    None
+                    if shard.name == my_shard.shard_name
+                    else shard,
+                    last_key,
+                )
+                if (
+                    s.node_name is not None
+                    and s.node_name in my_shard.dead_nodes
+                ):
+                    # Detector-Dead replica: never dial (the usual
+                    # fast-fail); the arc's other replicas carry it.
+                    s.dead = True
+                    s.error = PeerDead(
+                        f"scan replica {s.node_name} marked Dead"
+                    )
+                streams.append(s)
+        page_bytes = max(PAGE_MIN_BYTES, budget // max(1, len(arcs)))
+
+        # Emitted entries accumulate directly as splice fragments
+        # (fixarray(2) + key + value per entry) — pack_chunk joins
+        # them without a second per-entry pass.
+        emitted_parts: list = []
+        emitted_n = 0
+        out_bytes = 0
+        count = acc
+        done = False
+        limit_hit = False
+
+        while not done and not limit_hit and out_bytes < budget:
+            t_round = time.monotonic()
+            need = [
+                s
+                for s in streams
+                if not s.dead and s.more and not s.buffer
+            ]
+            if need:
+                results = await asyncio.gather(
+                    *(
+                        self._fetch_page(
+                            s,
+                            collection,
+                            page_bytes,
+                            prefix,
+                            with_values,
+                        )
+                        for s in need
+                    ),
+                    return_exceptions=True,
+                )
+                for s, r in zip(need, results):
+                    if isinstance(r, BaseException):
+                        if isinstance(r, asyncio.CancelledError):
+                            raise r
+                        s.dead = True
+                        s.error = r
+                        self.replica_errors += 1
+                # Arc liveness: a chunk is only correct when at least
+                # one replica of EVERY arc is still streaming.
+                for arc_id in range(len(arcs)):
+                    arc_streams = [
+                        s for s in streams if s.arc_id == arc_id
+                    ]
+                    if arc_streams and all(
+                        s.dead for s in arc_streams
+                    ):
+                        err = next(
+                            (
+                                s.error
+                                for s in arc_streams
+                                if s.error is not None
+                            ),
+                            None,
+                        )
+                        if isinstance(err, DbeelError):
+                            raise err
+                        raise PeerDead(
+                            f"scan: every replica of arc {arc_id} "
+                            f"failed: {err!r}"
+                        )
+                if ctx is not None:
+                    ctx.mark("iterate")
+            live = [s for s in streams if not s.dead]
+            # Coverage bound: keys <= bound are COMPLETE across every
+            # stream (a stream with more entries has produced all of
+            # its keys up to its cover).  None = every stream drained.
+            bound: Optional[bytes] = None
+            for s in live:
+                if s.more and (bound is None or s.cover < bound):
+                    bound = s.cover
+            batch: list = []
+            for s in live:
+                buf = s.buffer
+                if bound is None:
+                    if buf:
+                        batch.extend(buf)
+                        s.buffer = []
+                else:
+                    i = 0
+                    while i < len(buf) and buf[i][0] <= bound:
+                        i += 1
+                    if i:
+                        batch.extend(buf[:i])
+                        s.buffer = buf[i:]
+            if not batch:
+                if all(
+                    not s.more and not s.buffer for s in live
+                ):
+                    done = True
+                await self._pay_share(
+                    time.monotonic() - t_round, ctx
+                )
+                continue
+            arcs_live: dict = {}
+            for s in live:
+                arcs_live[s.arc_id] = arcs_live.get(s.arc_id, 0) + 1
+            if max(arcs_live.values()) == 1:
+                # Fast path — one live stream per arc (the RF=1
+                # shape): every key appears in exactly one stream, so
+                # no cross-stream dedup — the round reduces to one
+                # C-level sort plus sliced tombstone-filter /
+                # cumulative-size emits.  The 768-entry slices bound
+                # loop occupancy between yields (the isolation gate)
+                # while per-entry cost stays at C speed (the
+                # throughput gate).
+                batch.sort(key=_key0)
+                cut = False
+                idx = 0
+                nb = len(batch)
+                while idx < nb and not cut and not limit_hit:
+                    sl = batch[idx : idx + 768]
+                    idx += len(sl)
+                    live_entries = [
+                        e
+                        for e in sl
+                        if e[1] is None or len(e[1]) != 0
+                    ]
+                    if live_entries:
+                        if count_mode:
+                            sizes = [
+                                len(e[0]) + ENTRY_OVERHEAD
+                                for e in live_entries
+                            ]
+                        else:
+                            sizes = [
+                                len(e[0])
+                                + ENTRY_OVERHEAD
+                                + (
+                                    len(e[1])
+                                    if e[1] is not None
+                                    else 0
+                                )
+                                for e in live_entries
+                            ]
+                        cum = list(_accumulate(sizes))
+                        m = (
+                            _bisect_left(
+                                cum, budget - out_bytes
+                            )
+                            + 1
+                        )
+                        m = min(m, len(live_entries))
+                        if remaining != _NO_LIMIT:
+                            m = min(m, remaining)
+                        take = live_entries[:m]
+                        count += m
+                        if m:
+                            out_bytes += cum[m - 1]
+                            if not count_mode:
+                                emitted_n += m
+                                emitted_parts.extend(
+                                    x
+                                    for e in take
+                                    for x in (
+                                        b"\x92", e[0], e[1],
+                                    )
+                                )
+                            last_key = take[-1][0]
+                            if remaining != _NO_LIMIT:
+                                remaining -= m
+                                if remaining <= 0:
+                                    limit_hit = True
+                        if m < len(live_entries):
+                            # Budget cut mid-slice: the cursor must
+                            # not skip the unemitted tail (the rest
+                            # of the batch re-pulls next chunk).
+                            cut = True
+                    await asyncio.sleep(0)
+                if not cut and not limit_hit and nb:
+                    # Whole batch processed: the cursor covers any
+                    # trailing tombstones too.
+                    last_key = batch[-1][0]
+            else:
+                # Replicated arcs under divergence: per-key dedup,
+                # newest timestamp wins, tombstone winners drop.
+                batch.sort(key=lambda e: (e[0], -e[2]))
+                i = 0
+                n = len(batch)
+                while i < n:
+                    key = batch[i][0]
+                    best = batch[i]
+                    i += 1
+                    while i < n and batch[i][0] == key:
+                        if batch[i][2] > best[2]:
+                            best = batch[i]
+                        i += 1
+                    last_key = key
+                    value = best[1]
+                    if value is not None and len(value) == 0:
+                        continue  # tombstone wins: key is deleted
+                    count += 1
+                    if count_mode:
+                        out_bytes += len(key) + ENTRY_OVERHEAD
+                    else:
+                        emitted_n += 1
+                        emitted_parts.append(b"\x92")
+                        emitted_parts.append(key)
+                        emitted_parts.append(value)
+                        out_bytes += (
+                            len(key)
+                            + (
+                                len(value)
+                                if value is not None
+                                else 0
+                            )
+                            + ENTRY_OVERHEAD
+                        )
+                    if remaining != _NO_LIMIT:
+                        remaining -= 1
+                        if remaining <= 0:
+                            limit_hit = True
+                            break
+                    if out_bytes >= budget:
+                        break
+            if ctx is not None:
+                ctx.mark("merge")
+            # Cooperative slice + share payback: one merge round can
+            # touch thousands of entries — yield so queued point ops
+            # interleave between rounds, and while point traffic is
+            # live pay back the round's share debt before the next.
+            await asyncio.sleep(0)
+            await self._pay_share(
+                time.monotonic() - t_round, ctx
+            )
+
+        self.chunks += 1
+        self.entries_streamed += emitted_n
+        self.bytes_streamed += out_bytes
+        cursor = None
+        if not done and not limit_hit:
+            cursor = encode_cursor(
+                collection,
+                last_key,
+                prefix,
+                remaining,
+                count_mode,
+                count,
+                max_bytes,
+            )
+        if cursor is None and count_mode:
+            self.counts_served += 1
+        # Splice-encoded: stored key/value encodings go into the
+        # payload verbatim, so the client decodes the whole chunk in
+        # ONE unpack call.
+        return pack_chunk(emitted_parts, emitted_n, cursor, count)

@@ -221,6 +221,12 @@ class MyShard:
 
         self.governor = LoadGovernor(self, config)
         self.scheduler.overload_gate = self.governor.bg_gate
+        # Streaming scan/range query plane (PR 12): chunked, cursor-
+        # resumable scans merged across every ring arc's replicas,
+        # admitted chunk-by-chunk through the governor.
+        from .scan import ScanPlane
+
+        self.scan_plane = ScanPlane(self, config)
         # Continuous telemetry plane (PR 11): per-shard time-series
         # ring + health watchdog.  Constructed unconditionally so the
         # get_stats schema never depends on the knob; sampling only
@@ -881,6 +887,9 @@ class MyShard:
                     else None
                 ),
             },
+            # Streaming scan plane (PR 12): chunk/byte/cursor/shed
+            # counters + the active-chunks gauge.
+            "scan": self.scan_plane.stats(),
             "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
@@ -1744,6 +1753,15 @@ class MyShard:
         ShardRequest.MULTI_GET: 5,
     }
 
+    # Fixed arity of the SCAN peer frame (scan plane, PR 12):
+    # ["request","scan",collection,start,end,start_after,prefix,
+    #  limit,max_bytes,with_values].  No trailing deadline/trace
+    # dialects — scan pages ride pooled round trips like the RANGE_*
+    # family (the chunk-level deadline lives on the CLIENT frame).
+    # Lint-pinned against the encoder and both C sources
+    # (analysis/wire_parity.py).
+    _SCAN_PEER_ARITY = 10
+
     @classmethod
     def peer_trace_id(cls, request) -> Optional[int]:
         """Trace id a coordinator stamped on this peer frame, or None.
@@ -1937,6 +1955,44 @@ class MyShard:
                         nb,
                     )
             return ShardResponse.range_pull(entries)
+        if kind == ShardRequest.SCAN:
+            # Streaming scan page (scan plane, PR 12): one ordered,
+            # byte-bounded page of this shard's entries in the arc —
+            # served by the vectorized ScanStage (per-entry fallback),
+            # tombstones included so the coordinator merge can
+            # suppress stale live values.  Deliberately NOT under
+            # scheduler.bg_slice: the chunk was already admitted and
+            # paced by the COORDINATOR's governor (shed at hard, park
+            # at soft, byte-budgeted slices), and the unit payback would
+            # throttle the scan against its own chunk frames' fg
+            # marks (measured: 4x idle per page).  Page cost is
+            # bounded by the byte clamp + cooperative yields inside
+            # scan_page.  Clamps mirror RANGE_PULL's: peer-supplied
+            # sizes must not become allocation levers.
+            col = self.collections.get(request[2])
+            entries: list = []
+            more = False
+            if col is not None:
+                start_after = (
+                    bytes(request[5])
+                    if request[5] is not None
+                    else None
+                )
+                prefix = bytes(request[6]) if request[6] else None
+                limit = max(1, min(int(request[7]), 65536))
+                max_bytes = max(
+                    4096, min(int(request[8]), 16 << 20)
+                )
+                entries, more = await col.tree.scan_page(
+                    int(request[3]),
+                    int(request[4]),
+                    start_after,
+                    prefix,
+                    limit,
+                    max_bytes,
+                    bool(request[9]),
+                )
+            return ShardResponse.scan(entries, more)
         if kind == ShardRequest.RANGE_PUSH:
             col = self.collections.get(request[2])
             if col is None:
@@ -1978,67 +2034,13 @@ class MyShard:
     # alongside hinted handoff and read repair, both also added here)
     # ------------------------------------------------------------------
 
-    def replica_arcs(
-        self, rf: int
+    @staticmethod
+    def _merge_adjacent_arcs(
+        arcs: List[list],
     ) -> List[Tuple[int, int, List[Shard]]]:
-        """The EXACT owned-range union for this shard under the
-        distinct-node replica walk, as (start, end, peer_shards)
-        arcs: for every ring arc, the walk from the arc's owning
-        ring point selects one shard per distinct node until ``rf``
-        nodes; arcs where THIS shard is selected are owned, and
-        ``peer_shards`` are the other selected shards (the replicas
-        that must agree with us over that arc).
-
-        Bounds come back +1-shifted into the half-open [start, end)
-        form the anti-entropy filters take; start == end means the
-        whole ring.  Adjacent arcs with identical peer sets merge,
-        so the common single-shard-per-node ring costs ~rf arcs.
-
-        Replaces the (rf-th-distinct-predecessor, self] arc, which
-        under interleaved multi-shard nodes over-approximates the
-        union (ROADMAP open item) — importing ranges this shard can
-        never serve and missing none, but paying transfer for them.
-        Shared by quarantine repair and the background anti-entropy
-        loop so their notion of "what this shard stores" can never
-        diverge.  Property-tested against owns_key in
-        tests/test_convergence.py."""
-        ring = self._hash_sorted
-        n = len(ring)
-        shifted_self = (self.hash + 1) & 0xFFFFFFFF
-        if n < 2:
-            return [(shifted_self, shifted_self, [])]
-        arcs: List[list] = []
-        for i in range(n):
-            # Arc (ring[i-1].hash, ring[i].hash]: the walk starts at
-            # ring[i] (first shard at/after every hash in the arc).
-            nodes: set = set()
-            selected: List[Shard] = []
-            for off in range(n):
-                s = ring[(i + off) % n]
-                if s.node_name in nodes:
-                    continue
-                nodes.add(s.node_name)
-                selected.append(s)
-                if len(nodes) >= rf:
-                    break
-            if not any(s.name == self.shard_name for s in selected):
-                continue
-            peers = [
-                s
-                for s in selected
-                if s.name != self.shard_name
-                and s.node_name != self.config.name
-            ]
-            arcs.append(
-                [
-                    (ring[i - 1].hash + 1) & 0xFFFFFFFF,
-                    (ring[i].hash + 1) & 0xFFFFFFFF,
-                    peers,
-                ]
-            )
-        # Merge ring-adjacent arcs with identical peer sets (arcs are
-        # in sorted-ring order, so arc i's end is arc i+1's start;
-        # the (last, first) pair wraps).
+        """Merge ring-adjacent arcs with identical shard-name sets
+        (arcs arrive in sorted-ring order, so arc i's end is arc
+        i+1's start; the (last, first) pair wraps)."""
         merged: List[list] = []
         for arc in arcs:
             if (
@@ -2059,6 +2061,89 @@ class MyShard:
             merged[0][0] = merged[-1][0]
             merged.pop()
         return [(s, e, p) for s, e, p in merged]
+
+    def all_arcs(
+        self, rf: int
+    ) -> List[Tuple[int, int, List[Shard]]]:
+        """EVERY ring arc with its full rf-distinct-node replica
+        shard set, as (start, end, selected_shards) — the whole-ring
+        generalization of ``replica_arcs`` the streaming scan plane
+        merges across: for every arc, ``selected_shards`` are the
+        shards (possibly including THIS one) the distinct-node walk
+        from the arc's owning ring point selects.  Bounds are
+        +1-shifted half-open [start, end); start == end means the
+        whole ring.  Adjacent arcs with identical shard sets merge."""
+        ring = self._hash_sorted
+        n = len(ring)
+        if n == 0:
+            return []
+        shifted = (ring[0].hash + 1) & 0xFFFFFFFF
+        if n == 1:
+            return [(shifted, shifted, [ring[0]])]
+        arcs: List[list] = []
+        for i in range(n):
+            # Arc (ring[i-1].hash, ring[i].hash]: the walk starts at
+            # ring[i] (first shard at/after every hash in the arc).
+            nodes: set = set()
+            selected: List[Shard] = []
+            for off in range(n):
+                s = ring[(i + off) % n]
+                if s.node_name in nodes:
+                    continue
+                nodes.add(s.node_name)
+                selected.append(s)
+                if len(nodes) >= rf:
+                    break
+            arcs.append(
+                [
+                    (ring[i - 1].hash + 1) & 0xFFFFFFFF,
+                    (ring[i].hash + 1) & 0xFFFFFFFF,
+                    selected,
+                ]
+            )
+        return self._merge_adjacent_arcs(arcs)
+
+    def replica_arcs(
+        self, rf: int
+    ) -> List[Tuple[int, int, List[Shard]]]:
+        """The EXACT owned-range union for this shard under the
+        distinct-node replica walk, as (start, end, peer_shards)
+        arcs: for every ring arc, the walk from the arc's owning
+        ring point selects one shard per distinct node until ``rf``
+        nodes; arcs where THIS shard is selected are owned, and
+        ``peer_shards`` are the other selected shards (the replicas
+        that must agree with us over that arc).
+
+        Bounds come back +1-shifted into the half-open [start, end)
+        form the anti-entropy filters take; start == end means the
+        whole ring.  Adjacent arcs with identical peer sets merge,
+        so the common single-shard-per-node ring costs ~rf arcs.
+
+        Replaces the (rf-th-distinct-predecessor, self] arc, which
+        under interleaved multi-shard nodes over-approximates the
+        union (ROADMAP open item) — importing ranges this shard can
+        never serve and missing none, but paying transfer for them.
+        Shared by quarantine repair, the background anti-entropy
+        loop, and (via ``all_arcs``) the scan plane's merge, so their
+        notion of "what a shard stores" can never diverge.
+        Property-tested against owns_key in tests/test_convergence.py."""
+        ring = self._hash_sorted
+        n = len(ring)
+        shifted_self = (self.hash + 1) & 0xFFFFFFFF
+        if n < 2:
+            return [(shifted_self, shifted_self, [])]
+        arcs: List[list] = []
+        for start, end, selected in self.all_arcs(rf):
+            if not any(s.name == self.shard_name for s in selected):
+                continue
+            peers = [
+                s
+                for s in selected
+                if s.name != self.shard_name
+                and s.node_name != self.config.name
+            ]
+            arcs.append([start, end, peers])
+        return self._merge_adjacent_arcs(arcs)
 
     @staticmethod
     async def apply_if_newer(

@@ -192,6 +192,12 @@ class _RemoteShardProtocol(framed.FramedServerProtocol):
                 msgs.ShardRequest.RANGE_DIGEST,
                 msgs.ShardRequest.RANGE_PULL,
                 msgs.ShardRequest.RANGE_PUSH,
+                # Scan pages are governed background work too: the
+                # coordinator admitted the chunk; the replica-side
+                # page must not mark foreground activity or the
+                # bg_slice it runs under would throttle against the
+                # very request it serves.
+                msgs.ShardRequest.SCAN,
             )
         ):
             my_shard.scheduler.fg_mark()

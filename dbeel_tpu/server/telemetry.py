@@ -159,6 +159,11 @@ _RATES = {
     "hints_recorded_per_s": ("convergence.hints_recorded",),
     "keys_healed_per_s": ("convergence.keys_healed",),
     "wal_sync_errors_per_s": ("wal_fsync_errors",),
+    # Scan plane (PR 12): chunk/byte throughput and admission
+    # refusals of the streaming query lane.
+    "scan_chunks_per_s": ("scan.chunks",),
+    "scan_bytes_per_s": ("scan.bytes_streamed",),
+    "scan_sheds_per_s": ("scan.sheds",),
 }
 
 
@@ -334,6 +339,12 @@ STICKY_DEGRADED_WINDOWS = 2
 # capacity means the flight recorder turned over completely between
 # two telemetry samples — dumps no longer cover the window.
 TRACE_CHURN_FACTOR = 1.0
+# Scan storm: the scan lane refusing chunks at a sustained rate —
+# analytics load exceeding --scan-max-concurrent / arriving during
+# overload.  The point-op planes are protected by design (that is
+# what the sheds mean); the finding tells the operator WHY their
+# scans crawl.
+SCAN_STORM_SHEDS_PER_S = 5.0
 
 _FINDING_LOG_PERIOD_S = 1.0
 
@@ -464,6 +475,24 @@ class HealthWatchdog:
                 dead[-1],
                 f"dead-completion fraction climbing: {dead[0]:.2f} -> "
                 f"{dead[-1]:.2f}",
+            )
+
+        # scan_storm: the streaming-scan lane is refusing chunks at a
+        # sustained rate — scans beyond the concurrency cap or
+        # arriving into an overloaded shard.  Point ops are safe (the
+        # shed IS the protection); the finding names the pressure.
+        scan_sheds = rates.get("scan_sheds_per_s")
+        if (
+            scan_sheds is not None
+            and scan_sheds > SCAN_STORM_SHEDS_PER_S
+        ):
+            add(
+                "scan_storm",
+                "warn",
+                scan_sheds,
+                f"scan lane shedding {scan_sheds:.0f} chunks/s (> "
+                f"{SCAN_STORM_SHEDS_PER_S:.0f}) — analytics load "
+                "exceeds the scan lanes",
             )
 
         # trace_ring_churn: the flight recorder turned over completely

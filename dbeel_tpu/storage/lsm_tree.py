@@ -239,6 +239,13 @@ class LSMTree:
         # finish_repair must not race them when deleting quarantine/.
         self._retire_tasks: set = set()
 
+        # Streaming scan plane (PR 12): cached vectorized scan stage
+        # (key-sorted deduplicated columns) + the validity token and
+        # the sstable-list reader ref that pins its files.
+        self._scan_stage = None
+        self._scan_stage_key: Optional[tuple] = None
+        self._scan_stage_list: Optional[SSTableList] = None
+
         self.flush_start_event = LocalEvent()
         self.flush_done_event = LocalEvent()
         self.flow = flow_events.FlowEventNotifier()
@@ -389,6 +396,13 @@ class LSMTree:
         self._notify_write_state()
 
     def _notify_write_state(self) -> None:
+        # Scan plane: every write-state change (flush swap, table-list
+        # swap, quarantine) invalidates the cached scan stage HERE —
+        # not lazily on the next scan — because compaction and
+        # quarantine retirement wait for the old list's readers to
+        # drain, and a cached stage's reader ref with no scan running
+        # would stall them indefinitely.
+        self._drop_scan_stage()
         if self.write_state_listener is not None:
             try:
                 self.write_state_listener(self)
@@ -639,6 +653,7 @@ class LSMTree:
             # the next open()'s recovery listing.
             self._disposing_wal.join_disposed()
             self._disposing_wal = None
+        self._drop_scan_stage()
         for t in self._sstables.tables:
             t.close()
 
@@ -1436,6 +1451,227 @@ class LSMTree:
 
     def iter(self) -> AsyncIterator[Tuple[bytes, bytes, int]]:
         return self.iter_filter(None)
+
+    # ------------------------------------------------------------------
+    # Streaming scan pages (scan plane, PR 12): batched columnar
+    # iteration through a cached ScanStage — the vectorized
+    # range-digest staging generalized to ordered, value-bearing
+    # pages.  Chunks of one cursor walk hit the same stage; any write
+    # or table-list change invalidates it.
+    # ------------------------------------------------------------------
+
+    def _scan_stage_token(self) -> tuple:
+        return (
+            tuple(t.index for t in self._sstables.tables),
+            id(self._active),
+            self._appends_since_swap,
+            len(self._active),
+            self._flushing is not None,
+        )
+
+    def _drop_scan_stage(self) -> None:
+        if self._scan_stage is not None:
+            self._scan_stage = None
+            self._scan_stage_key = None
+            self._scan_stage_list.release()
+            self._scan_stage_list = None
+
+    async def _current_scan_stage(self):
+        """The cached vectorized stage for the CURRENT tree state, or
+        None (guard tripped — caller uses the per-entry path).  Holds
+        one reader ref on the staged sstable list so compaction
+        cannot retire the files under later pages of the same
+        stage."""
+        from . import scan_stage as ss
+
+        token = self._scan_stage_token()
+        if (
+            self._scan_stage is not None
+            and self._scan_stage_key == token
+        ):
+            return self._scan_stage
+        self._drop_scan_stage()
+        total = self.memtable_entries + self.sstable_entry_count()
+        if total < ss.MIN_VECTORIZED_ENTRIES:
+            return None
+        snap = self.scan_snapshot()
+        try:
+            stage = await asyncio.get_event_loop().run_in_executor(
+                None,
+                ss.build_stage,
+                snap.memtable_items,
+                snap.tables,
+            )
+        except CorruptedFile as e:
+            self.quarantine_by_exception(e, snap.tables)
+            snap.release()
+            raise
+        except BaseException:
+            snap.release()
+            raise
+        if stage is None:
+            snap.release()
+            return None
+        if self._scan_stage_token() != token:
+            # A write or swap landed during the executor build: the
+            # stage is already stale — serve this one page from it
+            # (it is a valid point-in-time view) but don't cache it.
+            # The snapshot ref is released by scan_page's finally.
+            stage._hold = snap
+            return stage
+        if (
+            self._scan_stage is not None
+            and self._scan_stage_key == token
+        ):
+            # A concurrent cold-cache build won the race and already
+            # cached an identical stage: use it and release OUR
+            # snapshot ref — overwriting the cache here would orphan
+            # the winner's reader ref and stall compaction's reader
+            # drain forever.
+            snap.release()
+            return self._scan_stage
+        self._drop_scan_stage()  # release any stale cached ref
+        self._scan_stage = stage
+        self._scan_stage_key = token
+        self._scan_stage_list = snap._sstables  # cache owns the ref
+        snap._released = True  # ownership moved to the cache
+        return stage
+
+    async def scan_page(
+        self,
+        start: int,
+        end: int,
+        start_after,
+        prefix,
+        limit: int,
+        max_bytes: int,
+        with_values: bool,
+    ) -> Tuple[list, bool]:
+        """One ordered scan page: up to ``limit`` entries /
+        ``max_bytes`` emitted bytes of [key, value|nil, ts] with
+        hash(key) in the wrap range [start, end), key > start_after
+        (and starting with ``prefix`` when given), ascending by key;
+        newest entry per key, tombstones included as value=b"".
+        Returns (entries, more).  Vectorized through the cached
+        ScanStage; per-entry fallback otherwise."""
+        stage = await self._current_scan_stage()
+        if stage is not None:
+            # Pin the staged table files across the materialization's
+            # cooperative yields: a flush/compaction swap during an
+            # await drops the CACHE's ref, and without this per-call
+            # ref the input files could be retired mid-read.
+            hold_list = None
+            if stage._hold is None and stage is self._scan_stage:
+                hold_list = self._scan_stage_list
+                if hold_list is not None:
+                    hold_list.acquire()
+            try:
+                # Selection is pure numpy over the remaining
+                # keyspace.  Only genuinely large stages go off-loop
+                # (mask/cumsum there would stall point ops for ms);
+                # below the threshold the executor hand-off latency
+                # (~ms of idle epoll per hop, measured) costs more
+                # than the selection itself.
+                if stage.n >= 200_000:
+                    pos, more = await asyncio.get_event_loop(
+                    ).run_in_executor(
+                        None,
+                        stage.select,
+                        start, end, start_after, prefix, limit,
+                        max_bytes, with_values,
+                    )
+                else:
+                    pos, more = stage.select(
+                        start, end, start_after, prefix, limit,
+                        max_bytes, with_values,
+                    )
+                entries: list = []
+                for j in range(0, len(pos), 512):
+                    entries.extend(
+                        stage.entries_at(
+                            pos[j : j + 512], with_values
+                        )
+                    )
+                    # Yield between slices of value reads so point
+                    # ops interleave within a large page.
+                    await asyncio.sleep(0)
+                return entries, more
+            except CorruptedFile as e:
+                # Stage-read corruption (value-page CRC): quarantine
+                # the attributed table so repair starts NOW, then
+                # error the page retryably — the coordinator's
+                # stream dies and the client resumes elsewhere.
+                self.quarantine_by_exception(
+                    e,
+                    [
+                        s.table
+                        for s in stage.sources
+                        if not isinstance(s, list)
+                    ],
+                )
+                raise
+            finally:
+                if hold_list is not None:
+                    hold_list.release()
+                if stage._hold is not None:
+                    stage._hold.release()
+                    stage._hold = None
+        return await self._scan_page_fallback(
+            start, end, start_after, prefix, limit, max_bytes,
+            with_values,
+        )
+
+    async def _scan_page_fallback(
+        self,
+        start: int,
+        end: int,
+        start_after,
+        prefix,
+        limit: int,
+        max_bytes: int,
+        with_values: bool,
+    ) -> Tuple[list, bool]:
+        """Per-entry page (tiny trees / no native lib / guard trips):
+        one full newest-wins walk, then the page cut.  Byte-identical
+        ordering and dedup to the staged path."""
+        from ..utils.murmur import hash_bytes as _hash_bytes
+        from . import scan_stage as ss
+
+        newest: dict = {}
+        async for key, value, ts in self.iter_filter(None):
+            if start_after is not None and key <= start_after:
+                continue
+            if prefix and not key.startswith(prefix):
+                continue
+            h = _hash_bytes(key)
+            width = (end - start) & 0xFFFFFFFF
+            if width != 0 and ((h - start) & 0xFFFFFFFF) >= width:
+                continue
+            prev = newest.get(key)
+            if prev is None or ts > prev[1]:
+                newest[key] = (value, ts)
+        entries: list = []
+        used = 0
+        items = sorted(newest.items())
+        for i, (key, (value, ts)) in enumerate(items):
+            vlen = len(value)
+            cost = len(key) + ss.ENTRY_OVERHEAD + (
+                vlen if with_values else 0
+            )
+            if entries and (
+                used + cost > max_bytes or len(entries) >= limit
+            ):
+                return entries, True
+            used += cost
+            if vlen == 0:
+                entries.append([key, b"", ts])
+            elif with_values:
+                entries.append([key, value, ts])
+            else:
+                entries.append([key, None, ts])
+            if len(entries) >= limit and i + 1 < len(items):
+                return entries, True
+        return entries, False
 
     def scan_snapshot(self) -> "ScanSnapshot":
         """Synchronous point-in-time view for OFF-LOOP bulk scans

@@ -96,6 +96,19 @@ struct MpBuf {
     }
   }
   void boolean(bool v) { u8(v ? 0xc3 : 0xc2); }
+  void bin(const uint8_t* p, uint64_t n) {
+    if (n <= 0xff) {
+      u8(0xc4);
+      u8((uint8_t)n);
+    } else if (n <= 0xffff) {
+      u8(0xc5);
+      be16((uint16_t)n);
+    } else {
+      u8(0xc6);
+      be32((uint32_t)n);
+    }
+    raw(p, n);
+  }
 };
 
 // ------------------------- msgpack decode ----------------------------
@@ -1166,6 +1179,84 @@ int64_t dbeel_cli_trace_dump(void* h, const char* ip, uint16_t port,
   }
   if (body.size() > cap) {
     c->last_error = "trace dump exceeds caller buffer";
+    return -((int64_t)body.size()) - 10;
+  }
+  std::memcpy(out, body.data(), body.size());
+  return (int64_t)body.size();
+}
+
+// One streaming-scan chunk (scan plane, PR 12).  cursor NULL/empty
+// starts a scan ({"type":"scan"} with the optional count/prefix/
+// limit/max_bytes pushdowns); otherwise continues one
+// ({"type":"scan_next","cursor":...}).  The raw msgpack chunk payload
+// ({"entries":[[key,value],...],"cursor":bin|nil,"count":n}) is
+// copied into out — the caller re-issues with the returned cursor
+// until it is nil.  Same target/buffer contract as
+// dbeel_cli_get_stats; a retryable server error (e.g. an Overloaded
+// shed — the cursor survives) returns -3 so the caller can back off
+// and resume, any other error -2.
+int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
+                             const char* collection,
+                             const uint8_t* cursor,
+                             uint32_t cursor_len, int count_only,
+                             const uint8_t* prefix,
+                             uint32_t prefix_len, uint64_t limit,
+                             uint64_t max_bytes, uint8_t* out,
+                             uint64_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string target_ip = (ip && *ip) ? ip : c->seed_ip;
+  uint16_t target_port = port ? port : c->seed_port;
+  MpBuf m;
+  if (cursor && cursor_len) {
+    m.map_header(3);
+    common_fields(&m, "scan_next", "", true);
+    m.str("cursor");
+    m.bin(cursor, cursor_len);
+  } else {
+    uint32_t fields = 3;  // type, collection, keepalive
+    if (count_only) fields++;
+    if (prefix && prefix_len) fields++;
+    if (limit) fields++;
+    if (max_bytes) fields++;
+    m.map_header(fields);
+    common_fields(&m, "scan", collection ? collection : "", true);
+    if (count_only) {
+      m.str("count");
+      m.boolean(true);
+    }
+    if (prefix && prefix_len) {
+      m.str("prefix");
+      m.bin(prefix, prefix_len);
+    }
+    if (limit) {
+      m.str("limit");
+      m.uint(limit);
+    }
+    if (max_bytes) {
+      m.str("max_bytes");
+      m.uint(max_bytes);
+    }
+  }
+  std::vector<uint8_t> body;
+  uint8_t rtype = 0;
+  if (!round_trip(c, target_ip, target_port, m, &body, &rtype)) {
+    return -3;  // transport: retryable, cursor survives
+  }
+  if (rtype == kResponseErr) {
+    std::string msg;
+    std::string kind = error_kind(body, &msg);
+    c->last_error = kind + ": " + msg;
+    // The retryable classes the Python walk retries on: the scan
+    // cursor is client-held state, so these resume after backoff.
+    if (kind == "Overloaded" || kind == "Timeout" ||
+        kind == "PeerDead" || kind == "ShardDegraded" ||
+        kind == "CorruptedFile") {
+      return -3;
+    }
+    return -2;
+  }
+  if (body.size() > cap) {
+    c->last_error = "scan chunk exceeds caller buffer";
     return -((int64_t)body.size()) - 10;
   }
   std::memcpy(out, body.data(), body.size());

@@ -56,6 +56,21 @@ HEALTH_BLOCK_KEYS = {
     "per_node",
 }
 
+# Streaming scan plane (ISSUE 12): scans under churn keep completing
+# and the final view agrees with quorum multi_gets.
+SCAN_KEYS = {
+    "window_s",
+    "scans_completed",
+    "scan_errors_during_churn",
+    "order_violations",
+    "final_scan_entries",
+    "journal_keys_compared",
+    "scan_vs_multiget_disagreements",
+    "stats_scan_block",
+    "nodes_alive",
+    "pass",
+}
+
 PARTITION_KEYS = {
     "victim",
     "keys",
@@ -110,6 +125,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
             "--disk-faults",
             "--partition",
             "--overload",
+            "--scan",
             "--report",
             report_path,
         ],
@@ -156,6 +172,18 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert ov["stats_overload_block_py"] is True
     assert ov["stats_overload_block_native"] is True
     assert "overload" in ov["errors_by_class"] or ov["ok"] > 0
+    # --scan phase schema (streaming scan plane, ISSUE 12): scans
+    # complete through the mid-stream kill, every completed stream is
+    # sorted/duplicate-free, and the healed scan view agrees with
+    # quorum multi_gets of the acked journal keys.
+    sc = report["scan"]
+    missing = SCAN_KEYS - set(sc)
+    assert not missing, missing
+    assert sc["nodes_alive"] is True
+    assert sc["scans_completed"] >= 1
+    assert sc["order_violations"] == 0
+    assert sc["scan_vs_multiget_disagreements"] == []
+    assert sc["stats_scan_block"]["chunks"] > 0
     # Tracing plane (ISSUE 9): the trace block must be present with
     # dumps from the (still alive) nodes; dominant_stages is a list
     # of [stage, share] pairs (may be empty when nothing was slow).

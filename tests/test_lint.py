@@ -129,12 +129,12 @@ def test_parity_flags_python_only_verb(tmp_path):
         root,
         "dbeel_tpu/cluster/messages.py",
         '    REARM = "rearm"\n',
-        '    REARM = "rearm"\n    SCAN = "scan"\n',
+        '    REARM = "rearm"\n    TRUNCATE = "truncate"\n',
         count=1,
     )
     findings = wire_parity.check(Repo(root))
     msgs = "\n".join(f.message for f in findings)
-    assert "scan" in msgs and "no encoder" in msgs, findings
+    assert "truncate" in msgs and "no encoder" in msgs, findings
     assert "not handled in handle_shard_request" in msgs
 
 
@@ -176,17 +176,11 @@ def test_parity_flags_trace_index_drift(tmp_path):
         "        ShardRequest.GET: 5,\n"
         "        ShardRequest.GET_DIGEST: 5,\n"
         "        ShardRequest.MULTI_SET: 5,\n"
-        "        ShardRequest.MULTI_GET: 5,\n"
-        "    }\n\n"
-        "    @classmethod\n"
-        "    def peer_trace_id",
+        "        ShardRequest.MULTI_GET: 5,\n",
         "        ShardRequest.GET: 6,\n"
         "        ShardRequest.GET_DIGEST: 5,\n"
         "        ShardRequest.MULTI_SET: 5,\n"
-        "        ShardRequest.MULTI_GET: 5,\n"
-        "    }\n\n"
-        "    @classmethod\n"
-        "    def peer_trace_id",
+        "        ShardRequest.MULTI_GET: 5,\n",
     )
     findings = wire_parity.check(Repo(root))
     assert any(
@@ -210,6 +204,39 @@ def test_parity_flags_trace_dialect_drift_in_c(tmp_path):
         or "trace-dialect" in f.message
         for f in findings
     ), findings
+
+
+def test_parity_flags_scan_arity_drift(tmp_path):
+    # Scan plane (PR 12): the SCAN peer frame's fixed arity is pinned
+    # between the encoder and shard.py's handler constant.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "_SCAN_PEER_ARITY = 10",
+        "_SCAN_PEER_ARITY = 9",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "scan peer-frame arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_scan_verb_lost_in_c_client(tmp_path):
+    # Scan plane (PR 12): the C client must keep emitting both scan
+    # op tokens — losing one strands the compiled fleet scanless.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_client.cpp",
+        '"scan_next"',
+        '"scan_nxt"',
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "no longer emits the 'scan_next' op" in msgs, findings
+    # ...and the typo'd token itself is unknown-wire-string drift.
+    assert "scan_nxt" in msgs
 
 
 def test_parity_flags_status_byte_drift(tmp_path):

@@ -200,3 +200,31 @@ def test_native_client_large_value_grows_buffer(server):
 
         with pytest.raises(DbeelError, match="frame too large"):
             cli.set("big", "k2", "x" * 70000)
+
+
+def test_native_client_scan_and_count(server):
+    """Scan plane (PR 12) through the compiled client: chunked
+    cursor-resumed scan + keys-only count, same stream semantics as
+    the Python client's DbeelCollection.scan/count."""
+    import msgpack
+
+    with native_client.NativeDbeelClient("127.0.0.1", PORT) as cli:
+        cli.create_collection("sc", replication_factor=1)
+        time.sleep(0.3)
+        items = {f"key-{i:04d}": {"v": i} for i in range(150)}
+        cli.multi_set("sc", items)
+        cli.delete("sc", "key-0003")
+        got = cli.scan("sc")
+        assert [k for k, _v in got] == sorted(
+            k for k in items if k != "key-0003"
+        )
+        assert all(v == items[k] for k, v in got)
+        assert cli.count("sc") == 149
+        # Raw encoded-key prefix pushdown (fixstr header + "key-00").
+        pfx = msgpack.packb("key-0000")[:7]
+        assert cli.count("sc", prefix=pfx) == 99
+        assert [k for k, _v in cli.scan("sc", prefix=pfx)] == sorted(
+            f"key-{i:04d}" for i in range(100) if i != 3
+        )
+        # Tiny chunks: many cursor hops, identical stream.
+        assert cli.scan("sc", max_bytes=512) == got

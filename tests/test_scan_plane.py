@@ -1,0 +1,574 @@
+"""Streaming scan/range query plane (PR 12).
+
+Covers the ISSUE 12 semantics checklist: chunked iteration with
+resumable cursors (including resume across a coordinator restart),
+RF=3 newest-wins merge dedup after replica divergence, tombstone
+exclusion, count/prefix pushdown, byte-budget honoring, hard-overload
+shedding with a surviving cursor, and staged-vs-fallback storage
+parity.
+"""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu.errors import Overloaded
+from dbeel_tpu.server.governor import LEVEL_HARD
+
+
+def _keys(n, skip=()):
+    return [
+        f"key-{i:04d}" for i in range(n) if i not in set(skip)
+    ]
+
+
+async def _scan_all(col, **kw):
+    return [kv async for kv in col.scan(**kw)]
+
+
+# ---------------------------------------------------------------------
+# Single-node semantics
+# ---------------------------------------------------------------------
+
+
+def test_scan_order_content_and_tombstones(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=2
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set(
+            {k: {"v": k} for k in _keys(400)}
+        )
+        await col.delete("key-0007")
+        got = await _scan_all(col)
+        assert [k for k, _v in got] == _keys(400, skip=(7,))
+        assert all(v == {"v": k} for k, v in got)
+        # Byte-agreement with a sorted multi_get of the keyspace.
+        values = await col.multi_get(_keys(400, skip=(7,)))
+        assert [v for _k, v in got] == values
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_scan_chunked_equals_full_and_budget(tmp_dir):
+    async def main():
+        cfg = make_config(tmp_dir, scan_bytes_per_slice=1 << 20)
+        node = await ClusterNode(cfg, num_shards=1).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set({k: {"v": k} for k in _keys(300)})
+        full = await _scan_all(col)
+        # Tiny per-chunk budget: many cursor hops, same stream.
+        small = await _scan_all(col, max_bytes=512)
+        assert small == full
+        stats = await client.get_stats(*node.db_address)
+        sc = stats["scan"]
+        assert sc["scans_started"] >= 2
+        assert sc["cursor_resumes"] > 10  # 300 entries / ~512B chunks
+        assert sc["chunks"] > sc["scans_started"]
+        assert sc["bytes_streamed"] > 0
+        assert sc["active_scans"] == 0
+        # Byte budget honored: no chunk materially above the slice
+        # budget → with 512B slices the per-chunk entry count stays
+        # tiny (each entry ~30B encoded, ENTRY_OVERHEAD=16).
+        assert sc["chunks"] >= 300 * 30 // 600
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_scan_limit_and_prefix_and_count(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=2
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set({k: {"v": k} for k in _keys(300)})
+        limited = await _scan_all(col, limit=25)
+        assert [k for k, _v in limited] == _keys(300)[:25]
+        # Raw encoded-key prefix: fixstr header byte + "key-00".
+        pfx = msgpack.packb("key-0000")[:7]
+        under = await _scan_all(col, prefix=pfx)
+        assert [k for k, _v in under] == _keys(100)
+        assert await col.count() == 300
+        assert await col.count(prefix=pfx) == 100
+        await col.delete("key-0042")
+        assert await col.count(prefix=pfx) == 99
+        # Scan chunks rotate across coordinators for load spread —
+        # the counter lives on whichever shard served the final
+        # count chunk.
+        assert (
+            sum(s.scan_plane.counts_served for s in node.shards)
+            >= 1
+        )
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_scan_sheds_retryably_under_hard_overload(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=2.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set({k: {"v": k} for k in _keys(120)})
+        shard = node.shards[0]
+        # Start the scan, take one chunk, then force hard overload.
+        agen = col.scan(max_bytes=512)
+        first = await agen.__anext__()
+        shard.governor.force_level(LEVEL_HARD)
+        with pytest.raises(Overloaded):
+            # The client walk retries with backoff but the level is
+            # pinned: the final surfaced error stays retryable.
+            while True:
+                await agen.__anext__()
+        sheds_while_hard = shard.scan_plane.sheds
+        assert sheds_while_hard >= 1
+        # Disarm: a FRESH scan (cursor state lives in the client's
+        # request loop, which the raised generator closed) streams
+        # the full keyspace — nothing was lost server-side.
+        shard.governor.force_level(None)
+        await agen.aclose()
+        got = await _scan_all(col)
+        assert len(got) == 120
+        assert first is not None
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_scan_max_concurrent_sheds(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        shard = node.shards[0]
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=2.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set({k: {"v": k} for k in _keys(50)})
+        # Saturate the gauge directly (deterministic: no timing).
+        shard.scan_plane.active_scans = (
+            shard.config.scan_max_concurrent
+        )
+        before = shard.scan_plane.sheds
+        with pytest.raises(Overloaded):
+            async for _ in col.scan():
+                pass
+        assert shard.scan_plane.sheds > before
+        shard.scan_plane.active_scans = 0
+        assert len(await _scan_all(col)) == 50
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_traced_scan_records_stage_marks(tmp_dir):
+    # Trace integration (PR 12 satellite): a client-stamped scan
+    # records per-chunk stage marks (pace/iterate/merge/respond) in
+    # the flight recorder, so `blackbox_bench.py --attribute`
+    # decomposes scan latency exactly like point ops.
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        await col.multi_set({k: {"v": k} for k in _keys(200)})
+        got = [
+            kv
+            async for kv in col.scan(max_bytes=2048, trace_id=7070)
+        ]
+        assert len(got) == 200
+        dump = await client.trace_dump(*node.db_address)
+        spans = [
+            e
+            for e in dump["entries"]
+            if e.get("sampled") and e["op"] in ("scan", "scan_next")
+        ]
+        assert spans, dump["entries"][-3:]
+        stage_names = {
+            s for e in spans for s, _us in e["stages"]
+        }
+        assert {"pace", "iterate", "merge", "respond"} <= stage_names
+        for e in spans:
+            # Strictly-sequential marks: the stage sum tracks the
+            # span total (same invariant as point-op spans).
+            assert sum(us for _s, us in e["stages"]) <= e[
+                "total_us"
+            ] + 1000
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+# ---------------------------------------------------------------------
+# RF=3 merge semantics + cursor resume across restart
+# ---------------------------------------------------------------------
+
+
+async def _start_cluster(tmp_dir, n_nodes=3, **cfg_kw):
+    cfg = make_config(tmp_dir, **cfg_kw)
+    nodes = [await ClusterNode(cfg, num_shards=1).start()]
+    for i in range(1, n_nodes):
+        ncfg = next_node_config(cfg, i, tmp_dir).replace(
+            seed_nodes=[nodes[0].seed_address]
+        )
+        nodes.append(await ClusterNode(ncfg, num_shards=1).start())
+    # Let gossip converge the ring everywhere.
+    for _ in range(100):
+        if all(
+            len(n.shards[0].shards) >= n_nodes for n in nodes
+        ):
+            break
+        await asyncio.sleep(0.05)
+    return nodes
+
+
+def test_rf3_merge_dedup_newer_replica_wins(tmp_dir):
+    async def main():
+        nodes = await _start_cluster(tmp_dir, 3)
+        client = await DbeelClient.from_seed_nodes(
+            [nodes[0].db_address], op_deadline_s=8.0
+        )
+        col = await client.create_collection("c", 3)
+        await asyncio.sleep(0.3)
+        keys = _keys(60)
+        for k in keys:
+            await col.set(k, {"v": k, "gen": 0})
+        # Diverge the replicas: write newer versions of some keys
+        # DIRECTLY into one node's local tree (older ts stays on the
+        # other two) — the scan merge must pick the newest and never
+        # resurrect the stale copy.
+        from dbeel_tpu.utils.timestamps import now_nanos
+
+        shard = nodes[1].shards[0]
+        tree = shard.collections["c"].tree
+        newer = keys[:10]
+        for k in newer:
+            await tree.set_with_timestamp(
+                msgpack.packb(k),
+                msgpack.packb({"v": k, "gen": 1}),
+                now_nanos(),
+            )
+        got = {k: v async for k, v in col.scan()}
+        assert len(got) == 60
+        for k in newer:
+            assert got[k]["gen"] == 1, k
+        for k in keys[10:]:
+            assert got[k]["gen"] == 0, k
+        # A tombstone on ONE replica newer than the others' live
+        # value suppresses the key cluster-wide.
+        dead = keys[20]
+        await tree.set_with_timestamp(
+            msgpack.packb(dead), b"", now_nanos()
+        )
+        got2 = {k async for k, _v in col.scan()}
+        assert dead not in got2
+        client.close()
+        for n in nodes:
+            await n.stop()
+
+    run(main(), 90)
+
+
+def test_scan_agrees_with_multi_get_after_replica_kill_heal(tmp_dir):
+    async def main():
+        nodes = await _start_cluster(
+            tmp_dir,
+            3,
+            hint_drain_interval_ms=200,
+            anti_entropy_interval_ms=0,
+        )
+        client = await DbeelClient.from_seed_nodes(
+            [nodes[0].db_address], op_deadline_s=8.0
+        )
+        col = await client.create_collection("c", 3)
+        await asyncio.sleep(0.3)
+        keys = _keys(40)
+        for k in keys[:20]:
+            await col.set(k, {"v": k, "gen": 0})
+        # Kill one replica, write through the survivors (W=2), heal.
+        await nodes[2].crash()
+        await asyncio.sleep(0.3)
+        for k in keys[20:]:
+            await col.set(k, {"v": k, "gen": 1}, consistency=(
+                "fixed", 2
+            ))
+        restarted = await ClusterNode(
+            nodes[2].config, num_shards=1
+        ).start()
+        nodes[2] = restarted
+        await asyncio.sleep(1.0)  # alive gossip + hint replay window
+        # Merge correctness under (possibly still-healing)
+        # divergence: the scan must byte-agree with the quorum-read
+        # view of every key.
+        got = {k: v async for k, v in col.scan()}
+        values = await col.multi_get(keys)
+        expect = {
+            k: v for k, v in zip(keys, values) if v is not None
+        }
+        assert got == expect
+        assert set(got) == set(keys)
+        client.close()
+        for n in nodes:
+            await n.stop()
+
+    run(main(), 90)
+
+
+def test_cursor_resumes_across_coordinator_restart(tmp_dir):
+    async def main():
+        nodes = await _start_cluster(tmp_dir, 2)
+        client = await DbeelClient.from_seed_nodes(
+            [nodes[0].db_address, nodes[1].db_address],
+            op_deadline_s=8.0,
+        )
+        col = await client.create_collection("c", 2)
+        await asyncio.sleep(0.3)
+        keys = _keys(80)
+        for k in keys:
+            await col.set(k, {"v": k})
+        # Pull a few chunks by hand so we hold a mid-scan cursor.
+        req = {
+            "type": "scan",
+            "collection": "c",
+            "max_bytes": 512,
+        }
+        chunk = await client._scan_chunk_request(req)
+        seen = [k for k, _v in chunk["entries"]]
+        cursor = chunk["cursor"]
+        assert cursor
+        # Restart the node that served the first chunk (cursors are
+        # self-contained, so ANY node can continue; the client walk
+        # retries through the other node while this one is down).
+        await nodes[0].crash()
+        restarted = await ClusterNode(
+            nodes[0].config, num_shards=1
+        ).start()
+        nodes[0] = restarted
+        while cursor:
+            chunk = await client._scan_chunk_request(
+                {"type": "scan_next", "cursor": cursor}
+            )
+            seen.extend(k for k, _v in chunk["entries"])
+            cursor = chunk["cursor"]
+        assert seen == keys
+        client.close()
+        for n in nodes:
+            await n.stop()
+
+    run(main(), 90)
+
+
+# ---------------------------------------------------------------------
+# Storage staging parity
+# ---------------------------------------------------------------------
+
+
+def test_staged_and_fallback_pages_agree(tmp_dir):
+    import dbeel_tpu.storage.scan_stage as ss
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            tmp_dir + "/t", capacity=128
+        )
+        for i in range(700):
+            await tree.set_with_timestamp(
+                msgpack.packb(f"k{i:05d}"),
+                msgpack.packb({"v": i}),
+                1000 + i,
+            )
+        await tree.flush()
+        for i in range(100, 220):  # newer overwrites post-flush
+            await tree.set_with_timestamp(
+                msgpack.packb(f"k{i:05d}"),
+                msgpack.packb({"v": -i}),
+                9000 + i,
+            )
+        await tree.delete_with_timestamp(
+            msgpack.packb("k00005"), 99000
+        )
+
+        async def page_all(**kw):
+            out, sa = [], None
+            while True:
+                es, more = await tree.scan_page(
+                    start_after=sa, **kw
+                )
+                out.extend(es)
+                if not more or not es:
+                    return out
+                sa = es[-1][0]
+
+        cases = [
+            dict(start=0, end=0, prefix=None, limit=64,
+                 max_bytes=4096, with_values=True),
+            dict(start=123, end=2**31 + 7, prefix=None, limit=50,
+                 max_bytes=2048, with_values=True),
+            dict(start=0, end=0,
+                 prefix=msgpack.packb("k00110")[:5], limit=1000,
+                 max_bytes=1 << 20, with_values=False),
+        ]
+        for case in cases:
+            staged = await page_all(**case)
+            assert tree._scan_stage is not None
+            old = ss.MIN_VECTORIZED_ENTRIES
+            ss.MIN_VECTORIZED_ENTRIES = 10**9
+            tree._drop_scan_stage()
+            try:
+                fallback = await page_all(**case)
+            finally:
+                ss.MIN_VECTORIZED_ENTRIES = old
+            assert staged == fallback, case
+        # Tombstone travels through both paths with value=b"".
+        staged = await page_all(
+            start=0, end=0, prefix=msgpack.packb("k00005"),
+            limit=10, max_bytes=4096, with_values=True,
+        )
+        assert staged == [[msgpack.packb("k00005"), b"", 99000]]
+        tree.close()
+
+    run(main(), 60)
+
+
+def test_concurrent_stage_builds_do_not_leak_reader_refs(tmp_dir):
+    # Review regression: two cold-cache scan chunks racing through
+    # _current_scan_stage must end with exactly ONE cached reader
+    # ref on the sstable list — an orphaned ref would stall
+    # compaction's reader drain forever.
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            tmp_dir + "/t", capacity=4096
+        )
+        for i in range(700):
+            await tree.set_with_timestamp(
+                msgpack.packb(f"k{i:05d}"),
+                msgpack.packb({"v": i}),
+                1000 + i,
+            )
+        await tree.flush()
+        assert tree._scan_stage is None  # cold cache
+        await asyncio.gather(
+            *[
+                tree.scan_page(0, 0, None, None, 10, 4096, True)
+                for _ in range(4)
+            ]
+        )
+        lst = tree._scan_stage_list
+        assert lst is not None
+        assert lst.readers == 1  # the cache's ref, nothing orphaned
+        tree._drop_scan_stage()
+        assert lst.readers == 0  # compaction's drain can proceed
+        tree.close()
+
+    run(main(), 60)
+
+
+def test_scan_stage_value_corruption_quarantines(tmp_dir):
+    # The staged value path slices a memmap, not the page cache — it
+    # must still verify pages against the CRC sidecar before serving
+    # (one crc32 per touched page per stage), and a flipped value bit
+    # must surface as retryable corruption + a quarantine, never as
+    # corrupt client bytes.
+    from dbeel_tpu.errors import CorruptedFile
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            tmp_dir + "/t", capacity=4096
+        )
+        for i in range(800):
+            await tree.set_with_timestamp(
+                msgpack.packb(f"k{i:05d}"),
+                msgpack.packb({"blob": "x" * 64, "i": i}),
+                1000 + i,
+            )
+        await tree.flush()
+        table = tree._sstables.tables[0]
+        off, ksz, _fsz = table._index_record(400)
+        flip_at = off + 16 + ksz + 8  # inside entry 400's value
+        with open(table.data_path, "r+b") as f:
+            f.seek(flip_at)
+            b = f.read(1)
+            f.seek(flip_at)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CorruptedFile):
+            await tree.scan_page(
+                0, 0, None, None, 10**6, 1 << 22, True
+            )
+        assert tree.durability["checksum_failures"] >= 1
+        assert tree.durability["quarantined_tables"] >= 1
+        assert tree.reads_suspect  # repair owns the heal
+        tree.close()
+
+    run(main(), 60)
+
+
+def test_stage_invalidated_by_writes_and_compaction(tmp_dir):
+    from dbeel_tpu.storage.lsm_tree import LSMTree
+
+    async def main():
+        tree = LSMTree.open_or_create(
+            tmp_dir + "/t", capacity=4096
+        )
+        for i in range(600):
+            await tree.set_with_timestamp(
+                msgpack.packb(f"k{i:05d}"),
+                msgpack.packb({"v": i}),
+                1000 + i,
+            )
+        es, _ = await tree.scan_page(
+            0, 0, None, None, 10, 4096, True
+        )
+        assert tree._scan_stage is not None
+        stage1 = tree._scan_stage
+        # A write invalidates via the token...
+        await tree.set_with_timestamp(
+            msgpack.packb("zz"), msgpack.packb(1), 5
+        )
+        es2, _ = await tree.scan_page(
+            0, 0, None, None, 10**6, 1 << 22, True
+        )
+        assert tree._scan_stage is not stage1
+        assert any(e[0] == msgpack.packb("zz") for e in es2)
+        # ...and a flush/table swap drops the cached stage EAGERLY
+        # (compaction's reader drain must never wait on an idle
+        # cached stage).
+        assert tree._scan_stage is not None
+        await tree.flush()
+        assert tree._scan_stage is None
+        assert tree._scan_stage_list is None
+        tree.close()
+
+    run(main(), 60)

@@ -698,3 +698,33 @@ def test_watchdog_surfaces_forced_degraded_live(tmp_dir):
 
     run(main(), timeout=45)
 
+
+
+def test_watchdog_scan_storm_fires_and_clears():
+    # Scan plane (PR 12): sustained scan-chunk sheds fire the named
+    # finding; an idle scan lane stays quiet.
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    _sample(ring, 0.0, **{"scan.sheds": 0})
+    _sample(ring, 1.0, **{"scan.sheds": 40})  # 40/s > threshold
+    assert "scan_storm" in _kinds(dog.evaluate(ring))
+    _sample(ring, 2.0, **{"scan.sheds": 41})  # 1/s: back under
+    assert "scan_storm" not in _kinds(dog.evaluate(ring))
+
+
+def test_scan_rates_derive_from_counters():
+    ring = tm.TelemetryRing(capacity=8)
+    _sample(
+        ring, 0.0,
+        **{"scan.chunks": 0, "scan.bytes_streamed": 0,
+           "scan.sheds": 0},
+    )
+    _sample(
+        ring, 2.0,
+        **{"scan.chunks": 20, "scan.bytes_streamed": 4096,
+           "scan.sheds": 4},
+    )
+    rates = ring.rates()
+    assert rates["scan_chunks_per_s"] == 10.0
+    assert rates["scan_bytes_per_s"] == 2048.0
+    assert rates["scan_sheds_per_s"] == 2.0
