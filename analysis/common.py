@@ -168,6 +168,14 @@ class Repo:
         return self.path("dbeel_tpu", "client", "__init__.py")
 
     @property
+    def scan_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "scan.py")
+
+    @property
+    def query_py(self) -> str:
+        return self.path("dbeel_tpu", "query.py")
+
+    @property
     def native_cpp(self) -> str:
         return self.path("native", "src", "dbeel_native.cpp")
 

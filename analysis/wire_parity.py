@@ -272,6 +272,52 @@ def _c_constexpr(src: str, name: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+def _c_constexpr_str(src: str, name: str) -> Optional[str]:
+    m = re.search(
+        r"constexpr\s+char\s+"
+        + re.escape(name)
+        + r"\s*\[\s*\]\s*=\s*\"([^\"]*)\"",
+        strip_c_comments(src),
+    )
+    return m.group(1) if m else None
+
+
+def _module_str_constant(tree: ast.AST, name: str) -> Optional[str]:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            return node.value.value
+    return None
+
+
+def _function_list_literal_len(
+    tree: ast.AST, fn_name: str
+) -> Optional[int]:
+    """Element count of the (single) list literal a function passes
+    to msgpack.packb — the cursor encoder's wire arity."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.FunctionDef)
+            and node.name == fn_name
+        ):
+            for call in ast.walk(node):
+                if (
+                    isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "packb"
+                    and call.args
+                    and isinstance(call.args[0], ast.List)
+                ):
+                    return len(call.args[0].elts)
+    return None
+
+
 _WANT_RE = re.compile(
     r"want\s*=\s*k_set\s*\?\s*(\d+)u?\s*:\s*k_del\s*\?\s*(\d+)u?"
     r"\s*:\s*(\d+)u?"
@@ -519,6 +565,98 @@ def check(repo: Repo) -> List[Finding]:
                 "plane must stay reachable from BOTH clients",
             )
 
+    # -- query compute plane (PR 13): spec/cursor dialect pins -------
+    # The SCAN peer frame arity is now pinned THREE ways: the
+    # encoder's element count, shard.py's _SCAN_PEER_ARITY, and the
+    # C shard plane's kScanPeerArity (it punts scan pages but must
+    # recognize the dialect it is punting).
+    c_scan_arity = _c_constexpr(native_src, "kScanPeerArity")
+    if c_scan_arity is None:
+        add(
+            repo.native_cpp,
+            1,
+            "kScanPeerArity constexpr missing — the scan peer-frame "
+            "arity must be a named, lint-compared constant in the C "
+            "shard plane too",
+        )
+    elif scan_arity is not None and c_scan_arity != scan_arity:
+        add(
+            repo.native_cpp,
+            1,
+            f"scan peer-frame arity drift: C pins kScanPeerArity="
+            f"{c_scan_arity} but shard.py's _SCAN_PEER_ARITY is "
+            f"{scan_arity}",
+        )
+    # The filter/aggregate spec version travels client -> coordinator
+    # -> replicas: the Python packer (query.SPEC_VERSION), the
+    # coordinator parser pin (scan.SPEC_WIRE_VERSION) and the C
+    # client's pass-through validation (kSpecVersion) must agree.
+    spec_versions: Dict[str, Optional[str]] = {}
+    query_tree = ast.parse(read_file(repo.query_py))
+    scan_tree = ast.parse(read_file(repo.scan_py))
+    spec_versions[repo.query_py] = _module_str_constant(
+        query_tree, "SPEC_VERSION"
+    )
+    spec_versions[repo.scan_py] = _module_str_constant(
+        scan_tree, "SPEC_WIRE_VERSION"
+    )
+    spec_versions[repo.client_cpp] = _c_constexpr_str(
+        client_src, "kSpecVersion"
+    )
+    for path, ver in spec_versions.items():
+        if ver is None:
+            add(
+                path,
+                1,
+                "spec version constant missing (SPEC_VERSION / "
+                "SPEC_WIRE_VERSION / kSpecVersion) — the query-spec "
+                "dialect must be a named, lint-compared constant "
+                "in all three emitters/parsers",
+            )
+    known_versions = {
+        v for v in spec_versions.values() if v is not None
+    }
+    if len(known_versions) > 1:
+        add(
+            repo.scan_py,
+            1,
+            f"spec version drift across the three surfaces: "
+            f"{sorted(known_versions)} — a client-packed spec would "
+            "be rejected by the coordinator (or vice versa)",
+        )
+    # The cursor arity is pinned between the scan.py constant, the
+    # encoder's list literal, and the decoder's accepted shape; the
+    # C client additionally must emit the "spec" request field or
+    # compiled callers silently lose the pushdown.
+    cursor_arity = _module_int_constant(scan_tree, "_CURSOR_ARITY")
+    enc_cursor = _function_list_literal_len(
+        scan_tree, "encode_cursor"
+    )
+    if cursor_arity is None:
+        add(
+            repo.scan_py,
+            1,
+            "_CURSOR_ARITY constant missing — the scan-cursor shape "
+            "must be a named, lint-compared constant",
+        )
+    elif enc_cursor is not None and enc_cursor != cursor_arity:
+        add(
+            repo.scan_py,
+            1,
+            f"scan-cursor arity drift: encode_cursor packs "
+            f"{enc_cursor} fields but _CURSOR_ARITY is "
+            f"{cursor_arity} — a freshly-minted cursor would be "
+            "rejected on resume",
+        )
+    if "spec" not in client_c_tokens:
+        add(
+            repo.client_cpp,
+            1,
+            "C client no longer emits the 'spec' request field — "
+            "filter/aggregate pushdown must stay reachable from "
+            "BOTH clients",
+        )
+
     # -- every C wire-token literal is in a Python registry ----------
     peer_verbs = (
         set(req.values())
@@ -534,6 +672,9 @@ def check(repo: Repo) -> List[Finding]:
         | client_ops
         | fields
         | _NON_WIRE_C_STRINGS
+        # The spec dialect tag (kSpecVersion's value) is wire
+        # vocabulary by construction.
+        | known_versions
     )
     for path, src in (
         (repo.native_cpp, native_src),

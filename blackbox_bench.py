@@ -14,6 +14,7 @@ Usage:
 
 import argparse
 import asyncio
+import json
 import os
 import random
 import sys
@@ -676,6 +677,157 @@ async def main_attribute(args):
     client.close()
 
 
+async def main_scan_filter(args):
+    """--scan-filter (query compute plane, ISSUE 13): selectivity
+    sweep comparing PREDICATE PUSHDOWN against client-side filtering
+    of the same stream, same session.  At each selectivity
+    (100% / 10% / 0.1%) both sides scan the identical keyspace; the
+    gate compares (a) client-received wire bytes (the server's
+    emitted-chunk accounting) and (b) keys-SCANNED/s — pushdown must
+    reduce bytes >= 50x at 0.1% selectivity and never lose on
+    throughput.  A grouped-aggregate pass (sum over a value field,
+    grouped by key prefix) measures the no-values-at-all path."""
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)],
+        pipeline_window=args.pipeline or 32,
+    )
+    rf = args.replication_factor or 1
+    try:
+        await client.create_collection(args.collection, rf)
+    except CollectionAlreadyExists:
+        pass
+    col = client.collection(args.collection)
+    n = args.clients * args.requests
+    keys = [f"key-{i:08}" for i in range(n)]
+
+    # Docs carry a numeric selectivity lane + the blob payload the
+    # wire-byte gate weighs.  One batched writer (load is no gate).
+    t0 = time.perf_counter()
+    for i in range(0, n, 256):
+        await col.multi_set(
+            {
+                keys[j]: {"v": j, "blob": "x" * args.value_size}
+                for j in range(i, min(i + 256, n))
+            }
+        )
+    print(f"load: {n} keys in {time.perf_counter() - t0:.2f}s")
+
+    async def scan_stats():
+        s = await client.get_stats(args.host, args.port)
+        sc = s["scan"]
+        return (
+            sc["bytes_streamed"],
+            sc["filter"]["rows_scanned"],
+            sc["filter"]["bytes_saved"],
+        )
+
+    def pred_for(frac):
+        cut = max(1, int(n * frac))
+        return ["cmp", "v", "<", cut], cut
+
+    # Warm the staged value column once (a count touches no values
+    # on the wire): the batched per-stage field decode is a ONE-TIME
+    # cost any multi-chunk scan amortizes; the sweep measures the
+    # steady state, not the first-ever spec against a cold stage.
+    await col.count(filter=["cmp", "v", ">=", 0])
+
+    report = {"n_keys": n, "value_size": args.value_size,
+              "selectivity": {}}
+    for label, frac in (
+        ("100%", 1.0), ("10%", 0.10), ("0.1%", 0.001),
+    ):
+        pred, cut = pred_for(frac)
+        await asyncio.sleep(0.4)  # let share pacing windows lapse
+        # Pushdown side.
+        b0, _r0, _s0 = await scan_stats()
+        t0 = time.perf_counter()
+        got = 0
+        async for _k, _v in col.scan(filter=pred):
+            got += 1
+        t_push = time.perf_counter() - t0
+        b1, _r1, _s1 = await scan_stats()
+        push_bytes = b1 - b0
+        assert got == cut, (got, cut)
+        await asyncio.sleep(0.4)
+        # Client-side filtering of the full stream (what PR 12
+        # offered): ship everything, test locally.
+        t0 = time.perf_counter()
+        got_c = 0
+        async for _k, v in col.scan():
+            if v["v"] < cut:
+                got_c += 1
+        t_client = time.perf_counter() - t0
+        b2, _r2, _s2 = await scan_stats()
+        client_bytes = b2 - b1
+        assert got_c == cut, (got_c, cut)
+        rate_push = n / t_push
+        rate_client = n / t_client
+        byte_ratio = client_bytes / max(1, push_bytes)
+        print(
+            f"selectivity {label:>5}: pushdown {t_push:.3f}s "
+            f"({rate_push:,.0f} keys-scanned/s, "
+            f"{push_bytes:,}B to client)  |  client-side "
+            f"{t_client:.3f}s ({rate_client:,.0f} keys/s, "
+            f"{client_bytes:,}B)  ->  bytes x{byte_ratio:,.1f} "
+            f"smaller, speedup x{rate_push / rate_client:.2f}"
+        )
+        report["selectivity"][label] = {
+            "pushdown_s": round(t_push, 4),
+            "pushdown_keys_scanned_per_s": round(rate_push),
+            "pushdown_client_bytes": push_bytes,
+            "client_side_s": round(t_client, 4),
+            "client_side_keys_per_s": round(rate_client),
+            "client_side_bytes": client_bytes,
+            "bytes_reduction_x": round(byte_ratio, 1),
+            "speedup_x": round(rate_push / rate_client, 2),
+        }
+
+    # Grouped aggregate: sum(v) grouped by a key prefix — replica
+    # partials only, no keys and no values on the wire.
+    await asyncio.sleep(0.4)
+    b0, _r, _s = await scan_stats()
+    t0 = time.perf_counter()
+    import msgpack as _mp
+
+    gp = len(_mp.packb(keys[0])) - 2  # group on all but last 2 chars
+    grouped = await col.count(
+        aggregate={"op": "sum", "field": "v", "group": gp}
+    )
+    t_agg = time.perf_counter() - t0
+    b1, _r, _s = await scan_stats()
+    t0 = time.perf_counter()
+    acc = {}
+    async for k, v in col.scan():
+        acc[k[:-2]] = acc.get(k[:-2], 0) + v["v"]
+    t_aggc = time.perf_counter() - t0
+    assert len(grouped) == len(acc) and sum(
+        grouped.values()
+    ) == sum(acc.values())
+    print(
+        f"grouped aggregate (sum/v, {len(grouped)} groups): "
+        f"pushdown {t_agg:.3f}s ({n / t_agg:,.0f} keys/s, "
+        f"{b1 - b0:,}B) vs client-side {t_aggc:.3f}s "
+        f"({n / t_aggc:,.0f} keys/s)  "
+        f"speedup x{t_aggc / t_agg:.2f}"
+    )
+    report["grouped_aggregate"] = {
+        "groups": len(grouped),
+        "pushdown_s": round(t_agg, 4),
+        "pushdown_keys_per_s": round(n / t_agg),
+        "pushdown_client_bytes": b1 - b0,
+        "client_side_s": round(t_aggc, 4),
+        "client_side_keys_per_s": round(n / t_aggc),
+        "speedup_x": round(t_aggc / t_agg, 2),
+    }
+    stats = await client.get_stats(args.host, args.port)
+    print(f"server filter block: {stats['scan']['filter']}")
+    report["server_filter_block"] = stats["scan"]["filter"]
+    client.close()
+    print("SCAN_FILTER_REPORT " + json.dumps(report))
+
+
 async def main_scan(args):
     """--scan (streaming scan plane, ISSUE 12): the two acceptance
     gates, same-session.  (1) Throughput: stream the whole keyspace
@@ -974,6 +1126,15 @@ def main():
         "governor pacing gate, all same-session",
     )
     ap.add_argument(
+        "--scan-filter",
+        action="store_true",
+        help="query-compute-plane phase (ISSUE 13): selectivity "
+        "sweep (100%%/10%%/0.1%%) of predicate pushdown vs "
+        "client-side filtering on client-received bytes and "
+        "keys-scanned/s, plus grouped-aggregate pushdown throughput "
+        "— all same-session",
+    )
+    ap.add_argument(
         "--telemetry-overhead",
         action="store_true",
         help="telemetry-plane A/B phase: lockstep set/get throughput "
@@ -1011,6 +1172,8 @@ def main():
         asyncio.run(main_knee_worker(args))
     elif args.telemetry_overhead:
         asyncio.run(main_telemetry_overhead(args))
+    elif args.scan_filter:
+        asyncio.run(main_scan_filter(args))
     elif args.scan:
         asyncio.run(main_scan(args))
     elif args.attribute:

@@ -1089,15 +1089,21 @@ async def overload_phase(nodes, report, quick):
 
 
 async def scan_phase(nodes, seeds, acks, report, quick):
-    """--scan (streaming scan plane, ISSUE 12): full-collection scans
+    """--scan (streaming scan plane, ISSUE 12; filtered stream,
+    ISSUE 13): full-collection scans AND predicate-pushdown scans
     WHILE a node churns (SIGKILL + restart mid-stream).  Gates:
-    (1) scans keep completing through the outage — the cursor walk
-    retries retryable chunks and every completed stream is sorted and
-    duplicate-free; (2) after the heal + a short quiet window, the
-    scan's view byte-agrees with quorum multi_gets of the journal's
-    acked keys (merge correctness under replica divergence); (3) the
-    scan stats block (chunks/cursor_resumes/sheds) is visible through
-    the client."""
+    (1) both stream kinds keep completing through the outage — the
+    cursor walk retries retryable chunks and every completed stream
+    is sorted and duplicate-free, with every filtered result
+    SATISFYING the predicate; (2) after the heal + a short quiet
+    window, the scan's view byte-agrees with quorum multi_gets of
+    the journal's acked keys, and the FILTERED view equals the
+    quorum-read ground truth under the same predicate (a healed
+    replica's stale copy must neither leak a non-matching doc in nor
+    suppress a matching one); (3) the scan + filter stats blocks are
+    visible through the client."""
+    from dbeel_tpu import query as Q
+
     client = await DbeelClient.from_seed_nodes(
         [("127.0.0.1", nodes[0].db_port)], op_deadline_s=12.0
     )
@@ -1106,9 +1112,15 @@ async def scan_phase(nodes, seeds, acks, report, quick):
     window_s = 20.0 if quick else 60.0
     down_s = 6.0 if quick else 15.0
     scans_completed = 0
+    filtered_scans_completed = 0
     scan_errors = 0
     order_violations = 0
+    predicate_violations = 0
     last_entries = 0
+    # Workers write {"v": version, "w": wid}: a partial-selectivity
+    # predicate over the worker lane (validated once, reused as the
+    # ground-truth matcher below).
+    wpred = Q.validate_where(["cmp", "w", "<=", 2])
 
     async def churner():
         await asyncio.sleep(2.0)
@@ -1120,15 +1132,34 @@ async def scan_phase(nodes, seeds, acks, report, quick):
 
     churn_task = asyncio.create_task(churner())
     t0 = time.time()
+    flip = 0
     while time.time() - t0 < window_s:
+        filtered = flip % 2 == 1
+        flip += 1
         try:
             keys = []
-            async for k, _v in col.scan():
-                keys.append(k)
-            if keys != sorted(keys) or len(keys) != len(set(keys)):
+            if filtered:
+                async for k, v in col.scan(filter=wpred):
+                    keys.append(k)
+                    if not (
+                        isinstance(v, dict) and v.get("w", 99) <= 2
+                    ):
+                        predicate_violations += 1
+                filtered_scans_completed += 1
+            else:
+                async for k, _v in col.scan():
+                    keys.append(k)
+                scans_completed += 1
+                last_entries = len(keys)
+            # Stream order is ENCODED-key byte order (the storage
+            # order) by contract — compare in that domain: python
+            # string order diverges on mixed-length keys (fixstr
+            # headers sort all 4-char keys before any 5-char one,
+            # e.g. the overload phase's ovl9 < ovl10 on the wire but
+            # not in str order).
+            enc = [msgpack.packb(k, use_bin_type=True) for k in keys]
+            if enc != sorted(enc) or len(enc) != len(set(enc)):
                 order_violations += 1
-            scans_completed += 1
-            last_entries = len(keys)
         except Exception as e:
             scan_errors += 1
             log(f"SCAN: stream failed ({classify_error(e)}): {e!r}")
@@ -1142,36 +1173,60 @@ async def scan_phase(nodes, seeds, acks, report, quick):
     final = {}
     async for k, v in col.scan():
         final[k] = v
+    filtered_final = {}
+    async for k, v in col.scan(filter=wpred):
+        filtered_final[k] = v
+    filtered_count = await col.count(filter=wpred)
     journal_keys = sorted(acks.last)[:400]
     got = await col.multi_get(journal_keys)
     disagree = []
+    filtered_disagree = []
     for k, v in zip(journal_keys, got):
         if v is None:
             if k in final:
                 disagree.append(k)
         elif final.get(k) != v:
             disagree.append(k)
+        # Healed filtered view == quorum ground truth under the SAME
+        # predicate (golden evaluator both sides).
+        matches = v is not None and Q.match_entry(
+            wpred, msgpack.packb(k), msgpack.packb(v)
+        )
+        if matches != (k in filtered_final) or (
+            matches and filtered_final.get(k) != v
+        ):
+            filtered_disagree.append(k)
     stats = await client.get_stats(
         "127.0.0.1", nodes[0].db_port
     )
     block = stats.get("scan") or {}
+    filter_block = block.get("filter") or {}
     client.close()
     alive = all(n_.alive() for n_ in nodes)
     ok_gate = (
         alive
         and scans_completed >= 1
+        and filtered_scans_completed >= 1
         and order_violations == 0
+        and predicate_violations == 0
         and not disagree
+        and not filtered_disagree
+        and filtered_count == len(filtered_final)
         and block.get("chunks", 0) > 0
     )
     phase = {
         "window_s": window_s,
         "scans_completed": scans_completed,
+        "filtered_scans_completed": filtered_scans_completed,
         "scan_errors_during_churn": scan_errors,
         "order_violations": order_violations,
+        "predicate_violations": predicate_violations,
         "final_scan_entries": last_entries,
+        "filtered_final_entries": len(filtered_final),
+        "filtered_count_verb": filtered_count,
         "journal_keys_compared": len(journal_keys),
         "scan_vs_multiget_disagreements": disagree[:10],
+        "filtered_vs_quorum_disagreements": filtered_disagree[:10],
         "stats_scan_block": {
             k: block.get(k)
             for k in (
@@ -1181,6 +1236,15 @@ async def scan_phase(nodes, seeds, acks, report, quick):
                 "cursor_resumes",
                 "sheds",
                 "replica_errors",
+            )
+        },
+        "stats_filter_block": {
+            k: filter_block.get(k)
+            for k in (
+                "specs_served",
+                "rows_scanned",
+                "rows_returned",
+                "bytes_saved",
             )
         },
         "nodes_alive": alive,

@@ -23,6 +23,7 @@ import msgpack
 
 from ..errors import (
     ERROR_CLASS_OVERLOAD,
+    BadFieldType,
     ConnectionError_,
     DbeelError,
     KeyNotFound,
@@ -1041,6 +1042,7 @@ class DbeelCollection:
         limit: Optional[int] = None,
         max_bytes: Optional[int] = None,
         trace_id: Optional[int] = None,
+        filter: Optional[Any] = None,
     ):
         """Streaming full/range scan (scan plane, PR 12): an async
         generator yielding (key, value) pairs — decoded documents —
@@ -1053,7 +1055,15 @@ class DbeelCollection:
         ``prefix`` filters on the msgpack-ENCODED key bytes (pushed
         down to the vectorized storage stage).  ``limit`` caps total
         yielded entries; ``max_bytes`` lowers the per-chunk byte
-        budget below the server's ``--scan-bytes-per-slice``."""
+        budget below the server's ``--scan-bytes-per-slice``.
+
+        ``filter`` (query compute plane, PR 13) is a predicate tree
+        — see dbeel_tpu.query (e.g. ``["and", ["cmp", "temp", ">=",
+        20], ["prefix", "city", "san"]]``) — evaluated VECTORIZED on
+        the replicas over their staged columns: non-matching values
+        never cross any wire, and the per-chunk budget bills bytes
+        scanned, so a selective scan returns in the same bounded
+        chunks with ~none of the bytes."""
         request: dict = {"type": "scan", "collection": self.name}
         if prefix:
             request["prefix"] = bytes(prefix)
@@ -1061,6 +1071,11 @@ class DbeelCollection:
             request["limit"] = int(limit)
         if max_bytes:
             request["max_bytes"] = int(max_bytes)
+        if filter is not None:
+            from .. import query as _query
+
+            w, _ = _query.build_spec(filter, None)
+            request["spec"] = _query.pack_spec(w, None)
         if isinstance(trace_id, int) and trace_id > 0:
             request["trace"] = trace_id
         while True:
@@ -1081,17 +1096,43 @@ class DbeelCollection:
         self,
         prefix: Optional[bytes] = None,
         limit: Optional[int] = None,
-    ) -> int:
+        filter: Optional[Any] = None,
+        aggregate: Optional[dict] = None,
+    ) -> Any:
         """Count live documents (optionally under an encoded-key
         prefix) WITHOUT materializing a single value: replicas stream
         keys-only pages (vectorized count pushdown), the coordinator
         merge dedups/count them, and only the running total crosses
-        back per chunk."""
+        back per chunk.
+
+        ``filter`` (query compute plane, PR 13) counts only matching
+        documents.  ``aggregate`` (e.g. ``{"op": "sum", "field":
+        "qty"}``, optionally ``{"group": L}`` for a group-by on the
+        first L encoded-key bytes) returns the aggregate instead of
+        the count — computed replica-side from the staged columns
+        where possible, combined as exact per-arc partials, with the
+        running state riding the resumable cursor.  Grouped results
+        come back as {key_prefix_bytes: value}."""
         request: dict = {
             "type": "scan",
             "collection": self.name,
-            "count": True,
         }
+        if aggregate is not None:
+            from .. import query as _query
+
+            w, a = _query.build_spec(filter, aggregate)
+            request["spec"] = _query.pack_spec(w, a)
+            if limit:
+                raise BadFieldType(
+                    "limit is not supported with an aggregate"
+                )
+        else:
+            request["count"] = True
+            if filter is not None:
+                from .. import query as _query
+
+                w, _ = _query.build_spec(filter, None)
+                request["spec"] = _query.pack_spec(w, None)
         if prefix:
             request["prefix"] = bytes(prefix)
         if limit:
@@ -1102,6 +1143,8 @@ class DbeelCollection:
             total = int(chunk.get("count") or 0)
             cursor = chunk.get("cursor")
             if not cursor:
+                if aggregate is not None:
+                    return chunk.get("agg")
                 return total
             request = {"type": "scan_next", "cursor": cursor}
 
@@ -1180,17 +1223,27 @@ class SyncCollection:
     def get(self, key, consistency=None):
         return self._c._run(self._col.get(key, consistency))
 
-    def scan(self, prefix=None, limit=None, max_bytes=None):
+    def scan(
+        self, prefix=None, limit=None, max_bytes=None, filter=None
+    ):
         async def collect():
             out = []
-            async for kv in self._col.scan(prefix, limit, max_bytes):
+            async for kv in self._col.scan(
+                prefix, limit, max_bytes, filter=filter
+            ):
                 out.append(kv)
             return out
 
         return self._c._run(collect())
 
-    def count(self, prefix=None, limit=None):
-        return self._c._run(self._col.count(prefix, limit))
+    def count(
+        self, prefix=None, limit=None, filter=None, aggregate=None
+    ):
+        return self._c._run(
+            self._col.count(
+                prefix, limit, filter=filter, aggregate=aggregate
+            )
+        )
 
     def delete(self, key, consistency=None):
         self._c._run(self._col.delete(key, consistency))

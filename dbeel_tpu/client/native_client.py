@@ -157,6 +157,7 @@ def _bind(lib) -> None:
             ctypes.c_uint64,
         ]
     if hasattr(lib, "dbeel_cli_scan_chunk"):  # scan plane (PR 12)
+        # +spec pass-through (query compute plane, PR 13).
         lib.dbeel_cli_scan_chunk.restype = ctypes.c_int64
         lib.dbeel_cli_scan_chunk.argtypes = [
             ctypes.c_void_p,
@@ -170,6 +171,8 @@ def _bind(lib) -> None:
             ctypes.c_uint32,
             ctypes.c_uint64,
             ctypes.c_uint64,
+            u8p,
+            ctypes.c_uint32,
             u8p,
             ctypes.c_uint64,
         ]
@@ -357,11 +360,14 @@ class NativeDbeelClient:
         prefix: Optional[bytes],
         limit: int,
         max_bytes: int,
+        spec: Optional[bytes] = None,
         ip: str = "",
         port: int = 0,
     ) -> dict:
         """One raw scan chunk through the C client (retryable server
-        sheds back off and resume — the cursor is client-held)."""
+        sheds back off and resume — the cursor is client-held).
+        ``spec`` is the packed filter/aggregate blob
+        (dbeel_tpu.query.pack_spec), forwarded verbatim."""
         if not hasattr(self._lib, "dbeel_cli_scan_chunk"):
             raise DbeelError(
                 "native library predates dbeel_cli_scan_chunk"
@@ -374,6 +380,11 @@ class NativeDbeelClient:
         pfx = (
             (ctypes.c_uint8 * len(prefix)).from_buffer_copy(prefix)
             if prefix
+            else None
+        )
+        spc = (
+            (ctypes.c_uint8 * len(spec)).from_buffer_copy(spec)
+            if spec
             else None
         )
         cap = 1 << 20
@@ -392,6 +403,8 @@ class NativeDbeelClient:
                 len(prefix) if prefix else 0,
                 limit,
                 max_bytes,
+                spc,
+                len(spec) if spec else 0,
                 buf,
                 cap,
             )
@@ -418,16 +431,27 @@ class NativeDbeelClient:
         prefix: Optional[bytes] = None,
         limit: int = 0,
         max_bytes: int = 0,
+        filter: Optional[Any] = None,
     ) -> list:
         """Full/range streaming scan through the C client: decoded
         (key, value) pairs in encoded-key byte order, chunked and
         cursor-resumed under the hood (same stream semantics as the
-        Python client's ``DbeelCollection.scan``)."""
+        Python client's ``DbeelCollection.scan``).  ``filter`` is a
+        predicate tree (dbeel_tpu.query) pushed down to the
+        replicas' staged columns — spec pass-through: this client
+        packs it once and forwards bytes."""
+        spec = None
+        if filter is not None:
+            from .. import query as _query
+
+            w, _ = _query.build_spec(filter, None)
+            spec = _query.pack_spec(w, None)
         out: list = []
         cursor: Optional[bytes] = None
         while True:
             chunk = self._scan_chunk(
-                collection, cursor, False, prefix, limit, max_bytes
+                collection, cursor, False, prefix, limit,
+                max_bytes, spec,
             )
             # Entries decode with the chunk itself (spliced stored
             # encodings — one unpack per chunk).
@@ -442,18 +466,39 @@ class NativeDbeelClient:
         collection: str,
         prefix: Optional[bytes] = None,
         limit: int = 0,
-    ) -> int:
+        filter: Optional[Any] = None,
+        aggregate: Optional[dict] = None,
+    ) -> Any:
         """Live-document count via the keys-only pushdown — no value
-        bytes cross any wire."""
+        bytes cross any wire.  ``filter`` counts matches only;
+        ``aggregate`` returns the pushed-down aggregate result
+        instead (the final chunk's "agg" field), mirroring the
+        Python client's ``DbeelCollection.count``."""
+        spec = None
+        count_only = True
+        if aggregate is not None:
+            from .. import query as _query
+
+            w, a = _query.build_spec(filter, aggregate)
+            spec = _query.pack_spec(w, a)
+            count_only = False
+        elif filter is not None:
+            from .. import query as _query
+
+            w, _ = _query.build_spec(filter, None)
+            spec = _query.pack_spec(w, None)
         cursor: Optional[bytes] = None
         total = 0
         while True:
             chunk = self._scan_chunk(
-                collection, cursor, True, prefix, limit, 0
+                collection, cursor, count_only, prefix, limit, 0,
+                spec,
             )
             total = int(chunk.get("count") or 0)
             cursor = chunk.get("cursor")
             if not cursor:
+                if aggregate is not None:
+                    return chunk.get("agg")
                 return total
 
     def create_collection(

@@ -305,6 +305,7 @@ class ShardRequest:
         limit: int,
         max_bytes: int,
         with_values: bool,
+        spec: Optional[bytes] = None,
     ) -> list:
         """Streaming scan page (scan plane, PR 12): up to ``limit``
         entries / ``max_bytes`` emitted bytes of [key, value, ts]
@@ -317,7 +318,14 @@ class ShardRequest:
         keys-only pushdown: values never cross the wire).  The
         response's trailing ``more`` flag tells the coordinator
         whether this replica's stream has entries beyond the page.
-        Arity is lint-pinned (shard._SCAN_PEER_ARITY)."""
+
+        ``spec`` (query compute plane, PR 13) is a packed peer
+        filter/aggregate spec (query.pack_peer_spec): the replica
+        evaluates the predicate over its staged columns and pages by
+        bytes SCANNED — entry shape then depends on the spec's mode
+        (see query.py), and the response trailer carries
+        cover/scanned/partial fields.  Arity is lint-pinned
+        (shard._SCAN_PEER_ARITY, native kScanPeerArity)."""
         return [
             "request",
             ShardRequest.SCAN,
@@ -329,6 +337,7 @@ class ShardRequest:
             limit,
             max_bytes,
             with_values,
+            spec,
         ]
 
     @staticmethod
@@ -442,10 +451,32 @@ class ShardResponse:
         return ["response", ShardResponse.RANGE_PULL, entries]
 
     @staticmethod
-    def scan(entries: list, more: bool) -> list:
+    def scan(
+        entries: list,
+        more: bool,
+        cover: "Optional[bytes]" = None,
+        scanned_rows: int = 0,
+        scanned_bytes: int = 0,
+        agg=None,
+    ) -> list:
         # One scan page: [[key, value|nil, ts], ...] ascending by
         # key; ``more`` = entries remain beyond the page's last key.
-        return ["response", ShardResponse.SCAN, entries, more]
+        # Filtered pages (query compute plane, PR 13) append the
+        # resume trailer: ``cover`` = last key SCANNED (the window
+        # may match nothing), scanned rows/bytes (what the
+        # coordinator bills against --scan-bytes-per-slice), and the
+        # drop-mode partial aggregate state.  The base 4-element
+        # prefix is unchanged, so a spec-less parser still reads it.
+        return [
+            "response",
+            ShardResponse.SCAN,
+            entries,
+            more,
+            cover,
+            scanned_rows,
+            scanned_bytes,
+            agg,
+        ]
 
     @staticmethod
     def error(err: DbeelError) -> list:

@@ -1,0 +1,244 @@
+"""Device twins of the query-plane filter/aggregate kernels (PR 13).
+
+The scan plane's pushdown evaluator (storage/query_vec.py) is numpy
+on the host — always on, no backend to wake.  This module holds the
+SAME kernels under ``jax.jit`` for the device-offload thesis (LUDA's
+GPU filters, this repo's TPU tunnel): numeric leaf masks, mask
+combination, and the sum/min/max reductions over a staged float64
+column.  Exactness contract: the device path only ever evaluates the
+float64 numeric lane — the byte lanes and the exact-int fix-up rows
+stay on the host evaluator, so a device mask is bit-equal to the
+numpy mask by construction (both compare float64 against the same
+scalar; non-fix int rows are <= 2^53 so the cast is exact).
+
+Gating mirrors the device-compaction plane: the jax_gate verdict must
+not be "dead", and the backend is only engaged when it is a real
+accelerator OR ``DBEEL_QUERY_DEVICE=cpu_ok`` forces the jit CPU
+backend (parity tests; on a CPU-only host jit adds dispatch overhead
+for nothing, so it stays off by default).  The first successful
+device evaluation of a round persists its working config to
+``DEVICE_LAST_GOOD.json`` (the device-capture discipline: wakes are
+rare, every one must leave an artifact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+# Below this many rows the jit dispatch overhead exceeds the numpy
+# kernel outright; the host path serves small stages regardless of
+# the gate.
+MIN_DEVICE_ROWS = 4096
+
+_lock = threading.Lock()
+_state: dict = {"checked": False, "ok": False, "platform": None}
+_persisted = False
+
+
+def _last_good_path() -> str:
+    override = os.environ.get("DBEEL_DEVICE_LAST_GOOD")
+    if override:
+        return override
+    return os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "DEVICE_LAST_GOOD.json",
+    )
+
+
+def available() -> bool:
+    """True when the jitted query kernels may serve evaluations.
+    Never probes a possibly-wedged tunnel from the serving path: the
+    jax_gate verdict (set by a prior probe / parent process) decides,
+    and plain CPU backends stay host-side unless explicitly forced."""
+    with _lock:
+        if _state["checked"]:
+            return _state["ok"]
+        _state["checked"] = True
+        _state["ok"] = False
+    force = os.environ.get("DBEEL_QUERY_DEVICE", "")
+    if force in ("0", "off"):
+        return False
+    from ..utils.jax_gate import jax_marked_dead
+
+    if jax_marked_dead():
+        return False
+    if not force and os.environ.get("DBEEL_JAX_PROBED") != "ok":
+        # No explicit opt-in and no prior successful probe:
+        # jax.devices() on a dead tunnel is an unbounded hang (the
+        # exact failure jax_gate exists for) — never risk it from
+        # the serving path.
+        return False
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    ok = platform != "cpu" or force in ("1", "cpu_ok")
+    with _lock:
+        _state["ok"] = ok
+        _state["platform"] = platform
+    return ok
+
+
+def platform() -> Optional[str]:
+    return _state.get("platform")
+
+
+def _persist_wake(rows: int) -> None:
+    """First successful device evaluation of the process: persist the
+    working config under DEVICE_LAST_GOOD.json (same artifact the
+    compaction bench feeds) so the next tunnel-down round can cite a
+    known-good query-kernel config instead of guessing."""
+    global _persisted
+    with _lock:
+        if _persisted:
+            return
+        _persisted = True
+    path = _last_good_path()
+    try:
+        import fcntl
+
+        with open(path + ".lock", "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    data = {}
+            except Exception:
+                data = {}
+            data["query_filter"] = {
+                "timestamp_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                ),
+                "platform": _state.get("platform"),
+                "rows": int(rows),
+                "jax_platforms_env": os.environ.get(
+                    "JAX_PLATFORMS", ""
+                ),
+                "kernels": "cmp_f64/jit + sum_min_max_f64/jit",
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+    except Exception:
+        pass  # the artifact is best-effort provenance, never serving
+
+
+_jitted = None
+
+
+def _kernels():
+    """Build (once) the jitted kernel table."""
+    global _jitted
+    if _jitted is not None:
+        return _jitted
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("op",))
+    def cmp_f64(vals, valid, operand, op):
+        if op == "==":
+            m = vals == operand
+        elif op == "!=":
+            m = vals != operand
+        elif op == "<":
+            m = vals < operand
+        elif op == "<=":
+            m = vals <= operand
+        elif op == ">":
+            m = vals > operand
+        else:
+            m = vals >= operand
+        return jnp.logical_and(m, valid)
+
+    @jax.jit
+    def range_f64(vals, valid, lo, hi, use_lo, use_hi):
+        m = valid
+        m = jnp.logical_and(
+            m, jnp.where(use_lo, vals >= lo, True)
+        )
+        m = jnp.logical_and(m, jnp.where(use_hi, vals < hi, True))
+        return m
+
+    @jax.jit
+    def sum_f64(vals, mask):
+        return jnp.sum(jnp.where(mask, vals, 0.0))
+
+    @jax.jit
+    def min_max_f64(vals, mask):
+        mn = jnp.min(jnp.where(mask, vals, jnp.inf))
+        mx = jnp.max(jnp.where(mask, vals, -jnp.inf))
+        return mn, mx
+
+    _jitted = {
+        "cmp": cmp_f64,
+        "range": range_f64,
+        "sum": sum_f64,
+        "min_max": min_max_f64,
+    }
+    return _jitted
+
+
+def eval_cmp_f64(
+    vals: np.ndarray, valid: np.ndarray, operand: float, op: str
+) -> Optional[np.ndarray]:
+    """Device twin of the numpy float64 comparison leaf, or None when
+    the gate is closed / the kernel fails (caller stays on numpy)."""
+    if op not in _OPS or not available():
+        return None
+    if vals.size < MIN_DEVICE_ROWS:
+        return None
+    try:
+        k = _kernels()
+        out = np.asarray(
+            k["cmp"](vals, valid, float(operand), op)
+        )
+        _persist_wake(vals.size)
+        return out
+    except Exception:
+        with _lock:
+            _state["ok"] = False  # flapped mid-round: host owns it
+        return None
+
+
+def eval_range_f64(
+    vals: np.ndarray,
+    valid: np.ndarray,
+    lo: Optional[float],
+    hi: Optional[float],
+) -> Optional[np.ndarray]:
+    if not available() or vals.size < MIN_DEVICE_ROWS:
+        return None
+    try:
+        k = _kernels()
+        out = np.asarray(
+            k["range"](
+                vals,
+                valid,
+                0.0 if lo is None else float(lo),
+                0.0 if hi is None else float(hi),
+                lo is not None,
+                hi is not None,
+            )
+        )
+        _persist_wake(vals.size)
+        return out
+    except Exception:
+        with _lock:
+            _state["ok"] = False
+        return None

@@ -1753,14 +1753,15 @@ class MyShard:
         ShardRequest.MULTI_GET: 5,
     }
 
-    # Fixed arity of the SCAN peer frame (scan plane, PR 12):
+    # Fixed arity of the SCAN peer frame (scan plane, PR 12; spec
+    # element appended by the query compute plane, PR 13):
     # ["request","scan",collection,start,end,start_after,prefix,
-    #  limit,max_bytes,with_values].  No trailing deadline/trace
+    #  limit,max_bytes,with_values,spec].  No trailing deadline/trace
     # dialects — scan pages ride pooled round trips like the RANGE_*
     # family (the chunk-level deadline lives on the CLIENT frame).
     # Lint-pinned against the encoder and both C sources
-    # (analysis/wire_parity.py).
-    _SCAN_PEER_ARITY = 10
+    # (analysis/wire_parity.py; native kScanPeerArity).
+    _SCAN_PEER_ARITY = 11
 
     @classmethod
     def peer_trace_id(cls, request) -> Optional[int]:
@@ -1972,18 +1973,37 @@ class MyShard:
             col = self.collections.get(request[2])
             entries: list = []
             more = False
-            if col is not None:
-                start_after = (
-                    bytes(request[5])
-                    if request[5] is not None
-                    else None
-                )
-                prefix = bytes(request[6]) if request[6] else None
-                limit = max(1, min(int(request[7]), 65536))
-                max_bytes = max(
-                    4096, min(int(request[8]), 16 << 20)
-                )
-                entries, more = await col.tree.scan_page(
+            if col is None:
+                return ShardResponse.scan(entries, more)
+            start_after = (
+                bytes(request[5])
+                if request[5] is not None
+                else None
+            )
+            prefix = bytes(request[6]) if request[6] else None
+            limit = max(1, min(int(request[7]), 65536))
+            max_bytes = max(
+                4096, min(int(request[8]), 16 << 20)
+            )
+            spec = request[10] if len(request) > 10 else None
+            if spec is not None:
+                # Query compute plane (PR 13): predicate/aggregate
+                # pushdown over the staged columns.  The peer spec
+                # is re-validated HERE — it crossed a network — and
+                # a malformed one raises the clean BadFieldType the
+                # coordinator relays, never a shard death.
+                from .. import query as Q
+
+                where, agg, mode = Q.unpack_peer_spec(spec)
+                (
+                    entries,
+                    more,
+                    cover,
+                    scanned_rows,
+                    scanned_bytes,
+                    partial,
+                    eval_path,
+                ) = await col.tree.scan_filter_page(
                     int(request[3]),
                     int(request[4]),
                     start_after,
@@ -1991,7 +2011,33 @@ class MyShard:
                     limit,
                     max_bytes,
                     bool(request[9]),
+                    where,
+                    agg,
+                    mode,
                 )
+                if eval_path == "device":
+                    self.scan_plane.device_evals += 1
+                elif eval_path in ("numpy", "golden"):
+                    self.scan_plane.fallback_evals += 1
+                if partial is not None:
+                    self.scan_plane.agg_partials += 1
+                return ShardResponse.scan(
+                    entries,
+                    more,
+                    cover,
+                    scanned_rows,
+                    scanned_bytes,
+                    partial,
+                )
+            entries, more = await col.tree.scan_page(
+                int(request[3]),
+                int(request[4]),
+                start_after,
+                prefix,
+                limit,
+                max_bytes,
+                bool(request[9]),
+            )
             return ShardResponse.scan(entries, more)
         if kind == ShardRequest.RANGE_PUSH:
             col = self.collections.get(request[2])

@@ -164,6 +164,10 @@ _RATES = {
     "scan_chunks_per_s": ("scan.chunks",),
     "scan_bytes_per_s": ("scan.bytes_streamed",),
     "scan_sheds_per_s": ("scan.sheds",),
+    # Query compute plane (PR 13): predicate-pushdown examination
+    # rate — rows the vectorized filter evaluated per second
+    # (scanned, not returned; the work the governor bills).
+    "scan_rows_filtered_per_s": ("scan.filter.rows_scanned",),
 }
 
 

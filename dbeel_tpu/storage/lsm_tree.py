@@ -1621,6 +1621,296 @@ class LSMTree:
             with_values,
         )
 
+    async def scan_filter_page(
+        self,
+        start: int,
+        end: int,
+        start_after,
+        prefix,
+        limit: int,
+        max_bytes: int,
+        with_values: bool,
+        where,
+        agg,
+        mode: str,
+    ) -> tuple:
+        """One filtered/aggregated scan page (query compute plane,
+        PR 13): ``(entries, more, cover, scanned_rows,
+        scanned_bytes, agg_partial, eval_path)``.
+
+        The window advances by bytes SCANNED (key+value+overhead of
+        every arc-member row examined), so a selective predicate
+        still pages in bounded work and the ``cover`` key lets the
+        coordinator resume past a window that matched nothing.
+        ``mode`` is the peer-spec contract (query.MODE_DROP /
+        MODE_MARK — see query.py): drop emits matching rows only
+        (or, with ``agg``, just a partial state); mark emits EVERY
+        newest-per-key row as [key, payload, ts, flag] so the
+        coordinator's newest-wins dedup decides acceptance.
+        ``eval_path`` says which evaluator ran ("device" / "numpy" /
+        "cached" / "golden") for the stats plane."""
+        from .. import query as Q
+        from . import query_vec
+
+        stage = await self._current_scan_stage()
+        if stage is None:
+            return await self._scan_filter_page_fallback(
+                start, end, start_after, prefix, limit, max_bytes,
+                with_values, where, agg, mode,
+            )
+        hold_list = None
+        if stage._hold is None and stage is self._scan_stage:
+            hold_list = self._scan_stage_list
+            if hold_list is not None:
+                hold_list.acquire()
+        try:
+            need_build = bool(
+                Q.spec_fields(where, agg)
+                - set(stage._field_cols)
+            ) or (
+                # A mask-cache miss re-evaluates the whole tree —
+                # including any O(n) scalar-leaf loops (trailing-NUL
+                # operands, >2^53 ints) — so it goes off-loop even
+                # when every column already exists.
+                where is not None
+                and msgpack.packb(where, use_bin_type=True)
+                not in stage._mask_cache
+            )
+
+            def _select():
+                pos, more, sbytes = stage.select_window(
+                    start, end, start_after, prefix, limit,
+                    max_bytes,
+                )
+                mask, path = query_vec.eval_where(stage, where)
+                sub = mask[pos]
+                matched = pos[sub]
+                partial = None
+                if agg is not None and mode == Q.MODE_DROP:
+                    partial = query_vec.agg_partial_for(
+                        stage, matched, agg
+                    )
+                return pos, more, sbytes, sub, matched, partial, path
+
+            # The first evaluation of a spec decodes the targeted
+            # field for EVERY staged row (the batched column build):
+            # always off-loop.  Re-evaluations are cached-mask
+            # lookups plus a window searchsorted — loop-side below
+            # the same size bar scan_page uses.
+            if need_build or stage.n >= 200_000:
+                (
+                    pos, more, sbytes, sub, matched, partial, path,
+                ) = await asyncio.get_event_loop().run_in_executor(
+                    None, _select
+                )
+            else:
+                (
+                    pos, more, sbytes, sub, matched, partial, path,
+                ) = _select()
+            cover = (
+                stage.key_at(int(pos[-1])) if pos.size else None
+            )
+            if mode == Q.MODE_DROP:
+                entries: list = []
+                if agg is None:
+                    for j in range(0, len(matched), 512):
+                        entries.extend(
+                            stage.entries_at(
+                                matched[j : j + 512], with_values
+                            )
+                        )
+                        await asyncio.sleep(0)
+            else:  # mark: every newest-per-key row, flagged
+                keys = stage.keys[pos].tolist()
+                ts = stage.ts[pos].tolist()
+                vl = stage.vlen[pos].tolist()
+                flags = sub.tolist()
+                fcol = (
+                    query_vec.field_column(stage, agg["field"])
+                    if agg is not None and agg.get("field")
+                    else None
+                )
+                entries = []
+                for i, p in enumerate(pos.tolist()):
+                    if vl[i] == 0:
+                        entries.append([keys[i], b"", ts[i], 0])
+                        continue
+                    if not flags[i]:
+                        entries.append([keys[i], None, ts[i], 0])
+                        continue
+                    if agg is not None:
+                        payload = (
+                            fcol.typed_at(p)
+                            if fcol is not None
+                            else None
+                        )
+                        if isinstance(payload, bytes):
+                            payload = None  # non-numeric: never folds
+                    elif with_values:
+                        payload = query_vec._value_bytes(stage, p)
+                    else:
+                        payload = None
+                    entries.append([keys[i], payload, ts[i], 1])
+                    if i and i % 512 == 0:
+                        await asyncio.sleep(0)
+            return (
+                entries,
+                more,
+                cover,
+                int(pos.size),
+                int(sbytes),
+                partial,
+                path,
+            )
+        except CorruptedFile as e:
+            # Column build / value materialization hit a flipped
+            # page: quarantine the attributed table so repair starts
+            # NOW, then error retryably (the coordinator stream dies
+            # and the client resumes elsewhere) — same contract as
+            # the unfiltered staged path.
+            self.quarantine_by_exception(
+                e,
+                [
+                    s.table
+                    for s in stage.sources
+                    if not isinstance(s, list)
+                ],
+            )
+            raise
+        finally:
+            if hold_list is not None:
+                hold_list.release()
+            if stage._hold is not None:
+                stage._hold.release()
+                stage._hold = None
+
+    async def _scan_filter_page_fallback(
+        self,
+        start: int,
+        end: int,
+        start_after,
+        prefix,
+        limit: int,
+        max_bytes: int,
+        with_values: bool,
+        where,
+        agg,
+        mode: str,
+    ) -> tuple:
+        """Golden per-entry filtered page (tiny trees / guard trips):
+        the reference evaluator the vectorized path is byte-identical
+        to, with the same scanned-window accounting."""
+        from ..utils.murmur import hash_bytes as _hash_bytes
+        from .. import query as Q
+        from . import scan_stage as ss
+
+        newest: dict = {}
+        async for key, value, ts in self.iter_filter(None):
+            if start_after is not None and key <= start_after:
+                continue
+            if prefix and not key.startswith(prefix):
+                continue
+            h = _hash_bytes(key)
+            width = (end - start) & 0xFFFFFFFF
+            if width != 0 and ((h - start) & 0xFFFFFFFF) >= width:
+                continue
+            prev = newest.get(key)
+            if prev is None or ts > prev[1]:
+                newest[key] = (value, ts)
+        items = sorted(newest.items())
+        entries: list = []
+        partial_state = None
+        agg_rows: list = []
+        scanned = 0
+        used = 0
+        more = False
+        cover = None
+        for i, (key, (value, ts)) in enumerate(items):
+            # Window cut mirrors ScanStage.select_window exactly
+            # (the byte-identical contract includes covers and
+            # scanned accounting): rows accumulate until the first
+            # one that REACHES the budget, inclusive.
+            cost = len(key) + ss.ENTRY_OVERHEAD + len(value)
+            used += cost
+            scanned += 1
+            cover = key
+            stop = scanned >= limit or used >= max_bytes
+            matched = Q.match_entry(where, key, value)
+            if mode == Q.MODE_DROP:
+                if matched:
+                    if agg is not None:
+                        agg_rows.append((key, value))
+                    elif with_values:
+                        entries.append([key, value, ts])
+                    else:
+                        entries.append([key, None, ts])
+            else:  # mark
+                if len(value) == 0:
+                    entries.append([key, b"", ts, 0])
+                elif not matched:
+                    entries.append([key, None, ts, 0])
+                elif agg is not None:
+                    x = Q.field_value(
+                        Q.decode_doc(value), agg["field"]
+                    ) if agg.get("field") else None
+                    if isinstance(x, (str, bytes)):
+                        x = None
+                    entries.append([key, x, ts, 1])
+                elif with_values:
+                    entries.append([key, value, ts, 1])
+                else:
+                    entries.append([key, None, ts, 1])
+            if stop:
+                more = i + 1 < len(items)
+                break
+        if agg is not None and mode == Q.MODE_DROP:
+            group = agg["group"]
+            if group:
+                groups: dict = {}
+                for key, value in agg_rows:
+                    x = (
+                        Q.field_value(
+                            Q.decode_doc(value), agg["field"]
+                        )
+                        if agg.get("field")
+                        else None
+                    )
+                    if not Q.contributes(agg["op"], x):
+                        continue
+                    g = key[:group]
+                    st = groups.get(g)
+                    if st is None:
+                        st = groups[g] = Q.agg_new()
+                    Q.agg_fold(
+                        st,
+                        agg["op"],
+                        None if agg["op"] == "count" else x,
+                    )
+                partial_state = [
+                    [g, st] for g, st in sorted(groups.items())
+                ]
+            else:
+                partial_state = Q.agg_new()
+                for key, value in agg_rows:
+                    x = (
+                        Q.field_value(
+                            Q.decode_doc(value), agg["field"]
+                        )
+                        if agg.get("field")
+                        else None
+                    )
+                    if not Q.contributes(agg["op"], x):
+                        continue
+                    Q.agg_fold(
+                        partial_state,
+                        agg["op"],
+                        None if agg["op"] == "count" else x,
+                    )
+        return (
+            entries, more, cover, scanned, used, partial_state,
+            "golden",
+        )
+
     async def _scan_page_fallback(
         self,
         start: int,

@@ -44,6 +44,11 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..errors import CorruptedFile
+
+# Prefix-window upper bound: one definition, shared with the golden
+# evaluator (query.py) so the staged window cut and the per-entry
+# reference can never diverge on the all-0xff edge.
+from ..query import increment_prefix  # noqa: F401  (re-exported)
 from . import checksums
 from . import native as native_mod
 from .columnar import ranges_to_positions
@@ -64,17 +69,6 @@ MIN_VECTORIZED_ENTRIES = 512
 ENTRY_OVERHEAD = 16
 
 
-def increment_prefix(prefix: bytes) -> Optional[bytes]:
-    """Smallest byte string greater than every string with ``prefix``:
-    the exclusive upper bound of a prefix window.  None when the
-    prefix is all 0xff (no upper bound exists)."""
-    b = bytearray(prefix)
-    while b:
-        if b[-1] != 0xFF:
-            b[-1] += 1
-            return bytes(b)
-        b.pop()
-    return None
 
 
 class _TableSrc:
@@ -149,6 +143,11 @@ class ScanStage:
         "sources",
         "n",
         "_hold",  # optional ScanSnapshot pinning table refs
+        # Query compute plane (PR 13): lazily-built per-field value
+        # columns and per-predicate match masks, cached for the
+        # stage lifetime like the key matrix (storage/query_vec.py).
+        "_field_cols",
+        "_mask_cache",
     )
 
     def __init__(
@@ -165,6 +164,8 @@ class ScanStage:
         self.sources = sources  # SSTable objects; last = memtable items
         self.n = int(keys.size)
         self._hold = None
+        self._field_cols: dict = {}
+        self._mask_cache: dict = {}
 
     # -- page selection (pure numpy; executor-safe) --------------------
 
@@ -226,6 +227,66 @@ class ScanStage:
         m = int(np.searchsorted(cum, max_bytes, side="left")) + 1
         m = max(1, min(m, int(limit), int(pos.size)))
         return pos[:m].astype(np.int64), m < total
+
+    def select_window(
+        self,
+        start: int,
+        end: int,
+        start_after: Optional[bytes],
+        prefix: Optional[bytes],
+        limit: int,
+        max_bytes: int,
+    ) -> Tuple[np.ndarray, bool, int]:
+        """Filtered-scan window (query compute plane, PR 13): the
+        next ``limit``/``max_bytes``-bounded run of arc-member
+        positions REGARDLESS of predicate outcome, plus whether more
+        exist and the SCANNED byte size of the window.  Unlike
+        ``select`` the cut is on bytes *scanned* (key + value + wire
+        overhead — the work the filter actually performs), not bytes
+        returned: that is what the coordinator bills against
+        ``--scan-bytes-per-slice``, and it keeps a 0.01%-selectivity
+        page from degenerating into an unbounded walk for one
+        matching row.  The window's last key is the resume cover
+        even when nothing in it matches."""
+        lo, hi = 0, self.n
+        width = self.keys.dtype.itemsize
+        if prefix:
+            if len(prefix) > width:
+                return np.zeros(0, dtype=np.int64), False, 0
+            lo = int(np.searchsorted(self.keys, prefix, side="left"))
+            upper = increment_prefix(prefix)
+            if upper is not None:
+                hi = int(
+                    np.searchsorted(self.keys, upper, side="left")
+                )
+        if start_after is not None:
+            lo = max(
+                lo,
+                int(
+                    np.searchsorted(
+                        self.keys,
+                        start_after[:width],
+                        side="right",
+                    )
+                ),
+            )
+        if lo >= hi:
+            return np.zeros(0, dtype=np.int64), False, 0
+        member = range_members_mask(self.hash[lo:hi], start, end)
+        pos = lo + np.flatnonzero(member)
+        total = int(pos.size)
+        if total == 0:
+            return pos.astype(np.int64), False, 0
+        pos = pos[: int(limit)]
+        sz = self.klen[pos] + ENTRY_OVERHEAD + self.vlen[pos]
+        cum = np.cumsum(sz)
+        m = int(np.searchsorted(cum, max_bytes, side="left")) + 1
+        m = max(1, min(m, int(limit), int(pos.size)))
+        return (
+            pos[:m].astype(np.int64),
+            m < total,
+            int(cum[m - 1]),
+        )
 
     # -- materialization (loop-side; verified reads) -------------------
 

@@ -1185,27 +1185,49 @@ int64_t dbeel_cli_trace_dump(void* h, const char* ip, uint16_t port,
   return (int64_t)body.size();
 }
 
+// Spec dialect version the query compute plane (PR 13) speaks: the
+// packed filter/aggregate blob this client forwards must lead with
+// this tag (msgpack fixarray + fixstr-2), or the server will reject
+// it — validating here turns a stale-caller mistake into an
+// immediate local error instead of a wire round trip.  Lint-pinned
+// against query.SPEC_VERSION / scan.SPEC_WIRE_VERSION
+// (analysis/wire_parity.py).
+static constexpr char kSpecVersion[] = "q1";
+
 // One streaming-scan chunk (scan plane, PR 12).  cursor NULL/empty
 // starts a scan ({"type":"scan"} with the optional count/prefix/
-// limit/max_bytes pushdowns); otherwise continues one
-// ({"type":"scan_next","cursor":...}).  The raw msgpack chunk payload
-// ({"entries":[[key,value],...],"cursor":bin|nil,"count":n}) is
-// copied into out — the caller re-issues with the returned cursor
-// until it is nil.  Same target/buffer contract as
-// dbeel_cli_get_stats; a retryable server error (e.g. an Overloaded
-// shed — the cursor survives) returns -3 so the caller can back off
-// and resume, any other error -2.
+// limit/max_bytes pushdowns and, since PR 13, the packed
+// filter/aggregate "spec" blob — built by the caller, forwarded
+// verbatim; the resumable cursor carries it afterwards); otherwise
+// continues one ({"type":"scan_next","cursor":...}).  The raw
+// msgpack chunk payload
+// ({"entries":[[key,value],...],"cursor":bin|nil,"count":n[,"agg":
+// result on an aggregate's final chunk]}) is copied into out — the
+// caller re-issues with the returned cursor until it is nil.  Same
+// target/buffer contract as dbeel_cli_get_stats; a retryable server
+// error (e.g. an Overloaded shed — the cursor survives) returns -3
+// so the caller can back off and resume, any other error -2.
 int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
                              const char* collection,
                              const uint8_t* cursor,
                              uint32_t cursor_len, int count_only,
                              const uint8_t* prefix,
                              uint32_t prefix_len, uint64_t limit,
-                             uint64_t max_bytes, uint8_t* out,
-                             uint64_t cap) {
+                             uint64_t max_bytes,
+                             const uint8_t* spec, uint32_t spec_len,
+                             uint8_t* out, uint64_t cap) {
   Client* c = static_cast<Client*>(h);
   std::string target_ip = (ip && *ip) ? ip : c->seed_ip;
   uint16_t target_port = port ? port : c->seed_port;
+  if (spec && spec_len) {
+    // [ver, ...] => fixarray marker, then fixstr(2) "q1".
+    if (spec_len < 4 || (spec[0] & 0xf0) != 0x90 ||
+        spec[1] != 0xa2 || spec[2] != (uint8_t)kSpecVersion[0] ||
+        spec[3] != (uint8_t)kSpecVersion[1]) {
+      c->last_error = "scan spec: unknown version or shape";
+      return -2;
+    }
+  }
   MpBuf m;
   if (cursor && cursor_len) {
     m.map_header(3);
@@ -1218,6 +1240,7 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
     if (prefix && prefix_len) fields++;
     if (limit) fields++;
     if (max_bytes) fields++;
+    if (spec && spec_len) fields++;
     m.map_header(fields);
     common_fields(&m, "scan", collection ? collection : "", true);
     if (count_only) {
@@ -1235,6 +1258,10 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
     if (max_bytes) {
       m.str("max_bytes");
       m.uint(max_bytes);
+    }
+    if (spec && spec_len) {
+      m.str("spec");
+      m.bin(spec, spec_len);
     }
   }
   std::vector<uint8_t> body;

@@ -1918,6 +1918,14 @@ static_assert(kCoordGetTrailerHdr ==
               "the t+N emit offsets in dbeel_dp_handle_coord AND "
               "dataplane.py's _OFF_* parse offsets");
 
+// SCAN peer-frame arity (scan plane PR 12 + the query compute
+// plane's trailing spec element, PR 13): ["request","scan",coll,
+// start,end,start_after,prefix,limit,max_bytes,with_values,spec].
+// The C shard plane always PUNTS scan pages to Python (the
+// ScanStage serves them), but pins the dialect: MUST equal
+// shard.py's _SCAN_PEER_ARITY (wire-parity lint).
+constexpr uint32_t kScanPeerArity = 11;
+
 static const uint32_t kDpHardMax = 16u << 20;
 
 // Envelope slack on top of kDpHardMax for grow-and-retry (-2) size
@@ -3570,6 +3578,15 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   const bool k_mset = is_req && slice_eq(kind_s, kind_n, "multi_set");
   const bool k_mget = is_req && slice_eq(kind_s, kind_n, "multi_get");
   if (is_event && !k_set) return -1;
+  if (is_req && slice_eq(kind_s, kind_n, "scan")) {
+    // Streaming-scan peer pages (fixed arity kScanPeerArity — the
+    // PR 13 query compute plane appended the filter/aggregate spec
+    // element) are served by the Python ScanStage path: always
+    // punt, but keep the dialect pinned here so an arity drift
+    // fails the wire-parity lint, not a production merge.
+    if (nelem != kScanPeerArity) return -1;
+    return -1;
+  }
   if (!(k_set || k_del || k_get || k_dig || k_mset || k_mget))
     return -1;
   const uint32_t want =

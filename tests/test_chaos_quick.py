@@ -57,16 +57,25 @@ HEALTH_BLOCK_KEYS = {
 }
 
 # Streaming scan plane (ISSUE 12): scans under churn keep completing
-# and the final view agrees with quorum multi_gets.
+# and the final view agrees with quorum multi_gets.  Query compute
+# plane (ISSUE 13): a filtered stream rides the same churn, and the
+# healed filtered view must equal quorum ground truth under the same
+# predicate.
 SCAN_KEYS = {
     "window_s",
     "scans_completed",
+    "filtered_scans_completed",
     "scan_errors_during_churn",
     "order_violations",
+    "predicate_violations",
     "final_scan_entries",
+    "filtered_final_entries",
+    "filtered_count_verb",
     "journal_keys_compared",
     "scan_vs_multiget_disagreements",
+    "filtered_vs_quorum_disagreements",
     "stats_scan_block",
+    "stats_filter_block",
     "nodes_alive",
     "pass",
 }
@@ -184,6 +193,15 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert sc["order_violations"] == 0
     assert sc["scan_vs_multiget_disagreements"] == []
     assert sc["stats_scan_block"]["chunks"] > 0
+    # Filtered stream (ISSUE 13): completed through the kill, never
+    # yielded a non-matching doc, and the healed filtered view (and
+    # the filtered count verb) equal quorum ground truth under the
+    # same predicate.
+    assert sc["filtered_scans_completed"] >= 1
+    assert sc["predicate_violations"] == 0
+    assert sc["filtered_vs_quorum_disagreements"] == []
+    assert sc["filtered_count_verb"] == sc["filtered_final_entries"]
+    assert sc["stats_filter_block"]["specs_served"] is not None
     # Tracing plane (ISSUE 9): the trace block must be present with
     # dumps from the (still alive) nodes; dominant_stages is a list
     # of [stage, share] pairs (may be empty when nothing was slow).

@@ -35,10 +35,12 @@ REPO_ROOT = os.path.dirname(
 _PARITY_FILES = [
     "dbeel_tpu/cluster/messages.py",
     "dbeel_tpu/errors.py",
+    "dbeel_tpu/query.py",
     "dbeel_tpu/server/shard.py",
     "dbeel_tpu/server/db_server.py",
     "dbeel_tpu/server/dataplane.py",
     "dbeel_tpu/server/metrics.py",
+    "dbeel_tpu/server/scan.py",
     "dbeel_tpu/client/__init__.py",
     "native/src/dbeel_native.cpp",
     "native/src/dbeel_client.cpp",
@@ -213,7 +215,7 @@ def test_parity_flags_scan_arity_drift(tmp_path):
     _edit(
         root,
         "dbeel_tpu/server/shard.py",
-        "_SCAN_PEER_ARITY = 10",
+        "_SCAN_PEER_ARITY = 11",
         "_SCAN_PEER_ARITY = 9",
     )
     findings = wire_parity.check(Repo(root))
@@ -237,6 +239,88 @@ def test_parity_flags_scan_verb_lost_in_c_client(tmp_path):
     assert "no longer emits the 'scan_next' op" in msgs, findings
     # ...and the typo'd token itself is unknown-wire-string drift.
     assert "scan_nxt" in msgs
+
+
+def test_parity_flags_scan_arity_drift_in_c_shard_plane(tmp_path):
+    # Query compute plane (PR 13): the THIRD copy of the scan
+    # peer-frame arity — the C shard plane's punt recognition —
+    # must move with the other two.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        "constexpr uint32_t kScanPeerArity = 11;",
+        "constexpr uint32_t kScanPeerArity = 10;",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "scan peer-frame arity drift" in f.message
+        and "kScanPeerArity" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_spec_version_drift(tmp_path):
+    # Query compute plane (PR 13): the filter/aggregate spec version
+    # is pinned three ways — Python packer, coordinator parser, C
+    # client pass-through validation.  Seed a one-sided bump.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_client.cpp",
+        'constexpr char kSpecVersion[] = "q1";',
+        'constexpr char kSpecVersion[] = "q2";',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "spec version drift" in f.message for f in findings
+    ), findings
+    # ...and deleting one of the pins is itself a finding.
+    root2 = _copy_fixture(tmp_path / "b")
+    _edit(
+        root2,
+        "dbeel_tpu/server/scan.py",
+        'SPEC_WIRE_VERSION = "q1"',
+        '_SPEC_WIRE_VER_GONE = "q1"',
+    )
+    findings2 = wire_parity.check(Repo(root2))
+    assert any(
+        "spec version constant missing" in f.message
+        for f in findings2
+    ), findings2
+
+
+def test_parity_flags_cursor_arity_drift(tmp_path):
+    # Query compute plane (PR 13): encode_cursor's packed field
+    # count must match the pinned _CURSOR_ARITY (what decode_cursor
+    # accepts) — a one-sided cursor field would strand every
+    # in-flight scan on resume.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/scan.py",
+        "_CURSOR_ARITY = 10",
+        "_CURSOR_ARITY = 9",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "scan-cursor arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_spec_field_lost_in_c_client(tmp_path):
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_client.cpp",
+        'm.str("spec");',
+        'm.str("sp_ec");',
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "no longer emits the 'spec' request field" in msgs, (
+        findings
+    )
 
 
 def test_parity_flags_status_byte_drift(tmp_path):

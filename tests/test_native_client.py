@@ -228,3 +228,32 @@ def test_native_client_scan_and_count(server):
         )
         # Tiny chunks: many cursor hops, identical stream.
         assert cli.scan("sc", max_bytes=512) == got
+        # Query compute plane (PR 13): the C client forwards the
+        # packed spec verbatim — filtered scan, filtered count, and
+        # a pushdown aggregate, matching the Python-side semantics.
+        flt = ["and", ["cmp", "v", ">=", 10], ["cmp", "v", "<", 30]]
+        assert [k for k, _v in cli.scan("sc", filter=flt)] == [
+            f"key-{i:04d}" for i in range(10, 30)
+        ]
+        assert cli.count("sc", filter=["cmp", "v", "<", 10]) == 9
+        assert cli.count(
+            "sc", aggregate={"op": "sum", "field": "v"}
+        ) == sum(i for i in range(150) if i != 3)
+        assert cli.count(
+            "sc",
+            aggregate={"op": "max", "field": "v"},
+            filter=["cmp", "v", "<", 100],
+        ) == 99
+        # The filter stats block is visible through the C client's
+        # get_stats pass-through too.
+        stats = cli.get_stats()
+        assert "filter" in stats["scan"]
+        assert set(stats["scan"]["filter"]) >= {
+            "specs_served",
+            "rows_scanned",
+            "rows_returned",
+            "bytes_saved",
+            "agg_partials",
+            "device_evals",
+            "fallback_evals",
+        }
