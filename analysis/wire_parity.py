@@ -335,6 +335,20 @@ _TRACE_PUNT_RE = re.compile(
     r"if\s*\(\s*has_trace\s*\)\s*return\s*-1\s*;"
 )
 
+# The C shard parser's qos-dialect recognition (QoS plane, ISSUE 14):
+# ``nelem == want + N`` where N MUST be 3 (deadline + trace
+# placeholder + class id).  Unlike the trace dialect this one SERVES
+# natively (the replica plane never sheds; the class is accounting),
+# but a live trace id inside it must still punt — checked by the
+# trailer-walk regex below (read trace, return -1 when positive).
+_QOS_DIALECT_RE = re.compile(
+    r"has_qos\s*=\s*nelem\s*==\s*want\s*\+\s*(\d+)u?"
+)
+_QOS_TRACE_PUNT_RE = re.compile(
+    r"if\s*\(\s*!mp_read_int64\(c,\s*&trace_v\)\s*\)\s*return\s*-1"
+    r"\s*;\s*if\s*\(\s*trace_v\s*>\s*0\s*\)\s*return\s*-1\s*;"
+)
+
 
 def check(repo: Repo) -> List[Finding]:
     findings: List[Finding] = []
@@ -528,6 +542,78 @@ def check(repo: Repo) -> List[Finding]:
                 "replica span piggyback",
             )
 
+    # -- qos-element arity (QoS plane, ISSUE 14) ---------------------
+    # The trailing class id must sit EXACTLY one slot past the trace
+    # id on every data verb — three-way agreement: the encoder
+    # wrapper appends (deadline-or-0, trace-or-0, qos) in order,
+    # shard.py's _PEER_QOS_INDEX is where replicas read it, and the
+    # C parser recognizes the want+3 dialect (serving it natively,
+    # but PUNTING when the trace placeholder carries a live id).
+    qos_index = _peer_index_table(shard, "_PEER_QOS_INDEX")
+    if not qos_index:
+        add(
+            repo.shard_py,
+            1,
+            "_PEER_QOS_INDEX not found — shard.py restructured? "
+            "update analysis/wire_parity",
+        )
+    for name, idx in trace_index.items():
+        q_idx = qos_index.get(name)
+        if q_idx is None:
+            add(
+                repo.shard_py,
+                1,
+                f"verb {req.get(name, name)!r} has a trace slot but "
+                "no _PEER_QOS_INDEX entry — a class-stamped frame's "
+                "lane accounting would silently default",
+            )
+        elif q_idx != idx + 1:
+            add(
+                repo.shard_py,
+                1,
+                f"qos-field arity drift for {req.get(name, name)!r}"
+                f": _PEER_QOS_INDEX={q_idx} but the class element "
+                f"rides exactly one past the trace id (index "
+                f"{idx + 1})",
+            )
+    for name in qos_index:
+        if name not in trace_index:
+            add(
+                repo.shard_py,
+                1,
+                f"_PEER_QOS_INDEX names {name} which has no trace "
+                "slot — the class element only ever rides after "
+                "(possibly 0) deadline and trace placeholders",
+            )
+    qm = _QOS_DIALECT_RE.search(stripped_native)
+    if qm is None:
+        add(
+            repo.native_cpp,
+            1,
+            "C shard-plane qos-dialect expression "
+            "(has_qos = nelem == want + 3) not found — a "
+            "class-stamped peer frame would be rejected",
+        )
+    else:
+        line = stripped_native.count("\n", 0, qm.start()) + 1
+        if int(qm.group(1)) != 3:
+            add(
+                repo.native_cpp,
+                line,
+                f"qos-field arity drift: C recognizes the qos "
+                f"dialect at want + {qm.group(1)} but the Python "
+                "plane appends (deadline, trace, qos) — want + 3",
+            )
+        if _QOS_TRACE_PUNT_RE.search(stripped_native) is None:
+            add(
+                repo.native_cpp,
+                line,
+                "C qos dialect must punt frames whose trace "
+                "placeholder carries a live id (read trace_v, "
+                "return -1 when positive) — Python owns sampled "
+                "frames",
+            )
+
     # -- scan plane (PR 12): peer-page arity + C client coverage -----
     # The SCAN peer frame has a FIXED arity (no deadline/trace
     # dialects): the encoder's element count must equal shard.py's
@@ -656,6 +742,30 @@ def check(repo: Repo) -> List[Finding]:
             "filter/aggregate pushdown must stay reachable from "
             "BOTH clients",
         )
+
+    # -- QoS plane (ISSUE 14): both clients must stamp the class and
+    # tenant request fields, and both C planes must know the tokens
+    # (the shard plane's parser punts tenant frames — losing the
+    # token would silently serve quota'd traffic unmetered).
+    for tok in ("qos", "tenant"):
+        if tok not in client_c_tokens:
+            add(
+                repo.client_cpp,
+                1,
+                f"C client no longer emits the {tok!r} request field "
+                "— QoS class/tenant stamping must stay reachable "
+                "from BOTH clients",
+            )
+        if tok not in {
+            v for _line, v in c_string_literals(native_src)
+        }:
+            add(
+                repo.native_cpp,
+                1,
+                f"C data plane no longer recognizes the {tok!r} "
+                "request field — tenant frames must punt to the "
+                "interpreted path that owns the quota buckets",
+            )
 
     # -- every C wire-token literal is in a Python registry ----------
     peer_verbs = (

@@ -432,41 +432,77 @@ async def main_overload_knee(args):
     import subprocess as _sp
     import sys as _sys
 
+    # --classes (QoS plane, ISSUE 14): the TWO-CLASS sweep — at each
+    # multiple, half the offered load is stamped `interactive` and
+    # half `batch`; the per-class knee is the lowest multiple where
+    # that class's overload-class errors exceed 1% of its launched
+    # ops.  The contract under test: the interactive knee sits at a
+    # STRICTLY higher multiple than batch, with batch sheds
+    # dominating below it.
+    classes = (
+        ("interactive", "batch") if args.classes else (None,)
+    )
     gen_procs = 3
+    sweep_rows = []
+    knees: dict = {}
     for mult in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0):
         offered = max(10.0, sustainable * mult)
         dur = 8.0
-        procs = [
-            _sp.Popen(
-                [
-                    _sys.executable,
-                    os.path.abspath(__file__),
-                    "--overload-knee-worker",
-                    "--knee-rate", str(offered / gen_procs),
-                    "--knee-duration", str(dur),
-                    "--host", args.host,
-                    "--port", str(args.port),
-                    "--collection", args.collection,
-                    "--value-size", str(args.value_size),
-                    "--seed", str(args.seed + wi),
-                ],
-                stdout=_sp.PIPE,
-                text=True,
+        procs = []
+        for ci, cname in enumerate(classes):
+            share = offered / len(classes)
+            procs.extend(
+                (
+                    cname,
+                    _sp.Popen(
+                        [
+                            _sys.executable,
+                            os.path.abspath(__file__),
+                            "--overload-knee-worker",
+                            "--knee-rate", str(share / gen_procs),
+                            "--knee-duration", str(dur),
+                            "--host", args.host,
+                            "--port", str(args.port),
+                            "--collection", args.collection,
+                            "--value-size", str(args.value_size),
+                            "--seed",
+                            str(args.seed + ci * 100 + wi),
+                        ]
+                        + (
+                            ["--knee-class", cname]
+                            if cname is not None
+                            else []
+                        ),
+                        stdout=_sp.PIPE,
+                        text=True,
+                    ),
+                )
+                for wi in range(gen_procs)
             )
-            for wi in range(gen_procs)
-        ]
-        ok = launched = 0
-        lat: list = []
-        err: dict = {}
-        for p in procs:
+        per_class: dict = {
+            cname: {"ok": 0, "launched": 0, "lat": [], "err": {}}
+            for cname in classes
+        }
+        for cname, p in procs:
             out, _ = p.communicate(timeout=dur + 60)
             row = _json.loads(out.strip().splitlines()[-1])
-            ok += row["ok"]
-            launched += row["launched"]
-            lat.extend(row["lat_ms"])
+            st = per_class[cname]
+            st["ok"] += row["ok"]
+            st["launched"] += row["launched"]
+            st["lat"].extend(row["lat_ms"])
             for k, v in row["err"].items():
+                st["err"][k] = st["err"].get(k, 0) + v
+        ok = sum(st["ok"] for st in per_class.values())
+        launched = sum(
+            st["launched"] for st in per_class.values()
+        )
+        lat = sorted(
+            x for st in per_class.values() for x in st["lat"]
+        )
+        err: dict = {}
+        for st in per_class.values():
+            for k, v in st["err"].items():
                 err[k] = err.get(k, 0) + v
-        lat.sort()
         p99 = lat[int(0.99 * (len(lat) - 1))] if lat else float("nan")
         overload_errs = err.get(ERROR_CLASS_OVERLOAD, 0)
         other_errs = sum(err.values()) - overload_errs
@@ -475,6 +511,64 @@ async def main_overload_knee(args):
             f"{ok / dur / max(1e-9, sustainable):>6.2f} "
             f"{p99:>8.1f} {overload_errs:>9} {other_errs:>9}"
         )
+        row_out = {
+            "mult": mult,
+            "offered_per_s": round(offered, 1),
+            "goodput_per_s": round(ok / dur, 1),
+            "p99_ms": None if lat == [] else p99,
+            "overload_errs": overload_errs,
+            "other_errs": other_errs,
+        }
+        for cname in classes:
+            if cname is None:
+                continue
+            st = per_class[cname]
+            clat = sorted(st["lat"])
+            c_ov = st["err"].get(ERROR_CLASS_OVERLOAD, 0)
+            shed_frac = c_ov / max(1, st["launched"])
+            row_out[cname] = {
+                "launched": st["launched"],
+                "ok": st["ok"],
+                "goodput_per_s": round(st["ok"] / dur, 1),
+                "p99_ms": clat[int(0.99 * (len(clat) - 1))]
+                if clat
+                else None,
+                "overload_errs": c_ov,
+                "shed_frac": round(shed_frac, 4),
+            }
+            if cname not in knees and shed_frac > 0.01:
+                knees[cname] = mult
+            print(
+                f"          {cname:>12}: goodput "
+                f"{st['ok'] / dur:>8,.0f}/s  sheds {c_ov:>7} "
+                f"({100 * shed_frac:.1f}%)  p99 "
+                f"{row_out[cname]['p99_ms'] or 0:.1f}ms"
+            )
+        sweep_rows.append(row_out)
+    if args.classes:
+        b_knee = knees.get("batch")
+        i_knee = knees.get("interactive")
+        print(
+            f"knees: batch={b_knee}x interactive={i_knee}x "
+            f"(None = never shed in the sweep)"
+        )
+        result = {
+            "sustainable_ops_per_s": round(sustainable, 1),
+            "baseline_p99_ms": round(base_p99 * 1000, 2),
+            "clients": args.clients,
+            "replication_factor": args.replication_factor,
+            "sweep": sweep_rows,
+            "knee_batch_mult": b_knee,
+            "knee_interactive_mult": i_knee,
+            "interactive_knee_strictly_higher": (
+                b_knee is not None
+                and (i_knee is None or i_knee > b_knee)
+            ),
+        }
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                _json.dump(result, f, indent=1, sort_keys=True)
+            print(f"wrote {args.json_out}")
     # The governor's view after the sweep.
     stats = await client.get_stats(args.host, args.port)
     ov = stats.get("overload", {})
@@ -493,6 +587,17 @@ async def main_overload_knee(args):
         f"python_sheds={np_.get('python_sheds')} "
         f"native_deadline_drops={np_.get('native_deadline_drops')}"
     )
+    qs = stats.get("qos") or {}
+    if args.classes and qs:
+        for cname, lane in (qs.get("classes") or {}).items():
+            print(
+                f"server qos {cname}: "
+                f"admitted={lane.get('admitted')} "
+                f"shed={lane.get('shed')} "
+                f"native_sheds={lane.get('native_sheds')} "
+                f"window={lane.get('window')} "
+                f"level={lane.get('level')}"
+            )
     client.close()
 
 
@@ -512,6 +617,8 @@ async def main_knee_worker(args):
         [(args.host, args.port)],
         op_deadline_s=1.5,
         pipeline_window=256,
+        # Two-class sweep (QoS plane): this generator's lane.
+        qos_class=args.knee_class or None,
     )
     col = client.collection(args.collection)
     value = {"blob": "x" * args.value_size}
@@ -1152,6 +1259,22 @@ def main():
         "load — the overload-control knee curve",
     )
     ap.add_argument(
+        "--classes",
+        action="store_true",
+        help="with --overload-knee (QoS plane, ISSUE 14): the "
+        "TWO-CLASS sweep — half the offered load stamped "
+        "interactive, half batch; records both knees (the lowest "
+        "multiple where a class's sheds exceed 1%% of its launched "
+        "ops).  Acceptance: the interactive knee sits strictly "
+        "higher, with batch sheds dominating below it",
+    )
+    ap.add_argument(
+        "--json-out",
+        default="",
+        help="with --overload-knee --classes: write the sweep + "
+        "knee verdict as JSON (the BENCH_r14.json artifact)",
+    )
+    ap.add_argument(
         "--overload-knee-worker",
         action="store_true",
         help=argparse.SUPPRESS,  # internal: one generator subprocess
@@ -1164,6 +1287,9 @@ def main():
         type=float,
         default=8.0,
         help=argparse.SUPPRESS,
+    )
+    ap.add_argument(
+        "--knee-class", default="", help=argparse.SUPPRESS
     )
     args = ap.parse_args()
     if args.pipeline and args.batch:

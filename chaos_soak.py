@@ -1002,6 +1002,134 @@ async def overload_phase(nodes, report, quick):
     adm_p99 = lat[int(0.99 * (len(lat) - 1))] if lat else float("inf")
     p99_bound = max(20 * base_p99, 1.0)
 
+    # ---- two-class open loop (QoS plane, ISSUE 14) -------------------
+    # interactive + batch generators each offered 1.5x sustainable
+    # (3x total): the class-priority contract says the HIGH class's
+    # goodput share holds while the LOW class sheds first.  Gated
+    # only when anyone actually shed — a host that absorbs 3x (the
+    # r8 "absorbed regime") proves nothing about priority.
+    cls_dur = 6.0 if quick else 12.0
+    cls_clients = {}
+    for cname in ("interactive", "batch"):
+        cls_clients[cname] = await DbeelClient.from_seed_nodes(
+            [("127.0.0.1", nodes[0].db_port)],
+            op_deadline_s=4.0,
+            qos_class=cname,
+        )
+    cls_stats = {
+        cname: {"ok": 0, "launched": 0, "err": {}, "lat": []}
+        for cname in cls_clients
+    }
+    # PER-CLASS outstanding caps (review r14): with one shared pool,
+    # the class launched first each tick claims every freed slot —
+    # the gates would then measure client launch ordering, not the
+    # server's class priority.  Separate pools keep the OFFERED load
+    # symmetric; only the server decides who gets served.
+    cls_inflight = {cname: set() for cname in cls_clients}
+    per_class_outstanding = max_outstanding // 2
+
+    async def one_cls(cname, i):
+        st = cls_stats[cname]
+        t0 = time.perf_counter()
+        try:
+            await asyncio.wait_for(
+                cls_clients[cname]
+                .collection(COLLECTION)
+                .set(
+                    f"ovc-{cname}-{i}", {"v": i},
+                    consistency=Consistency.fixed(2),
+                ),
+                10,
+            )
+            st["lat"].append(time.perf_counter() - t0)
+            st["ok"] += 1
+        except Exception as e:
+            ecls = classify_error(e) or "other"
+            st["err"][ecls] = st["err"].get(ecls, 0) + 1
+
+    per_class_rate = max(10.0, sustainable * 1.5)
+    t_start = loop.time()
+    carry_i = carry_b = 0.0
+    while loop.time() - t_start < cls_dur:
+        carry_i += per_class_rate * tick
+        carry_b += per_class_rate * tick
+        for cname, carry in (
+            ("interactive", int(carry_i)),
+            ("batch", int(carry_b)),
+        ):
+            if cname == "interactive":
+                carry_i -= carry
+            else:
+                carry_b -= carry
+            st = cls_stats[cname]
+            pool = cls_inflight[cname]
+            for _ in range(carry):
+                if len(pool) >= per_class_outstanding:
+                    continue
+                st["launched"] += 1
+                t = asyncio.ensure_future(
+                    one_cls(cname, st["launched"])
+                )
+                pool.add(t)
+                t.add_done_callback(pool.discard)
+        await asyncio.sleep(tick)
+    cls_wall = loop.time() - t_start
+    remaining = set().union(*cls_inflight.values())
+    if remaining:
+        await asyncio.wait(remaining, timeout=15)
+    for c_ in cls_clients.values():
+        c_.close()
+
+    def _cls_block(cname):
+        st = cls_stats[cname]
+        l_ = sorted(st["lat"])
+        return {
+            "launched": st["launched"],
+            "ok": st["ok"],
+            "goodput_ops_per_s": round(st["ok"] / cls_wall, 1),
+            "overload_errors": st["err"].get(
+                ERROR_CLASS_OVERLOAD, 0
+            ),
+            "errors_by_class": dict(st["err"]),
+            "admitted_p99_ms": round(
+                (l_[int(0.99 * (len(l_) - 1))] * 1000)
+                if l_
+                else float("inf"),
+                2,
+            ),
+        }
+
+    i_blk = _cls_block("interactive")
+    b_blk = _cls_block("batch")
+    total_cls_sheds = (
+        i_blk["overload_errors"] + b_blk["overload_errors"]
+    )
+    total_cls_ok = i_blk["ok"] + b_blk["ok"]
+    i_share = (
+        i_blk["ok"] / total_cls_ok if total_cls_ok else 0.0
+    )
+    # Gates (only binding when the load actually shed): the low
+    # class's sheds dominate, and the high class holds at least its
+    # fair (equal-offered) share of the served goodput.
+    sheds_ordered = (
+        total_cls_sheds == 0
+        or b_blk["overload_errors"] >= i_blk["overload_errors"]
+    )
+    share_held = total_cls_sheds == 0 or i_share >= 0.45
+    classes_pass = (
+        sheds_ordered and share_held and total_cls_ok > 0
+    )
+    classes_block = {
+        "offered_multiplier_per_class": 1.5,
+        "duration_s": round(cls_wall, 1),
+        "interactive": i_blk,
+        "batch": b_blk,
+        "interactive_goodput_share": round(i_share, 3),
+        "batch_sheds_dominate": sheds_ordered,
+        "share_held": share_held,
+        "pass": classes_pass,
+    }
+
     # ---- server-side counters + both clients' stats blocks -----------
     server_sheds = server_deadline_drops = bg_delays = 0
     py_block = True
@@ -1012,7 +1140,9 @@ async def overload_phase(nodes, report, quick):
                     "127.0.0.1", n_.db_port + sid
                 )
                 ov = s.get("overload")
-                if not isinstance(ov, dict):
+                if not isinstance(ov, dict) or not isinstance(
+                    s.get("qos"), dict
+                ):
                     py_block = False
                     continue
                 server_sheds += ov.get("shed_ops", 0)
@@ -1029,7 +1159,9 @@ async def overload_phase(nodes, report, quick):
 
         ncli = NativeDbeelClient("127.0.0.1", nodes[0].db_port)
         nstats = ncli.get_stats()
-        native_block = isinstance(nstats.get("overload"), dict)
+        native_block = isinstance(
+            nstats.get("overload"), dict
+        ) and isinstance(nstats.get("qos"), dict)
         ncli.close()
     except Exception as e:
         log(f"OVERLOAD: native client stats failed: {repr(e)[:80]}")
@@ -1063,6 +1195,9 @@ async def overload_phase(nodes, report, quick):
         "stats_overload_block_py": py_block,
         "stats_overload_block_native": native_block,
         "nodes_alive": alive,
+        # QoS plane (ISSUE 14): the two-class open loop — high class
+        # holds its goodput share, low class sheds first.
+        "classes": classes_block,
     }
     # Honest shedding: the server visibly refused work (shed counters
     # or overload-class client errors) rather than hanging.  When the
@@ -1081,6 +1216,7 @@ async def overload_phase(nodes, report, quick):
         and overload_visible
         and py_block
         and native_block
+        and classes_pass
     )
     phase["pass"] = ok_gate
     report["overload"] = phase

@@ -23,6 +23,7 @@ import msgpack
 
 from ..errors import (
     ERROR_CLASS_OVERLOAD,
+    ERROR_CLASS_QUOTA,
     BadFieldType,
     ConnectionError_,
     DbeelError,
@@ -35,6 +36,7 @@ from ..errors import (
     is_retryable_class,
 )
 from ..cluster.messages import ClusterMetadata
+from ..cluster.messages import qos_class_of as _qos_class_of
 from ..utils.murmur import hash_bytes, hash_string
 
 RESPONSE_ERR = 0
@@ -213,7 +215,20 @@ class DbeelClient:
         pooled: bool = True,
         op_deadline_s: Optional[float] = None,
         pipeline_window: Optional[int] = None,
+        qos_class: "str | int | None" = None,
+        tenant: Optional[str] = None,
     ):
+        # QoS plane (ISSUE 14): when set, every data-op frame this
+        # client sends is stamped with the traffic class
+        # ("interactive" > "standard" > "batch" — under server
+        # overload batch sheds first and interactive last) and/or the
+        # tenant id the server's per-collection token buckets key by.
+        # A QuotaExceeded answer is retryable like an Overloaded shed
+        # (the walk backs off; tokens refill).
+        self._qos_class: Optional[int] = (
+            None if qos_class is None else _qos_class_of(qos_class)
+        )
+        self._tenant = tenant if tenant else None
         self._seeds = list(seed_addresses)
         self._ring: List[_RingShard] = []
         self._ring_hashes: List[int] = []
@@ -427,6 +442,15 @@ class DbeelClient:
         d = min(cls.BACKOFF_CAP_S, cls.BACKOFF_BASE_S * (1 << shift))
         return d * (0.5 + 0.5 * rng.random())
 
+    def _stamp_qos(self, request: dict) -> None:
+        """QoS stamp (class + tenant) on a data-op frame — one place
+        so every transport (walk, scan chunks, multi frames) stamps
+        identically."""
+        if self._qos_class is not None:
+            request["qos"] = self._qos_class
+        if self._tenant is not None:
+            request["tenant"] = self._tenant
+
     async def _sharded_request(
         self, key: Any, request: dict, rf: int
     ) -> bytes:
@@ -434,6 +458,7 @@ class DbeelClient:
         key_hash = hash_bytes(key_encoded)
         request = dict(request)
         request["hash"] = key_hash
+        self._stamp_qos(request)
 
         loop = asyncio.get_event_loop()
         deadline = loop.time() + self._op_deadline_s
@@ -531,12 +556,12 @@ class DbeelClient:
                 except (DbeelError, OSError, asyncio.TimeoutError):
                     pass
             backoff_attempt = attempt
-            if (
-                last_error is not None
-                and classify_error(last_error) == ERROR_CLASS_OVERLOAD
-            ):
-                # The server is SHEDDING: retrying fast only feeds
-                # the overload — skip ahead in the backoff schedule
+            if last_error is not None and classify_error(
+                last_error
+            ) in (ERROR_CLASS_OVERLOAD, ERROR_CLASS_QUOTA):
+                # The server is SHEDDING (or this tenant's bucket is
+                # dry): retrying fast only feeds the overload / burns
+                # the refill — skip ahead in the backoff schedule
                 # (the jittered cap still bounds the pause).
                 backoff_attempt += 2
             pause = min(
@@ -564,6 +589,7 @@ class DbeelClient:
         request["deadline_ms"] = int(
             (time.time() + self._op_deadline_s) * 1000
         )
+        self._stamp_qos(request)
         attempt = 0
         last_error: Optional[Exception] = None
         while True:
@@ -615,13 +641,12 @@ class DbeelClient:
                 except (DbeelError, OSError, asyncio.TimeoutError):
                     pass
             backoff_attempt = attempt
-            if (
-                last_error is not None
-                and classify_error(last_error)
-                == ERROR_CLASS_OVERLOAD
-            ):
-                # The shard shed the chunk: the cursor survives —
-                # back off harder before resuming.
+            if last_error is not None and classify_error(
+                last_error
+            ) in (ERROR_CLASS_OVERLOAD, ERROR_CLASS_QUOTA):
+                # The shard shed the chunk (or the tenant's bucket is
+                # dry): the cursor survives — back off harder before
+                # resuming.
                 backoff_attempt += 2
             pause = min(
                 self._backoff_s(backoff_attempt, self._rng),
@@ -697,6 +722,7 @@ class DbeelClient:
             }
             if consistency is not None:
                 request["consistency"] = consistency
+            self._stamp_qos(request)
             if isinstance(trace_id, int) and trace_id > 0:
                 # Tracing plane: the whole batch frame records one
                 # per-stage span (replica spans piggyback on the

@@ -156,6 +156,13 @@ def _bind(lib) -> None:
             ctypes.c_void_p,
             ctypes.c_uint64,
         ]
+    if hasattr(lib, "dbeel_cli_set_qos"):  # QoS plane (ISSUE 14)
+        lib.dbeel_cli_set_qos.restype = None
+        lib.dbeel_cli_set_qos.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_char_p,
+        ]
     if hasattr(lib, "dbeel_cli_scan_chunk"):  # scan plane (PR 12)
         # +spec pass-through (query compute plane, PR 13).
         lib.dbeel_cli_scan_chunk.restype = ctypes.c_int64
@@ -328,6 +335,25 @@ class NativeDbeelClient:
         if not hasattr(self._lib, "dbeel_cli_set_trace"):
             return False
         self._lib.dbeel_cli_set_trace(self._h, base_trace_id)
+        return True
+
+    def set_qos(
+        self, qos_class: "str | int | None" = None,
+        tenant: "str | None" = None,
+    ) -> bool:
+        """Arm QoS stamping (QoS plane, ISSUE 14): every data-op
+        frame carries the traffic class ("interactive" > "standard" >
+        "batch", or the wire int) and/or the tenant id the server's
+        per-collection token buckets key by.  ``None, None`` disarms.
+        Returns False on a stale .so without the QoS ABI."""
+        if not hasattr(self._lib, "dbeel_cli_set_qos"):
+            return False
+        from ..cluster.messages import qos_class_of
+
+        cls = -1 if qos_class is None else qos_class_of(qos_class)
+        self._lib.dbeel_cli_set_qos(
+            self._h, cls, (tenant or "").encode()
+        )
         return True
 
     def trace_dump(self, ip: str = "", port: int = 0) -> dict:

@@ -68,6 +68,36 @@ class ClusterMetadata:
 
 
 # ---------------------------------------------------------------------
+# QoS wire vocabulary (QoS plane, ISSUE 14).  Lives HERE — the wire
+# module both sides already share — so clients can stamp classes
+# without importing the server package (server/qos.py re-exports
+# these for the policy machinery).  The `qos` client-frame field and
+# the trailing peer-frame dialect element carry the class id.
+# ---------------------------------------------------------------------
+
+QOS_INTERACTIVE = 0
+QOS_STANDARD = 1
+QOS_BATCH = 2
+NCLASSES = 3
+QOS_CLASS_NAMES = ("interactive", "standard", "batch")
+_QOS_NAME_TO_CLASS = {n: i for i, n in enumerate(QOS_CLASS_NAMES)}
+
+
+def qos_class_of(value) -> int:
+    """Resolve a wire/user class stamp to a class index.  Accepts the
+    wire int, a class name string, or None; anything else (or out of
+    range) is STANDARD — an unknown stamp must degrade to the default
+    lane, never to an error or a privilege."""
+    if isinstance(value, bool):
+        return QOS_STANDARD
+    if isinstance(value, int):
+        return value if 0 <= value < NCLASSES else QOS_STANDARD
+    if isinstance(value, str):
+        return _QOS_NAME_TO_CLASS.get(value, QOS_STANDARD)
+    return QOS_STANDARD
+
+
+# ---------------------------------------------------------------------
 # Events / Requests / Responses as tagged msgpack arrays.
 # Timestamps travel as int64 nanoseconds.
 # ---------------------------------------------------------------------
@@ -147,21 +177,31 @@ class ShardRequest:
     # work with a retryable Overloaded error instead of computing a
     # dead response; (2) the trace id of a sampled op (tracing plane,
     # PR 9) — a replica serving a traced frame piggybacks its own
-    # stage summary on the response.  The trace element only ever
-    # appears AFTER the deadline slot (a 0 deadline placeholder is
-    # appended when no real budget exists; both planes treat
-    # non-positive deadlines as absent), so the three dialects are
-    # base / base+1 (deadline) / base+2 (deadline+trace).  Old-
-    # dialect consumers index from the front and simply ignore the
-    # tail; the native parsers accept base and base+1 and punt base+2
-    # to Python, which owns sampled frames.
+    # stage summary on the response; (3) the QoS traffic-class id
+    # (QoS plane, ISSUE 14) — replicas account the class so a bulk
+    # load's replica writes show up in the batch lane cluster-wide.
+    # Each element only ever appears AFTER the previous slot (0
+    # placeholders keep earlier slots fixed; all planes treat
+    # non-positive deadline/trace as absent), so the four dialects
+    # are base / base+1 (deadline) / base+2 (+trace) / base+3
+    # (+qos).  Old-dialect consumers index from the front and simply
+    # ignore the tail; the native parsers accept base, base+1 and
+    # base+3 (qos with the 0-trace placeholder), and punt any frame
+    # with a live trace id to Python, which owns sampled frames.
+    # The qos element is only appended for NON-STANDARD classes, so
+    # default traffic keeps the PR-9 dialects byte-for-byte.
 
     @staticmethod
     def _with_deadline(
-        frame: list, deadline_ms, trace_id=None
+        frame: list, deadline_ms, trace_id=None, qos=None
     ) -> list:
         has_deadline = isinstance(deadline_ms, int) and deadline_ms > 0
-        if isinstance(trace_id, int) and trace_id > 0:
+        has_trace = isinstance(trace_id, int) and trace_id > 0
+        if isinstance(qos, int) and 0 <= qos:
+            frame.append(deadline_ms if has_deadline else 0)
+            frame.append(trace_id if has_trace else 0)
+            frame.append(qos)
+        elif has_trace:
             frame.append(deadline_ms if has_deadline else 0)
             frame.append(trace_id)
         elif has_deadline:
@@ -173,11 +213,13 @@ class ShardRequest:
         collection: str, key: bytes, value: bytes, ts: int,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.SET, collection, key, value, ts],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -185,11 +227,13 @@ class ShardRequest:
         collection: str, key: bytes, ts: int,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.DELETE, collection, key, ts],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -197,11 +241,13 @@ class ShardRequest:
         collection: str, key: bytes,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         return ShardRequest._with_deadline(
             ["request", ShardRequest.GET, collection, key],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -209,6 +255,7 @@ class ShardRequest:
         collection: str, key: bytes,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         """Digest read (quorum-get fast path, beyond the reference —
         db_server.rs:318-370 ships RF full entries): the replica
@@ -218,6 +265,7 @@ class ShardRequest:
             ["request", ShardRequest.GET_DIGEST, collection, key],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -225,6 +273,7 @@ class ShardRequest:
         collection: str, entries: list,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         """Batched replica mutation: ``entries`` is
         [[key, value, ts], ...] (tombstone value = delete).  ONE
@@ -235,6 +284,7 @@ class ShardRequest:
             ["request", ShardRequest.MULTI_SET, collection, entries],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -242,6 +292,7 @@ class ShardRequest:
         collection: str, keys: list,
         deadline_ms: "int | None" = None,
         trace_id: "int | None" = None,
+        qos: "int | None" = None,
     ) -> list:
         """Batched replica read: the response carries one entry (or
         nil) per key, aligned with ``keys``."""
@@ -249,6 +300,7 @@ class ShardRequest:
             ["request", ShardRequest.MULTI_GET, collection, keys],
             deadline_ms,
             trace_id,
+            qos,
         )
 
     @staticmethod
@@ -306,6 +358,7 @@ class ShardRequest:
         max_bytes: int,
         with_values: bool,
         spec: Optional[bytes] = None,
+        qos: int = 2,
     ) -> list:
         """Streaming scan page (scan plane, PR 12): up to ``limit``
         entries / ``max_bytes`` emitted bytes of [key, value, ts]
@@ -325,7 +378,12 @@ class ShardRequest:
         bytes SCANNED — entry shape then depends on the spec's mode
         (see query.py), and the response trailer carries
         cover/scanned/partial fields.  Arity is lint-pinned
-        (shard._SCAN_PEER_ARITY, native kScanPeerArity)."""
+        (shard._SCAN_PEER_ARITY, native kScanPeerArity).
+
+        ``qos`` (QoS plane, ISSUE 14) is the scan's traffic-class id
+        — replicas account the page in that lane (batch by default),
+        so an analytics stream's replica-side work is visible in the
+        batch lane cluster-wide."""
         return [
             "request",
             ShardRequest.SCAN,
@@ -338,6 +396,7 @@ class ShardRequest:
             max_bytes,
             with_values,
             spec,
+            qos,
         ]
 
     @staticmethod

@@ -135,6 +135,16 @@ class Config:
     # survives, the client backs off and resumes).  0 disables the cap.
     scan_max_concurrent: int = 4
 
+    # ---- Multi-tenant QoS plane (ISSUE 14) ---------------------------
+    # Per-tenant token-bucket quotas, enforced at dispatch with the
+    # retryable QuotaExceeded error.  The rate is the DEFAULT each
+    # tenant gets PER COLLECTION (buckets are keyed
+    # (tenant, collection), so a tenant's bulk load into one
+    # collection cannot drain its budget for another).  0 disables
+    # that limit.  Traffic without a tenant stamp is not quota'd.
+    tenant_ops_per_sec: int = 0
+    tenant_bytes_per_sec: int = 0
+
     # Tombstone GC grace (the delete-resurrection hazard): compaction
     # refuses to drop a tombstone younger than this, so a replica that
     # missed the delete cannot resurrect the old value through hint
@@ -402,6 +412,21 @@ def build_parser() -> argparse.ArgumentParser:
         "with the retryable Overloaded error (0 disables the cap)",
     )
     p.add_argument(
+        "--tenant-ops-per-sec",
+        type=int,
+        default=d.tenant_ops_per_sec,
+        help="per-tenant per-collection op-rate quota (token bucket; "
+        "over it ops refuse with the retryable QuotaExceeded; "
+        "0 disables)",
+    )
+    p.add_argument(
+        "--tenant-bytes-per-sec",
+        type=int,
+        default=d.tenant_bytes_per_sec,
+        help="per-tenant per-collection byte-rate quota (charged as "
+        "debt once the op's real size is known; 0 disables)",
+    )
+    p.add_argument(
         "--gc-grace",
         type=int,
         dest="gc_grace_ms",
@@ -498,6 +523,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         metrics_port=ns.metrics_port,
         scan_bytes_per_slice=ns.scan_bytes_per_slice,
         scan_max_concurrent=ns.scan_max_concurrent,
+        tenant_ops_per_sec=ns.tenant_ops_per_sec,
+        tenant_bytes_per_sec=ns.tenant_bytes_per_sec,
         gc_grace_ms=ns.gc_grace_ms,
         shards=ns.shards,
         compaction_backend=ns.compaction_backend,

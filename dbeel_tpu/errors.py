@@ -70,6 +70,12 @@ Overloaded = _mk(
     "limit and shed this request; retry after backoff — the backlog "
     "drains, this is a transient condition, not a failure.",
 )
+QuotaExceeded = _mk(
+    "QuotaExceeded",
+    "The tenant's token bucket for this collection is exhausted; "
+    "retry after backoff — tokens refill continuously at the "
+    "configured per-tenant rate (QoS plane).",
+)
 
 _BY_KIND = {
     cls.kind: cls
@@ -101,6 +107,12 @@ ERROR_CLASS_DEGRADED = "degraded"
 # that keeps the node alive, so clients must treat it as "try again
 # shortly", never as data loss.
 ERROR_CLASS_OVERLOAD = "overload"
+# Multi-tenant QoS plane (ISSUE 14): the tenant's token bucket for
+# the target collection is exhausted.  Retryable after backoff —
+# tokens refill continuously, so "try again shortly" is the contract;
+# distinct from `overload` because the SHARD is healthy: only this
+# tenant is over its configured rate.
+ERROR_CLASS_QUOTA = "quota"
 ERROR_CLASS_OTHER = "other"
 ERROR_CLASSES = (
     ERROR_CLASS_COORDINATOR_DEAD,
@@ -110,6 +122,7 @@ ERROR_CLASSES = (
     ERROR_CLASS_CORRUPTION,
     ERROR_CLASS_DEGRADED,
     ERROR_CLASS_OVERLOAD,
+    ERROR_CLASS_QUOTA,
     ERROR_CLASS_OTHER,
 )
 
@@ -149,6 +162,8 @@ def classify_error(exc: BaseException) -> "str | None":
             return ERROR_CLASS_DEGRADED
         if kind == "Overloaded":
             return ERROR_CLASS_OVERLOAD
+        if kind == "QuotaExceeded":
+            return ERROR_CLASS_QUOTA
         if kind in _CONNECTION_KINDS:
             return ERROR_CLASS_COORDINATOR_DEAD
         return ERROR_CLASS_OTHER
@@ -179,6 +194,9 @@ def is_retryable_class(error_class: "str | None") -> bool:
         # Shedding is transient by design: back off and retry (walk
         # too — another replica may be below its limits).
         ERROR_CLASS_OVERLOAD,
+        # Quota refusals refill with time: back off and retry — the
+        # same transient contract as shedding, scoped to one tenant.
+        ERROR_CLASS_QUOTA,
     )
 
 

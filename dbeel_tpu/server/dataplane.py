@@ -153,6 +153,12 @@ class DataPlane:
         # a stale .so without it keeps the PR-5 behavior (FAST_MISS
         # under hard overload, multi frames punt).
         self._has_native6 = hasattr(lib, "dbeel_dp_set_overload")
+        # QoS plane (ISSUE 14): per-class shed levels + per-class
+        # shed counters.  A stale .so without the ABI keeps the
+        # class-blind scalar gate.
+        self._has_qos = hasattr(
+            lib, "dbeel_dp_set_class_levels"
+        ) and hasattr(lib, "dbeel_dp_sheds_by_class")
         self._shed_armed = False
         # DBEEL_DP_NO_MULTI=1 punts client MULTI frames to the Python
         # fallback (A/B gate for the native-floor bench: the
@@ -428,6 +434,28 @@ class DataPlane:
         — shed frames never reach the Python dispatcher."""
         if self._has_native6:
             self._lib.dbeel_dp_set_overload(self._handle, level)
+
+    def set_class_levels(self, levels) -> None:
+        """Mirror the governor's PER-CLASS levels into C (QoS plane,
+        ISSUE 14): the native shed gate checks the frame's stamped
+        class against its own level, so a batch flood is refused in C
+        while interactive frames keep serving natively.  A stale .so
+        without the ABI falls back to the scalar level (class-blind
+        but safe — exactly the pre-QoS behavior)."""
+        if self._has_qos:
+            l = list(levels)[:3] + [0, 0, 0]
+            self._lib.dbeel_dp_set_class_levels(
+                self._handle, l[0], l[1], l[2]
+            )
+
+    def sheds_by_class(self):
+        """Native per-class shed counters, or None when the .so
+        predates the QoS ABI."""
+        if not self._has_qos:
+            return None
+        buf = (ctypes.c_uint64 * 3)()
+        self._lib.dbeel_dp_sheds_by_class(self._handle, buf)
+        return [int(buf[i]) for i in range(3)]
 
     def set_overload_responses(
         self, shed_resp: bytes, deadline_resp: bytes

@@ -45,6 +45,7 @@ from ..storage.entry import TOMBSTONE
 from ..utils.murmur import hash_bytes, murmur3_32
 from ..utils.timestamps import now_nanos
 from . import framed
+from . import qos as qos_mod
 from . import trace as trace_mod
 from .shard import MyShard
 
@@ -164,6 +165,15 @@ def _trace_id_for_peers(ctx) -> Optional[int]:
     """Trace id to stamp on fan-out peer frames: replicas serving a
     traced frame piggyback their own stage summary on the response."""
     return ctx.trace_id if ctx is not None else None
+
+
+def _qos_for_peers(request: dict) -> Optional[int]:
+    """QoS class to stamp on fan-out peer frames (QoS plane): the
+    client's class, or None for STANDARD so default traffic keeps the
+    pre-QoS peer dialects byte-for-byte (old replicas treat an absent
+    element as standard anyway)."""
+    cls = qos_mod.request_class(request)
+    return cls if cls != qos_mod.QOS_STANDARD else None
 
 
 def _encode_field(value) -> bytes:
@@ -336,17 +346,20 @@ async def handle_request(
 
         if rf > 1:
             peer_deadline = _wall_deadline_ms(request, timeout_ms)
+            peer_qos = _qos_for_peers(request)
             remote_request = (
                 ShardRequest.set(
                     collection_name, key, value, timestamp,
                     deadline_ms=peer_deadline,
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=peer_qos,
                 )
                 if rtype == "set"
                 else ShardRequest.delete(
                     collection_name, key, timestamp,
                     deadline_ms=peer_deadline,
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=peer_qos,
                 )
             )
             expected = (
@@ -460,6 +473,7 @@ async def handle_request(
                         request, timeout_ms
                     ),
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=_qos_for_peers(request),
                 )
                 if ctx is not None:
                     ctx.mark("digest")
@@ -491,6 +505,7 @@ async def handle_request(
                         request, timeout_ms
                     ),
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=_qos_for_peers(request),
                 ),
                 consistency - 1,
                 rf - replica_index - 1,
@@ -637,6 +652,7 @@ async def _handle_multi(
     if not keyed:
         return msgpack.packb(results, use_bin_type=True)
 
+    peer_qos = _qos_for_peers(request)
     if is_set:
         await _multi_set_keyed(
             my_shard,
@@ -649,6 +665,7 @@ async def _handle_multi(
             rf,
             replica_index,
             timeout_ms,
+            peer_qos,
         )
     else:
         await _multi_get_keyed(
@@ -661,6 +678,7 @@ async def _handle_multi(
             rf,
             replica_index,
             timeout_ms,
+            peer_qos,
         )
     return msgpack.packb(results, use_bin_type=True)
 
@@ -676,6 +694,7 @@ async def _multi_set_keyed(
     rf: int,
     replica_index: int,
     timeout_ms: int,
+    peer_qos: Optional[int] = None,
 ) -> None:
     entries = [(key, value, timestamp) for _i, key, value in keyed]
     op_status: dict = {}
@@ -703,6 +722,7 @@ async def _multi_set_keyed(
                     [[k, v, ts] for k, v, ts in entries],
                     deadline_ms=int(time.time() * 1000) + timeout_ms,
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=peer_qos,
                 ),
                 consistency - 1,
                 rf - replica_index - 1,
@@ -741,6 +761,7 @@ async def _multi_get_keyed(
     rf: int,
     replica_index: int,
     timeout_ms: int,
+    peer_qos: Optional[int] = None,
 ) -> None:
     keys = [key for _i, key in keyed]
     op_status: dict = {}
@@ -766,6 +787,7 @@ async def _multi_get_keyed(
                     keys,
                     deadline_ms=int(time.time() * 1000) + timeout_ms,
                     trace_id=_trace_id_for_peers(ctx),
+                    qos=peer_qos,
                 ),
                 consistency - 1,
                 number_of_nodes,
@@ -854,6 +876,7 @@ async def _digest_quorum_round(
     op_status: Optional[dict] = None,
     deadline_ms: Optional[int] = None,
     trace_id: Optional[int] = None,
+    qos: Optional[int] = None,
 ):
     """Digest-read round for an RF>1 get (beyond the reference, which
     ships RF full entries — db_server.rs:318-370): replicas answer
@@ -872,7 +895,7 @@ async def _digest_quorum_round(
     digest = pack_message(
         ShardRequest.get_digest(
             collection_name, key, deadline_ms=deadline_ms,
-            trace_id=trace_id,
+            trace_id=trace_id, qos=qos,
         )
     )
     framed = struct.pack("<I", len(digest)) + digest
@@ -1284,6 +1307,7 @@ async def _serve_frame(
     op = "invalid"
     keepalive = False
     err_kind = None
+    lane_cls = None
     token = (
         trace_mod.CURRENT.set(ctx) if ctx is not None else None
     )
@@ -1297,6 +1321,13 @@ async def _serve_frame(
             raise BadFieldType("document")
         op = str(req.get("type", "invalid"))
         keepalive = bool(req.get("keepalive"))
+        if op in _SHEDDABLE_OPS:
+            # QoS lane accounting: this op occupies its class's
+            # admission share until it completes; the lane's AIMD
+            # window ticks on the release (end pairs with this begin
+            # through the except-all below).
+            lane_cls = qos_mod.request_class(req)
+            my_shard.qos.begin(lane_cls)
         if ctx is not None:
             ctx.op = op
             col = req.get("collection")
@@ -1315,6 +1346,8 @@ async def _serve_frame(
     finally:
         if token is not None:
             trace_mod.CURRENT.reset(token)
+        if lane_cls is not None:
+            my_shard.qos.end(lane_cls)
     if ctx is not None:
         # Merge + response pack since the last stage mark; the span
         # then covers arrival → response bytes ready (the coalesced
@@ -1332,6 +1365,22 @@ async def _serve_frame(
             req.get("timeout"),
             req.get("deadline_ms"),
         )
+        if op in ("get", "multi_get"):
+            # Tenant byte quota, read side: point reads are billed by
+            # their RESPONSE bytes (the request frame the dispatcher
+            # billed carries only collection + keys — a tenant
+            # streaming large documents out must pay for what it
+            # reads, like scan chunks do).  Debt semantics: the real
+            # size is only known now, the NEXT op pays.  Writes stay
+            # billed by request bytes at dispatch.  Every tenant-
+            # stamped frame serves on THIS interpreted path (the C
+            # planes punt tenant frames; tenant gets skip the
+            # coalesced batch), so this point covers them all.
+            my_shard.qos.charge_bytes(
+                qos_mod.request_tenant(req),
+                req.get("collection"),
+                len(buf),
+            )
     return buf, keepalive
 
 
@@ -1475,7 +1524,7 @@ class _DbProtocol(framed.FramedServerProtocol):
         # data_received when nothing is queued or in flight, so the
         # direct transport.write cannot overtake a parked response.
         dp = self.shard.dataplane
-        if self.shard.governor.should_shed() and (
+        if self.shard.governor.any_should_shed() and (
             dp is None or not dp.shed_armed
         ):
             # Hard overload without the native shed gate (no .so, or
@@ -1558,6 +1607,15 @@ class _DbProtocol(framed.FramedServerProtocol):
     async def _drain(self) -> None:
         try:
             while self.pending and not self.closing:
+                # The window-full wait is bypassed only at STANDARD
+                # hard (the classic global shed regime, where every
+                # popped data frame is cheaply refused) — NOT when
+                # merely the batch class reads hard: standard/
+                # interactive frames would then pop past the AIMD
+                # window and be ADMITTED, bypassing exactly the
+                # backpressure the window exists for (review r14).
+                # Batch frames behind a full window wait for a slot
+                # and shed at dispatch like any popped frame.
                 if len(self.inflight) >= max(
                     1, int(self.window)
                 ) and not self.shard.governor.should_shed():
@@ -1626,7 +1684,11 @@ class _DbProtocol(framed.FramedServerProtocol):
         this connection.  ``arrived``: frame receipt stamp (queue-wait
         attribution for traced ops)."""
         gov = self.shard.governor
-        shedding = gov.should_shed()
+        # Any class at its hard limit (batch trips first): routing
+        # gate — per-class decisions happen below once the frame's
+        # class is known (interpreted path) or in C (native gate,
+        # which holds the per-class levels).
+        shedding = gov.any_should_shed()
         rec = self.shard.trace_recorder
         sampled = self._sampled_next
         ticked = self._ticked_next or sampled
@@ -1733,14 +1795,59 @@ class _DbProtocol(framed.FramedServerProtocol):
                     client_stamped=tid is not None,
                 )
                 ctx.mark("queue")
+            refusal = None
             if (
-                shedding
-                and isinstance(req, dict)
+                isinstance(req, dict)
                 and req.get("type") in _SHEDDABLE_OPS
             ):
+                # QoS admission (class-aware shed + tenant quota):
+                # per-class hard limits and lane windows shed with
+                # the retryable Overloaded; an exhausted tenant
+                # bucket refuses with the retryable QuotaExceeded.
+                # Cheap (dict lookups + int compares) and evaluated
+                # for EVERY interpreted data op — a batch flood sheds
+                # here while standard/interactive frames keep
+                # serving.
+                qp = self.shard.qos
+                cls = qos_mod.request_class(req)
+                if qp.should_shed(cls):
+                    refusal = qp.shed_error(cls)
+                    gov.record_shed(str(req.get("type")))
+                    gov.python_sheds += 1
+                else:
+                    try:
+                        ops_field = req.get("ops")
+                        qp.charge_ops(
+                            qos_mod.request_tenant(req),
+                            req.get("collection"),
+                            len(ops_field)
+                            if isinstance(ops_field, (list, tuple))
+                            else 1,
+                        )
+                        # Byte quota meters REQUEST bytes for WRITES
+                        # (the frame carries the encoded key and
+                        # value).  Reads are billed by their RESPONSE
+                        # bytes in _serve_frame — charging their tiny
+                        # request frame here too would double-bill
+                        # them against the documented contract.
+                        # Streamed chunk bytes are charged by the
+                        # scan plane.
+                        if req.get("type") not in (
+                            "get",
+                            "multi_get",
+                        ):
+                            qp.charge_bytes(
+                                qos_mod.request_tenant(req),
+                                req.get("collection"),
+                                len(frame),
+                            )
+                    except DbeelError as e:  # QuotaExceeded
+                        refusal = e
+            if refusal is not None:
                 # Hard-limit admission: answer a cheap retryable
                 # error NOW instead of adding this op to the backlog
-                # that made the shard overloaded.  The error frame
+                # that made the shard overloaded (or letting a
+                # tenant overdraft its bucket).  The error frame
                 # takes an in-order parked slot like any response;
                 # non-keepalive semantics are preserved.  With the
                 # native shed gate armed only frames the C parser
@@ -1748,11 +1855,7 @@ class _DbProtocol(framed.FramedServerProtocol):
                 # that residue (the bench's zero-Python-dispatch
                 # acceptance counter).
                 op = str(req.get("type"))
-                gov.record_shed(op)
-                gov.python_sheds += 1
-                err = Overloaded(
-                    f"shard {self.shard.shard_name} shedding load"
-                )
+                err = refusal
                 err_kind = classify_error(err)
                 self.shard.metrics.record_error(err_kind)
                 # Flight recorder: sheds ARE the interesting tail —
@@ -1839,6 +1942,11 @@ class _DbProtocol(framed.FramedServerProtocol):
         silently diverging from both the native plane and the
         unbatched path."""
         if req.get("type") != "get" or not req.get("keepalive"):
+            return False
+        if "tenant" in req or "qos" in req:
+            # QoS-stamped gets keep their own frame task so the lane
+            # inflight gauge and tenant byte accounting stay exact
+            # (the coalesced batch path has no per-frame class walk).
             return False
         deadline_ms = req.get("deadline_ms")
         if (

@@ -59,7 +59,15 @@ in remote_comm), and never to reads of the governor's own state.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Optional, Tuple
+
+from .qos import (
+    CLASS_HARD_FACTOR,
+    CLASS_SOFT_FACTOR,
+    NCLASSES,
+    QOS_INTERACTIVE,
+    QOS_STANDARD,
+)
 
 LEVEL_OK = 0
 LEVEL_SOFT = 1
@@ -119,6 +127,9 @@ class LoadGovernor:
         "_hb_task",
         "_dead_ewma",
         "_pushed_level",
+        "_class_levels",
+        "_pushed_class_levels",
+        "_soft_reasons",
         "telemetry_hook",
         "dead_completions",
         # counters (get_stats.overload)
@@ -148,6 +159,19 @@ class LoadGovernor:
         self._hb_task = None
         self._dead_ewma = 0.0
         self._pushed_level: Optional[int] = None
+        # Per-class levels (QoS plane, ISSUE 14): the same sampled
+        # signals compared against thresholds scaled by each class's
+        # factors — batch trips first, interactive last; STANDARD is
+        # exactly the classic scalar level.
+        self._class_levels: Tuple[int, ...] = (0,) * NCLASSES
+        self._pushed_class_levels: Optional[Tuple[int, ...]] = None
+        # Which signal families fired each class's soft level on the
+        # last sample ("ops"/"memtable"/"debt"/"lag"/"dead") — the
+        # scan plane paces instead of hard-parking when a resting
+        # shard's memtable fill is the ONLY pressure (BENCH r13).
+        self._soft_reasons: Tuple[frozenset, ...] = (
+            frozenset(),
+        ) * NCLASSES
         # Telemetry plane (PR 11): the continuous sampler rides THIS
         # heartbeat — one callable check per beat when armed, nothing
         # at all when --telemetry-interval is 0 (the hook stays None).
@@ -255,19 +279,54 @@ class LoadGovernor:
             "loop_lag_ms": round(lag * 1000, 1),
             "dead_completion_frac": round(dead, 3),
         }
-        level = LEVEL_OK
-        if (cfg.overload_soft_ops and ops > cfg.overload_soft_ops) or (
-            max(mem_fill, appends_fill) > MEMTABLE_SOFT_FILL
-        ) or (
-            cfg.overload_compaction_debt
-            and debt > cfg.overload_compaction_debt
-        ) or lag > LAG_SOFT_S or dead > DEAD_FRAC_SOFT:
-            level = LEVEL_SOFT
-        if (cfg.overload_hard_ops and ops > cfg.overload_hard_ops) or (
-            appends_fill > MEMTABLE_HARD_APPENDS
-        ) or lag > LAG_HARD_S or dead > DEAD_FRAC_HARD:
-            level = LEVEL_HARD
-        return level
+        # Per-class levels (QoS plane): the SAME signals against
+        # thresholds scaled by each class's factors — factor < 1
+        # trips earlier (batch sheds first), > 1 later (interactive's
+        # knee moves to a strictly higher offered-load multiple).
+        # STANDARD's factors are 1.0, so its level is exactly the
+        # classic PR-5 scalar.
+        levels = []
+        all_reasons = []
+        for cls in range(NCLASSES):
+            fs = CLASS_SOFT_FACTOR[cls]
+            fh = CLASS_HARD_FACTOR[cls]
+            reasons = set()
+            if cfg.overload_soft_ops and ops > cfg.overload_soft_ops * fs:
+                reasons.add("ops")
+            if max(mem_fill, appends_fill) > MEMTABLE_SOFT_FILL * fs:
+                reasons.add("memtable")
+            if (
+                cfg.overload_compaction_debt
+                and debt > cfg.overload_compaction_debt * fs
+            ):
+                reasons.add("debt")
+            # Wall-time signals (loop lag, dead completions) keep the
+            # UNSCALED soft thresholds for every class: they measure
+            # the whole shard, not one lane's queue — halving them
+            # for batch would pace analytics on any legitimately busy
+            # host (this host class shows tens of ms of lag under
+            # healthy full load).  The class factors still scale the
+            # HARD bars below, which is what moves the shed knees.
+            if lag > LAG_SOFT_S:
+                reasons.add("lag")
+            if dead > DEAD_FRAC_SOFT:
+                reasons.add("dead")
+            level = LEVEL_SOFT if reasons else LEVEL_OK
+            if (
+                (
+                    cfg.overload_hard_ops
+                    and ops > cfg.overload_hard_ops * fh
+                )
+                or appends_fill > MEMTABLE_HARD_APPENDS * fh
+                or lag > LAG_HARD_S * fh
+                or dead > DEAD_FRAC_HARD * fh
+            ):
+                level = LEVEL_HARD
+            levels.append(level)
+            all_reasons.append(frozenset(reasons))
+        self._class_levels = tuple(levels)
+        self._soft_reasons = tuple(all_reasons)
+        return levels[QOS_STANDARD]
 
     def level(self) -> int:
         if self._forced is not None:
@@ -287,24 +346,95 @@ class LoadGovernor:
         self._push_level(self._level)
         return self._level
 
+    def class_level(self, cls: int) -> int:
+        """The QoS level of one traffic class (qos.QOS_*).  Under the
+        forced test seam every class reads the forced level except
+        INTERACTIVE, which reads one level lower — the deterministic
+        mirror of its higher thresholds (a forced LEVEL_HARD sheds
+        batch+standard while interactive keeps serving, the class-
+        priority contract tests pin)."""
+        level = self.level()
+        if self._forced is not None:
+            if cls == QOS_INTERACTIVE:
+                return max(LEVEL_OK, level - 1)
+            return level
+        if 0 <= cls < NCLASSES:
+            return self._class_levels[cls]
+        return level
+
+    def soft_reasons(self, cls: int = QOS_STANDARD) -> frozenset:
+        """Signal families that fired this class's soft level on the
+        last sample.  Empty under the forced seam (forcing has no
+        attributable signal — consumers fall back to the classic
+        behavior)."""
+        if self._forced is not None or not 0 <= cls < NCLASSES:
+            return frozenset()
+        return self._soft_reasons[cls]
+
+    def memtable_only_soft(self, cls: int = QOS_STANDARD) -> bool:
+        """True when this class reads soft (not hard) and the ONLY
+        pressure is memtable fill — a resting shard whose arena sits
+        near capacity with no queue/lag/debt/dead-completion signal.
+        Scan chunks PACE through this state instead of hard-parking
+        (BENCH r13: an 88%-fill idle shard parked every chunk 2s);
+        the memtable protection that matters (appends outrunning the
+        flush) shows up as ops/lag pressure or the hard level."""
+        if self._forced is not None or not 0 <= cls < NCLASSES:
+            return False
+        return (
+            self.class_level(cls) == LEVEL_SOFT
+            and self._soft_reasons[cls] == frozenset(("memtable",))
+        )
+
     def _push_level(self, level: int) -> None:
         """Mirror the level into the native data plane (all-native
         serving path): at LEVEL_HARD the C client plane answers data
         verbs with the prebuilt retryable Overloaded response itself,
         so shed frames never reach the Python dispatcher whose
-        backlog the governor is protecting."""
-        if level == self._pushed_level:
+        backlog the governor is protecting.  The per-class levels ride
+        along (QoS plane) so the native shed gate stays class-aware:
+        a batch flood is refused in C while interactive frames keep
+        serving natively."""
+        if self._forced is not None:
+            # The forced seam's class mapping, mirrored natively.
+            class_levels = tuple(
+                max(LEVEL_OK, level - 1)
+                if cls == QOS_INTERACTIVE
+                else level
+                for cls in range(NCLASSES)
+            )
+        else:
+            class_levels = self._class_levels
+        if (
+            level == self._pushed_level
+            and class_levels == self._pushed_class_levels
+        ):
             return
         self._pushed_level = level
+        self._pushed_class_levels = class_levels
         dp = getattr(self.shard, "dataplane", None)
         if dp is not None:
             dp.set_overload(level)
+            dp.set_class_levels(class_levels)
 
     # -- decision points ----------------------------------------------
 
     def should_shed(self) -> bool:
-        """Hard-limit admission check for NEW public data ops."""
+        """Hard-limit admission check for NEW public data ops of the
+        STANDARD class (the classic PR-5 scalar; per-class decisions
+        live on the QoS plane)."""
         return self.level() >= LEVEL_HARD
+
+    def any_should_shed(self) -> bool:
+        """True when ANY traffic class is at its hard limit (in
+        practice batch first — its thresholds sit lowest).  The
+        dispatcher's routing gate: while any class sheds and the
+        native shed gate is unarmed, frames must take the interpreted
+        path so Python can make the per-class decision."""
+        level = self.level()
+        if self._forced is not None:
+            return level >= LEVEL_HARD
+        return max(self._class_levels) >= LEVEL_HARD
 
     def soft_overloaded(self) -> bool:
         return self.level() >= LEVEL_SOFT
@@ -324,7 +454,17 @@ class LoadGovernor:
         background units wait (bounded) for the backlog to ease
         before starting — serving latency recovers first, maintenance
         resumes the moment pressure lifts (and after BG_DELAY_MAX_S
-        regardless: anti-entropy/scrub must never starve outright)."""
+        regardless: anti-entropy/scrub must never starve outright).
+
+        Deliberately gated on the STANDARD level, not the batch
+        lane's (QoS plane): the units behind this gate include the
+        compaction/flush maintenance that CURES memtable-fill and
+        debt pressure, and batch's half-scaled thresholds would hold
+        them parked from ~43% fill — near-permanently on a
+        write-heavy shard (measured: compaction-under-load p99 blew
+        its bound).  The analytics lane that must not starve
+        interactive point ops is the SCAN plane, whose chunk
+        admission does consume the batch budget."""
         import asyncio
 
         if self.level() < LEVEL_SOFT:

@@ -47,6 +47,8 @@ from typing import List, Optional, Tuple
 
 import msgpack
 
+import contextvars
+
 from .. import query as Q
 from ..cluster.local_comm import LocalShardConnection
 from ..cluster.messages import ShardRequest, ShardResponse
@@ -58,7 +60,19 @@ from ..errors import (
     ProtocolError,
     from_wire,
 )
+from . import qos as qos_mod
 from . import trace as trace_mod
+
+# The QoS class of the chunk currently being served in this task tree
+# (QoS plane, ISSUE 14): set by handle(), read by _fetch_page so every
+# peer page of the chunk is stamped with the same lane — asyncio tasks
+# copy the context, so concurrent chunks of different classes cannot
+# cross-stamp.  Scans default to the BATCH lane (analytics must not
+# starve interactive point ops); an operator may stamp a scan
+# interactive/standard via the client `qos` field.
+_CHUNK_QOS: contextvars.ContextVar = contextvars.ContextVar(
+    "dbeel_scan_qos", default=None
+)
 
 _key0 = itemgetter(0)
 
@@ -374,15 +388,24 @@ class ScanPlane:
 
     # -- admission -----------------------------------------------------
 
-    def _shed(self, why: str):
+    def _shed(self, why: str, cls: Optional[int] = None):
         self.sheds += 1
+        if cls is not None:
+            # The refused chunk counts in its CLASS's shed column
+            # too — the class_starvation watchdog needs scan sheds
+            # visible in the lane, not only in scan.sheds.
+            self.shard.qos.note_shed(cls)
         return Overloaded(f"scan chunk shed: {why}")
 
-    async def _admit(self, ctx) -> None:
+    async def _admit(self, ctx, cls: int = qos_mod.QOS_BATCH) -> None:
+        from .governor import LEVEL_HARD, LEVEL_SOFT
+
         gov = self.shard.governor
-        if gov.should_shed():
+        if gov.class_level(cls) >= LEVEL_HARD:
             raise self._shed(
-                f"shard {self.shard.shard_name} at hard overload"
+                f"shard {self.shard.shard_name} at hard overload "
+                f"for {qos_mod.CLASS_NAMES[cls]}-class work",
+                cls,
             )
         cap = self.config.scan_max_concurrent
         # The caller already incremented active_scans (so chunks
@@ -392,22 +415,38 @@ class ScanPlane:
         if cap > 0 and self.active_scans > cap:
             raise self._shed(
                 f"{self.active_scans - 1} scan chunks already in "
-                "flight"
+                "flight",
+                cls,
             )
-        if gov.soft_overloaded():
-            # Park first: scans are the lowest lane.  Bounded — the
-            # scan resumes (slower) under sustained soft pressure
-            # rather than starving outright.
-            self.paced += 1
-            waited = 0.0
-            while waited < PACE_MAX_S and gov.soft_overloaded():
-                if gov.should_shed():
-                    raise self._shed(
-                        "hard overload during scan pacing"
-                    )
+        if gov.class_level(cls) >= LEVEL_SOFT:
+            if gov.memtable_only_soft(cls):
+                # A RESTING shard whose arena sits near capacity with
+                # no queue/lag/debt pressure (BENCH r13: an 88%-fill
+                # idle shard parked EVERY chunk the full 2s): pace one
+                # slice so the flush keeps priority, then serve —
+                # pacing, not parking.  Real backlog (ops, lag, dead
+                # completions) keeps the bounded park below.
+                self.paced += 1
+                self.paced_s += PACE_SLICE_S
                 await asyncio.sleep(PACE_SLICE_S)
-                waited += PACE_SLICE_S
-            self.paced_s += waited
+            else:
+                # Park first: scans are the lowest lane.  Bounded —
+                # the scan resumes (slower) under sustained soft
+                # pressure rather than starving outright.
+                self.paced += 1
+                waited = 0.0
+                while (
+                    waited < PACE_MAX_S
+                    and gov.class_level(cls) >= LEVEL_SOFT
+                    and not gov.memtable_only_soft(cls)
+                ):
+                    if gov.class_level(cls) >= LEVEL_HARD:
+                        raise self._shed(
+                            "hard overload during scan pacing", cls
+                        )
+                    await asyncio.sleep(PACE_SLICE_S)
+                    waited += PACE_SLICE_S
+                self.paced_s += waited
         if ctx is not None:
             ctx.mark("pace")
 
@@ -480,16 +519,36 @@ class ScanPlane:
                 )
 
         ctx = trace_mod.current()
+        # QoS plane (ISSUE 14): scans consume the BATCH lane's budget
+        # unless the client stamped a class — one analytics stream
+        # cannot starve interactive point ops.  The class rides every
+        # peer page of the chunk (_CHUNK_QOS → _fetch_page) and the
+        # tenant pays one op per chunk plus the chunk's streamed
+        # bytes.
+        q = request.get("qos")
+        cls = (
+            qos_mod.class_of(q) if q is not None else qos_mod.QOS_BATCH
+        )
+        tenant = qos_mod.request_tenant(request)
         col = my_shard.get_collection(collection)
+        my_shard.qos.charge_ops(tenant, collection, 1)
         # Hold the concurrency slot across BOTH admission (incl. the
         # soft-level park) and the chunk itself: _admit's cap check
         # counts this increment, so parked chunks cannot pile past
         # the cap and stampede when pressure lifts.
         self.active_scans += 1
+        qtok = _CHUNK_QOS.set(cls)
+        # Lane accounting begins only once the chunk is ADMITTED —
+        # a shed chunk must count in the lane's shed column, never
+        # as admitted work (the class_starvation watchdog compares
+        # exactly those two rates).
+        began = False
         try:
-            await self._admit(ctx)
+            await self._admit(ctx, cls)
+            my_shard.qos.begin(cls)
+            began = True
             if spec_raw is not None:
-                return await self._chunk_filtered(
+                payload = await self._chunk_filtered(
                     col,
                     collection,
                     last_key,
@@ -504,19 +563,25 @@ class ScanPlane:
                     agg_state_wire,
                     ctx,
                 )
-            return await self._chunk(
-                col,
-                collection,
-                last_key,
-                prefix,
-                remaining,
-                count_mode,
-                acc,
-                max_bytes,
-                ctx,
-            )
+            else:
+                payload = await self._chunk(
+                    col,
+                    collection,
+                    last_key,
+                    prefix,
+                    remaining,
+                    count_mode,
+                    acc,
+                    max_bytes,
+                    ctx,
+                )
+            my_shard.qos.charge_bytes(tenant, collection, len(payload))
+            return payload
         finally:
             # Pacing happens per merge round inside _chunk.
+            _CHUNK_QOS.reset(qtok)
+            if began:
+                my_shard.qos.end(cls)
             self.active_scans -= 1
 
     async def _pay_share(self, elapsed: float, ctx) -> None:
@@ -558,6 +623,7 @@ class ScanPlane:
         spec: Optional[bytes] = None,
     ) -> int:
         my_shard = self.shard
+        qos_cls = _CHUNK_QOS.get()
         req = ShardRequest.scan(
             collection,
             s.start,
@@ -568,6 +634,7 @@ class ScanPlane:
             page_bytes,
             with_values,
             spec,
+            qos_mod.QOS_BATCH if qos_cls is None else qos_cls,
         )
         if s.shard is None:
             resp = await my_shard.handle_shard_request(req)

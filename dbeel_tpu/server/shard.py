@@ -221,6 +221,13 @@ class MyShard:
 
         self.governor = LoadGovernor(self, config)
         self.scheduler.overload_gate = self.governor.bg_gate
+        # Multi-tenant QoS plane (ISSUE 14): class lanes (weighted
+        # admission shares, per-class AIMD windows over the
+        # governor's per-class levels) + per-tenant token-bucket
+        # quotas enforced at dispatch.
+        from .qos import QosPlane
+
+        self.qos = QosPlane(self, config)
         # Streaming scan/range query plane (PR 12): chunked, cursor-
         # resumable scans merged across every ring arc's replicas,
         # admitted chunk-by-chunk through the governor.
@@ -890,6 +897,11 @@ class MyShard:
             # Streaming scan plane (PR 12): chunk/byte/cursor/shed
             # counters + the active-chunks gauge.
             "scan": self.scan_plane.stats(),
+            # Multi-tenant QoS plane (ISSUE 14): per-class admitted/
+            # shed/window/level lanes + per-tenant token balances and
+            # throttle counters — reachable through BOTH clients like
+            # every other block.
+            "qos": self.qos.stats(),
             "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
@@ -1753,15 +1765,51 @@ class MyShard:
         ShardRequest.MULTI_GET: 5,
     }
 
+    # Position of the OPTIONAL trailing QoS class id (QoS plane,
+    # ISSUE 14): always exactly one slot past the trace id (frames
+    # with a qos element carry 0 placeholders for an absent deadline
+    # and trace, so the slot never shifts).  The wire-parity lint
+    # pins each entry to trace_index + 1 and checks the C parser's
+    # qos-dialect (`want + 3`) recognition in lockstep.  Old-dialect
+    # frames simply lack the element (class = standard).
+    _PEER_QOS_INDEX = {
+        ShardRequest.SET: 8,
+        ShardRequest.DELETE: 7,
+        ShardRequest.GET: 6,
+        ShardRequest.GET_DIGEST: 6,
+        ShardRequest.MULTI_SET: 6,
+        ShardRequest.MULTI_GET: 6,
+    }
+
     # Fixed arity of the SCAN peer frame (scan plane, PR 12; spec
-    # element appended by the query compute plane, PR 13):
+    # element appended by the query compute plane, PR 13; qos class
+    # appended by the QoS plane, ISSUE 14):
     # ["request","scan",collection,start,end,start_after,prefix,
-    #  limit,max_bytes,with_values,spec].  No trailing deadline/trace
-    # dialects — scan pages ride pooled round trips like the RANGE_*
-    # family (the chunk-level deadline lives on the CLIENT frame).
+    #  limit,max_bytes,with_values,spec,qos].  No trailing deadline/
+    # trace dialects — scan pages ride pooled round trips like the
+    # RANGE_* family (the chunk-level deadline lives on the CLIENT
+    # frame); old-arity frames (no spec and/or no qos) are accepted.
     # Lint-pinned against the encoder and both C sources
     # (analysis/wire_parity.py; native kScanPeerArity).
-    _SCAN_PEER_ARITY = 11
+    _SCAN_PEER_ARITY = 12
+
+    @classmethod
+    def peer_qos_class(cls, request) -> int:
+        """QoS class a coordinator stamped on this data-op peer frame
+        (QoS plane, ISSUE 14); STANDARD when absent (old dialect) or
+        malformed — an unknown stamp degrades to the default lane."""
+        from . import qos as qos_mod
+
+        if (
+            not isinstance(request, (list, tuple))
+            or len(request) < 2
+            or request[0] != "request"
+        ):
+            return qos_mod.QOS_STANDARD
+        idx = cls._PEER_QOS_INDEX.get(request[1])
+        if idx is None or len(request) <= idx:
+            return qos_mod.QOS_STANDARD
+        return qos_mod.class_of(request[idx])
 
     @classmethod
     def peer_trace_id(cls, request) -> Optional[int]:
@@ -1803,6 +1851,12 @@ class MyShard:
 
     async def handle_shard_request(self, request: list) -> list:
         kind = request[1]
+        if kind in self._PEER_DEADLINE_INDEX:
+            # QoS plane: account the propagated class so a bulk
+            # load's replica-side writes are visible in the batch
+            # lane cluster-wide.  Accounting only — the peer plane
+            # never sheds (replica work keeps quorums alive).
+            self.qos.note_peer(self.peer_qos_class(request))
         if kind in self._PEER_DEADLINE_INDEX and (
             self._peer_deadline_expired(request)
         ):
@@ -1986,6 +2040,15 @@ class MyShard:
                 4096, min(int(request[8]), 16 << 20)
             )
             spec = request[10] if len(request) > 10 else None
+            # QoS plane: scan pages account in the stamped lane
+            # (batch by default — old-arity frames lack the element).
+            from . import qos as qos_mod
+
+            self.qos.note_peer(
+                qos_mod.class_of(request[11])
+                if len(request) > 11
+                else qos_mod.QOS_BATCH
+            )
             if spec is not None:
                 # Query compute plane (PR 13): predicate/aggregate
                 # pushdown over the staged columns.  The peer spec

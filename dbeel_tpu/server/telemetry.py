@@ -168,7 +168,19 @@ _RATES = {
     # rate — rows the vectorized filter evaluated per second
     # (scanned, not returned; the work the governor bills).
     "scan_rows_filtered_per_s": ("scan.filter.rows_scanned",),
+    # QoS plane (ISSUE 14): per-class shed rates — under overload
+    # batch's rate should lead and interactive's stay ~0 until a
+    # strictly higher offered load (the class-priority contract).
+    "qos_sheds_interactive_per_s": ("qos.classes.interactive.shed",),
+    "qos_sheds_standard_per_s": ("qos.classes.standard.shed",),
+    "qos_sheds_batch_per_s": ("qos.classes.batch.shed",),
+    "qos_quota_refusals_per_s": ("qos.quota_refusals",),
 }
+
+# QoS classes the class_starvation watchdog rule walks (mirrors
+# qos.CLASS_NAMES; literal here because this module must stay
+# stdlib-only importable for the stats-schema lint).
+QOS_CLASS_NAMES = ("interactive", "standard", "batch")
 
 
 class TelemetryRing:
@@ -349,6 +361,13 @@ TRACE_CHURN_FACTOR = 1.0
 # what the sheds mean); the finding tells the operator WHY their
 # scans crawl.
 SCAN_STORM_SHEDS_PER_S = 5.0
+# Class starvation (QoS plane, ISSUE 14): a traffic class shedding at
+# a sustained rate while admitting NOTHING over the same window —
+# demand exists (the sheds prove it) but zero of it is served.  For
+# batch under overload that is the design working (warn tells the
+# operator why their bulk load stalled); for interactive it would be
+# a priority inversion — severity escalates to crit.
+CLASS_STARVATION_SHEDS_PER_S = 2.0
 
 _FINDING_LOG_PERIOD_S = 1.0
 
@@ -498,6 +517,33 @@ class HealthWatchdog:
                 f"{SCAN_STORM_SHEDS_PER_S:.0f}) — analytics load "
                 "exceeds the scan lanes",
             )
+
+        # class_starvation (QoS plane): a class sheds at a sustained
+        # rate while admitting zero ops over the same window — its
+        # lane is fully squeezed out.  Expected for batch under
+        # overload (warn: names why the bulk load stalled); a starved
+        # INTERACTIVE lane is a priority inversion (crit).
+        for cname in QOS_CLASS_NAMES:
+            shed_rate = ring.delta_per_s(
+                f"qos.classes.{cname}.shed"
+            )
+            admit_rate = ring.delta_per_s(
+                f"qos.classes.{cname}.admitted"
+            )
+            if (
+                shed_rate is not None
+                and admit_rate is not None
+                and shed_rate > CLASS_STARVATION_SHEDS_PER_S
+                and admit_rate == 0.0
+            ):
+                add(
+                    "class_starvation",
+                    "crit" if cname == "interactive" else "warn",
+                    shed_rate,
+                    f"{cname} class starved: shedding "
+                    f"{shed_rate:.0f}/s with zero admitted over the "
+                    "window",
+                )
 
         # trace_ring_churn: the flight recorder turned over completely
         # within one telemetry window — slow-tail evidence is being

@@ -500,6 +500,22 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.dbeel_odirect_fallbacks.restype = ctypes.c_uint64
             lib.dbeel_odirect_fallbacks.argtypes = []
+        if hasattr(lib, "dbeel_dp_set_class_levels"):
+            # QoS plane (ISSUE 14): per-class shed levels + per-class
+            # native shed counters.  Gated separately — stale .so
+            # tolerance (a class-blind .so keeps the scalar gate).
+            lib.dbeel_dp_set_class_levels.restype = None
+            lib.dbeel_dp_set_class_levels.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+            lib.dbeel_dp_sheds_by_class.restype = None
+            lib.dbeel_dp_sheds_by_class.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
         if hasattr(lib, "dbeel_dp_trace_snapshot"):
             # Tracing plane (PR 9): coarse per-verb native stage
             # counters.  Gated separately — stale .so tolerance.

@@ -251,6 +251,12 @@ struct Client {
   // each stamped op gets a distinct, correlatable id) — the server
   // records a full per-stage span for them.
   uint64_t trace_id = 0;
+  // QoS plane (ISSUE 14): when armed via dbeel_cli_set_qos, every
+  // data-op frame carries the traffic class (0 interactive,
+  // 1 standard, 2 batch; -1 = unstamped) and/or the tenant id the
+  // server's quota buckets are keyed by.
+  int32_t qos_class = -1;
+  std::string tenant;
 
   ~Client() {
     for (auto& kv : conns) {
@@ -451,6 +457,26 @@ std::string error_kind(const std::vector<uint8_t>& body,
   return kind;
 }
 
+// QoS stamp helpers: data-op frame builders add qos_field_count(c)
+// to their map headers and call append_qos_fields right after the
+// common fields, so every transport (walk, pipelined, multi, scan)
+// stamps identically.
+uint32_t qos_field_count(Client* c) {
+  return (c->qos_class >= 0 ? 1u : 0u) +
+         (c->tenant.empty() ? 0u : 1u);
+}
+
+void append_qos_fields(Client* c, MpBuf* m) {
+  if (c->qos_class >= 0) {
+    m->str("qos");
+    m->uint((uint64_t)c->qos_class);
+  }
+  if (!c->tenant.empty()) {
+    m->str("tenant");
+    m->str(c->tenant);
+  }
+}
+
 void common_fields(MpBuf* m, const char* type,
                    const std::string& collection, bool keepalive) {
   m->str("type");
@@ -641,9 +667,10 @@ int keyed_request(Client* c, const char* type,
       // + trace id when armed via dbeel_cli_set_trace).
       uint32_t fields = 7 + (is_set ? 1 : 0) +
                         (consistency > 0 ? 1 : 0) +
-                        (c->trace_id ? 1 : 0);
+                        (c->trace_id ? 1 : 0) + qos_field_count(c);
       m.map_header(fields);
       common_fields(&m, type, collection, true);
+      append_qos_fields(c, &m);
       m.str("key");
       m.raw(key, klen);  // raw msgpack blob straight into the map
       if (is_set) {
@@ -690,7 +717,10 @@ int keyed_request(Client* c, const char* type,
       }
       if (kind == "KeyNotFound") {
         last_rc = -1;
-      } else if (kind == "Overloaded") {
+      } else if (kind == "Overloaded" || kind == "QuotaExceeded") {
+        // Shed or quota refusal: retryable after backoff — sheds
+        // drain and tenant tokens refill; hammering back defeats
+        // both mechanisms.
         shed = true;
         last_rc = -2;
         c->last_error = kind + ": " + msg;
@@ -803,9 +833,11 @@ int pipe_op(Client* c, const char* type, const std::string& collection,
   const RingShard* s = replicas[0];
   bool is_set = std::strcmp(type, "set") == 0;
   MpBuf m;
-  uint32_t fields = 6 + (is_set ? 1 : 0) + (consistency > 0 ? 1 : 0);
+  uint32_t fields = 6 + (is_set ? 1 : 0) + (consistency > 0 ? 1 : 0) +
+                    qos_field_count(c);
   m.map_header(fields);
   common_fields(&m, type, collection, true);
+  append_qos_fields(c, &m);
   m.str("key");
   m.raw(key, klen);
   if (is_set) {
@@ -901,9 +933,11 @@ int multi_round_trip(Client* c, const char* type,
                      uint8_t* status,
                      std::vector<std::vector<uint8_t>>* values_out) {
   MpBuf m;
-  uint32_t fields = 5 + (consistency > 0 ? 1 : 0);
+  uint32_t fields = 5 + (consistency > 0 ? 1 : 0) +
+                    qos_field_count(c);
   m.map_header(fields);
   common_fields(&m, type, collection, true);
+  append_qos_fields(c, &m);
   m.str("ops");
   m.array_header((uint32_t)idxs.size());
   for (uint32_t i : idxs) {
@@ -1155,6 +1189,20 @@ void dbeel_cli_set_trace(void* h, uint64_t base) {
   static_cast<Client*>(h)->trace_id = base;
 }
 
+// Arm QoS stamping (QoS plane, ISSUE 14): every data-op frame this
+// client builds carries the traffic class under "qos" (0
+// interactive, 1 standard, 2 batch; -1 disarms) and/or the tenant id
+// under "tenant" (NULL/empty disarms) — the server's per-class
+// admission and per-tenant token buckets key off them.  A
+// QuotaExceeded answer is retryable exactly like an Overloaded shed
+// (the walk backs off; tokens refill).
+void dbeel_cli_set_qos(void* h, int32_t qos_class,
+                       const char* tenant) {
+  Client* c = static_cast<Client*>(h);
+  c->qos_class = (qos_class >= 0 && qos_class <= 2) ? qos_class : -1;
+  c->tenant = (tenant != nullptr) ? tenant : "";
+}
+
 // Fetch one server's flight-recorder dump (raw msgpack map — the
 // schema is shared with the Python client's trace_dump()): sampled
 // per-stage spans plus every slow/error op.  Same target/buffer
@@ -1230,12 +1278,13 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
   }
   MpBuf m;
   if (cursor && cursor_len) {
-    m.map_header(3);
+    m.map_header(3 + qos_field_count(c));
     common_fields(&m, "scan_next", "", true);
+    append_qos_fields(c, &m);
     m.str("cursor");
     m.bin(cursor, cursor_len);
   } else {
-    uint32_t fields = 3;  // type, collection, keepalive
+    uint32_t fields = 3 + qos_field_count(c);  // type, collection, keepalive (+qos)
     if (count_only) fields++;
     if (prefix && prefix_len) fields++;
     if (limit) fields++;
@@ -1243,6 +1292,7 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
     if (spec && spec_len) fields++;
     m.map_header(fields);
     common_fields(&m, "scan", collection ? collection : "", true);
+    append_qos_fields(c, &m);
     if (count_only) {
       m.str("count");
       m.boolean(true);
@@ -1275,7 +1325,8 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
     c->last_error = kind + ": " + msg;
     // The retryable classes the Python walk retries on: the scan
     // cursor is client-held state, so these resume after backoff.
-    if (kind == "Overloaded" || kind == "Timeout" ||
+    if (kind == "Overloaded" || kind == "QuotaExceeded" ||
+        kind == "Timeout" ||
         kind == "PeerDead" || kind == "ShardDegraded" ||
         kind == "CorruptedFile") {
       return -3;

@@ -1677,6 +1677,14 @@ struct DataPlane {
   uint64_t crc_failures = 0;           // sidecar mismatches in probes
   int32_t verify_crc = 0;  // runtime flag (dbeel_dp_set_verify)
   int32_t overload_level = 0;  // governor level (dbeel_dp_set_overload)
+  // QoS plane (ISSUE 14): per-class governor levels pushed by
+  // dbeel_dp_set_class_levels — the shed gate checks the frame's
+  // stamped class, so a batch flood is refused natively while
+  // interactive frames keep serving.  Until the first push the
+  // scalar overload_level applies (class-blind, pre-QoS behavior).
+  int32_t class_levels[3] = {0, 0, 0};
+  int32_t has_class_levels = 0;
+  uint64_t sheds_by_class[3] = {0, 0, 0};
   int32_t multi_enabled = 1;  // A/B gate (dbeel_dp_set_multi): 0
                               // punts MULTI frames to the Python
                               // fallback for same-session baselines
@@ -1919,12 +1927,14 @@ static_assert(kCoordGetTrailerHdr ==
               "dataplane.py's _OFF_* parse offsets");
 
 // SCAN peer-frame arity (scan plane PR 12 + the query compute
-// plane's trailing spec element, PR 13): ["request","scan",coll,
-// start,end,start_after,prefix,limit,max_bytes,with_values,spec].
-// The C shard plane always PUNTS scan pages to Python (the
+// plane's trailing spec element, PR 13, + the QoS plane's trailing
+// class element, ISSUE 14): ["request","scan",coll,
+// start,end,start_after,prefix,limit,max_bytes,with_values,spec,
+// qos].  The C shard plane always PUNTS scan pages to Python (the
 // ScanStage serves them), but pins the dialect: MUST equal
-// shard.py's _SCAN_PEER_ARITY (wire-parity lint).
-constexpr uint32_t kScanPeerArity = 11;
+// shard.py's _SCAN_PEER_ARITY (wire-parity lint).  Old-arity frames
+// (one element short, pre-QoS senders) stay recognized.
+constexpr uint32_t kScanPeerArity = 12;
 
 static const uint32_t kDpHardMax = 16u << 20;
 
@@ -2525,6 +2535,27 @@ void dbeel_dp_set_overload(void* h, int32_t level) {
   static_cast<DataPlane*>(h)->overload_level = level;
 }
 
+// Per-class governor levels (QoS plane, ISSUE 14): pushed whenever
+// they change, so the native shed gate refuses exactly the classes
+// the Python governor would — batch floods shed in C while
+// interactive frames keep serving natively.
+void dbeel_dp_set_class_levels(void* h, int32_t l0, int32_t l1,
+                               int32_t l2) {
+  auto* dp = static_cast<DataPlane*>(h);
+  dp->class_levels[0] = l0;
+  dp->class_levels[1] = l1;
+  dp->class_levels[2] = l2;
+  dp->has_class_levels = 1;
+}
+
+// Native per-class shed counters (out must hold 3 u64s).
+void dbeel_dp_sheds_by_class(void* h, uint64_t* out) {
+  auto* dp = static_cast<DataPlane*>(h);
+  out[0] = dp->sheds_by_class[0];
+  out[1] = dp->sheds_by_class[1];
+  out[2] = dp->sheds_by_class[2];
+}
+
 // Install the prebuilt COMPLETE wire responses (u32-LE length +
 // msgpack error payload + type byte) for native sheds and deadline
 // drops.  Packed by Python with its own msgpack encoder so the
@@ -2572,6 +2603,9 @@ struct ClientFrame {
   // Client-propagated absolute wall deadline (overload plane).
   // 0 = absent; Python honors only positive ints.
   int64_t deadline_ms = 0;
+  // QoS traffic class (QoS plane, ISSUE 14): 0 interactive,
+  // 1 standard (the default for unstamped frames), 2 batch.
+  int32_t qos_class = 1;
   // multi_set/multi_get: the raw msgpack ops array slice + element
   // count (frames carry key XOR ops).
   const uint8_t* ops_raw = nullptr;
@@ -2721,6 +2755,24 @@ static bool dp_parse_client_frame(const uint8_t* frame, uint32_t len,
       if (!mp_skip_n(c, count, 1)) return false;
       f->ops_n = (uint32_t)(c.p - f->ops_raw);
       f->ops_count = count;
+    } else if (slice_eq(ks, kn, "qos")) {
+      // QoS plane (ISSUE 14): traffic-class stamp.  nil counts as
+      // absent (standard); canonical uints in class range pass
+      // through; anything else punts so Python's class_of decides.
+      if (!mp_need(c, 1)) return false;
+      uint64_t q;
+      if (*c.p == 0xc0) {
+        c.p++;
+      } else if (mp_read_uint(c, &q) && q <= 2) {
+        f->qos_class = (int32_t)q;
+      } else {
+        return false;
+      }
+    } else if (slice_eq(ks, kn, "tenant")) {
+      // QoS plane: tenant-stamped frames punt — the interpreted
+      // path owns the per-tenant token buckets (the trace-field
+      // division of labor: Python serves what Python accounts).
+      return false;
     } else if (slice_eq(ks, kn, "trace")) {
       // Tracing plane (PR 9): a client-stamped trace id forces a
       // full per-stage span, which only the interpreted path can
@@ -2821,11 +2873,25 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   // answer every frame of the flood it was shedding.  Order matches
   // Python (_dispatch sheds before handle_request's deadline check).
   // Non-data verbs (admin, get_stats) punted above and always serve.
-  if (dp->overload_level >= 2 && !dp->shed_resp.empty() &&
+  // QoS plane (ISSUE 14): the shed decision is per CLASS when the
+  // governor has pushed class levels — a batch flood sheds here
+  // while interactive frames keep serving natively.
+  const int32_t shed_level =
+      dp->has_class_levels ? dp->class_levels[f.qos_class]
+                           : dp->overload_level;
+  // BATCH at its (earliest) SOFT level punts to the interpreted
+  // path, whose per-lane AIMD window squeezes batch admission to its
+  // weighted share — served natively here, a batch flood would run
+  // at full rate until its HARD bar, the exact regime the squeeze
+  // exists for.  Below soft batch serves natively like everyone.
+  if (dp->has_class_levels && f.qos_class == 2 && shed_level == 1)
+    return -1;
+  if (shed_level >= 2 && !dp->shed_resp.empty() &&
       dp->shed_resp.size() <= out_cap) {
     std::memcpy(out, dp->shed_resp.data(), dp->shed_resp.size());
     *out_len = (uint32_t)dp->shed_resp.size();
     dp->native_sheds++;
+    dp->sheds_by_class[f.qos_class]++;
     return (keepalive ? 1 : 0) | 0xC0 | 4 | (verb << 24) |
            (1ll << 27);
   }
@@ -3584,7 +3650,8 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     // element) are served by the Python ScanStage path: always
     // punt, but keep the dialect pinned here so an arity drift
     // fails the wire-parity lint, not a production merge.
-    if (nelem != kScanPeerArity) return -1;
+    if (nelem != kScanPeerArity && nelem != kScanPeerArity - 1)
+      return -1;
     return -1;
   }
   if (!(k_set || k_del || k_get || k_dig || k_mset || k_mget))
@@ -3603,13 +3670,24 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   // (deadline index + 1) in server/shard.py.
   const bool has_trace = nelem == want + 2u;
   if (has_trace) return -1;
-  if (nelem != want && !has_deadline) return -1;
+  // QoS dialect (QoS plane, ISSUE 14): deadline + trace + class id
+  // (0 placeholders keep earlier slots fixed).  Served natively —
+  // the class is accounting-side only on the replica plane (it never
+  // sheds) — EXCEPT when the trace placeholder carries a live id,
+  // which punts like the want+2 dialect.  Lint-pinned against
+  // _PEER_QOS_INDEX (trace index + 1) in server/shard.py.
+  const bool has_qos = nelem == want + 3u;
+  if (nelem != want && !has_deadline && !has_qos) return -1;
 
   const uint8_t* coll_s;
   uint32_t coll_n;
   if (!mp_read_str(c, &coll_s, &coll_n)) return -1;
   const uint64_t tr1 = dp_now_ns(dp);  // header+verb+coll decoded
   if (k_mset || k_mget) {
+    // QoS-dialect multi frames punt: dp_shard_multi's trailer walk
+    // knows the base/deadline dialects only, and the interpreted
+    // replica path owns the lane accounting for tagged batches.
+    if (has_qos) return -1;
     const int64_t mrc = dp_shard_multi(dp, c, k_mset, has_deadline,
                                        coll_s, coll_n, out, out_cap,
                                        out_len);
@@ -3625,7 +3703,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (k_set && !mp_read_bin(c, &val_s, &val_n)) return -1;
   int64_t ts = 0;
   if ((k_set || k_del) && !mp_read_int64(c, &ts)) return -1;
-  if (has_deadline) {
+  if (has_deadline || has_qos) {
     int64_t deadline_ms = 0;
     if (!mp_read_int64(c, &deadline_ms)) return -1;
     if (deadline_ms > 0) {
@@ -3649,6 +3727,18 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
         return 0x80 | 4;
       }
     }
+  }
+  if (has_qos) {
+    // QoS dialect trailer: the trace placeholder (a LIVE id punts —
+    // Python owns sampled frames and the span piggyback) and the
+    // class id, parsed for dialect validity; replica-side class
+    // accounting happens on the Python plane's counters.
+    int64_t trace_v = 0;
+    if (!mp_read_int64(c, &trace_v)) return -1;
+    if (trace_v > 0) return -1;
+    int64_t qos_v = 0;
+    if (!mp_read_int64(c, &qos_v)) return -1;
+    if (qos_v < 0 || qos_v > 2) return -1;
   }
   if (c.p != c.end) return -1;
 
@@ -3877,6 +3967,12 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   ClientFrame f;
   if (!dp_parse_client_frame(frame, len, &f)) return -1;
   if (!mp_key_canonical(f.key_raw, f.key_n)) return -1;
+  // QoS plane: non-standard classes take the interpreted
+  // coordinator, whose peer frames carry the class dialect element
+  // and whose lane accounting owns them; a class at its shed level
+  // must not sneak past admission via the assist either.
+  if (f.qos_class != 1) return -1;
+  if (dp->has_class_levels && dp->class_levels[1] >= 2) return -1;
   const bool is_set = slice_eq(f.type_s, f.type_n, "set");
   const bool is_del = slice_eq(f.type_s, f.type_n, "delete");
   const bool is_get = slice_eq(f.type_s, f.type_n, "get");

@@ -112,6 +112,21 @@ OVERLOAD_KEYS = {
     "stats_overload_block_py",
     "stats_overload_block_native",
     "nodes_alive",
+    "classes",
+    "pass",
+}
+
+# QoS plane (ISSUE 14): the two-class overload sub-phase — equal
+# offered load per class; the high class holds its goodput share
+# while the low class sheds first.
+OVERLOAD_CLASS_KEYS = {
+    "offered_multiplier_per_class",
+    "duration_s",
+    "interactive",
+    "batch",
+    "interactive_goodput_share",
+    "batch_sheds_dominate",
+    "share_held",
     "pass",
 }
 
@@ -181,6 +196,15 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert ov["stats_overload_block_py"] is True
     assert ov["stats_overload_block_native"] is True
     assert "overload" in ov["errors_by_class"] or ov["ok"] > 0
+    # QoS plane (ISSUE 14): two-class sub-phase — schema + the
+    # class-priority gates (vacuous only when nothing shed).
+    cb = ov["classes"]
+    missing = OVERLOAD_CLASS_KEYS - set(cb)
+    assert not missing, missing
+    assert cb["pass"] is True, cb
+    assert cb["batch_sheds_dominate"] is True
+    for cname in ("interactive", "batch"):
+        assert cb[cname]["launched"] > 0, cb
     # --scan phase schema (streaming scan plane, ISSUE 12): scans
     # complete through the mid-stream kill, every completed stream is
     # sorted/duplicate-free, and the healed scan view agrees with

@@ -215,7 +215,7 @@ def test_parity_flags_scan_arity_drift(tmp_path):
     _edit(
         root,
         "dbeel_tpu/server/shard.py",
-        "_SCAN_PEER_ARITY = 11",
+        "_SCAN_PEER_ARITY = 12",
         "_SCAN_PEER_ARITY = 9",
     )
     findings = wire_parity.check(Repo(root))
@@ -249,7 +249,7 @@ def test_parity_flags_scan_arity_drift_in_c_shard_plane(tmp_path):
     _edit(
         root,
         "native/src/dbeel_native.cpp",
-        "constexpr uint32_t kScanPeerArity = 11;",
+        "constexpr uint32_t kScanPeerArity = 12;",
         "constexpr uint32_t kScanPeerArity = 10;",
     )
     findings = wire_parity.check(Repo(root))
@@ -258,6 +258,76 @@ def test_parity_flags_scan_arity_drift_in_c_shard_plane(tmp_path):
         and "kScanPeerArity" in f.message
         for f in findings
     ), findings
+
+
+def test_parity_flags_qos_index_drift(tmp_path):
+    # QoS plane (ISSUE 14): the class element rides exactly one slot
+    # past the trace id on every data verb — a seeded Python-side
+    # table drift must fail the lint.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "    _PEER_QOS_INDEX = {\n"
+        "        ShardRequest.SET: 8,",
+        "    _PEER_QOS_INDEX = {\n"
+        "        ShardRequest.SET: 9,",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "qos-field arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_qos_dialect_drift_in_c(tmp_path):
+    # The C shard parser must recognize the want+3 qos dialect;
+    # seeding it to want+4 is wire drift.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        "const bool has_qos = nelem == want + 3u;",
+        "const bool has_qos = nelem == want + 4u;",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "qos-field arity drift" in f.message
+        or "qos-dialect" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_qos_trace_punt_lost_in_c(tmp_path):
+    # Inside the qos dialect a LIVE trace id must punt to Python
+    # (sampled frames own the span piggyback) — removing the punt is
+    # drift.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        "if (trace_v > 0) return -1;",
+        "if (trace_v > 1) return -1;",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "qos dialect must punt" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_tenant_field_lost_in_c_plane(tmp_path):
+    # The C data plane must keep recognizing (and punting) the
+    # "tenant" request field — losing the token would serve quota'd
+    # traffic unmetered.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        'slice_eq(ks, kn, "tenant")',
+        'slice_eq(ks, kn, "tennant")',
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "no longer recognizes the 'tenant'" in msgs, findings
 
 
 def test_parity_flags_spec_version_drift(tmp_path):

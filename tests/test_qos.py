@@ -1,0 +1,635 @@
+"""Multi-tenant QoS plane (ISSUE 14): class priority under forced
+LEVEL_HARD (batch sheds before interactive), tenant token-bucket
+refill math, QuotaExceeded classified retryable in BOTH clients'
+backoff walks, old-dialect peer frames accepted everywhere, per-class
+AIMD window recovery, the get_stats.qos block through both clients,
+and the BENCH-r13 memtable-near-full-at-rest soft-park regression
+(a resting shard at ~88% fill must PACE scan chunks, not park each
+one the full 2 s).
+"""
+
+import asyncio
+import time
+
+import msgpack
+import pytest
+
+from dbeel_tpu.client import DbeelClient, native_client
+from dbeel_tpu.cluster import remote_comm
+from dbeel_tpu.cluster.messages import ShardRequest
+from dbeel_tpu.errors import (
+    ERROR_CLASS_QUOTA,
+    Overloaded,
+    QuotaExceeded,
+    classify_error,
+    from_wire,
+    is_retryable_class,
+)
+from dbeel_tpu.server.governor import LEVEL_HARD, LEVEL_OK, LEVEL_SOFT
+from dbeel_tpu.server.qos import (
+    QOS_BATCH,
+    QOS_INTERACTIVE,
+    QOS_STANDARD,
+    TokenBucket,
+    class_of,
+)
+from dbeel_tpu.server.shard import MyShard
+
+from conftest import run
+from harness import ClusterNode, make_config
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_fanout(monkeypatch):
+    monkeypatch.setenv("DBEEL_NO_QF", "1")
+    yield
+    remote_comm.clear_faults()
+
+
+async def _one_node(tmp_dir, rf=1, col_name="qv", **kw):
+    cfg = make_config(tmp_dir, **kw)
+    node = await ClusterNode(cfg).start()
+    client = await DbeelClient.from_seed_nodes(
+        [node.db_address], op_deadline_s=1.5
+    )
+    col = await client.create_collection(
+        col_name, replication_factor=rf
+    )
+    return node, client, col
+
+
+# ----------------------------------------------------------------------
+# Taxonomy plumbing
+# ----------------------------------------------------------------------
+
+
+def test_quota_error_class_is_retryable():
+    assert classify_error(QuotaExceeded("x")) == ERROR_CLASS_QUOTA
+    assert is_retryable_class(ERROR_CLASS_QUOTA)
+    e = from_wire(["QuotaExceeded", "dry"])
+    assert isinstance(e, QuotaExceeded)
+
+
+def test_class_of_resolves_names_ints_and_garbage():
+    assert class_of("interactive") == QOS_INTERACTIVE
+    assert class_of("standard") == QOS_STANDARD
+    assert class_of("batch") == QOS_BATCH
+    assert class_of(0) == QOS_INTERACTIVE
+    assert class_of(2) == QOS_BATCH
+    # Unknown stamps degrade to the default lane, never to an error
+    # or a privilege.
+    assert class_of(None) == QOS_STANDARD
+    assert class_of(17) == QOS_STANDARD
+    assert class_of("vip") == QOS_STANDARD
+    assert class_of(True) == QOS_STANDARD
+
+
+# ----------------------------------------------------------------------
+# Token-bucket refill math (deterministic: injected clock)
+# ----------------------------------------------------------------------
+
+
+def test_token_bucket_refill_math():
+    b = TokenBucket(10, now=0.0)  # burst = 2 s of rate = 20
+    assert b.tokens == 20.0
+    assert b.take(5, now=0.0)
+    assert b.tokens == 15.0
+    # Refill is continuous and capped at the burst.
+    assert b.take(0, now=10.0)
+    assert b.tokens == 20.0
+    # take() refuses only while the balance is non-positive; the
+    # charge itself may push it negative (whole batches admit
+    # atomically).
+    assert b.take(25, now=10.0)
+    assert b.tokens == -5.0
+    assert not b.take(1, now=10.0)
+    # 0.4 s refills +4: still negative, still refused.
+    assert not b.take(1, now=10.4)
+    assert b.tokens == pytest.approx(-1.0)
+    # Past the overdraft the next op admits.
+    assert b.take(1, now=10.2 + 0.4)
+    # Byte debt is unconditional and blocks future ops until the
+    # refill covers it.
+    b2 = TokenBucket(10, now=0.0)
+    b2.debit(120, now=0.0)
+    assert b2.tokens == -100.0
+    assert not b2.take(1, now=5.0)  # +50 -> -50
+    assert b2.take(1, now=12.5)  # +125 (capped rel.) -> positive
+
+
+# ----------------------------------------------------------------------
+# Class priority: batch sheds before interactive (forced seam)
+# ----------------------------------------------------------------------
+
+
+def test_forced_hard_batch_sheds_before_interactive(tmp_dir):
+    """Under forced LEVEL_HARD, batch- and standard-class ops shed
+    with the retryable Overloaded while INTERACTIVE ops keep serving
+    (its thresholds sit one level higher — the deterministic mirror
+    of the 1.5x signal factors), and the sheds land in the per-class
+    lane counters."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        b_client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=1.0, qos_class="batch"
+        )
+        i_client = await DbeelClient.from_seed_nodes(
+            [node.db_address],
+            op_deadline_s=1.5,
+            qos_class="interactive",
+        )
+        try:
+            await col.set("k", {"v": 1})
+            shard.governor.force_level(LEVEL_HARD)
+            assert shard.governor.class_level(QOS_BATCH) == LEVEL_HARD
+            assert (
+                shard.governor.class_level(QOS_INTERACTIVE)
+                == LEVEL_SOFT
+            )
+            with pytest.raises(Overloaded):
+                await b_client.collection("qv").set("kb", {"v": 2})
+            with pytest.raises(Overloaded):
+                await col.set("ks", {"v": 2})  # standard default
+            # Interactive keeps serving THROUGH the forced hard level.
+            await i_client.collection("qv").set("ki", {"v": 3})
+            assert (
+                await i_client.collection("qv").get("ki")
+            )["v"] == 3
+            stats = await client.get_stats(*node.db_address)
+            classes = stats["qos"]["classes"]
+            for cname in ("batch", "standard"):
+                lane = classes[cname]
+                shed_total = lane["shed"] + lane.get(
+                    "native_sheds", 0
+                )
+                assert shed_total > 0, (cname, lane)
+            ilane = classes["interactive"]
+            assert ilane["shed"] + ilane.get("native_sheds", 0) == 0
+            assert ilane["admitted"] + ilane.get("peer_ops", 0) >= 0
+        finally:
+            shard.governor.force_level(None)
+            b_client.close()
+            i_client.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_bg_gate_stays_on_standard_level(tmp_dir):
+    """bg_gate keys on the STANDARD level, not the batch lane's: the
+    units behind it include the compaction/flush maintenance that
+    CURES memtable pressure, and batch's half-scaled thresholds
+    would park them from ~43% fill near-permanently on a write-heavy
+    shard (the compaction-under-load p99 regression this test pins).
+    A shard whose fill is batch-soft but standard-OK must run
+    background units WITHOUT delay; forced SOFT (standard) still
+    parks them."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, memtable_capacity=64
+        )
+        shard = node.shards[0]
+        try:
+            # 40/64 = 0.625 fill: past batch's 0.425 bar, under
+            # standard's 0.85 — batch-soft, standard-OK.
+            for i in range(40):
+                await col.set(f"g{i:03}", {"v": i})
+            await asyncio.sleep(0.1)
+            gov = shard.governor
+            gov.level()
+            assert gov.class_level(QOS_BATCH) >= LEVEL_SOFT
+            assert gov.class_level(QOS_STANDARD) == LEVEL_OK
+            ran = []
+
+            async def unit():
+                async with shard.scheduler.bg_slice():
+                    ran.append(1)
+
+            await asyncio.wait_for(
+                asyncio.ensure_future(unit()), 2
+            )
+            assert ran  # no park: maintenance cures the pressure
+            assert gov.bg_delays == 0
+
+            # Standard soft still parks (the PR-5 contract).
+            gov.force_level(LEVEL_SOFT)
+            ran2 = []
+
+            async def unit2():
+                async with shard.scheduler.bg_slice():
+                    ran2.append(1)
+
+            task = asyncio.ensure_future(unit2())
+            await asyncio.sleep(0.12)
+            assert gov.bg_delays == 1
+            assert not ran2
+            gov.force_level(None)
+            await asyncio.wait_for(task, 5)
+            assert ran2
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Tenant quotas end to end (byte debt makes the refusal deterministic)
+# ----------------------------------------------------------------------
+
+
+def test_tenant_byte_quota_refuses_retryably_python_client(tmp_dir):
+    """A tenant whose byte bucket is deep in debt gets the retryable
+    QuotaExceeded: the client's backoff walk retries it (not a
+    terminal error) and re-raises the classified error once its
+    deadline budget is spent; an UNSTAMPED client on the same shard
+    keeps serving — the refusal is scoped to the tenant."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, tenant_bytes_per_sec=64
+        )
+        shard = node.shards[0]
+        t_client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=1.0, tenant="acme"
+        )
+        tcol = t_client.collection("qv")
+        try:
+            # ~4 KiB frame >> the 128-token burst: the charge lands
+            # as debt, so the NEXT op faces a ~minute of refill.
+            await tcol.set("big", {"blob": "x" * 4096})
+            t0 = time.monotonic()
+            with pytest.raises(QuotaExceeded):
+                await tcol.set("next", {"v": 1})
+            # The walk retried with backoff inside ITS deadline (the
+            # server answers each attempt instantly — a terminal
+            # classification would have raised in milliseconds
+            # without the retry train; retryable is asserted via the
+            # taxonomy below, the wall bound just catches hangs).
+            assert time.monotonic() - t0 < 5.0
+            assert is_retryable_class(
+                classify_error(QuotaExceeded("x"))
+            )
+            # Unstamped traffic is untouched.
+            await col.set("free", {"v": 2})
+            stats = await client.get_stats(*node.db_address)
+            qs = stats["qos"]
+            assert qs["quota_refusals"] > 0
+            assert qs["tenants"]["acme"]["throttles"] > 0
+            assert qs["tenant_tokens"]["acme"]["qv"]["bytes"] < 0
+            assert shard.qos.tenant_throttles["acme"] > 0
+        finally:
+            t_client.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_tenant_ops_quota_paces_then_admits(tmp_dir):
+    """The ops bucket is a PACER: once drained, an op is refused at
+    the instant but a backoff retry succeeds as tokens refill — the
+    'retry after backoff' contract QuotaExceeded documents."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, tenant_ops_per_sec=50
+        )
+        t_client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0, tenant="pacer"
+        )
+        tcol = t_client.collection("qv")
+        try:
+            # Burst = 100 tokens; 120 ops must all eventually land
+            # (refused attempts retry after backoff into the refill).
+            for i in range(120):
+                await tcol.set(f"p{i}", {"v": i})
+            assert (await tcol.get("p119"))["v"] == 119
+            stats = await client.get_stats(*node.db_address)
+            assert stats["qos"]["tenants"]["pacer"]["ops"] >= 120
+        finally:
+            t_client.close()
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_quota_refusal_retryable_in_c_client_walk(tmp_dir):
+    """The compiled client treats QuotaExceeded like an Overloaded
+    shed: backoff + retry (not a terminal error), surfacing the kind
+    in last_error once its deadline budget is spent."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, tenant_bytes_per_sec=64
+        )
+        client.close()
+        ip, port = node.db_address
+
+        def native_part():
+            with native_client.NativeDbeelClient(ip, port) as nc:
+                assert nc.set_qos(tenant="cten")
+                nc.set_retry(op_deadline_ms=500)
+                nc.set("qv", "big", {"blob": "x" * 4096}, rf=1)
+                t0 = time.monotonic()
+                with pytest.raises(Exception) as ei:
+                    nc.set("qv", "next", {"v": 1}, rf=1)
+                elapsed = time.monotonic() - t0
+                assert "QuotaExceeded" in str(ei.value)
+                # The walk kept retrying with backoff until its
+                # budget ran out instead of failing terminally on
+                # the first refusal.
+                assert elapsed >= 0.15, elapsed
+
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, native_part
+            )
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Peer-frame dialects: old arity accepted everywhere
+# ----------------------------------------------------------------------
+
+
+def test_peer_frame_dialects_old_and_qos_accepted(tmp_dir):
+    """A replica accepts all four SET dialects — base, +deadline,
+    +trace, +qos — applies each write, and accounts the propagated
+    class; the SCAN peer frame accepts both the old (11) and new (12)
+    arities."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            enc = lambda v: msgpack.packb(v, use_bin_type=True)
+            future_ms = int(time.time() * 1000) + 60_000
+            ts = 1
+            frames = [
+                ["request", "set", "qv", enc("d0"), enc({"v": 0}), 1],
+                [
+                    "request", "set", "qv", enc("d1"), enc({"v": 1}),
+                    2, future_ms,
+                ],
+                [
+                    "request", "set", "qv", enc("d2"), enc({"v": 2}),
+                    3, future_ms, 0,
+                ],
+                [
+                    "request", "set", "qv", enc("d3"), enc({"v": 3}),
+                    4, future_ms, 0, QOS_BATCH,
+                ],
+                # qos dialect with placeholder deadline AND trace.
+                [
+                    "request", "set", "qv", enc("d4"), enc({"v": 4}),
+                    5, 0, 0, QOS_INTERACTIVE,
+                ],
+            ]
+            for f in frames:
+                resp = await shard.handle_shard_request(f)
+                assert resp == ["response", "set"], (f, resp)
+            for i in range(5):
+                got = await col.get(f"d{i}")
+                assert got["v"] == i
+            lanes = shard.qos.stats()["classes"]
+            assert lanes["batch"]["peer_ops"] >= 1
+            assert lanes["interactive"]["peer_ops"] >= 1
+            # Old-dialect frames default to the standard lane.
+            assert lanes["standard"]["peer_ops"] >= 3
+
+            # peer_qos_class parses exactly the _PEER_QOS_INDEX slot.
+            assert MyShard.peer_qos_class(frames[0]) == QOS_STANDARD
+            assert MyShard.peer_qos_class(frames[3]) == QOS_BATCH
+            assert (
+                MyShard.peer_qos_class(frames[4]) == QOS_INTERACTIVE
+            )
+
+            # SCAN: old arity (no qos element) and new arity both
+            # serve a page.
+            new_frame = ShardRequest.scan(
+                "qv", 0, 0, None, None, 100, 1 << 20, True, None,
+                QOS_BATCH,
+            )
+            assert len(new_frame) == MyShard._SCAN_PEER_ARITY
+            old_frame = new_frame[:-1]
+            for f in (old_frame, new_frame):
+                resp = await shard.handle_shard_request(list(f))
+                assert resp[0] == "response" and resp[1] == "scan"
+                assert len(resp[2]) >= 5  # the five d* entries
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Per-class AIMD windows
+# ----------------------------------------------------------------------
+
+
+def test_per_class_aimd_window_halves_and_recovers(tmp_dir):
+    """The batch lane's window halves (once per window of
+    completions) while the class reads soft overload and recovers
+    additively to its WEIGHTED ceiling once it clears; the
+    interactive lane (forced soft maps to OK for it) never shrinks."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, pipeline_window_max=8, overload_window_min=2
+        )
+        shard = node.shards[0]
+        qp = shard.qos
+        b_lane = qp.lanes[QOS_BATCH]
+        i_lane = qp.lanes[QOS_INTERACTIVE]
+        try:
+            # Weighted ceilings: interactive gets the full window,
+            # batch a quarter (weights 4:2:1).
+            assert i_lane.wmax == 8.0
+            assert qp.lanes[QOS_STANDARD].wmax == 4.0
+            assert b_lane.wmax == 2.0
+            shard.governor.force_level(LEVEL_SOFT)
+            assert (
+                shard.governor.class_level(QOS_INTERACTIVE)
+                == LEVEL_OK
+            )
+            for _ in range(50):
+                qp.begin(QOS_BATCH)
+                qp.end(QOS_BATCH)
+                qp.begin(QOS_INTERACTIVE)
+                qp.end(QOS_INTERACTIVE)
+            assert b_lane.window == 2.0  # at the floor (wmin)
+            assert i_lane.window == 8.0  # never shrank
+            shard.governor.force_level(None)
+            for _ in range(400):
+                qp.begin(QOS_BATCH)
+                qp.end(QOS_BATCH)
+                if b_lane.window == b_lane.wmax:
+                    break
+            assert b_lane.window == b_lane.wmax
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_soft_over_window_sheds_only_that_class(tmp_dir):
+    """Under a class's soft level, work beyond its lane window sheds
+    retryably (the weighted-share squeeze) while a class still under
+    its window admits."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, pipeline_window_max=8, overload_window_min=2
+        )
+        shard = node.shards[0]
+        qp = shard.qos
+        try:
+            shard.governor.force_level(LEVEL_SOFT)
+            # Saturate the batch lane's window (floor 2 after AIMD
+            # halvings; inflight >= window => shed).
+            qp.begin(QOS_BATCH)
+            qp.begin(QOS_BATCH)
+            assert qp.should_shed(QOS_BATCH)
+            # Interactive reads OK under forced soft: admits freely.
+            assert not qp.should_shed(QOS_INTERACTIVE)
+            err = qp.shed_error(QOS_BATCH)
+            assert isinstance(err, Overloaded)
+            assert qp.lanes[QOS_BATCH].shed == 1
+            qp.end(QOS_BATCH)
+            qp.end(QOS_BATCH)
+        finally:
+            shard.governor.force_level(None)
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# get_stats.qos through BOTH clients
+# ----------------------------------------------------------------------
+
+
+def test_qos_stats_block_both_clients(tmp_dir):
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        try:
+            await col.set("k", {"v": 1})
+            stats = await client.get_stats(*node.db_address)
+            qs = stats["qos"]
+            for cname in ("interactive", "standard", "batch"):
+                lane = qs["classes"][cname]
+                for key in (
+                    "admitted", "shed", "inflight", "window",
+                    "window_max", "peer_ops", "level",
+                ):
+                    assert key in lane, (cname, key)
+            assert "tenants" in qs and "quota_refusals" in qs
+            ip, port = node.db_address
+
+            def native_part():
+                with native_client.NativeDbeelClient(
+                    ip, port
+                ) as nc:
+                    nqs = nc.get_stats()["qos"]
+                    assert "classes" in nqs
+                    assert "standard" in nqs["classes"]
+
+            await asyncio.get_event_loop().run_in_executor(
+                None, native_part
+            )
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Satellite: memtable-near-full-at-rest scan pacing (BENCH r13)
+# ----------------------------------------------------------------------
+
+
+def test_resting_memtable_fill_paces_scans_instead_of_parking(
+    tmp_dir,
+):
+    """A RESTING shard whose memtable sits at ~88% fill (soft level
+    driven SOLELY by memtable fill — no queue/lag/debt pressure) must
+    pace scan chunks, not park each one the full 2 s (BENCH r13: the
+    old park made every chunk of an idle shard's scan wait 2 s)."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, memtable_capacity=64
+        )
+        shard = node.shards[0]
+        try:
+            # 56/64 = 0.875 fill: past the 0.85 soft bar, below any
+            # flush trigger; then the shard RESTS.
+            for i in range(56):
+                await col.set(f"m{i:03}", {"v": i})
+            await asyncio.sleep(0.3)  # drain; signals re-sample
+            gov = shard.governor
+            assert gov.class_level(QOS_BATCH) >= LEVEL_SOFT
+            assert gov.memtable_only_soft(QOS_BATCH), (
+                gov.level(),
+                gov.soft_reasons(QOS_BATCH),
+            )
+            t0 = time.monotonic()
+            got = [k async for k, _v in col.scan()]
+            wall = time.monotonic() - t0
+            assert len(got) == 56
+            # Paced (one 50 ms slice per chunk), never the 2 s park.
+            assert wall < 1.5, wall
+            assert shard.scan_plane.sheds == 0
+            assert shard.scan_plane.paced_s < 1.0
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+# ----------------------------------------------------------------------
+# Signal-driven class levels (unforced): batch trips first
+# ----------------------------------------------------------------------
+
+
+def test_signal_thresholds_scale_by_class(tmp_dir):
+    """With real signals (no force seam), the same backlog reads a
+    HIGHER level for batch than for interactive: here a memtable at
+    88% is soft for standard and batch but OK for interactive (its
+    0.85 * 1.5 bar is out of reach)."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, memtable_capacity=64
+        )
+        shard = node.shards[0]
+        try:
+            for i in range(56):
+                await col.set(f"s{i:03}", {"v": i})
+            await asyncio.sleep(0.3)
+            gov = shard.governor
+            gov.level()  # re-sample
+            assert gov.class_level(QOS_BATCH) >= LEVEL_SOFT
+            assert gov.class_level(QOS_STANDARD) >= LEVEL_SOFT
+            assert gov.class_level(QOS_INTERACTIVE) == LEVEL_OK
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
