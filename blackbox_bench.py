@@ -1165,6 +1165,190 @@ async def main_telemetry_overhead(args):
     client.close()
 
 
+def main_compaction(args):
+    """Single-pass compaction phase (ISSUE 15): same-session A/B of a
+    major compaction through the native merge —
+
+      posthoc      the pre-PR pipeline: merge writes the triplet with
+                   NO inline sidecar, then the whole freshly-written
+                   output is re-read and summed (checksums.
+                   compute_and_write), roughly doubling read
+                   amplification;
+      single_pass  the PR pipeline: per-page CRCs accumulated while
+                   the output is still in RAM, sidecar written
+                   inline, inputs loaded by the overlapped io_uring
+                   reader.
+
+    Storage-level by design (no server): major-compaction keys/s is a
+    background-pass number, and the host-weather rule makes only the
+    same-session pair meaningful.  Acceptance: single_pass keys/s
+    >= 1.2x posthoc, outputs byte-identical."""
+    import shutil
+    import tempfile
+
+    from dbeel_tpu.storage import checksums
+    from dbeel_tpu.storage.compaction import compaction_stats
+    from dbeel_tpu.storage.entry import file_name
+    from dbeel_tpu.storage.entry_writer import EntryWriter
+    from dbeel_tpu.storage.native import (
+        NativeMergeStrategy,
+        native_available,
+        read_overlap_stats,
+    )
+    from dbeel_tpu.storage.sstable import SSTable
+
+    if not native_available():
+        print("compaction phase SKIPPED: native library unavailable")
+        return
+
+    rng = random.Random(args.seed)
+    d = tempfile.mkdtemp(prefix="dbeel-compaction-bench-")
+    try:
+        ntab = args.compaction_tables
+        per = args.compaction_keys
+        print(
+            f"building {ntab} input tables x {per} keys "
+            f"(value {args.value_size}B) ..."
+        )
+        sources = []
+        for t in range(ntab):
+            idx = t * 2
+            w = EntryWriter(d, idx, None)
+            keys = sorted(
+                f"key-{rng.randrange(1 << 48):014d}-{t}".encode()
+                for _ in range(per)
+            )
+            for k in keys:
+                w.write(
+                    k,
+                    bytes(rng.getrandbits(8) for _ in range(8))
+                    * (args.value_size // 8 + 1),
+                    rng.randrange(1, 1 << 60),
+                )
+            w.close()
+            checksums.compute_and_write(
+                d,
+                idx,
+                os.path.join(d, file_name(idx, "data")),
+                os.path.join(d, file_name(idx, "index")),
+                os.path.join(d, file_name(idx, "bloom")),
+            )
+            sources.append(SSTable(d, idx, None))
+        total_keys = sum(s.entry_count for s in sources)
+        input_bytes = sum(
+            s.data_size + s.entry_count * 16 for s in sources
+        )
+        print(
+            f"inputs: {total_keys} keys, "
+            f"{input_bytes / 1e6:.1f} MB (data+index)"
+        )
+
+        def clean(out_index):
+            for ext in (
+                "compact_data",
+                "compact_index",
+                "compact_bloom",
+                "compact_sums",
+                "sums",
+            ):
+                p = os.path.join(d, file_name(out_index, ext))
+                if os.path.exists(p):
+                    os.unlink(p)
+
+        real_write = checksums.write
+
+        def run_once(out_index, single_pass):
+            clean(out_index)
+            s = NativeMergeStrategy()
+            t0 = time.perf_counter()
+            if single_pass:
+                s.merge(sources, d, out_index, None, True, 1)
+            else:
+                # Pre-PR semantics: serial input reads (overlap
+                # disabled), the merge writes NO inline sidecar
+                # (checksums.write patched out for the duration),
+                # then the post-hoc re-read sums the whole triplet.
+                checksums.write = lambda *a, **k: None
+                os.environ["DBEEL_NO_OVERLAP_READS"] = "1"
+                try:
+                    s.merge(sources, d, out_index, None, True, 1)
+                finally:
+                    checksums.write = real_write
+                    os.environ.pop("DBEEL_NO_OVERLAP_READS", None)
+                checksums.compute_and_write(
+                    d,
+                    out_index,
+                    os.path.join(
+                        d, file_name(out_index, "compact_data")
+                    ),
+                    os.path.join(
+                        d, file_name(out_index, "compact_index")
+                    ),
+                    os.path.join(
+                        d, file_name(out_index, "compact_bloom")
+                    ),
+                    "compact_sums",
+                )
+            return time.perf_counter() - t0
+
+        rounds = args.compaction_rounds
+        best = {}
+        for mode, single in (("posthoc", False), ("single_pass", True)):
+            times = [
+                run_once(9 if single else 7, single)
+                for _ in range(rounds)
+            ]
+            best[mode] = min(times)
+            print(
+                f"{mode:12s} best {best[mode]:.3f}s of "
+                f"{[f'{t:.3f}' for t in times]} "
+                f"({total_keys / best[mode]:,.0f} keys/s)"
+            )
+
+        # Output byte-identity across the two pipelines (the sidecar
+        # route must never change the triplet).
+        for ext in ("compact_data", "compact_index", "compact_bloom",
+                    "compact_sums"):
+            a = open(os.path.join(d, file_name(7, ext)), "rb").read()
+            b = open(os.path.join(d, file_name(9, ext)), "rb").read()
+            assert a == b, f"{ext} differs between pipelines"
+        gain = best["posthoc"] / best["single_pass"] - 1.0
+        uring, serial = read_overlap_stats()
+        print(
+            f"single-pass speedup: +{gain * 100:.1f}% keys/s "
+            f"(overlapped input passes: uring={uring} "
+            f"serial={serial})"
+        )
+        print(f"compaction stats: {compaction_stats.stats()}")
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(
+                    {
+                        "phase": "compaction",
+                        "tables": ntab,
+                        "keys": total_keys,
+                        "input_mb": round(input_bytes / 1e6, 1),
+                        "posthoc_s": round(best["posthoc"], 4),
+                        "single_pass_s": round(
+                            best["single_pass"], 4
+                        ),
+                        "keys_per_s_posthoc": round(
+                            total_keys / best["posthoc"]
+                        ),
+                        "keys_per_s_single_pass": round(
+                            total_keys / best["single_pass"]
+                        ),
+                        "gain_frac": round(gain, 4),
+                        "overlap_uring_passes": uring,
+                        "overlap_serial_passes": serial,
+                    },
+                    f,
+                    indent=2,
+                )
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
@@ -1275,6 +1459,34 @@ def main():
         "knee verdict as JSON (the BENCH_r14.json artifact)",
     )
     ap.add_argument(
+        "--compaction",
+        action="store_true",
+        help="single-pass compaction phase (ISSUE 15): same-session "
+        "A/B of a major native-merge compaction — pre-PR post-hoc "
+        "sidecar re-read vs inline single-pass sidecar + overlapped "
+        "io_uring input reads — reporting keys/s, the speedup, "
+        "output byte-identity, and get_stats.compaction counters.  "
+        "Storage-level; needs no server",
+    )
+    ap.add_argument(
+        "--compaction-tables",
+        type=int,
+        default=4,
+        help="input tables for the --compaction merge",
+    )
+    ap.add_argument(
+        "--compaction-keys",
+        type=int,
+        default=120000,
+        help="keys per input table for --compaction",
+    )
+    ap.add_argument(
+        "--compaction-rounds",
+        type=int,
+        default=3,
+        help="rounds per pipeline for --compaction (best-of)",
+    )
+    ap.add_argument(
         "--overload-knee-worker",
         action="store_true",
         help=argparse.SUPPRESS,  # internal: one generator subprocess
@@ -1294,7 +1506,9 @@ def main():
     args = ap.parse_args()
     if args.pipeline and args.batch:
         ap.error("--pipeline and --batch are separate phases")
-    if args.overload_knee_worker:
+    if args.compaction:
+        main_compaction(args)
+    elif args.overload_knee_worker:
         asyncio.run(main_knee_worker(args))
     elif args.telemetry_overhead:
         asyncio.run(main_telemetry_overhead(args))

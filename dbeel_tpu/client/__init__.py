@@ -847,11 +847,24 @@ class DbeelClient:
     # -- public API (lib.rs:482-619) -------------------------------------
 
     async def create_collection(
-        self, name: str, replication_factor: Optional[int] = None
+        self,
+        name: str,
+        replication_factor: Optional[int] = None,
+        ops_per_sec: Optional[int] = None,
+        bytes_per_sec: Optional[int] = None,
     ) -> "DbeelCollection":
+        """``ops_per_sec``/``bytes_per_sec`` carry per-collection
+        tenant-quota overrides on the DDL (ISSUE 15 satellite): they
+        beat the server's ``--tenant-*`` flag defaults for this
+        collection only (0 disables the limit), and round-trip
+        through collection metadata (restart- and gossip-safe)."""
         request = {"type": "create_collection", "name": name}
         if replication_factor is not None:
             request["replication_factor"] = replication_factor
+        if ops_per_sec is not None:
+            request["ops_per_sec"] = int(ops_per_sec)
+        if bytes_per_sec is not None:
+            request["bytes_per_sec"] = int(bytes_per_sec)
         host, port = self._seeds[0]
         await self._send_to(host, port, request)
         await self.sync_metadata()

@@ -164,7 +164,20 @@ class ShardRequest:
         return ["request", ShardRequest.GET_COLLECTIONS]
 
     @staticmethod
-    def create_collection(name: str, rf: int) -> list:
+    def create_collection(name: str, rf: int, quotas=None) -> list:
+        # Optional trailing element: per-collection tenant-quota
+        # overrides ({"ops_per_sec", "bytes_per_sec"}, ISSUE 15
+        # satellite).  Appended only when present, so quota-less DDL
+        # keeps the pre-ISSUE-15 arity byte-for-byte; old receivers
+        # index from the front and ignore the tail.
+        if quotas:
+            return [
+                "request",
+                ShardRequest.CREATE_COLLECTION,
+                name,
+                rf,
+                quotas,
+            ]
         return ["request", ShardRequest.CREATE_COLLECTION, name, rf]
 
     @staticmethod
@@ -447,11 +460,15 @@ class ShardResponse:
         ]
 
     @staticmethod
-    def get_collections(cols: List[Tuple[str, int]]) -> list:
+    def get_collections(cols) -> list:
+        # Entries are [name, rf] or [name, rf, quotas] — the optional
+        # third element carries per-collection quota overrides
+        # (ISSUE 15 satellite); old receivers index [0]/[1] and
+        # ignore the tail.
         return [
             "response",
             ShardResponse.GET_COLLECTIONS,
-            [[n, rf] for n, rf in cols],
+            [list(c) for c in cols],
         ]
 
     @staticmethod
@@ -585,8 +602,12 @@ class GossipEvent:
         return [GossipEvent.DEAD, node_name]
 
     @staticmethod
-    def create_collection(name: str, rf: int) -> list:
-        return [GossipEvent.CREATE_COLLECTION, name, rf]
+    def create_collection(name: str, rf: int, quotas=None) -> list:
+        # Same optional quota tail as the peer-request dialect.
+        event = [GossipEvent.CREATE_COLLECTION, name, rf]
+        if quotas:
+            event.append(quotas)
+        return event
 
     @staticmethod
     def drop_collection(name: str) -> list:

@@ -643,12 +643,17 @@ class RemoteShardConnection:
         )
         return [NodeMetadata.from_wire(n) for n in nodes]
 
-    async def get_collections(self) -> List[Tuple[str, int]]:
+    async def get_collections(self):
         cols = response_to_result(
             await self.send_request(ShardRequest.get_collections()),
             ShardResponse.GET_COLLECTIONS,
         )
-        return [(c[0], c[1]) for c in cols]
+        # Third element (when the peer sends one): per-collection
+        # quota overrides — propagated so a discovering node adopts
+        # the same admission config (old peers simply lack it).
+        return [
+            (c[0], c[1], c[2] if len(c) > 2 else None) for c in cols
+        ]
 
     async def open_stream(self) -> "RemoteShardStream":
         """Persistent multi-message connection (migration uses one

@@ -47,6 +47,7 @@ import logging
 import os
 import queue
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
@@ -623,9 +624,18 @@ def _pipeline_merge_impl(
 
     data_path = f"{dir_path}/{file_name(output_index, COMPACT_DATA_FILE_EXT)}"
     index_path = f"{dir_path}/{file_name(output_index, COMPACT_INDEX_FILE_EXT)}"
-    handle = lib.dbeel_writer_open(
-        data_path.encode(), index_path.encode()
-    )
+    # Single-pass sidecar (ISSUE 15): arm the gather writer's inline
+    # page-CRC accumulators so the .sums sidecar is written from the
+    # bytes AS they streamed through — no post-hoc triplet re-read.
+    writer_crcs = hasattr(lib, "dbeel_writer_open2")
+    if writer_crcs:
+        handle = lib.dbeel_writer_open2(
+            data_path.encode(), index_path.encode(), 1
+        )
+    else:
+        handle = lib.dbeel_writer_open(
+            data_path.encode(), index_path.encode()
+        )
     if not handle:
         return None
 
@@ -1087,18 +1097,52 @@ def _pipeline_merge_impl(
     # accounting, so nothing here depends on close completing.
     _ev("writer close (async)")
     data_size = ctypes.c_uint64(0)
-    close_ret = {"entries": -1}
+    close_ret = {"entries": -1, "crcs": None}
+    # CRC handoff caps: the merged output can never exceed the sum of
+    # its inputs (dedup/tombstone-drop only shrink it).
+    _dcap = int(sum(r.size for r in runs)) // 4096 + 2
+    _icap = int(run_base[-1]) * 16 // 4096 + 2
 
     def _close():
-        close_ret["entries"] = lib.dbeel_writer_close(
-            handle, ctypes.byref(data_size)
-        )
+        if writer_crcs:
+            dcrc = (ctypes.c_uint32 * _dcap)()
+            icrc = (ctypes.c_uint32 * _icap)()
+            nd = ctypes.c_uint64(0)
+            ni = ctypes.c_uint64(0)
+            rc = lib.dbeel_writer_close2(
+                handle,
+                ctypes.byref(data_size),
+                dcrc,
+                _dcap,
+                icrc,
+                _icap,
+                ctypes.byref(nd),
+                ctypes.byref(ni),
+            )
+            if rc == -2:
+                # Triplet closed fine; only the CRC handoff was
+                # refused — the LSM's counted post-hoc sidecar
+                # covers it.  Entries are known from the writer's
+                # own accounting.
+                close_ret["entries"] = writer_state["wrote"]
+            else:
+                close_ret["entries"] = rc
+                if rc >= 0:
+                    close_ret["crcs"] = (
+                        list(dcrc[: nd.value]),
+                        list(icrc[: ni.value]),
+                    )
+        else:
+            close_ret["entries"] = lib.dbeel_writer_close(
+                handle, ctypes.byref(data_size)
+            )
 
     t_close = threading.Thread(target=_close, daemon=True)
     t_close.start()
 
     entries = writer_state["wrote"]
     wrote_bloom = False
+    bloom_blob = None
     from ..storage.compaction import COMPACT_BLOOM_FILE_EXT
 
     bloom_path = (
@@ -1144,7 +1188,7 @@ def _pipeline_merge_impl(
                     ctypes.c_uint32(_SEED1),
                     ctypes.c_uint32(_SEED2),
                 )
-            _write_bloom(dir_path, output_index, bloom)
+            bloom_blob = _write_bloom(dir_path, output_index, bloom)
             wrote_bloom = True
     except BaseException:
         # The merge's contract is the whole triplet: a failed bloom
@@ -1169,5 +1213,23 @@ def _pipeline_merge_impl(
         raise _PipelineError("native writer close failed")
     assert close_ret["entries"] == entries
     assert int(data_size.value) == writer_state["bytes"]
+
+    if close_ret["crcs"] is not None:
+        # Single-pass sidecar: the per-page CRCs streamed out of the
+        # gather writer; the bloom blob is still in RAM.  Written
+        # under the same journaled rename as the triplet.
+        from ..storage import checksums
+
+        dcrcs, icrcs = close_ret["crcs"]
+        checksums.write_crcs(
+            dir_path,
+            output_index,
+            dcrcs,
+            icrcs,
+            int(data_size.value),
+            zlib.crc32(bloom_blob) if bloom_blob is not None else 0,
+            bloom_blob is not None,
+            ext=checksums.COMPACT_SUMS_FILE_EXT,
+        )
 
     return MergeResult(int(entries), int(data_size.value), wrote_bloom)

@@ -8,6 +8,15 @@ within a small window (or up to ``max_batch``) are padded to a common
 call (ops/bitonic.py: merge_runs_prefix_batch_kernel).  Each shard gets
 back its own permutation.
 
+The packing itself lives in ``pack_jobs`` — the vmap-ready launch shape
+ARCHITECTURE.md describes, computed independently of the device so the
+CPU path executes the SAME batched shape today (dryrun-parity tested
+against the ops/device_compaction.py twins) and a future device wake
+changes only where the kernel runs.  The first successful batched
+launch on a real accelerator persists its working config to
+``DEVICE_LAST_GOOD.json`` (the device-capture discipline: wakes are
+rare, every one must leave an artifact).
+
 One coalescer is shared per process (all shards of a node run on one
 loop), matching the reference's one-TPU-per-host deployment picture.
 """
@@ -26,6 +35,63 @@ from ..storage import columnar
 log = logging.getLogger(__name__)
 
 
+class PackedBatch:
+    """One vmap-ready coalesced launch: every job's staged prefixes
+    padded to the common (jobs, K, P) stack the batch kernel compiles
+    for.  ``pad_frac`` measures the padding waste — the operator's
+    answer to "is the window coalescing similar-shaped jobs"."""
+
+    __slots__ = (
+        "k", "p", "out_rows", "prefixes", "counts", "bases",
+        "real_rows", "pad_frac",
+    )
+
+    def __init__(self, k, p, out_rows, prefixes, counts, bases,
+                 real_rows, pad_frac) -> None:
+        self.k = k
+        self.p = p
+        self.out_rows = out_rows
+        self.prefixes = prefixes
+        self.counts = counts
+        self.bases = bases
+        self.real_rows = real_rows
+        self.pad_frac = pad_frac
+
+
+def pack_jobs(jobs: List[Tuple]) -> PackedBatch:
+    """Pack per-shard compaction jobs into ONE vmap-batched launch
+    shape: K = max run count (next pow2), P = max run length (next
+    pow2), every job's 8-byte key prefixes staged into a common
+    (jobs, K, P) stack.  Pure host-side packing — the caller decides
+    whether the batched kernel runs on the device or the CPU twin."""
+    k = max(bitonic._pow2(max(1, len(rc))) for _, rc, *_ in jobs)
+    p = max(
+        bitonic._pow2(max(8, max(rc) if rc else 8))
+        for _, rc, *_ in jobs
+    )
+    out_rows = 0
+    staged = []
+    real_rows = 0
+    for cols, rc, *_ in jobs:
+        prefixes, counts, bases, rows = bitonic.stage_prefixes(
+            cols, rc, k=k, p=p
+        )
+        staged.append((prefixes, counts, bases))
+        out_rows = max(out_rows, rows)
+        # Actual staged rows, NOT stage_prefixes' 64Ki-bucketed
+        # out_rows — pad_frac must measure real padding waste.
+        real_rows += int(sum(rc))
+    batch_prefixes = np.stack([s[0] for s in staged])
+    batch_counts = np.stack([s[1] for s in staged])
+    bases = [s[2] for s in staged]
+    padded = len(jobs) * k * p
+    pad_frac = round(1.0 - real_rows / padded, 4) if padded else 0.0
+    return PackedBatch(
+        int(k), int(p), int(out_rows), batch_prefixes, batch_counts,
+        bases, real_rows, pad_frac,
+    )
+
+
 class CompactionCoalescer:
     def __init__(
         self, window_s: float = 0.01, max_batch: int = 16
@@ -36,6 +102,13 @@ class CompactionCoalescer:
         self._flush_task: Optional[asyncio.Task] = None
         self.launches = 0  # batched kernel launches (observability)
         self.jobs_coalesced = 0
+        # Last launch's vmap shape + padding waste (observability:
+        # whether the window actually coalesces, and how much of the
+        # compiled (jobs, K, P) stack was real data).
+        self.last_batch_jobs = 0
+        self.last_batch_k = 0
+        self.last_batch_p = 0
+        self.last_pad_frac = 0.0
 
     async def submit(
         self, cols: columnar.MergeColumns, run_counts: List[int]
@@ -75,29 +148,12 @@ class CompactionCoalescer:
         if not jobs:
             return
         try:
-            # Common batch shape.
-            k = max(
-                bitonic._pow2(max(1, len(rc))) for _, rc, _ in jobs
-            )
-            p = max(
-                bitonic._pow2(max(8, max(rc) if rc else 8))
-                for _, rc, _ in jobs
-            )
-            out_rows = 0
-            staged = []
-            for cols, rc, _ in jobs:
-                prefixes, counts, bases, rows = bitonic.stage_prefixes(
-                    cols, rc, k=k, p=p
-                )
-                staged.append((prefixes, counts, bases))
-                out_rows = max(out_rows, rows)
-            batch_prefixes = np.stack([s[0] for s in staged])
-            batch_counts = np.stack([s[1] for s in staged])
+            batch = pack_jobs(jobs)
 
             def run() -> np.ndarray:
                 return np.asarray(
                     bitonic.merge_runs_prefix_batch_kernel(
-                        batch_prefixes, batch_counts, out_rows
+                        batch.prefixes, batch.counts, batch.out_rows
                     )
                 )
 
@@ -106,15 +162,20 @@ class CompactionCoalescer:
             )
             self.launches += 1
             self.jobs_coalesced += len(jobs)
+            self.last_batch_jobs = len(jobs)
+            self.last_batch_k = batch.k
+            self.last_batch_p = batch.p
+            self.last_pad_frac = batch.pad_frac
+            _persist_wake(len(jobs), batch.k, batch.p)
 
-            shift = np.uint32(p.bit_length() - 1)
-            mask = np.uint32(p - 1)
+            shift = np.uint32(batch.p.bit_length() - 1)
+            mask = np.uint32(batch.p - 1)
             for j, (cols, _rc, fut) in enumerate(jobs):
                 n = len(cols)
                 row = packed[j, :n]
                 run_ids = (row >> shift).astype(np.int64)
                 pos = (row & mask).astype(np.int64)
-                perm = staged[j][2][run_ids] + pos
+                perm = batch.bases[j][run_ids] + pos
                 if not fut.done():
                     fut.set_result(perm)
         except Exception as e:
@@ -125,6 +186,66 @@ class CompactionCoalescer:
 
 
 _default: Optional[CompactionCoalescer] = None
+_wake_persisted = False
+
+
+def _persist_wake(jobs: int, k: int, p: int) -> None:
+    """First successful batched launch of the process on a REAL
+    accelerator: persist the working coalescer config under
+    DEVICE_LAST_GOOD.json (same artifact every other device plane
+    feeds), so the next tunnel-down round can cite a known-good
+    vmap-batch shape instead of guessing.  CPU-twin launches (today's
+    normal mode) skip silently — the artifact records device wakes
+    only."""
+    global _wake_persisted
+    if _wake_persisted:
+        return
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        return
+    if platform == "cpu":
+        return
+    _wake_persisted = True
+    try:
+        import fcntl
+        import json
+        import os
+        import time
+
+        from ..ops.query_kernels import _last_good_path
+
+        path = _last_good_path()
+        with open(path + ".lock", "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    data = {}
+            except Exception:
+                data = {}
+            data["coalesced_compaction"] = {
+                "timestamp_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                ),
+                "platform": platform,
+                "batch_jobs": int(jobs),
+                "k": int(k),
+                "p": int(p),
+                "jax_platforms_env": os.environ.get(
+                    "JAX_PLATFORMS", ""
+                ),
+                "kernel": "merge_runs_prefix_batch_kernel/vmap",
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+    except Exception as e:  # best-effort artifact, never a failure
+        log.warning("DEVICE_LAST_GOOD persist failed: %s", e)
 
 
 def default_coalescer() -> CompactionCoalescer:
@@ -142,6 +263,10 @@ def stats() -> "dict | None":
     return {
         "launches": _default.launches,
         "jobs_coalesced": _default.jobs_coalesced,
+        "last_batch_jobs": _default.last_batch_jobs,
+        "last_batch_k": _default.last_batch_k,
+        "last_batch_p": _default.last_batch_p,
+        "last_pad_frac": _default.last_pad_frac,
     }
 
 

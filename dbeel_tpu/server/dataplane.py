@@ -457,6 +457,23 @@ class DataPlane:
         self._lib.dbeel_dp_sheds_by_class(self._handle, buf)
         return [int(buf[i]) for i in range(3)]
 
+    def admits_by_class(self):
+        """Native lane accounting (ISSUE 15 satellite): per-class
+        counters of frames SERVED by the C planes —
+        ``(client_plane[3], peer_plane[3])`` — or None when the .so
+        predates the ABI.  Before this, ``get_stats.qos`` lane
+        counters (admitted/peer_ops) covered interpreted frames only,
+        so the native fast path was invisible to per-class
+        accounting."""
+        if not hasattr(self._lib, "dbeel_dp_admits_by_class"):
+            return None
+        buf = (ctypes.c_uint64 * 6)()
+        self._lib.dbeel_dp_admits_by_class(self._handle, buf)
+        return (
+            [int(buf[i]) for i in range(3)],
+            [int(buf[3 + i]) for i in range(3)],
+        )
+
     def set_overload_responses(
         self, shed_resp: bytes, deadline_resp: bytes
     ) -> None:

@@ -267,27 +267,39 @@ async def handle_request(
         rf = request.get("replication_factor")
         if not isinstance(rf, int):
             rf = my_shard.config.default_replication_factor
+        # DDL-carried tenant-quota overrides (ISSUE 15 satellite):
+        # per-collection ops/bytes rates that beat the --tenant-*
+        # flag defaults, round-tripped through collection metadata.
+        quotas = None
+        if isinstance(request.get("ops_per_sec"), int) or isinstance(
+            request.get("bytes_per_sec"), int
+        ):
+            quotas = {}
+            if isinstance(request.get("ops_per_sec"), int):
+                quotas["ops_per_sec"] = request["ops_per_sec"]
+            if isinstance(request.get("bytes_per_sec"), int):
+                quotas["bytes_per_sec"] = request["bytes_per_sec"]
         from ..errors import CollectionAlreadyExists
 
         if name in my_shard.collections:
             raise CollectionAlreadyExists(name)
-        await my_shard.create_collection(name, rf)
+        await my_shard.create_collection(name, rf, quotas)
         await my_shard.send_request_to_local_shards(
-            ShardRequest.create_collection(name, rf),
+            ShardRequest.create_collection(name, rf, quotas),
             ShardResponse.CREATE_COLLECTION,
         )
         await my_shard.gossip(
-            msgs.GossipEvent.create_collection(name, rf)
+            msgs.GossipEvent.create_collection(name, rf, quotas)
         )
         return None
 
     if rtype == "get_collection":
         name = _extract(request, "name")
         col = my_shard.get_collection(name)
-        return msgpack.packb(
-            {"replication_factor": col.replication_factor},
-            use_bin_type=True,
-        )
+        body = {"replication_factor": col.replication_factor}
+        if col.quotas:
+            body["quotas"] = col.quotas
+        return msgpack.packb(body, use_bin_type=True)
 
     if rtype == "drop_collection":
         name = _extract(request, "name")

@@ -283,7 +283,34 @@ class QosPlane:
             b = self._buckets[key] = TokenBucket(rate)
         else:
             self._buckets.move_to_end(key)
+            if b.rate != float(rate):
+                # A per-collection override landed (or changed) after
+                # this bucket was minted: adopt the new rate in place,
+                # keeping the accumulated balance/debt.
+                b.rate = float(rate)
+                b.burst = max(1.0, b.rate * BUCKET_BURST_S)
         return b
+
+    def quota_rates(self, collection) -> "Tuple[int, int]":
+        """Effective (ops_per_sec, bytes_per_sec) for one collection:
+        DDL-carried per-collection overrides (``create_collection``'s
+        ``quotas`` metadata, ISSUE 15 satellite) beat the
+        ``--tenant-*`` flag defaults; 0 disables a limit either way."""
+        cfg = self.config
+        ops, byts = cfg.tenant_ops_per_sec, cfg.tenant_bytes_per_sec
+        cols = getattr(self.shard, "collections", None)
+        col = (
+            cols.get(collection)
+            if cols is not None and isinstance(collection, str)
+            else None
+        )
+        q = getattr(col, "quotas", None) if col is not None else None
+        if q:
+            if q.get("ops_per_sec") is not None:
+                ops = int(q["ops_per_sec"])
+            if q.get("bytes_per_sec") is not None:
+                byts = int(q["bytes_per_sec"])
+        return ops, byts
 
     def charge_ops(
         self, tenant: Optional[str], collection, n: int = 1
@@ -294,19 +321,17 @@ class QosPlane:
         refill covers it)."""
         if tenant is None:
             return
-        cfg = self.config
         col = collection if isinstance(collection, str) else ""
+        ops_rate, bytes_rate = self.quota_rates(col)
         # Byte-debt check FIRST: it charges nothing, so an op refused
         # for byte debt must not burn ops tokens (a tenant retrying
         # through a byte overdraft would otherwise drain its ops
         # bucket on refusals and stay throttled past the byte quota).
-        bytes_rate = cfg.tenant_bytes_per_sec
         if bytes_rate > 0:
             b = self._bucket(tenant, col, "bytes", bytes_rate)
             b._refill(None)
             if b.tokens <= 0.0:
                 self._refuse(tenant, "bytes")
-        ops_rate = cfg.tenant_ops_per_sec
         if ops_rate > 0:
             if not self._bucket(tenant, col, "ops", ops_rate).take(n):
                 self._refuse(tenant, "ops")
@@ -319,10 +344,10 @@ class QosPlane:
         after encode/serve).  Never raises — the NEXT op pays."""
         if tenant is None or nbytes <= 0:
             return
-        rate = self.config.tenant_bytes_per_sec
+        col = collection if isinstance(collection, str) else ""
+        rate = self.quota_rates(col)[1]
         if rate <= 0:
             return
-        col = collection if isinstance(collection, str) else ""
         self._bucket(tenant, col, "bytes", rate).debit(nbytes)
 
     def _bump(self, d: Dict[str, int], tenant: str, n: int) -> None:
@@ -378,6 +403,18 @@ class QosPlane:
         if native_sheds is not None:
             for i, lane in enumerate(self.lanes):
                 classes[lane.name]["native_sheds"] = native_sheds[i]
+        # Native lane accounting (ISSUE 15 satellite): frames the C
+        # planes served per class.  ``peer_ops`` counts interpreted
+        # replica frames; ``peer_ops_native`` adds the C-served share
+        # so replica-plane class accounting covers BOTH paths.
+        native_admits = (
+            dp.admits_by_class() if dp is not None else None
+        )
+        if native_admits is not None:
+            client_adm, peer_adm = native_admits
+            for i, lane in enumerate(self.lanes):
+                classes[lane.name]["native_admits"] = client_adm[i]
+                classes[lane.name]["peer_ops_native"] = peer_adm[i]
         tenants = {}
         for t in self.tenant_ops:
             tenants[t] = {

@@ -73,6 +73,16 @@ def _coalescer_stats():
     return mod.stats() if mod is not None else None
 
 
+def _compaction_stats_block():
+    """Single-pass compaction counters (ISSUE 15) for get_stats —
+    storage/compaction.py is always imported by the time a shard
+    serves (the tree construction pulls it), so this is a straight
+    read of the process-wide accounting object."""
+    from ..storage.compaction import compaction_stats
+
+    return compaction_stats.stats()
+
+
 def is_between(item: int, start: int, end: int) -> bool:
     """Half-open wrap-around ring range [start, end)
     (shards.rs:103-109)."""
@@ -106,6 +116,27 @@ class Shard:
 class Collection:
     tree: LSMTree
     replication_factor: int
+    # Per-collection tenant-quota overrides (ISSUE 15 satellite):
+    # DDL-carried {"ops_per_sec": int, "bytes_per_sec": int} rates
+    # that beat the --tenant-* flag defaults for THIS collection
+    # (None / missing key = use the flag default; 0 disables).
+    # Round-tripped through the collection metadata file.
+    quotas: "Optional[dict]" = None
+
+
+def _sanitize_quotas(quotas) -> "Optional[dict]":
+    """Normalize a DDL-carried quota override map: only the two known
+    rate keys survive, as non-negative ints.  Anything malformed (the
+    map crosses the wire from clients and gossip) degrades to None —
+    the flag defaults — rather than poisoning admission."""
+    if not isinstance(quotas, dict):
+        return None
+    out = {}
+    for k in ("ops_per_sec", "bytes_per_sec"):
+        v = quotas.get(k)
+        if isinstance(v, int) and not isinstance(v, bool) and v >= 0:
+            out[k] = v
+    return out or None
 
 
 class MigrationAction:
@@ -902,6 +933,13 @@ class MyShard:
             # throttle counters — reachable through BOTH clients like
             # every other block.
             "qos": self.qos.stats(),
+            # Single-pass compaction plane (ISSUE 15): bytes
+            # read/written per background pass, inline vs post-hoc
+            # sidecar counts, and the read-amplification ratio the
+            # tentpole claims (~1.0 single-pass, ~2.0 when outputs
+            # are re-read for their sidecar).  Process-wide, like the
+            # device-coalescer counters.
+            "compaction": _compaction_stats_block(),
             "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
@@ -1060,10 +1098,14 @@ class MyShard:
         }
 
     async def create_collection(
-        self, name: str, replication_factor: int
+        self,
+        name: str,
+        replication_factor: int,
+        quotas: "Optional[dict]" = None,
     ) -> None:
         if name in self.collections:
             raise CollectionAlreadyExists(name)
+        quotas = _sanitize_quotas(quotas)
         # Audited sync I/O: DDL is rare (operator-rate, gossiped once)
         # and the metadata file is tens of bytes — an executor hop
         # would cost more than the write.  The fsync CAN stall the
@@ -1072,16 +1114,19 @@ class MyShard:
         tree = self._create_lsm_tree(name)
         path = self._collection_metadata_path(name)
         if not os.path.exists(path):
+            meta = {"replication_factor": replication_factor}
+            if quotas:
+                # Per-collection quota overrides ride the same
+                # metadata file, so a restart rediscovers them.
+                meta["quotas"] = quotas
             # lint: allow(async-blocking)
             with open(path, "wb") as f:
-                f.write(
-                    msgpack.packb(
-                        {"replication_factor": replication_factor}
-                    )
-                )
+                f.write(msgpack.packb(meta))
                 f.flush()
                 os.fsync(f.fileno())  # lint: allow(async-blocking)
-        self.collections[name] = Collection(tree, replication_factor)
+        self.collections[name] = Collection(
+            tree, replication_factor, quotas
+        )
         if self.dataplane is not None:
             # RF=1: full client-plane fast path.  RF>1: replica plane
             # + coordinator assist; the client plane punts so Python
@@ -1110,9 +1155,10 @@ class MyShard:
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_DROPPED)
 
-    def get_collections_from_disk(self) -> List[Tuple[str, int]]:
+    def get_collections_from_disk(self) -> List[Tuple[str, int, Optional[dict]]]:
         """Disk discovery by '<name>-<id>' directory scan
-        (shards.rs:265-311)."""
+        (shards.rs:265-311); the third element is the DDL-carried
+        per-collection quota override map (or None)."""
         if not os.path.isdir(self.config.dir):
             return []
         pattern = re.compile(rf"^(.*?)\-{self.id}$")
@@ -1128,7 +1174,13 @@ class MyShard:
             try:
                 with open(meta_path, "rb") as f:
                     meta = msgpack.unpackb(f.read(), raw=False)
-                out.append((name, meta["replication_factor"]))
+                out.append(
+                    (
+                        name,
+                        meta["replication_factor"],
+                        meta.get("quotas"),
+                    )
+                )
             except FileNotFoundError:
                 log.error(
                     "collection %r has no metadata file on disk", name
@@ -1885,12 +1937,22 @@ class MyShard:
         if kind == ShardRequest.GET_COLLECTIONS:
             return ShardResponse.get_collections(
                 [
-                    (n, c.replication_factor)
+                    (
+                        (n, c.replication_factor, c.quotas)
+                        if c.quotas
+                        else (n, c.replication_factor)
+                    )
                     for n, c in self.collections.items()
                 ]
             )
         if kind == ShardRequest.CREATE_COLLECTION:
-            await self.create_collection(request[2], request[3])
+            # Optional 5th element: per-collection quota overrides
+            # (old-arity frames from pre-ISSUE-15 peers are accepted).
+            await self.create_collection(
+                request[2],
+                request[3],
+                request[4] if len(request) > 4 else None,
+            )
             return ShardResponse.empty(ShardResponse.CREATE_COLLECTION)
         if kind == ShardRequest.DROP_COLLECTION:
             await self.drop_collection(request[2])
@@ -2518,7 +2580,11 @@ class MyShard:
                 await self.handle_dead_node(node_name)
         elif kind == GossipEvent.CREATE_COLLECTION:
             try:
-                await self.create_collection(event[1], event[2])
+                await self.create_collection(
+                    event[1],
+                    event[2],
+                    event[3] if len(event) > 3 else None,
+                )
             except CollectionAlreadyExists:
                 pass
         elif kind == GossipEvent.DROP_COLLECTION:

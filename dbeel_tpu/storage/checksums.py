@@ -59,6 +59,7 @@ __all__ = [
     "verification_enabled",
     "load",
     "write",
+    "write_crcs",
     "compute_and_write",
     "sums_path",
 ]
@@ -246,6 +247,30 @@ def write(
         index_crcs,
         zlib.crc32(bloom_bytes) if bloom_bytes is not None else 0,
         bloom_bytes is not None,
+    )
+    path = sums_path(dir_path, index, ext)
+    with open(path, "wb") as f:
+        f.write(sums.serialize())
+
+
+def write_crcs(
+    dir_path: str,
+    index: int,
+    data_crcs: Sequence[int],
+    index_crcs: Sequence[int],
+    data_size: int,
+    bloom_crc: int = 0,
+    has_bloom: bool = False,
+    ext: str = SUMS_FILE_EXT,
+) -> None:
+    """Write a sums sidecar from PRE-COMPUTED page CRCs — the
+    single-pass compaction/flush path (ISSUE 15): the native writers
+    accumulate the per-page CRCs (and the bloom whole-file CRC) as
+    they emit bytes, so the sidecar costs zero re-reads.  Byte-
+    identical to ``write()`` given the same inputs (one serializer,
+    golden-tested against ``compute_and_write``)."""
+    sums = TableSums(
+        data_size, data_crcs, index_crcs, bloom_crc, has_bloom
     )
     path = sums_path(dir_path, index, ext)
     with open(path, "wb") as f:

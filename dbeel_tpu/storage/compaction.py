@@ -51,6 +51,95 @@ class MergeResult:
     wrote_bloom: bool
 
 
+class CompactionStats:
+    """Process-wide single-pass compaction/flush accounting
+    (ISSUE 15): bytes read and written per background pass, and
+    whether each output's ``.sums`` sidecar was emitted INLINE
+    (single-pass, CRCs accumulated as bytes were written) or rebuilt
+    POST-HOC (the legacy full-triplet re-read, which roughly doubled
+    compaction read amplification).  ``read_amplification`` is the
+    measurable claim: bytes_read / merge input bytes — ~1.0 when every
+    pass is single-pass, ~2.0 when every output is re-read for its
+    sidecar.  One instance per process (merges from all shards of a
+    node fold in), mirrored into ``get_stats.compaction``."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self.merge_passes = 0
+        self.flush_passes = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.merge_input_bytes = 0
+        self.sidecar_inline = 0
+        self.sidecar_posthoc = 0
+        self.posthoc_bytes_reread = 0
+
+    def note_merge(
+        self, input_bytes: int, bytes_written: int
+    ) -> None:
+        """One completed merge pass: inputs are read exactly once by
+        every strategy (the single-pass contract), outputs written
+        once."""
+        with self._lock:
+            self.merge_passes += 1
+            self.merge_input_bytes += int(input_bytes)
+            self.bytes_read += int(input_bytes)
+            self.bytes_written += int(bytes_written)
+
+    def note_flush(self, bytes_written: int) -> None:
+        with self._lock:
+            self.flush_passes += 1
+            self.bytes_written += int(bytes_written)
+
+    def note_sidecar(
+        self, inline: bool, reread_bytes: int = 0
+    ) -> None:
+        """One sidecar emitted: inline (no extra IO) or post-hoc
+        (the whole freshly-written triplet re-read and summed —
+        ``reread_bytes`` joins the read-amplification numerator)."""
+        with self._lock:
+            if inline:
+                self.sidecar_inline += 1
+            else:
+                self.sidecar_posthoc += 1
+                self.posthoc_bytes_reread += int(reread_bytes)
+                self.bytes_read += int(reread_bytes)
+
+    def stats(self) -> dict:
+        from . import native as native_mod
+
+        with self._lock:
+            amp = (
+                round(
+                    self.bytes_read / self.merge_input_bytes, 3
+                )
+                if self.merge_input_bytes > 0
+                else None
+            )
+            block = {
+                "merge_passes": self.merge_passes,
+                "flush_passes": self.flush_passes,
+                "bytes_read": self.bytes_read,
+                "bytes_written": self.bytes_written,
+                "merge_input_bytes": self.merge_input_bytes,
+                "sidecar_inline": self.sidecar_inline,
+                "sidecar_posthoc": self.sidecar_posthoc,
+                "posthoc_bytes_reread": self.posthoc_bytes_reread,
+                "read_amplification": amp,
+            }
+        overlap = native_mod.read_overlap_stats()
+        block["overlapped_read_passes"] = overlap[0]
+        block["serial_read_passes"] = overlap[1]
+        return block
+
+
+# One per process — every shard's trees fold into it, like the
+# device-coalescer counters.
+compaction_stats = CompactionStats()
+
+
 class CompactionStrategy(ABC):
     name = "abstract"
 

@@ -1028,31 +1028,74 @@ class LSMTree:
                 if getattr(flushing, "has_native_flush", False):
 
                     def _native_flush():
-                        flushing.flush_to_sstable(
-                            self.dir_path,
-                            flush_index,
-                            self.bloom_min_size,
+                        from .compaction import compaction_stats
+
+                        # Single-pass flush (ISSUE 15): the C writer
+                        # page-CRCs every byte AS it emits it and the
+                        # .sums sidecar is written from those inline
+                        # CRCs — no re-read of the fresh triplet.
+                        _n, inline = (
+                            flushing.flush_to_sstable_with_sums(
+                                self.dir_path,
+                                flush_index,
+                                self.bloom_min_size,
+                            )
                         )
-                        # The C writer doesn't know the checksum
-                        # sidecar: sum the triplet it just wrote
-                        # (OS-cache-hot) in the same executor job so
-                        # the table opens verified.
-                        checksums.compute_and_write(
-                            self.dir_path,
-                            flush_index,
-                            os.path.join(
+                        written = 0
+                        for ext in (
+                            DATA_FILE_EXT,
+                            INDEX_FILE_EXT,
+                            BLOOM_FILE_EXT,
+                            SUMS_FILE_EXT,
+                        ):
+                            try:
+                                written += os.path.getsize(
+                                    os.path.join(
+                                        self.dir_path,
+                                        file_name(flush_index, ext),
+                                    )
+                                )
+                            except OSError:
+                                pass
+                        if not inline:
+                            # Stale .so without the single-pass ABI:
+                            # post-hoc sidecar (counted — the re-read
+                            # shows up in read amplification).
+                            data_p = os.path.join(
                                 self.dir_path,
                                 file_name(flush_index, DATA_FILE_EXT),
-                            ),
-                            os.path.join(
+                            )
+                            index_p = os.path.join(
                                 self.dir_path,
-                                file_name(flush_index, INDEX_FILE_EXT),
-                            ),
-                            os.path.join(
+                                file_name(
+                                    flush_index, INDEX_FILE_EXT
+                                ),
+                            )
+                            bloom_p = os.path.join(
                                 self.dir_path,
-                                file_name(flush_index, BLOOM_FILE_EXT),
-                            ),
-                        )
+                                file_name(
+                                    flush_index, BLOOM_FILE_EXT
+                                ),
+                            )
+                            checksums.compute_and_write(
+                                self.dir_path,
+                                flush_index,
+                                data_p,
+                                index_p,
+                                bloom_p,
+                            )
+                            reread = 0
+                            for p in (data_p, index_p, bloom_p):
+                                try:
+                                    reread += os.path.getsize(p)
+                                except OSError:
+                                    pass
+                            compaction_stats.note_sidecar(
+                                False, reread
+                            )
+                        else:
+                            compaction_stats.note_sidecar(True)
+                        compaction_stats.note_flush(written)
 
                     await asyncio.get_event_loop().run_in_executor(
                         None, _native_flush
@@ -1136,6 +1179,14 @@ class LSMTree:
             written,
             bloom_bytes,
             ext=SUMS_FILE_EXT,
+        )
+        from .compaction import compaction_stats
+
+        compaction_stats.note_sidecar(True)  # writer-tracked CRCs
+        compaction_stats.note_flush(
+            written
+            + len(items) * 16
+            + (len(bloom_bytes) if bloom_bytes is not None else 0)
         )
 
     # ------------------------------------------------------------------
@@ -1282,11 +1333,15 @@ class LSMTree:
                     ),
                 ]
             )
-        # Checksum sidecar rides the same journaled rename.  Python
-        # strategies write compact_sums inline; native (C) merges
-        # don't know the sidecar — sum their freshly-written triplet
-        # post-hoc (off-loop, OS-cache-hot) so compaction outputs are
-        # always verified tables.
+        # Checksum sidecar rides the same journaled rename.  Every
+        # merge strategy now writes compact_sums INLINE (single-pass,
+        # ISSUE 15: CRCs accumulated while the output bytes were
+        # still in RAM / in the writer); this post-hoc re-read is the
+        # safety net for exotic strategies or a stale native library,
+        # and it is COUNTED — the re-read shows up in
+        # get_stats.compaction's read amplification.
+        from .compaction import compaction_stats
+
         compact_sums = os.path.join(
             self.dir_path,
             file_name(output_index, COMPACT_SUMS_FILE_EXT),
@@ -1305,6 +1360,38 @@ class LSMTree:
                 ),
                 COMPACT_SUMS_FILE_EXT,
             )
+            reread = 0
+            for p in (
+                renames[0][0],
+                renames[1][0],
+                os.path.join(
+                    self.dir_path,
+                    file_name(output_index, COMPACT_BLOOM_FILE_EXT),
+                ),
+            ):
+                try:
+                    reread += os.path.getsize(p)
+                except OSError:
+                    pass
+            compaction_stats.note_sidecar(False, reread)
+        else:
+            compaction_stats.note_sidecar(True)
+        # One completed merge pass: inputs (data + index) are read
+        # exactly once; outputs = the renamed triplet + sidecar.
+        input_bytes = sum(
+            t.data_size + t.entry_count * 16 for t in inputs
+        )
+        written_bytes = 0
+        for src, _dst in renames:
+            try:
+                written_bytes += os.path.getsize(src)
+            except OSError:
+                pass
+        try:
+            written_bytes += os.path.getsize(compact_sums)
+        except OSError:
+            pass
+        compaction_stats.note_merge(input_bytes, written_bytes)
         renames.append(
             [
                 compact_sums,

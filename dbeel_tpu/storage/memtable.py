@@ -306,6 +306,75 @@ class ArenaMemtable(MemtableBase):
             )
         return rc
 
+    def flush_to_sstable_with_sums(
+        self, dir_path: str, index: int, bloom_min_size: int
+    ) -> "Tuple[int, bool]":
+        """Single-pass flush (ISSUE 15): triplet write + inline
+        ``.sums`` sidecar in one GIL-free call — the C writer
+        page-CRCs every byte AS it emits it, so the sidecar costs
+        zero re-reads (the old path re-read the whole freshly-written
+        triplet).  Returns ``(entry_count, sums_written)``;
+        ``sums_written`` False means the library predates the ABI (or
+        a cap raced) and the caller must fall back to the post-hoc
+        sidecar."""
+        ct = self._ctypes
+        lib = self._lib
+        if not hasattr(lib, "dbeel_memtable_flush_write2"):
+            return (
+                self.flush_to_sstable(dir_path, index, bloom_min_size),
+                False,
+            )
+        # The dump byte format IS the data-file record format, so the
+        # dump size bounds the data file exactly; the index file is
+        # 16 bytes per entry.  +1 page of slack costs 4 bytes.
+        data_bytes = int(lib.dbeel_memtable_dump_size(self._handle))
+        n_entries = int(lib.dbeel_memtable_len(self._handle))
+        data_cap = data_bytes // 4096 + 2
+        index_cap = (n_entries * 16) // 4096 + 2
+        data_crcs = (ct.c_uint32 * data_cap)()
+        index_crcs = (ct.c_uint32 * index_cap)()
+        n_data = ct.c_uint64(0)
+        n_index = ct.c_uint64(0)
+        bloom_crc = ct.c_uint32(0)
+        wrote_bloom = ct.c_int32(0)
+        rc = int(
+            lib.dbeel_memtable_flush_write2(
+                self._handle,
+                dir_path.encode(),
+                index,
+                bloom_min_size,
+                data_crcs,
+                data_cap,
+                index_crcs,
+                index_cap,
+                ct.byref(n_data),
+                ct.byref(n_index),
+                ct.byref(bloom_crc),
+                ct.byref(wrote_bloom),
+            )
+        )
+        if rc == -1:
+            raise OSError(
+                f"native memtable flush failed for index {index}"
+            )
+        if rc == -2:
+            # Triplet IS complete on disk; only the CRC handoff was
+            # refused (cap mismatch — should not happen given the
+            # exact sizing above).  Post-hoc sidecar covers it.
+            return n_entries, False
+        from . import checksums
+
+        checksums.write_crcs(
+            dir_path,
+            index,
+            list(data_crcs[: n_data.value]),
+            list(index_crcs[: n_index.value]),
+            data_bytes,
+            int(bloom_crc.value),
+            bool(wrote_bloom.value),
+        )
+        return rc, True
+
 
 class HashMemtable(MemtableBase):
     def _new_map(self):

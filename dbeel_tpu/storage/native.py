@@ -250,6 +250,65 @@ def _load() -> Optional[ctypes.CDLL]:
     if hasattr(lib, "dbeel_writer_sync"):
         lib.dbeel_writer_sync.restype = None
         lib.dbeel_writer_sync.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "dbeel_writer_open2"):
+        # Single-pass sidecar gather writer (ISSUE 15): per-page CRCs
+        # accumulated as bytes are emitted, handed back at close so
+        # the .sums sidecar costs zero re-reads.  Gated together with
+        # close2 — one build ships both.
+        lib.dbeel_writer_open2.restype = ctypes.c_void_p
+        lib.dbeel_writer_open2.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.dbeel_writer_close2.restype = ctypes.c_int64
+        lib.dbeel_writer_close2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+    if hasattr(lib, "dbeel_memtable_flush_write2"):
+        # Single-pass native flush: triplet write + inline sidecar
+        # CRCs in one GIL-free call (replaces the post-hoc
+        # compute_and_write re-read of the whole freshly-written
+        # triplet).
+        lib.dbeel_memtable_flush_write2.restype = ctypes.c_int64
+        lib.dbeel_memtable_flush_write2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    if hasattr(lib, "dbeel_read_files_overlapped"):
+        # Overlapped O_DIRECT input loader (io_uring double-buffered;
+        # serial fallback counted) — the k-way merge's input pass.
+        lib.dbeel_read_files_overlapped.restype = ctypes.c_int64
+        lib.dbeel_read_files_overlapped.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint32,
+            TICK_FN,
+            ctypes.c_uint64,
+        ]
+        lib.dbeel_read_overlap_stats.restype = None
+        lib.dbeel_read_overlap_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
     if hasattr(lib, "dbeel_dp_handle"):
         lib.dbeel_wal_new.restype = ctypes.c_void_p
         lib.dbeel_wal_new.argtypes = [ctypes.c_int32, ctypes.c_uint64]
@@ -516,6 +575,16 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,
                 ctypes.POINTER(ctypes.c_uint64),
             ]
+        if hasattr(lib, "dbeel_dp_admits_by_class"):
+            # Native lane accounting (ISSUE 15 satellite): per-class
+            # served-frame counters (client/coord plane + peer plane),
+            # mirrored like sheds_by_class.  Gated separately — stale
+            # .so tolerance.
+            lib.dbeel_dp_admits_by_class.restype = None
+            lib.dbeel_dp_admits_by_class.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
         if hasattr(lib, "dbeel_dp_trace_snapshot"):
             # Tracing plane (PR 9): coarse per-verb native stage
             # counters.  Gated separately — stale .so tolerance.
@@ -671,6 +740,53 @@ def odirect_fallbacks() -> int:
     return n
 
 
+def read_overlap_stats() -> "tuple[int, int]":
+    """(uring_passes, serial_passes) of the overlapped multi-file
+    input loader — how many merge input passes rode io_uring vs fell
+    back to the serial chunked reader.  Free when the lib is not
+    loaded (observability must never trigger a build)."""
+    lib = _lib
+    if lib is None or not hasattr(lib, "dbeel_read_overlap_stats"):
+        return (0, 0)
+    a = ctypes.c_uint64(0)
+    b = ctypes.c_uint64(0)
+    lib.dbeel_read_overlap_stats(ctypes.byref(a), ctypes.byref(b))
+    return (int(a.value), int(b.value))
+
+
+def aligned_u8_buffer(size: int) -> np.ndarray:
+    """4 KiB-aligned uint8 destination of ``max(1, size)`` logical
+    bytes with page-rounded capacity — what the O_DIRECT readers
+    require (an unaligned buffer silently degrades to buffered IO)."""
+    cap = (size + 4095) & ~4095
+    raw = np.empty(cap + 4096, dtype=np.uint8)
+    off = (-raw.ctypes.data) % 4096
+    return raw[off : off + max(1, size)]
+
+
+def page_crcs_native(lib, arr: np.ndarray, size: int) -> list:
+    """Per-4KiB-page CRCs of ``arr[:size]`` via the C kernel — the
+    in-RAM half of the single-pass sidecar (the merged output is
+    still resident; summing it here beats re-reading the file it was
+    just written to)."""
+    from .entry import PAGE_SIZE
+
+    npages = (size + PAGE_SIZE - 1) // PAGE_SIZE
+    if npages == 0:
+        return []
+    if lib is None or not hasattr(lib, "dbeel_crc32_pages"):
+        from . import checksums
+
+        return checksums.page_crcs(memoryview(arr)[:size])
+    out = np.zeros(npages, dtype=np.uint32)
+    lib.dbeel_crc32_pages(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_uint64(int(size)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out.tolist()
+
+
 def murmur3_32_native(data: bytes, seed: int = 0) -> int:
     lib = _load()
     if lib is None:
@@ -725,10 +841,7 @@ class NativeMergeStrategy(CompactionStrategy):
             # 4KiB-aligned destination so the chunked read takes the
             # O_DIRECT path (an unaligned buffer silently falls back
             # to buffered reads).
-            cap = (size + 4095) & ~4095
-            raw = np.empty(cap + 4096, dtype=np.uint8)
-            off = (-raw.ctypes.data) % 4096
-            buf = raw[off : off + max(1, size)]
+            buf = aligned_u8_buffer(size)
             got = lib.dbeel_read_file_cb(
                 path.encode(),
                 buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -740,16 +853,69 @@ class NativeMergeStrategy(CompactionStrategy):
                 raise OSError(f"short read {got} != {size} for {path}")
             return buf
 
-        datas = [
-            _read_whole(s.data_path, s.data_size) for s in sources
-        ]
-        indexes = []
-        counts = []
-        for s in sources:
-            indexes.append(
-                _read_whole(s.index_path, s.entry_count * 16)
+        # Overlapped input pass (ISSUE 15): all data+index files ride
+        # ONE io_uring with double-buffered chunk reads, so the k-way
+        # merge's input load approaches device bandwidth instead of
+        # paying per-file latency in sequence.  tick() still fires per
+        # chunk — the BgThrottle pacing is unchanged.  Small merges
+        # and stale .so keep the serial reader.
+        counts = [s.entry_count for s in sources]
+        datas: "list | None" = None
+        indexes: "list | None" = None
+        total_in = sum(
+            s.data_size + s.entry_count * 16 for s in sources
+        )
+        if (
+            hasattr(lib, "dbeel_read_files_overlapped")
+            and total_in >= _IO_CHUNK_BYTES
+            # Escape hatch + bench-baseline switch: serial chunked
+            # reads exactly as before ISSUE 15.
+            and os.environ.get("DBEEL_NO_OVERLAP_READS", "0")
+            in ("", "0")
+        ):
+            paths = [s.data_path for s in sources] + [
+                s.index_path for s in sources
+            ]
+            sizes = [s.data_size for s in sources] + [
+                s.entry_count * 16 for s in sources
+            ]
+            bufs = [aligned_u8_buffer(sz) for sz in sizes]
+            PathArr = ctypes.c_char_p * len(paths)
+            PtrArr = ctypes.POINTER(ctypes.c_uint8) * len(paths)
+            SizeArr = ctypes.c_uint64 * len(paths)
+            got = lib.dbeel_read_files_overlapped(
+                PathArr(*[p.encode() for p in paths]),
+                PtrArr(
+                    *[
+                        b.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint8)
+                        )
+                        for b in bufs
+                    ]
+                ),
+                SizeArr(*sizes),
+                len(paths),
+                tick_cb,
+                ctypes.c_uint64(_IO_CHUNK_BYTES),
             )
-            counts.append(s.entry_count)
+            if got == sum(sizes):
+                datas = bufs[: len(sources)]
+                indexes = bufs[len(sources) :]
+            else:
+                log.warning(
+                    "overlapped input read failed (%d); serial "
+                    "fallback",
+                    got,
+                )
+        if datas is None or indexes is None:
+            datas = [
+                _read_whole(s.data_path, s.data_size)
+                for s in sources
+            ]
+            indexes = [
+                _read_whole(s.index_path, s.entry_count * 16)
+                for s in sources
+            ]
 
         total_data = sum(s.data_size for s in sources)
         total_count = sum(counts)
@@ -875,6 +1041,7 @@ class NativeMergeStrategy(CompactionStrategy):
             index_w.close()
 
         wrote_bloom = False
+        bloom_bytes = None
         if data_size >= bloom_min_size and n_out > 0:
             rec = np.frombuffer(
                 out_index[: n_out * 16].tobytes(),
@@ -904,7 +1071,24 @@ class NativeMergeStrategy(CompactionStrategy):
                 ctypes.c_uint32(_SEED1),
                 ctypes.c_uint32(_SEED2),
             )
-            _write_bloom(dir_path, output_index, bloom)
+            bloom_bytes = _write_bloom(dir_path, output_index, bloom)
             wrote_bloom = True
+
+        # Single-pass sidecar (ISSUE 15): the merged output is still
+        # resident — page-CRC it in RAM (C kernel) and write the
+        # compact_sums sidecar inline under the same journaled rename,
+        # instead of the post-hoc whole-triplet re-read that roughly
+        # doubled compaction read amplification.
+        from . import checksums
+
+        checksums.write(
+            dir_path,
+            output_index,
+            page_crcs_native(lib, out_data, int(data_size)),
+            page_crcs_native(lib, out_index, int(n_out) * 16),
+            int(data_size),
+            bloom_bytes,
+            ext=checksums.COMPACT_SUMS_FILE_EXT,
+        )
 
         return MergeResult(int(n_out), int(data_size), wrote_bloom)

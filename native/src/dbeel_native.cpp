@@ -270,6 +270,103 @@ namespace {
 constexpr uint64_t KALIGN = 4096;
 constexpr uint64_t KBUF = 8u << 20;  // 8 MiB staging buffers
 
+// CRC-32 (IEEE reflected, zlib-compatible).  Defined here — above the
+// streaming writers — because the single-pass sidecar pipeline feeds
+// every emitted byte through a page accumulator as it is written
+// (storage/checksums.py page semantics), instead of re-reading the
+// whole output triplet post-hoc.
+// Slice-by-8 tables: the accumulators sit on the hot path of every
+// flush/compaction byte now (the whole point is paying the sidecar
+// once, inline), so the CRC must run at zlib-class speed, not the
+// 1-byte/iteration table walk.  t[0] is the classic reflected table;
+// t[j] extends it j bytes ahead.
+struct Crc32Table {
+  uint32_t t[8][256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+      for (int j = 1; j < 8; j++)
+        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
+  }
+};
+static const Crc32Table kCrc;
+
+// Raw-state update (no init/final xor): the incremental form the
+// streaming accumulators need.  Little-endian u32 loads — the same
+// assumption every on-disk format in this file already makes.
+static inline uint32_t crc32z_update(uint32_t c, const uint8_t* p,
+                                     size_t n) {
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    lo ^= c;
+    c = kCrc.t[7][lo & 0xFF] ^ kCrc.t[6][(lo >> 8) & 0xFF] ^
+        kCrc.t[5][(lo >> 16) & 0xFF] ^ kCrc.t[4][lo >> 24] ^
+        kCrc.t[3][hi & 0xFF] ^ kCrc.t[2][(hi >> 8) & 0xFF] ^
+        kCrc.t[1][(hi >> 16) & 0xFF] ^ kCrc.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  for (size_t i = 0; i < n; i++)
+    c = kCrc.t[0][(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c;
+}
+
+static uint32_t crc32z(const uint8_t* p, size_t n) {
+  return crc32z_update(0xFFFFFFFFu, p, n) ^ 0xFFFFFFFFu;
+}
+
+// zlib-compatible CRC of an n-byte prefix zero-padded to `padded`
+// bytes — exactly storage/checksums.py page_crcs' final-page rule.
+static uint32_t crc32z_pad(const uint8_t* p, size_t n, size_t padded) {
+  uint32_t c = crc32z_update(0xFFFFFFFFu, p, n);
+  for (size_t i = n; i < padded; i++)
+    c = kCrc.t[0][c & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Streaming per-4KiB-page CRC accumulator: feed() the logical byte
+// stream in any chunking; finish() zero-pads the final partial page.
+// The emitted sequence is byte-identical to checksums.page_crcs over
+// the finished file (golden-tested from Python).
+struct PageCrcAcc {
+  std::vector<uint32_t> crcs;
+  uint32_t cur = 0xFFFFFFFFu;
+  uint64_t in_page = 0;
+
+  void feed(const uint8_t* p, uint64_t n) {
+    while (n) {
+      const uint64_t take =
+          n < KALIGN - in_page ? n : KALIGN - in_page;
+      cur = crc32z_update(cur, p, (size_t)take);
+      p += take;
+      n -= take;
+      in_page += take;
+      if (in_page == KALIGN) {
+        crcs.push_back(cur ^ 0xFFFFFFFFu);
+        cur = 0xFFFFFFFFu;
+        in_page = 0;
+      }
+    }
+  }
+
+  void finish() {
+    if (in_page) {
+      for (uint64_t i = in_page; i < KALIGN; i++)
+        cur = kCrc.t[0][cur & 0xFF] ^ (cur >> 8);
+      crcs.push_back(cur ^ 0xFFFFFFFFu);
+      cur = 0xFFFFFFFFu;
+      in_page = 0;
+    }
+  }
+};
+
 // Silent-degradation counter (ISSUE 6 satellite): every place the
 // O_DIRECT path quietly falls back to buffered IO — an unaligned
 // destination buffer, or a filesystem/open that refuses O_DIRECT —
@@ -284,6 +381,11 @@ struct StreamFile {
   uint64_t file_off = 0;   // flushed bytes (KALIGN multiple)
   uint64_t logical = 0;    // total logical bytes appended
   bool ok = true;
+  // Optional single-pass sidecar hook: when set, every LOGICAL byte
+  // appended is fed through the page accumulator as it is staged —
+  // the close-time zero padding never reaches it (page_crcs pads
+  // virtually via finish()).
+  PageCrcAcc* crc = nullptr;
 
   bool open_for_write(const char* path) {
     fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
@@ -323,6 +425,7 @@ struct StreamFile {
   }
 
   bool append(const uint8_t* src, uint64_t len) {
+    if (crc != nullptr) crc->feed(src, len);
     while (len) {
       const uint64_t space = KBUF - fill;
       const uint64_t c = len < space ? len : space;
@@ -370,6 +473,13 @@ struct GatherWriter {
   StreamFile data;
   StreamFile index;
   int64_t entries = 0;
+  // Single-pass sidecar accumulators (dbeel_writer_open2): per-page
+  // CRCs of the data/index streams collected AS they are written, so
+  // the caller can emit the .sums sidecar without re-reading the
+  // freshly-written triplet.
+  bool with_crc = false;
+  PageCrcAcc data_crc;
+  PageCrcAcc index_crc;
 };
 
 }  // namespace
@@ -530,6 +640,22 @@ void* dbeel_writer_open(const char* data_path, const char* index_path) {
   return w;
 }
 
+// open + arm the single-pass sidecar accumulators: every byte the
+// gather writer emits is page-CRC'd inline (with_crcs != 0), so
+// dbeel_writer_close2 can hand the per-page CRC arrays back without
+// the post-hoc whole-triplet re-read.
+void* dbeel_writer_open2(const char* data_path, const char* index_path,
+                         int32_t with_crcs) {
+  auto* w = static_cast<GatherWriter*>(
+      dbeel_writer_open(data_path, index_path));
+  if (w != nullptr && with_crcs) {
+    w->with_crc = true;
+    w->data.crc = &w->data_crc;
+    w->index.crc = &w->index_crc;
+  }
+  return w;
+}
+
 // Append ``n`` records selected from per-run blobs: record i lives at
 // run_ptrs[src_run[i]] + src_off[i], length full_size[i].  Emits the
 // matching 16B index entries with globally cumulative offsets.
@@ -571,6 +697,39 @@ int64_t dbeel_writer_close(void* handle, uint64_t* data_size) {
   *data_size = w->data.logical;
   delete w;
   return (d && i) ? entries : -1;
+}
+
+// close2: like dbeel_writer_close, but also copies out the per-page
+// CRCs accumulated since dbeel_writer_open2(with_crcs=1).  Caller
+// sizes data_crcs/index_crcs at ceil(max_possible_size / 4096);
+// n_data/n_index receive the actual page counts.  Returns the entry
+// count, -1 on IO error, -2 when a cap is too small or the writer was
+// opened without accumulators (files are still closed/synced; the
+// caller falls back to the post-hoc sidecar path).
+int64_t dbeel_writer_close2(void* handle, uint64_t* data_size,
+                            uint32_t* data_crcs, uint64_t data_cap,
+                            uint32_t* index_crcs, uint64_t index_cap,
+                            uint64_t* n_data, uint64_t* n_index) {
+  auto* w = static_cast<GatherWriter*>(handle);
+  const bool armed = w->with_crc;
+  if (armed) {
+    w->data_crc.finish();
+    w->index_crc.finish();
+  }
+  std::vector<uint32_t> dcrc, icrc;
+  if (armed) {
+    dcrc = std::move(w->data_crc.crcs);
+    icrc = std::move(w->index_crc.crcs);
+  }
+  const int64_t entries = dbeel_writer_close(handle, data_size);
+  if (entries < 0) return -1;
+  if (!armed || dcrc.size() > data_cap || icrc.size() > index_cap)
+    return -2;
+  std::memcpy(data_crcs, dcrc.data(), dcrc.size() * 4);
+  std::memcpy(index_crcs, icrc.data(), icrc.size() * 4);
+  *n_data = dcrc.size();
+  *n_index = icrc.size();
+  return entries;
 }
 
 // Flush the data file's written bytes to stable storage WITHOUT
@@ -1005,6 +1164,10 @@ struct FlushFile {
   int fd = -1;
   std::string path;
   std::vector<uint8_t> buf;
+  // Single-pass sidecar hook (dbeel_memtable_flush_write2): per-page
+  // CRCs accumulated as bytes are appended, so the flush emits its
+  // .sums inline instead of re-reading the triplet it just wrote.
+  PageCrcAcc* crc = nullptr;
 
   ~FlushFile() {
     if (fd >= 0) ::close(fd);  // exception unwind: no fd leak
@@ -1031,6 +1194,7 @@ struct FlushFile {
   }
   bool append(const void* p, size_t n) {
     const uint8_t* s = (const uint8_t*)p;
+    if (crc != nullptr) crc->feed(s, n);
     buf.insert(buf.end(), s, s + n);
     return buf.size() < (4u << 20) || drain();
   }
@@ -1059,10 +1223,6 @@ std::string sstable_path(const char* dir, uint64_t index,
   return p;
 }
 
-}  // namespace
-
-extern "C" {
-
 // Flush the arena memtable straight to an SSTable triplet — the whole
 // flush write path in one GIL-free call.  Role parity with the
 // reference's flush_memtable_to_disk (lsm_tree.rs:925-946); replaces
@@ -1075,11 +1235,20 @@ extern "C" {
 // BloomFilter.with_capacity (round-half-even via nearbyint) and the
 // same double-hash bit layout.  Returns entry count, or -1 (partial
 // outputs unlinked).
-int64_t dbeel_memtable_flush_write(void* h, const char* dir,
-                                   uint64_t index,
-                                   uint64_t bloom_min_size) {
-  auto* t = static_cast<ArenaMemtable*>(h);
+//
+// Single-pass sidecar (dbeel_memtable_flush_write2): when the CRC
+// accumulators are supplied, every data/index byte is page-CRC'd as
+// it is appended and the bloom file's whole-file CRC is computed from
+// the in-memory serialization — the caller then writes the .sums
+// sidecar without re-reading one byte of the triplet.
+static int64_t memtable_flush_write_impl(
+    ArenaMemtable* t, const char* dir, uint64_t index,
+    uint64_t bloom_min_size, PageCrcAcc* dacc, PageCrcAcc* iacc,
+    uint32_t* bloom_crc_out, int32_t* wrote_bloom_out) {
   FlushFile data, idx;
+  data.crc = dacc;
+  idx.crc = iacc;
+  if (wrote_bloom_out != nullptr) *wrote_bloom_out = 0;
   try {
     if (!data.open(sstable_path(dir, index, "data"))) return -1;
     if (!idx.open(sstable_path(dir, index, "index"))) {
@@ -1179,13 +1348,62 @@ int64_t dbeel_memtable_flush_write(void* h, const char* dir,
         ::unlink(idx.path.c_str());
         return -1;
       }
+      if (bloom_crc_out != nullptr) {
+        // Whole-file bloom CRC (checksums.py: zlib.crc32 of the
+        // serialized filter), from the bytes still in memory.
+        uint32_t bc = crc32z_update(0xFFFFFFFFu, bh, 16);
+        bc = crc32z_update(bc, bloom_bits.data(), bloom_bits.size());
+        *bloom_crc_out = bc ^ 0xFFFFFFFFu;
+      }
+      if (wrote_bloom_out != nullptr) *wrote_bloom_out = 1;
     }
+    if (dacc != nullptr) dacc->finish();
+    if (iacc != nullptr) iacc->finish();
     return (int64_t)entries;
   } catch (...) {
     data.abort();  // ~FlushFile closed nothing yet: fds still held
     idx.abort();
     return -1;
   }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t dbeel_memtable_flush_write(void* h, const char* dir,
+                                   uint64_t index,
+                                   uint64_t bloom_min_size) {
+  return memtable_flush_write_impl(static_cast<ArenaMemtable*>(h),
+                                   dir, index, bloom_min_size,
+                                   nullptr, nullptr, nullptr, nullptr);
+}
+
+// Single-pass flush: triplet write + inline sidecar CRCs in one
+// GIL-free call.  data_crcs/index_crcs are caller-sized at
+// ceil(expected_size / 4096) entries (dump_size / entry count are
+// known to the caller); n_data/n_index receive the page counts,
+// bloom_crc/wrote_bloom the bloom sidecar inputs.  Returns the entry
+// count, -1 on IO error (partial outputs unlinked), -2 when a CRC
+// cap was too small (triplet IS complete on disk; the caller falls
+// back to the post-hoc sidecar).
+int64_t dbeel_memtable_flush_write2(
+    void* h, const char* dir, uint64_t index, uint64_t bloom_min_size,
+    uint32_t* data_crcs, uint64_t data_cap, uint32_t* index_crcs,
+    uint64_t index_cap, uint64_t* n_data, uint64_t* n_index,
+    uint32_t* bloom_crc, int32_t* wrote_bloom) {
+  PageCrcAcc dacc, iacc;
+  const int64_t entries = memtable_flush_write_impl(
+      static_cast<ArenaMemtable*>(h), dir, index, bloom_min_size,
+      &dacc, &iacc, bloom_crc, wrote_bloom);
+  if (entries < 0) return entries;
+  if (dacc.crcs.size() > data_cap || iacc.crcs.size() > index_cap)
+    return -2;
+  std::memcpy(data_crcs, dacc.crcs.data(), dacc.crcs.size() * 4);
+  std::memcpy(index_crcs, iacc.crcs.data(), iacc.crcs.size() * 4);
+  *n_data = dacc.crcs.size();
+  *n_index = iacc.crcs.size();
+  return entries;
 }
 
 }  // extern "C"
@@ -1213,34 +1431,9 @@ int64_t dbeel_memtable_flush_write(void* h, const char* dir,
 
 namespace {
 
-// CRC-32 (IEEE reflected, zlib-compatible) for WAL records.
-struct Crc32Table {
-  uint32_t t[256];
-  Crc32Table() {
-    for (uint32_t i = 0; i < 256; i++) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; k++)
-        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      t[i] = c;
-    }
-  }
-};
-static const Crc32Table kCrc;
-
-static uint32_t crc32z(const uint8_t* p, size_t n) {
-  uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; i++) c = kCrc.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
-
-// zlib-compatible CRC of an n-byte prefix zero-padded to `padded`
-// bytes — exactly storage/checksums.py page_crcs' final-page rule.
-static uint32_t crc32z_pad(const uint8_t* p, size_t n, size_t padded) {
-  uint32_t c = 0xFFFFFFFFu;
-  for (size_t i = 0; i < n; i++) c = kCrc.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
-  for (size_t i = n; i < padded; i++) c = kCrc.t[c & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
+// (CRC-32 table + helpers now live with the streaming writers near
+// the top of the file — the single-pass sidecar accumulators need
+// them before the WAL section.)
 
 constexpr uint32_t kWalMagic = 0x77A11065u;
 constexpr uint64_t kWalPage = 4096;
@@ -1685,6 +1878,13 @@ struct DataPlane {
   int32_t class_levels[3] = {0, 0, 0};
   int32_t has_class_levels = 0;
   uint64_t sheds_by_class[3] = {0, 0, 0};
+  // Native lane accounting (ISSUE 15 satellite): frames SERVED by
+  // the C planes per traffic class — client/coordinator plane and
+  // peer (shard) plane separately, so get_stats.qos shows the native
+  // share next to the interpreted lane counters (before this,
+  // peer_ops counted interpreted frames only).
+  uint64_t admits_by_class[3] = {0, 0, 0};
+  uint64_t peer_admits_by_class[3] = {0, 0, 0};
   int32_t multi_enabled = 1;  // A/B gate (dbeel_dp_set_multi): 0
                               // punts MULTI frames to the Python
                               // fallback for same-session baselines
@@ -2549,6 +2749,17 @@ void dbeel_dp_set_class_levels(void* h, int32_t l0, int32_t l1,
 }
 
 // Native per-class shed counters (out must hold 3 u64s).
+// Per-class NATIVE admit counters, mirrored like sheds_by_class:
+// out[0..2] = client/coordinator-plane frames served in C per class,
+// out[3..5] = peer (shard)-plane frames served in C per class.
+void dbeel_dp_admits_by_class(void* h, uint64_t* out) {
+  auto* dp = static_cast<DataPlane*>(h);
+  for (int i = 0; i < 3; i++) {
+    out[i] = dp->admits_by_class[i];
+    out[3 + i] = dp->peer_admits_by_class[i];
+  }
+}
+
 void dbeel_dp_sheds_by_class(void* h, uint64_t* out) {
   auto* dp = static_cast<DataPlane*>(h);
   out[0] = dp->sheds_by_class[0];
@@ -3004,6 +3215,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
       dp->fast_gets++;
     else
       dp->fast_table_gets++;
+    dp->admits_by_class[f.qos_class]++;
     dp_trace_op(dp, TR_GET, tr0, tr1, tr2, dp_now_ns(dp));
     return get_flags;
   }
@@ -3039,6 +3251,7 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
     return flags | 0x10;
   }
   dp->fast_sets++;
+  dp->admits_by_class[f.qos_class]++;
   // wal-sync tree: the OK must not leave until a completed fdatasync
   // covers this append — Python parks the response on the WAL's sync
   // ticket (bit5).
@@ -3362,6 +3575,7 @@ int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
     std::memcpy(out, &body, 4);
     *out_len = (uint32_t)o;
     dp->fast_multi_sets++;
+    dp->admits_by_class[f.qos_class]++;
     if (col->wal->sync_enabled.load(std::memory_order_relaxed))
       flags |= 0x20;
     return flags;
@@ -3414,6 +3628,7 @@ int64_t dp_handle_multi(DataPlane* dp, const ClientFrame& f,
   std::memcpy(out + 4, mb.data(), mb.size());
   *out_len = (uint32_t)total;
   dp->fast_multi_gets++;
+  dp->admits_by_class[f.qos_class]++;
   return (f.keepalive ? 1 : 0) | 0x80 | 4 |
          ((int64_t)col_idx << 8) | ((int64_t)n << 32);
 }
@@ -3540,6 +3755,7 @@ int64_t dp_shard_multi(DataPlane* dp, MpCur& c, bool is_mset,
     if (col->wal->sync_enabled.load(std::memory_order_relaxed))
       flags |= 0x40;
     dp->fast_replica_ops++;
+    dp->peer_admits_by_class[1]++;  // qos-dialect multi frames punt
     return flags;
   }
 
@@ -3584,6 +3800,7 @@ int64_t dp_shard_multi(DataPlane* dp, MpCur& c, bool is_mset,
   std::memcpy(out + 4, mb.data(), mb.size());
   *out_len = (uint32_t)total;
   dp->fast_replica_ops++;
+  dp->peer_admits_by_class[1]++;  // qos-dialect multi frames punt
   return ((int64_t)col_idx << 8) | 4;
 }
 
@@ -3728,17 +3945,19 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
       }
     }
   }
+  int32_t peer_cls = 1;  // base dialect = standard class
   if (has_qos) {
     // QoS dialect trailer: the trace placeholder (a LIVE id punts —
     // Python owns sampled frames and the span piggyback) and the
-    // class id, parsed for dialect validity; replica-side class
-    // accounting happens on the Python plane's counters.
+    // class id — captured for the native lane accounting
+    // (peer_admits_by_class); shedding stays off the replica plane.
     int64_t trace_v = 0;
     if (!mp_read_int64(c, &trace_v)) return -1;
     if (trace_v > 0) return -1;
     int64_t qos_v = 0;
     if (!mp_read_int64(c, &qos_v)) return -1;
     if (qos_v < 0 || qos_v > 2) return -1;
+    peer_cls = (int32_t)qos_v;
   }
   if (c.p != c.end) return -1;
 
@@ -3782,6 +4001,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     std::memcpy(out + 4, hdr, o);
     *out_len = 4 + t32;
     dp->fast_replica_ops++;
+    dp->peer_admits_by_class[peer_cls]++;
     {
       const uint64_t t = dp_now_ns(dp);
       dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
@@ -3834,6 +4054,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
     std::memcpy(out, &t32, 4);
     *out_len = 4 + t32;
     dp->fast_replica_ops++;
+    dp->peer_admits_by_class[peer_cls]++;
     {
       const uint64_t t = dp_now_ns(dp);
       dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
@@ -3921,6 +4142,7 @@ int64_t dbeel_dp_handle_shard(void* h, const uint8_t* frame,
   if (col->wal->sync_enabled.load(std::memory_order_relaxed))
     flags |= 0x40;
   dp->fast_replica_ops++;
+  dp->peer_admits_by_class[peer_cls]++;
   {
     const uint64_t t = dp_now_ns(dp);
     dp_trace_op(dp, TR_SHARD, tr0, tr1, t, t);
@@ -4067,6 +4289,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
     std::memcpy(t + kCoordGetTrailerHdr + tvn, f.key_raw, f.key_n);
     *out_len = 4 + n32 + kCoordGetTrailerHdr + tvn + f.key_n;
     dp->fast_coord_gets++;
+    dp->admits_by_class[f.qos_class]++;
     return base_flags | 8;
   }
 
@@ -4144,6 +4367,7 @@ int64_t dbeel_dp_handle_coord(void* h, const uint8_t* frame,
   std::memcpy(out, &n32, 4);
   *out_len = 4 + n32;
   dp->fast_coord_writes++;
+  dp->admits_by_class[f.qos_class]++;
 
   int64_t flags = base_flags;
   if (dp_col_full(col)) flags |= 2;
@@ -4369,6 +4593,223 @@ int dbeel_uring_reap(void* h, uint64_t* tags, int32_t* results,
   __atomic_store_n(u->cq_head, head, __ATOMIC_RELEASE);
   if (n > 0 && u->in_flight >= (unsigned)n) u->in_flight -= n;
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------
+// Overlapped O_DIRECT multi-file loader — the k-way merge's input
+// pass.  The serial reader paid first-chunk latency per file in
+// sequence; here the chunks of ALL input files ride one io_uring with
+// a small queue depth (double-buffered per active stream), so total
+// read wall time approaches device bandwidth instead of
+// latency × chunks.  tick() fires once per completed chunk — the same
+// BgThrottle pacing hook as the serial path, so the burst still
+// yields to serving.  Falls back to the serial chunked reader when
+// the kernel has no io_uring (counted; get_stats.compaction surfaces
+// the split).
+// ---------------------------------------------------------------------
+
+namespace {
+
+std::atomic<uint64_t> g_overlap_uring{0};   // ring-backed passes
+std::atomic<uint64_t> g_overlap_serial{0};  // fallback passes
+
+struct OverlapFile {
+  int fd = -1;        // O_DIRECT fd (-1: degraded, full serial read)
+  uint64_t body = 0;  // aligned prefix length
+  uint64_t next = 0;  // next un-submitted body offset
+  bool degraded = false;
+};
+
+struct OverlapSlot {
+  uint32_t file = 0;
+  uint64_t off = 0;
+  uint32_t len = 0;
+  bool used = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t dbeel_read_files_overlapped(const char* const* paths,
+                                    uint8_t* const* dsts,
+                                    const uint64_t* sizes,
+                                    uint32_t nfiles,
+                                    dbeel_tick_fn tick,
+                                    uint64_t chunk) {
+  if (nfiles == 0) return 0;
+  chunk &= ~(KALIGN - 1);
+  if (chunk == 0) chunk = 4u << 20;
+
+  auto serial_all = [&]() -> int64_t {
+    int64_t total = 0;
+    for (uint32_t i = 0; i < nfiles; i++) {
+      const int64_t r =
+          dbeel_read_file_cb(paths[i], dsts[i], sizes[i], tick, chunk);
+      if (r < 0 || (uint64_t)r != sizes[i]) return -1;
+      total += r;
+    }
+    return total;
+  };
+
+  void* uh = dbeel_uring_create(8);
+  if (uh == nullptr) {
+    g_overlap_serial.fetch_add(1, std::memory_order_relaxed);
+    return serial_all();
+  }
+  auto* u = static_cast<UringReader*>(uh);
+
+  std::vector<OverlapFile> files(nfiles);
+  for (uint32_t i = 0; i < nfiles; i++) {
+    OverlapFile& f = files[i];
+    f.body = sizes[i] & ~(KALIGN - 1);
+    const bool aligned =
+        (reinterpret_cast<uintptr_t>(dsts[i]) % KALIGN) == 0;
+    if (f.body && aligned) {
+      f.fd = ::open(paths[i], O_RDONLY | O_DIRECT);
+      if (f.fd < 0)
+        g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    } else if (f.body) {
+      g_odirect_fallbacks.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (f.fd < 0) f.degraded = true;  // whole file read serially below
+  }
+
+  constexpr uint32_t kQD = 4;  // 2 streams double-buffered
+  OverlapSlot slots[8];
+  uint32_t inflight = 0, rr = 0;
+  bool ring_ok = true;
+
+  auto submit_more = [&]() {
+    while (inflight < kQD) {
+      bool any = false;
+      for (uint32_t tried = 0; tried < nfiles; tried++) {
+        const uint32_t fi = (rr + tried) % nfiles;
+        OverlapFile& f = files[fi];
+        if (f.fd < 0 || f.next >= f.body) continue;
+        int s = -1;
+        for (int k = 0; k < 8; k++)
+          if (!slots[k].used) {
+            s = k;
+            break;
+          }
+        if (s < 0) return;
+        const uint32_t len = (uint32_t)(
+            chunk < f.body - f.next ? chunk : f.body - f.next);
+        if (dbeel_uring_queue_read(u, f.fd, dsts[fi] + f.next, len,
+                                   f.next, (uint64_t)s) != 0) {
+          // SQ/CQ refused the submit: this file's remaining body
+          // would otherwise be silently skipped and returned as
+          // "read" — degrade it to the serial re-read below.
+          f.degraded = true;
+          f.next = f.body;
+          return;
+        }
+        slots[s] = {fi, f.next, len, true};
+        f.next += len;
+        inflight++;
+        rr = fi + 1;
+        any = true;
+        break;
+      }
+      if (!any) return;
+    }
+  };
+
+  submit_more();
+  if (dbeel_uring_flush(u) < 0) ring_ok = false;
+  uint64_t tags[8];
+  int32_t results[8];
+  while (ring_ok && inflight > 0) {
+    int got = dbeel_uring_reap(u, tags, results, 8);
+    if (got == 0) {
+      int rc;
+      do {
+        rc = sys_uring_enter(u->ring_fd, 0, 1,
+                             IORING_ENTER_GETEVENTS);
+      } while (rc < 0 && errno == EINTR);
+      if (rc < 0) {
+        ring_ok = false;
+        break;
+      }
+      got = dbeel_uring_reap(u, tags, results, 8);
+    }
+    for (int c = 0; c < got; c++) {
+      OverlapSlot& s = slots[tags[c] & 7];
+      OverlapFile& f = files[s.file];
+      if (results[c] != (int32_t)s.len) {
+        // Short/errored chunk: degrade THIS file to the serial
+        // buffered path below; its other in-flight chunks complete
+        // harmlessly into a buffer the re-read overwrites.
+        f.degraded = true;
+        f.next = f.body;  // stop submitting for it
+      }
+      s.used = false;
+      if (inflight > 0) inflight--;
+      if (tick != nullptr) tick();
+    }
+    submit_more();
+    if (dbeel_uring_flush(u) < 0) {
+      ring_ok = false;
+      break;
+    }
+  }
+
+  for (auto& f : files)
+    if (f.fd >= 0) ::close(f.fd);
+  dbeel_uring_destroy(uh);
+
+  if (!ring_ok) {
+    g_overlap_serial.fetch_add(1, std::memory_order_relaxed);
+    return serial_all();
+  }
+
+  // Tails (the unaligned final partial page) + degraded files go
+  // through the buffered serial reader; a degraded file is re-read
+  // whole (its O_DIRECT chunks may be incomplete).
+  int64_t total = 0;
+  for (uint32_t i = 0; i < nfiles; i++) {
+    OverlapFile& f = files[i];
+    if (f.degraded) {
+      const int64_t r =
+          dbeel_read_file_cb(paths[i], dsts[i], sizes[i], tick, chunk);
+      if (r < 0 || (uint64_t)r != sizes[i]) return -1;
+      total += r;
+      continue;
+    }
+    uint64_t done = f.body;
+    if (done < sizes[i]) {
+      const int fd = ::open(paths[i], O_RDONLY);
+      if (fd < 0) return -(int64_t)errno;
+      while (done < sizes[i]) {
+        const ssize_t r =
+            ::pread(fd, dsts[i] + done, sizes[i] - done, done);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          ::close(fd);
+          return -(int64_t)errno;
+        }
+        if (r == 0) break;
+        done += (uint64_t)r;
+      }
+      ::close(fd);
+      if (done != sizes[i]) return -1;
+    }
+    total += (int64_t)done;
+  }
+  g_overlap_uring.fetch_add(1, std::memory_order_relaxed);
+  return total;
+}
+
+// Pass counters for the overlapped loader: how many multi-file input
+// passes rode the ring vs fell back to the serial reader.  Surfaced
+// in get_stats.compaction.
+void dbeel_read_overlap_stats(uint64_t* uring_passes,
+                              uint64_t* serial_passes) {
+  *uring_passes = g_overlap_uring.load(std::memory_order_relaxed);
+  *serial_passes = g_overlap_serial.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

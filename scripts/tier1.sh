@@ -40,6 +40,8 @@ if [ "${1:-}" = "--sanitize" ]; then
             tests/test_dataplane.py \
             tests/test_wal_sync_native.py \
             tests/test_native_client.py \
+            tests/test_memtable.py \
+            tests/test_compaction_sidecar.py \
             -q -m 'not slow' \
             -p no:cacheprovider -p no:xdist -p no:randomly
 fi

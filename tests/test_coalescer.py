@@ -89,3 +89,65 @@ def test_single_job_still_works(tmp_dir):
         tree.close()
 
     run(main(), timeout=60)
+
+
+def test_pack_jobs_vmap_shape_and_dryrun_parity(tmp_dir):
+    """The vmap-ready packing (ISSUE 15): pack_jobs pads every job to
+    one common pow2 (K, P) stack — the single compiled batch shape —
+    and the coalesced permutation per job equals the
+    DeviceMergeStrategy twin's (executed via the CPU path today, the
+    dryrun-parity contract for a future device wake)."""
+    import numpy as np
+
+    from dbeel_tpu.ops.device_compaction import DeviceMergeStrategy
+    from dbeel_tpu.server.coalescer import pack_jobs
+    from dbeel_tpu.storage import columnar
+    from dbeel_tpu.storage.entry_writer import EntryWriter
+    from dbeel_tpu.storage.sstable import SSTable
+
+    import random as _random
+
+    rng = _random.Random(42)
+
+    def stage(base_idx, runs, per):
+        tabs = []
+        for r in range(runs):
+            w = EntryWriter(tmp_dir, base_idx + 2 * r, None)
+            for k in sorted(
+                f"{base_idx}-{rng.randrange(10**6):06d}".encode()
+                for _ in range(per)
+            ):
+                w.write(k, b"v", rng.randrange(1, 10**9))
+            w.close()
+            tabs.append(SSTable(tmp_dir, base_idx + 2 * r, None))
+        cols = columnar.load_columns(tabs)
+        rc = np.bincount(cols.src).tolist() if len(cols) else []
+        return cols, rc
+
+    jobs = [stage(0, 2, 40), stage(100, 3, 25)]
+    batch = pack_jobs([(c, rc, None) for c, rc in jobs])
+    # One compiled shape: pow2 K covering the widest job, pow2 P
+    # covering the longest run, stacked over jobs.
+    assert batch.k >= 4 and batch.k & (batch.k - 1) == 0
+    assert batch.p >= 64 and batch.p & (batch.p - 1) == 0
+    # (jobs, K, P, words): the kernel's packed u32 prefix words.
+    assert batch.prefixes.shape[:3] == (2, batch.k, batch.p)
+    assert batch.counts.shape == (2, batch.k)
+    assert 0.0 <= batch.pad_frac < 1.0
+
+    async def main():
+        from dbeel_tpu.server.coalescer import CompactionCoalescer
+
+        co = CompactionCoalescer(window_s=0.01)
+        twin = DeviceMergeStrategy()
+        for cols, rc in jobs:
+            perm = await co.submit(cols, rc)
+            got, keep = columnar.fixup_and_dedup_prefix(
+                cols, perm, words=2
+            )
+            want, want_keep = twin.sort_and_dedup(cols)
+            assert np.array_equal(got[keep], want[want_keep])
+        assert co.launches >= 1
+        assert co.last_batch_k >= 1 and co.last_batch_p >= 8
+
+    run(main(), timeout=30)

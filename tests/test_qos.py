@@ -633,3 +633,107 @@ def test_signal_thresholds_scale_by_class(tmp_dir):
             await node.stop()
 
     run(main(), timeout=30)
+
+
+# ----------------------------------------------------------------------
+# Satellite (ISSUE 15): per-collection quota overrides + native lane
+# accounting
+# ----------------------------------------------------------------------
+
+
+def test_per_collection_quota_override_round_trip(tmp_dir):
+    """DDL-carried ops/bytes rates beat the --tenant-* flag defaults
+    for THEIR collection only, round-trip through the collection
+    metadata file (restart discovery), and actually bind: the
+    overridden collection refuses a tenant the flag-default
+    collection keeps serving."""
+
+    async def main():
+        node, client, col = await _one_node(
+            tmp_dir, tenant_ops_per_sec=100000
+        )
+        shard = node.shards[0]
+        try:
+            # DDL with a tiny ops override on a second collection.
+            await client.create_collection(
+                "metered", replication_factor=1, ops_per_sec=1,
+                bytes_per_sec=0,
+            )
+            c = shard.collections["metered"]
+            assert c.quotas == {"ops_per_sec": 1, "bytes_per_sec": 0}
+            # Metadata round-trip: the disk scan rediscovers the
+            # override (what a restart replays).
+            on_disk = {
+                name: quotas
+                for name, _rf, quotas in (
+                    shard.get_collections_from_disk()
+                )
+            }
+            assert on_disk["metered"] == c.quotas
+            # Resolution: override beats the flag for "metered";
+            # the default collection keeps the flag rates.
+            assert shard.qos.quota_rates("metered") == (1, 0)
+            assert shard.qos.quota_rates("qv") == (100000, 0)
+            # get_collection surfaces the override to clients.
+            raw = await client._send_to(
+                *node.db_address,
+                {"type": "get_collection", "name": "metered"},
+            )
+            assert msgpack.unpackb(raw, raw=False)["quotas"] == {
+                "ops_per_sec": 1,
+                "bytes_per_sec": 0,
+            }
+            # Behavior: a tenant burns the 1 op/s bucket (burst 2)
+            # on "metered" while the SAME tenant sails on the
+            # flag-default collection.
+            t_client = await DbeelClient.from_seed_nodes(
+                [node.db_address], op_deadline_s=0.5, tenant="acme"
+            )
+            try:
+                mcol = t_client.collection("metered")
+                with pytest.raises(QuotaExceeded):
+                    for i in range(10):
+                        await mcol.set(f"k{i}", {"v": i})
+                for i in range(10):
+                    await t_client.collection("qv").set(
+                        f"k{i}", {"v": i}
+                    )
+            finally:
+                t_client.close()
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_native_lane_admits_in_qos_stats(tmp_dir):
+    """Native lane accounting (ISSUE 15 satellite): frames the C
+    client plane serves show up per class in get_stats.qos
+    (native_admits / peer_ops_native) — before this, only interpreted
+    frames were counted, so a native-served flood was invisible to
+    per-class accounting."""
+
+    async def main():
+        node, client, col = await _one_node(tmp_dir)
+        shard = node.shards[0]
+        try:
+            if shard.dataplane is None or (
+                shard.dataplane.admits_by_class() is None
+            ):
+                pytest.skip("no native data plane / stale .so")
+            for i in range(20):
+                await col.set(f"k{i}", {"v": i})
+            for i in range(20):
+                await col.get(f"k{i}")
+            stats = await client.get_stats(*node.db_address)
+            lane = stats["qos"]["classes"]["standard"]
+            assert "native_admits" in lane
+            assert "peer_ops_native" in lane
+            # RF=1 sets/gets ride the native client plane here.
+            assert lane["native_admits"] > 0
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=30)
