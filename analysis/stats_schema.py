@@ -213,16 +213,22 @@ def check(repo: Repo) -> List[Finding]:
             # Single-pass compaction plane (ISSUE 15): the process-
             # wide CompactionStats counters feed get_stats.compaction.
             repo.path("dbeel_tpu", "storage", "compaction.py"),
+            # Secondary-index plane (ISSUE 17): the process-wide
+            # IndexStats counters feed get_stats.index.
+            repo.path("dbeel_tpu", "storage", "secondary_index.py"),
         )
         if os.path.exists(p)
     ]
-    # compaction.py's counters are ALSO increment-checked (its
-    # CompactionStats block is pure observability — a counter bumped
-    # there but missing from the schema is exactly the drift this
-    # checker exists for).  wal/lsm_tree stay export-only: they mix
-    # counters with internal storage state predating the rule.
+    # compaction.py's and secondary_index.py's counters are ALSO
+    # increment-checked (their CompactionStats/IndexStats blocks are
+    # pure observability — a counter bumped there but missing from
+    # the schema is exactly the drift this checker exists for).
+    # wal/lsm_tree stay export-only: they mix counters with internal
+    # storage state predating the rule.
     counted = set(server_files) | {
-        p for p in extra if p.endswith("compaction.py")
+        p
+        for p in extra
+        if p.endswith(("compaction.py", "secondary_index.py"))
     }
 
     exports = _ExportCollector()

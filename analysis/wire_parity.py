@@ -76,7 +76,10 @@ def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
     """Base element count of the list literal each encoder
     staticmethod returns, keyed by verb attribute name.  Handles the
     ``_with_deadline([...], deadline_ms)`` wrapper (the optional
-    trailing deadline is NOT part of the base arity)."""
+    trailing deadline is NOT part of the base arity) and the
+    build-then-append shape (``frame = [...]; frame.append(x);
+    return frame`` — conditional tail slots are NOT part of the base
+    arity either; the DDL-tail check pins them separately)."""
     out: Dict[str, int] = {}
     for node in ast.walk(tree):
         if not (
@@ -86,6 +89,17 @@ def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
         for fn in node.body:
             if not isinstance(fn, ast.FunctionDef):
                 continue
+            # Name -> list-literal assignments in this function body,
+            # so a ``return frame`` resolves to its base literal.
+            assigns: Dict[str, ast.List] = {}
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.List)
+                ):
+                    assigns[sub.targets[0].id] = sub.value
             for ret in ast.walk(fn):
                 if not isinstance(ret, ast.Return) or ret.value is None:
                     continue
@@ -97,6 +111,8 @@ def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
                     and value.args
                 ):
                     value = value.args[0]
+                if isinstance(value, ast.Name):
+                    value = assigns.get(value.id)
                 if not isinstance(value, ast.List) or not value.elts:
                     continue
                 if const_str(value.elts[0]) != "request":
@@ -109,6 +125,105 @@ def _encoder_arities(tree: ast.AST, cls_name: str) -> Dict[str, int]:
                 ):
                     out[verb.attr] = len(value.elts)
     return out
+
+
+def _fn_base_list_len(
+    tree: ast.AST, cls_name: str, fn_name: str
+) -> Optional[int]:
+    """Length of the list literal ``fn_name`` in ``cls_name`` builds
+    (directly returned or assigned-then-returned) — the frame's base
+    arity before any conditional tail appends."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.ClassDef) and node.name == cls_name
+        ):
+            continue
+        for fn in node.body:
+            if not (
+                isinstance(fn, ast.FunctionDef) and fn.name == fn_name
+            ):
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.List
+                ):
+                    return len(sub.value.elts)
+                if isinstance(sub, ast.Return) and isinstance(
+                    sub.value, ast.List
+                ):
+                    return len(sub.value.elts)
+    return None
+
+
+def _fn_append_count(
+    tree: ast.AST, cls_name: str, fn_name: str
+) -> int:
+    """Number of ``.append(...)`` calls inside ``cls_name.fn_name`` —
+    the encoder's optional tail-slot count."""
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.ClassDef) and node.name == cls_name
+        ):
+            continue
+        for fn in node.body:
+            if not (
+                isinstance(fn, ast.FunctionDef) and fn.name == fn_name
+            ):
+                continue
+            return sum(
+                1
+                for sub in ast.walk(fn)
+                if isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "append"
+            )
+    return 0
+
+
+def _subscript_slots(tree: ast.AST, target: str) -> Set[int]:
+    """Integer subscript indices applied to Name ``target`` anywhere
+    in the tree (``request[4]`` -> 4)."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == target
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, int)
+        ):
+            out.add(node.slice.value)
+    return out
+
+
+def _handler_branch_slots(
+    tree: ast.AST, cls_name: str, verb_attr: str, target: str
+) -> Optional[Set[int]]:
+    """Subscript slots read from ``target`` inside the handler branch
+    testing ``kind == cls_name.verb_attr`` (the if/elif dispatch arm
+    — NOT the whole file, so another verb's reads can't mask a
+    missing slot)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if not (
+            isinstance(test, ast.Compare)
+            and len(test.comparators) == 1
+        ):
+            continue
+        comp = test.comparators[0]
+        if (
+            isinstance(comp, ast.Attribute)
+            and comp.attr == verb_attr
+            and isinstance(comp.value, ast.Name)
+            and comp.value.id == cls_name
+        ):
+            slots: Set[int] = set()
+            for stmt in node.body:
+                slots |= _subscript_slots(stmt, target)
+            return slots
+    return None
 
 
 def _peer_index_table(tree: ast.AST, table_name: str) -> Dict[str, int]:
@@ -742,6 +857,73 @@ def check(repo: Repo) -> List[Finding]:
             "filter/aggregate pushdown must stay reachable from "
             "BOTH clients",
         )
+
+    # -- DDL plane (ISSUEs 15/17): quotas-then-index tail dialect ----
+    # create_collection frames (peer request AND gossip event) carry
+    # up to DDL_TAIL_SLOTS optional trailing elements after the base
+    # arity — quotas, then the secondary-index field list, with a
+    # None quota placeholder keeping positions fixed.  Pinned three
+    # ways: both encoders' append counts, and both shard.py handlers
+    # actually reading every optional slot (a handler that stops one
+    # short silently drops the declared index cluster-wide).
+    ddl_tail = _module_int_constant(messages, "DDL_TAIL_SLOTS")
+    if ddl_tail is None:
+        add(
+            repo.messages_py,
+            1,
+            "DDL_TAIL_SLOTS constant missing — the create_collection "
+            "optional tail (quotas, index) must be a named, "
+            "lint-compared constant",
+        )
+    else:
+        for cls in ("ShardRequest", "GossipEvent"):
+            n_app = _fn_append_count(messages, cls, "create_collection")
+            if n_app != ddl_tail:
+                add(
+                    repo.messages_py,
+                    1,
+                    f"DDL tail drift: {cls}.create_collection appends "
+                    f"{n_app} optional slots but DDL_TAIL_SLOTS is "
+                    f"{ddl_tail} — a declared index or quota override "
+                    "would be dropped on the wire",
+                )
+        for cls, fn_base, handler_target in (
+            ("ShardRequest", "create_collection", "request"),
+            ("GossipEvent", "create_collection", "event"),
+        ):
+            base = _fn_base_list_len(messages, cls, fn_base)
+            if base is None:
+                add(
+                    repo.messages_py,
+                    1,
+                    f"{cls}.create_collection base frame literal not "
+                    "found — encoder restructured? update "
+                    "analysis/wire_parity",
+                )
+                continue
+            got = _handler_branch_slots(
+                shard, cls, "CREATE_COLLECTION", handler_target
+            )
+            if got is None:
+                add(
+                    repo.shard_py,
+                    1,
+                    f"no handler branch testing "
+                    f"{cls}.CREATE_COLLECTION found in shard.py — "
+                    "dispatch restructured? update "
+                    "analysis/wire_parity",
+                )
+                continue
+            for slot in range(base, base + ddl_tail):
+                if slot not in got:
+                    add(
+                        repo.shard_py,
+                        1,
+                        f"DDL tail drift: the {cls}.CREATE_COLLECTION "
+                        f"handler never reads {handler_target}[{slot}]"
+                        f" — an optional tail slot the encoder emits "
+                        "(quotas/index) would be silently ignored",
+                    )
 
     # -- QoS plane (ISSUE 14): both clients must stamp the class and
     # tenant request fields, and both C planes must know the tokens

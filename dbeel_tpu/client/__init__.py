@@ -852,12 +852,19 @@ class DbeelClient:
         replication_factor: Optional[int] = None,
         ops_per_sec: Optional[int] = None,
         bytes_per_sec: Optional[int] = None,
+        index: Optional[list] = None,
     ) -> "DbeelCollection":
         """``ops_per_sec``/``bytes_per_sec`` carry per-collection
         tenant-quota overrides on the DDL (ISSUE 15 satellite): they
         beat the server's ``--tenant-*`` flag defaults for this
         collection only (0 disables the limit), and round-trip
-        through collection metadata (restart- and gossip-safe)."""
+        through collection metadata (restart- and gossip-safe).
+
+        ``index`` names value fields to maintain persisted secondary
+        index runs for (ISSUE 17): flush/compaction emit per-SSTable
+        fidx runs inline and indexed ``scan(filter=)`` / ``count``
+        predicates on those fields skip the full scan.  Round-trips
+        through metadata/gossip like quotas."""
         request = {"type": "create_collection", "name": name}
         if replication_factor is not None:
             request["replication_factor"] = replication_factor
@@ -865,6 +872,8 @@ class DbeelClient:
             request["ops_per_sec"] = int(ops_per_sec)
         if bytes_per_sec is not None:
             request["bytes_per_sec"] = int(bytes_per_sec)
+        if index:
+            request["index"] = [str(f) for f in index]
         host, port = self._seeds[0]
         await self._send_to(host, port, request)
         await self.sync_metadata()

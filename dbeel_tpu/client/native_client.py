@@ -54,6 +54,15 @@ def _bind(lib) -> None:
         ctypes.c_char_p,
         ctypes.c_uint32,
     ]
+    if hasattr(lib, "dbeel_cli_create_collection_indexed"):
+        # stale .so tolerance (ISSUE 17 DDL surface)
+        lib.dbeel_cli_create_collection_indexed.restype = ctypes.c_int
+        lib.dbeel_cli_create_collection_indexed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+        ]
     lib.dbeel_cli_set.restype = ctypes.c_int
     lib.dbeel_cli_set.argtypes = [
         ctypes.c_void_p,
@@ -528,11 +537,24 @@ class NativeDbeelClient:
                 return total
 
     def create_collection(
-        self, name: str, replication_factor: int = 1
+        self,
+        name: str,
+        replication_factor: int = 1,
+        index: Optional[list] = None,
     ) -> None:
-        rc = self._lib.dbeel_cli_create_collection(
-            self._h, name.encode(), replication_factor
-        )
+        if index:
+            if not hasattr(self._lib, "dbeel_cli_create_collection_indexed"):
+                raise DbeelError(
+                    "native client .so predates indexed DDL — rebuild"
+                )
+            csv = ",".join(str(f) for f in index)
+            rc = self._lib.dbeel_cli_create_collection_indexed(
+                self._h, name.encode(), replication_factor, csv.encode()
+            )
+        else:
+            rc = self._lib.dbeel_cli_create_collection(
+                self._h, name.encode(), replication_factor
+            )
         if rc != 0:
             raise DbeelError(self._err())
 

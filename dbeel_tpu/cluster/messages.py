@@ -20,6 +20,14 @@ import msgpack
 from ..errors import DbeelError, ProtocolError, from_wire
 from ..utils.murmur import murmur3_32
 
+# create_collection frames (peer request and gossip event) carry this
+# many optional trailing slots after the base arity: the tenant-quota
+# override map (ISSUE 15), then the secondary-index field list
+# (ISSUE 17).  A None quota placeholder keeps positions fixed when
+# only the index is declared.  Pinned by analysis/wire_parity against
+# both encoders' append counts and both shard.py handlers' slot reads.
+DDL_TAIL_SLOTS = 2
+
 
 @dataclass(frozen=True)
 class NodeMetadata:
@@ -164,21 +172,23 @@ class ShardRequest:
         return ["request", ShardRequest.GET_COLLECTIONS]
 
     @staticmethod
-    def create_collection(name: str, rf: int, quotas=None) -> list:
-        # Optional trailing element: per-collection tenant-quota
+    def create_collection(
+        name: str, rf: int, quotas=None, index=None
+    ) -> list:
+        # Optional trailing elements: per-collection tenant-quota
         # overrides ({"ops_per_sec", "bytes_per_sec"}, ISSUE 15
-        # satellite).  Appended only when present, so quota-less DDL
-        # keeps the pre-ISSUE-15 arity byte-for-byte; old receivers
-        # index from the front and ignore the tail.
-        if quotas:
-            return [
-                "request",
-                ShardRequest.CREATE_COLLECTION,
-                name,
-                rf,
-                quotas,
-            ]
-        return ["request", ShardRequest.CREATE_COLLECTION, name, rf]
+        # satellite) then the secondary-index field list (ISSUE 17).
+        # Each appears only AFTER the previous slot (a None quota
+        # placeholder keeps position 4 fixed when only the index is
+        # set), so plain DDL keeps the pre-ISSUE-15 arity
+        # byte-for-byte; old receivers index from the front and
+        # ignore the tail.
+        frame = ["request", ShardRequest.CREATE_COLLECTION, name, rf]
+        if quotas or index:
+            frame.append(quotas if quotas else None)
+        if index:
+            frame.append(list(index))
+        return frame
 
     @staticmethod
     def drop_collection(name: str) -> list:
@@ -461,10 +471,12 @@ class ShardResponse:
 
     @staticmethod
     def get_collections(cols) -> list:
-        # Entries are [name, rf] or [name, rf, quotas] — the optional
-        # third element carries per-collection quota overrides
-        # (ISSUE 15 satellite); old receivers index [0]/[1] and
-        # ignore the tail.
+        # Entries are [name, rf], [name, rf, quotas] or [name, rf,
+        # quotas|nil, index] — the optional third element carries
+        # per-collection quota overrides (ISSUE 15 satellite), the
+        # optional fourth the secondary-index field list (ISSUE 17,
+        # nil quota placeholder keeps position 2 fixed); old
+        # receivers index [0]/[1] and ignore the tail.
         return [
             "response",
             ShardResponse.GET_COLLECTIONS,
@@ -602,11 +614,17 @@ class GossipEvent:
         return [GossipEvent.DEAD, node_name]
 
     @staticmethod
-    def create_collection(name: str, rf: int, quotas=None) -> list:
-        # Same optional quota tail as the peer-request dialect.
+    def create_collection(
+        name: str, rf: int, quotas=None, index=None
+    ) -> list:
+        # Same optional quotas-then-index tail dialect as the
+        # peer-request frame (None quota placeholder keeps slot 3
+        # fixed when only the index is declared).
         event = [GossipEvent.CREATE_COLLECTION, name, rf]
-        if quotas:
-            event.append(quotas)
+        if quotas or index:
+            event.append(quotas if quotas else None)
+        if index:
+            event.append(list(index))
         return event
 
     @staticmethod

@@ -651,8 +651,15 @@ class RemoteShardConnection:
         # Third element (when the peer sends one): per-collection
         # quota overrides — propagated so a discovering node adopts
         # the same admission config (old peers simply lack it).
+        # Fourth (ISSUE 17): the secondary-index field list.
         return [
-            (c[0], c[1], c[2] if len(c) > 2 else None) for c in cols
+            (
+                c[0],
+                c[1],
+                c[2] if len(c) > 2 else None,
+                c[3] if len(c) > 3 else None,
+            )
+            for c in cols
         ]
 
     async def open_stream(self) -> "RemoteShardStream":

@@ -110,6 +110,7 @@ class DeviceMergeStrategy(ColumnarMergeStrategy):
         return write_output_columnar(
             cols, perm[keep], dir_path, output_index, cache,
             bloom_min_size, throttle=self.throttle,
+            index_fields=self.index_fields,
         )
 
     def _refine(self, cols, perm):

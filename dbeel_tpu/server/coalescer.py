@@ -367,6 +367,7 @@ class CoalescedDeviceMergeStrategy:
             return write_output_columnar(
                 cols, order, dir_path, output_index, cache,
                 bloom_min_size, throttle=self.throttle,
+                index_fields=self.index_fields,
             )
 
         return await loop.run_in_executor(None, finish)

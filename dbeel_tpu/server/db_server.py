@@ -279,17 +279,23 @@ async def handle_request(
                 quotas["ops_per_sec"] = request["ops_per_sec"]
             if isinstance(request.get("bytes_per_sec"), int):
                 quotas["bytes_per_sec"] = request["bytes_per_sec"]
+        # Secondary-index DDL (ISSUE 17): optional list of value
+        # fields to maintain persisted per-SSTable index runs for.
+        # Sanitized shard-side; junk entries are dropped there.
+        index = request.get("index")
+        if not isinstance(index, (list, tuple)):
+            index = None
         from ..errors import CollectionAlreadyExists
 
         if name in my_shard.collections:
             raise CollectionAlreadyExists(name)
-        await my_shard.create_collection(name, rf, quotas)
+        await my_shard.create_collection(name, rf, quotas, index)
         await my_shard.send_request_to_local_shards(
-            ShardRequest.create_collection(name, rf, quotas),
+            ShardRequest.create_collection(name, rf, quotas, index),
             ShardResponse.CREATE_COLLECTION,
         )
         await my_shard.gossip(
-            msgs.GossipEvent.create_collection(name, rf, quotas)
+            msgs.GossipEvent.create_collection(name, rf, quotas, index)
         )
         return None
 
@@ -299,6 +305,8 @@ async def handle_request(
         body = {"replication_factor": col.replication_factor}
         if col.quotas:
             body["quotas"] = col.quotas
+        if col.index_fields:
+            body["index"] = col.index_fields
         return msgpack.packb(body, use_bin_type=True)
 
     if rtype == "drop_collection":

@@ -80,9 +80,9 @@ async def discover_collections(my_shard: MyShard) -> None:
     create gossip is long gone — and one reachable-but-stale seed
     must not mask a remembered peer that knows it, nor dead peers
     serialize the boot."""
-    for name, rf, quotas in my_shard.get_collections_from_disk():
+    for name, rf, quotas, index in my_shard.get_collections_from_disk():
         try:
-            await my_shard.create_collection(name, rf, quotas)
+            await my_shard.create_collection(name, rf, quotas, index)
         except DbeelError:
             pass
     candidates = _discovery_candidates(my_shard)
@@ -105,11 +105,11 @@ async def discover_collections(my_shard: MyShard) -> None:
                 "seed %s collection discovery failed: %s", seed, res
             )
             continue
-        for name, rf, quotas in res:
+        for name, rf, quotas, index in res:
             if name not in my_shard.collections:
                 try:
                     await my_shard.create_collection(
-                        name, rf, quotas
+                        name, rf, quotas, index
                     )
                 except DbeelError:
                     pass

@@ -358,6 +358,9 @@ class ScanPlane:
         self.agg_partials = 0
         self.device_evals = 0
         self.fallback_evals = 0
+        # Pages answered via the secondary-index planner (ISSUE 17) —
+        # candidate set came from persisted fidx runs, not a full scan.
+        self.indexed_evals = 0
 
     def stats(self) -> dict:
         return {
@@ -383,6 +386,7 @@ class ScanPlane:
                 "agg_partials": self.agg_partials,
                 "device_evals": self.device_evals,
                 "fallback_evals": self.fallback_evals,
+                "indexed_evals": self.indexed_evals,
             },
         }
 

@@ -40,6 +40,7 @@ from ..storage import DEFAULT_TREE_CAPACITY
 from ..storage.compaction import get_strategy
 from ..storage.lsm_tree import LSMTree, TOMBSTONE
 from ..storage.page_cache import PageCache, PartitionPageCache
+from ..storage.secondary_index import index_stats, sanitize_index_fields
 from ..utils.event import LocalEvent
 from ..utils.murmur import hash_bytes, hash_string
 from ..cluster import messages as msgs
@@ -122,6 +123,11 @@ class Collection:
     # (None / missing key = use the flag default; 0 disables).
     # Round-tripped through the collection metadata file.
     quotas: "Optional[dict]" = None
+    # Secondary-index DDL (ISSUE 17): value fields whose per-SSTable
+    # index runs the flush/compaction writers maintain inline and the
+    # scan planner consults.  Round-tripped through the metadata file
+    # and the create_collection gossip/peer frames like quotas.
+    index_fields: "Optional[list]" = None
 
 
 def _sanitize_quotas(quotas) -> "Optional[dict]":
@@ -629,7 +635,9 @@ class MyShard:
             raise CollectionNotFound(name)
         return col
 
-    def _create_lsm_tree(self, name: str) -> LSMTree:
+    def _create_lsm_tree(
+        self, name: str, index_fields: "Optional[list]" = None
+    ) -> LSMTree:
         capacity = self.config.memtable_capacity or DEFAULT_TREE_CAPACITY
         strategy = get_strategy(self.config.compaction_backend)
         # Intra-merge latency class: the merge worker thread yields CPU
@@ -645,6 +653,7 @@ class MyShard:
             strategy=strategy,
             memtable_kind=self.config.memtable_kind,
             gc_grace_s=self.config.gc_grace_s(),
+            index_fields=index_fields,
         )
         # Durability-plane escalation hooks: disk errors degrade the
         # whole shard; a corruption quarantine pulls the lost range
@@ -940,6 +949,12 @@ class MyShard:
             # are re-read for their sidecar).  Process-wide, like the
             # device-coalescer counters.
             "compaction": _compaction_stats_block(),
+            # Secondary-index plane (ISSUE 17): runs built/merged at
+            # flush/compaction time, planner hit/miss counters, and
+            # quarantines.  The maintenance-cost ratio lives under
+            # "compaction" (index_maintenance_amplification) next to
+            # the read-amplification claim it rides on.
+            "index": index_stats.stats(),
             "device_coalescer": _coalescer_stats(),
             "dataplane": (
                 self.dataplane.stats()
@@ -1102,16 +1117,18 @@ class MyShard:
         name: str,
         replication_factor: int,
         quotas: "Optional[dict]" = None,
+        index: "Optional[list]" = None,
     ) -> None:
         if name in self.collections:
             raise CollectionAlreadyExists(name)
         quotas = _sanitize_quotas(quotas)
+        index = sanitize_index_fields(index)
         # Audited sync I/O: DDL is rare (operator-rate, gossiped once)
         # and the metadata file is tens of bytes — an executor hop
         # would cost more than the write.  The fsync CAN stall the
         # loop ~ms-scale on a slow disk; acceptable on this path.
         os.makedirs(self.config.dir, exist_ok=True)  # lint: allow(async-blocking)
-        tree = self._create_lsm_tree(name)
+        tree = self._create_lsm_tree(name, index_fields=index)
         path = self._collection_metadata_path(name)
         if not os.path.exists(path):
             meta = {"replication_factor": replication_factor}
@@ -1119,13 +1136,17 @@ class MyShard:
                 # Per-collection quota overrides ride the same
                 # metadata file, so a restart rediscovers them.
                 meta["quotas"] = quotas
+            if index:
+                # Indexed-field DDL persists the same way (ISSUE 17)
+                # so a restart keeps maintaining the same runs.
+                meta["index"] = index
             # lint: allow(async-blocking)
             with open(path, "wb") as f:
                 f.write(msgpack.packb(meta))
                 f.flush()
                 os.fsync(f.fileno())  # lint: allow(async-blocking)
         self.collections[name] = Collection(
-            tree, replication_factor, quotas
+            tree, replication_factor, quotas, index
         )
         if self.dataplane is not None:
             # RF=1: full client-plane fast path.  RF>1: replica plane
@@ -1155,10 +1176,13 @@ class MyShard:
         self.collections_change_event.notify()
         self.flow.notify(FlowEvent.COLLECTION_DROPPED)
 
-    def get_collections_from_disk(self) -> List[Tuple[str, int, Optional[dict]]]:
+    def get_collections_from_disk(
+        self,
+    ) -> List[Tuple[str, int, Optional[dict], Optional[list]]]:
         """Disk discovery by '<name>-<id>' directory scan
         (shards.rs:265-311); the third element is the DDL-carried
-        per-collection quota override map (or None)."""
+        per-collection quota override map (or None), the fourth the
+        secondary-index field list (or None)."""
         if not os.path.isdir(self.config.dir):
             return []
         pattern = re.compile(rf"^(.*?)\-{self.id}$")
@@ -1179,6 +1203,7 @@ class MyShard:
                         name,
                         meta["replication_factor"],
                         meta.get("quotas"),
+                        meta.get("index"),
                     )
                 )
             except FileNotFoundError:
@@ -1935,23 +1960,28 @@ class MyShard:
         if kind == ShardRequest.GET_METADATA:
             return ShardResponse.get_metadata(self.get_nodes())
         if kind == ShardRequest.GET_COLLECTIONS:
-            return ShardResponse.get_collections(
-                [
-                    (
-                        (n, c.replication_factor, c.quotas)
-                        if c.quotas
-                        else (n, c.replication_factor)
-                    )
-                    for n, c in self.collections.items()
-                ]
-            )
+            # Tail dialect mirrors the CREATE_COLLECTION frame: quotas
+            # at slot 2 (None placeholder when only an index is set),
+            # index field list at slot 3.  Short entries stay short so
+            # pre-ISSUE-15/17 peers parse them unchanged.
+            entries = []
+            for n, c in self.collections.items():
+                e = [n, c.replication_factor]
+                if c.quotas or c.index_fields:
+                    e.append(c.quotas if c.quotas else None)
+                if c.index_fields:
+                    e.append(c.index_fields)
+                entries.append(tuple(e))
+            return ShardResponse.get_collections(entries)
         if kind == ShardRequest.CREATE_COLLECTION:
             # Optional 5th element: per-collection quota overrides
-            # (old-arity frames from pre-ISSUE-15 peers are accepted).
+            # (old-arity frames from pre-ISSUE-15 peers are accepted);
+            # optional 6th: secondary-index field list (ISSUE 17).
             await self.create_collection(
                 request[2],
                 request[3],
                 request[4] if len(request) > 4 else None,
+                request[5] if len(request) > 5 else None,
             )
             return ShardResponse.empty(ShardResponse.CREATE_COLLECTION)
         if kind == ShardRequest.DROP_COLLECTION:
@@ -2142,6 +2172,8 @@ class MyShard:
                 )
                 if eval_path == "device":
                     self.scan_plane.device_evals += 1
+                elif eval_path == "indexed":
+                    self.scan_plane.indexed_evals += 1
                 elif eval_path in ("numpy", "golden"):
                     self.scan_plane.fallback_evals += 1
                 if partial is not None:
@@ -2584,6 +2616,7 @@ class MyShard:
                     event[1],
                     event[2],
                     event[3] if len(event) > 3 else None,
+                    event[4] if len(event) > 4 else None,
                 )
             except CollectionAlreadyExists:
                 pass

@@ -208,10 +208,12 @@ def sums_path(dir_path: str, index: int, ext: str = SUMS_FILE_EXT) -> str:
     return os.path.join(dir_path, file_name(index, ext))
 
 
-def load(dir_path: str, index: int) -> Optional[TableSums]:
+def load(
+    dir_path: str, index: int, ext: str = SUMS_FILE_EXT
+) -> Optional[TableSums]:
     """Sidecar for a live table, or None (legacy/unverified — missing
     file, short file, failed self-check, unknown version)."""
-    path = sums_path(dir_path, index)
+    path = sums_path(dir_path, index, ext)
     try:
         with open(path, "rb") as f:
             blob = f.read()

@@ -75,6 +75,13 @@ class CompactionStats:
         self.sidecar_inline = 0
         self.sidecar_posthoc = 0
         self.posthoc_bytes_reread = 0
+        # Secondary-index maintenance (ISSUE 17): bytes of .fidx runs
+        # written alongside flush/compaction outputs.  Kept OUT of
+        # bytes_written/bytes_read — runs are built from the writers'
+        # still-resident buffers, so they add zero data reads and
+        # read_amplification stays a pure data-plane measure; their
+        # cost is reported as index_maintenance_amplification.
+        self.index_bytes_written = 0
 
     def note_merge(
         self, input_bytes: int, bytes_written: int
@@ -107,6 +114,11 @@ class CompactionStats:
                 self.posthoc_bytes_reread += int(reread_bytes)
                 self.bytes_read += int(reread_bytes)
 
+    def note_index(self, nbytes: int) -> None:
+        """One index run emitted inline with a flush/merge output."""
+        with self._lock:
+            self.index_bytes_written += int(nbytes)
+
     def stats(self) -> dict:
         from . import native as native_mod
 
@@ -116,6 +128,13 @@ class CompactionStats:
                     self.bytes_read / self.merge_input_bytes, 3
                 )
                 if self.merge_input_bytes > 0
+                else None
+            )
+            idx_amp = (
+                round(
+                    self.index_bytes_written / self.bytes_written, 4
+                )
+                if self.bytes_written > 0
                 else None
             )
             block = {
@@ -128,6 +147,8 @@ class CompactionStats:
                 "sidecar_posthoc": self.sidecar_posthoc,
                 "posthoc_bytes_reread": self.posthoc_bytes_reread,
                 "read_amplification": amp,
+                "index_bytes_written": self.index_bytes_written,
+                "index_maintenance_amplification": idx_amp,
             }
         overlap = native_mod.read_overlap_stats()
         block["overlapped_read_passes"] = overlap[0]
@@ -159,6 +180,14 @@ class CompactionStrategy(ABC):
     # (reference behavior; tests/benches constructing strategies
     # directly are unchanged).  Set per merge by LSMTree.compact.
     tombstone_drop_before = None
+
+    # Secondary-index DDL (ISSUE 17): when LSMTree.compact sets this
+    # to the collection's indexed field list, the merge also emits a
+    # compact_fidx index run for its output — extracted from the
+    # output records while they are STILL RESIDENT in the writer
+    # (zero extra data reads), never by re-reading the triplet.
+    # None (the default) = no index emission.
+    index_fields = None
 
     def _tick(self) -> None:
         t = self.throttle
@@ -214,6 +243,13 @@ class HeapMergeStrategy(CompactionStrategy):
         keys: List[bytes] = []
         last_key: Optional[bytes] = None
         popped = 0
+        # Index-run extraction (ISSUE 17): collected AS entries
+        # stream through the writer — the values are in hand, so the
+        # run costs zero re-reads even on this per-entry path.
+        idx_rows: Optional[List[Tuple[int, bytes]]] = (
+            [] if self.index_fields else None
+        )
+        run_off = 0
         while heap:
             popped += 1
             if popped % 8192 == 0:
@@ -234,6 +270,9 @@ class HeapMergeStrategy(CompactionStrategy):
                 # resurrect the deleted value.
             writer.write(key, value, ~_nts)
             keys.append(key)
+            if idx_rows is not None:
+                idx_rows.append((run_off, value))
+            run_off += ENTRY_HEADER_SIZE + len(key) + len(value)
         data_size = writer.close()
         wrote_bloom = False
         bloom_bytes = None
@@ -252,6 +291,16 @@ class HeapMergeStrategy(CompactionStrategy):
             bloom_bytes,
             ext=COMPACT_SUMS_FILE_EXT,
         )
+        if idx_rows is not None:
+            from . import secondary_index as si
+
+            si.emit_run(
+                dir_path,
+                output_index,
+                self.index_fields,
+                idx_rows,
+                compact=True,
+            )
         return MergeResult(writer.entries_written, data_size, wrote_bloom)
 
 
@@ -290,7 +339,7 @@ class ColumnarMergeStrategy(CompactionStrategy):
         order = perm[keep]
         return write_output_columnar(
             cols, order, dir_path, output_index, cache, bloom_min_size,
-            throttle=self.throttle,
+            throttle=self.throttle, index_fields=self.index_fields,
         )
 
 
@@ -316,6 +365,7 @@ def write_output_columnar(
     cache: Optional[PartitionPageCache],
     bloom_min_size: int,
     throttle=None,
+    index_fields=None,
 ) -> MergeResult:
     """Bulk-write the compact_* triplet from a surviving-record order."""
     full_sizes = cols.full_size[order].astype(np.uint64)
@@ -389,6 +439,34 @@ def write_output_columnar(
         bloom_bytes,
         ext=COMPACT_SUMS_FILE_EXT,
     )
+    if index_fields:
+        # Index run (ISSUE 17) sliced straight out of the gathered
+        # output blob still resident in RAM — zero re-reads.
+        from . import secondary_index as si
+
+        dview = memoryview(data_arr)
+        offs = index_arr["offset"].tolist()
+        kss = index_arr["key_size"].tolist()
+        fss = index_arr["full_size"].tolist()
+        si.emit_run(
+            dir_path,
+            output_index,
+            index_fields,
+            (
+                (
+                    offs[i],
+                    bytes(
+                        dview[
+                            offs[i]
+                            + ENTRY_HEADER_SIZE
+                            + kss[i] : offs[i] + fss[i]
+                        ]
+                    ),
+                )
+                for i in range(n)
+            ),
+            compact=True,
+        )
     return MergeResult(n, data_size, wrote_bloom)
 
 

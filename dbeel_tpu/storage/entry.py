@@ -45,6 +45,13 @@ COMPACT_ACTION_FILE_EXT = "compact_action"
 # Per-block CRC32 sidecar (storage/checksums.py) — no reference analog.
 SUMS_FILE_EXT = "sums"
 COMPACT_SUMS_FILE_EXT = "compact_sums"
+# Secondary index run + its CRC sidecar (storage/secondary_index.py):
+# built inline by flush/compaction, renamed/retired by the same action
+# journal as the data triplet.
+FIDX_FILE_EXT = "fidx"
+FIDX_SUMS_FILE_EXT = "fidx_sums"
+COMPACT_FIDX_FILE_EXT = "compact_fidx"
+COMPACT_FIDX_SUMS_FILE_EXT = "compact_fidx_sums"
 
 # Zero-padded index in file names so lexicographic order == numeric order
 # (reference INDEX_PADDING = 20, mod.rs:21).

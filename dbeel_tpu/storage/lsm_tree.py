@@ -27,6 +27,7 @@ import shutil
 from typing import AsyncIterator, Callable, List, Optional, Sequence, Tuple
 
 import msgpack
+import numpy as np
 
 from .. import flow_events
 from ..errors import (
@@ -47,9 +48,13 @@ from .entry import (
     COMPACT_ACTION_FILE_EXT,
     COMPACT_BLOOM_FILE_EXT,
     COMPACT_DATA_FILE_EXT,
+    COMPACT_FIDX_FILE_EXT,
+    COMPACT_FIDX_SUMS_FILE_EXT,
     COMPACT_INDEX_FILE_EXT,
     COMPACT_SUMS_FILE_EXT,
     DATA_FILE_EXT,
+    FIDX_FILE_EXT,
+    FIDX_SUMS_FILE_EXT,
     INDEX_FILE_EXT,
     MEMTABLE_FILE_EXT,
     SUMS_FILE_EXT,
@@ -131,8 +136,13 @@ class LSMTree:
         strategy: Optional[CompactionStrategy] = None,
         memtable_kind: str = "sorted",
         gc_grace_s: float = 0.0,
+        index_fields: Optional[list] = None,
     ) -> None:
         self.dir_path = dir_path
+        # Secondary-index DDL (ISSUE 17): value fields whose per-table
+        # index runs the flush/compaction writers emit inline and the
+        # scan planner consults.  None/empty = no index maintenance.
+        self.index_fields = list(index_fields) if index_fields else None
         self.cache = cache
         self.capacity = capacity
         self.wal_sync = wal_sync
@@ -245,6 +255,12 @@ class LSMTree:
         self._scan_stage = None
         self._scan_stage_key: Optional[tuple] = None
         self._scan_stage_list: Optional[SSTableList] = None
+        # Secondary-index runs (ISSUE 17): table index -> IndexRun (or
+        # None for absent/torn), loaded lazily off-loop by the scan
+        # planner; invalidated with the scan stage.  Quarantined run
+        # indices never reload until the table itself turns over.
+        self._index_runs: dict = {}
+        self._fidx_quarantined: set = set()
 
         self.flush_start_event = LocalEvent()
         self.flush_done_event = LocalEvent()
@@ -291,6 +307,8 @@ class LSMTree:
                 COMPACT_INDEX_FILE_EXT,
                 COMPACT_BLOOM_FILE_EXT,
                 COMPACT_SUMS_FILE_EXT,
+                COMPACT_FIDX_FILE_EXT,
+                COMPACT_FIDX_SUMS_FILE_EXT,
             ):
                 os.unlink(os.path.join(self.dir_path, name))
 
@@ -1096,6 +1114,23 @@ class LSMTree:
                         else:
                             compaction_stats.note_sidecar(True)
                         compaction_stats.note_flush(written)
+                        if self.index_fields:
+                            # Index run (ISSUE 17): extracted from the
+                            # arena's RAM dump — the same records the
+                            # C writer just emitted — so building it
+                            # reads zero data-file bytes.
+                            from . import secondary_index as si
+
+                            nb = si.emit_run(
+                                self.dir_path,
+                                flush_index,
+                                self.index_fields,
+                                si.rows_from_items(
+                                    flushing.sorted_items()
+                                ),
+                                compact=False,
+                            )
+                            compaction_stats.note_index(nb)
 
                     await asyncio.get_event_loop().run_in_executor(
                         None, _native_flush
@@ -1188,6 +1223,21 @@ class LSMTree:
             + len(items) * 16
             + (len(bloom_bytes) if bloom_bytes is not None else 0)
         )
+        # getattr: golden-writer tests drive this method on a bare
+        # LSMTree.__new__ skeleton that never ran __init__.
+        if getattr(self, "index_fields", None):
+            # Index run (ISSUE 17) from the same in-RAM items the
+            # writer just serialized — zero data-file reads.
+            from . import secondary_index as si
+
+            nb = si.emit_run(
+                self.dir_path,
+                index,
+                self.index_fields,
+                si.rows_from_items(items),
+                compact=False,
+            )
+            compaction_stats.note_index(nb)
 
     # ------------------------------------------------------------------
     # Compaction (lsm_tree.rs:950-1156)
@@ -1261,6 +1311,10 @@ class LSMTree:
                 if not keep_tombstones and self.gc_grace_s > 0
                 else None
             )
+            # Index DDL rides the strategy the same way (ISSUE 17):
+            # every built-in merge emits a compact_fidx run from its
+            # still-resident output buffers when this is set.
+            self.strategy.index_fields = self.index_fields
             merge_async = getattr(self.strategy, "merge_async", None)
             if merge_async is not None:
                 result = await merge_async(
@@ -1401,6 +1455,44 @@ class LSMTree:
                 ),
             ]
         )
+        # Secondary-index run (ISSUE 17): when the merge emitted one,
+        # it rides the SAME action journal — data and index runs
+        # rename (and below, retire) in lockstep, so a crash replay
+        # can never leave one without the other.
+        compact_fidx = os.path.join(
+            self.dir_path,
+            file_name(output_index, COMPACT_FIDX_FILE_EXT),
+        )
+        if os.path.exists(compact_fidx):
+            try:
+                compaction_stats.note_index(
+                    os.path.getsize(compact_fidx)
+                )
+            except OSError:
+                pass
+            renames.append(
+                [
+                    compact_fidx,
+                    os.path.join(
+                        self.dir_path,
+                        file_name(output_index, FIDX_FILE_EXT),
+                    ),
+                ]
+            )
+            renames.append(
+                [
+                    os.path.join(
+                        self.dir_path,
+                        file_name(
+                            output_index, COMPACT_FIDX_SUMS_FILE_EXT
+                        ),
+                    ),
+                    os.path.join(
+                        self.dir_path,
+                        file_name(output_index, FIDX_SUMS_FILE_EXT),
+                    ),
+                ]
+            )
         deletes = [p for t in inputs for p in t.paths()]
         action_path = os.path.join(
             self.dir_path, file_name(output_index, COMPACT_ACTION_FILE_EXT)
@@ -1562,6 +1654,13 @@ class LSMTree:
             self._scan_stage_key = None
             self._scan_stage_list.release()
             self._scan_stage_list = None
+        # Index runs are per-table immutable artifacts, but the cache
+        # is keyed by table index; a table-list swap (flush/compaction/
+        # quarantine) can retire an index and a later table can reuse
+        # nothing — still, drop with the stage so stale runs never
+        # outlive the tables they describe.
+        if self._index_runs:
+            self._index_runs = {}
 
     async def _current_scan_stage(self):
         """The cached vectorized stage for the CURRENT tree state, or
@@ -1708,6 +1807,145 @@ class LSMTree:
             with_values,
         )
 
+    def _quarantine_index_run(self, tidx: int) -> None:
+        """Contain a corrupt secondary-index run WITHOUT touching its
+        data table: the run is a derived artifact, so it moves to
+        quarantine/ alone (the triplet keeps serving) and the caller
+        surfaces a retryable CorruptedFile — the client's retry
+        replans without the run."""
+        from . import secondary_index as si
+
+        if tidx in self._fidx_quarantined:
+            return
+        self._fidx_quarantined.add(tidx)
+        self._index_runs[tidx] = None
+        self.durability["checksum_failures"] += 1
+        si.index_stats.note_quarantine()
+        fidx_p, fsums_p = si.run_paths(self.dir_path, tidx)
+        qdir = os.path.join(self.dir_path, QUARANTINE_DIR)
+        log.error(
+            "quarantining corrupt index run %s (data table stays "
+            "live)",
+            fidx_p,
+        )
+
+        def _move():
+            os.makedirs(qdir, exist_ok=True)
+            for p in (fidx_p, fsums_p):
+                try:
+                    if os.path.exists(p):
+                        os.replace(
+                            p,
+                            os.path.join(qdir, os.path.basename(p)),
+                        )
+                except OSError:
+                    log.warning(
+                        "index-run quarantine move failed for %s", p
+                    )
+
+        # The loader reads the whole file and closes it, so nothing
+        # holds the run open — the move needs no reader drain.
+        asyncio.get_event_loop().run_in_executor(None, _move)
+
+    async def _load_index_runs(self, stage) -> dict:
+        """stage source position -> IndexRun for every staged table
+        with a usable run, loading uncached runs off-loop.  A
+        provably-corrupt run quarantines (alone) and raises a
+        retryable CorruptedFile tagged ``index_run_only``."""
+        from . import secondary_index as si
+
+        runs_by_src: dict = {}
+        loop = asyncio.get_event_loop()
+        for s, source in enumerate(stage.sources):
+            if isinstance(source, list):
+                continue
+            tidx = source.table.index
+            if tidx in self._fidx_quarantined:
+                continue
+            if tidx not in self._index_runs:
+                try:
+                    run = await loop.run_in_executor(
+                        None, si.load_run, self.dir_path, tidx
+                    )
+                except CorruptedFile as e:
+                    self._quarantine_index_run(tidx)
+                    e.index_run_only = True
+                    raise
+                self._index_runs[tidx] = run
+            run = self._index_runs[tidx]
+            if run is not None:
+                runs_by_src[s] = run
+        return runs_by_src
+
+    async def _scan_filter_indexed(
+        self,
+        stage,
+        start: int,
+        end: int,
+        start_after,
+        prefix,
+        limit: int,
+        max_bytes: int,
+        where,
+        agg,
+    ):
+        """Index-planned page: ``(pos, more, sbytes, matched,
+        partial)`` or None (planner miss — the caller runs the
+        vectorized evaluator).  The window cut is the exact
+        ``select_window`` the non-indexed path uses; only the
+        EVALUATION shrinks, to a golden ``match_entry`` re-check of
+        the index's candidate rows — so results, covers and
+        accounting cannot diverge."""
+        from .. import query as Q
+        from . import query_vec
+        from . import secondary_index as si
+        from .entry import ENTRY_HEADER_SIZE
+
+        runs_by_src = await self._load_index_runs(stage)
+
+        def _plan_and_select():
+            cand = si.candidate_mask(
+                stage, where, runs_by_src, self.index_fields
+            )
+            if cand is None:
+                return None
+            pos, more, sbytes = stage.select_window(
+                start, end, start_after, prefix, limit, max_bytes
+            )
+            flags = np.zeros(pos.size, dtype=bool)
+            csub = np.flatnonzero(cand[pos])
+            vlen = stage.vlen
+            for i in csub.tolist():
+                p = int(pos[i])
+                if vlen[p] == 0:
+                    continue  # tombstone: matches nothing
+                source = stage.sources[int(stage.src[p])]
+                if isinstance(source, list):
+                    value = source[int(stage.off[p])][1]
+                else:
+                    value = source.value_at(
+                        int(stage.off[p])
+                        + ENTRY_HEADER_SIZE
+                        + int(stage.klen[p]),
+                        int(vlen[p]),
+                    )
+                if Q.match_entry(where, stage.key_at(p), value):
+                    flags[i] = True
+            matched = pos[flags]
+            partial = (
+                query_vec.agg_partial_for(stage, matched, agg)
+                if agg is not None
+                else None
+            )
+            return pos, more, sbytes, matched, partial
+
+        # Candidate-mask searchsorteds + per-candidate value reads:
+        # off-loop (a selective predicate touches few values, but the
+        # membership probe is O(stage rows) per leaf).
+        return await asyncio.get_event_loop().run_in_executor(
+            None, _plan_and_select
+        )
+
     async def scan_filter_page(
         self,
         start: int,
@@ -1751,6 +1989,52 @@ class LSMTree:
             if hold_list is not None:
                 hold_list.acquire()
         try:
+            # Secondary-index plan (ISSUE 17): when this collection
+            # declares indexed fields and the spec is plannable
+            # (predicate present, drop mode, no agg or count — other
+            # aggs need the full field column anyway), consult the
+            # per-table index runs to shrink the exact evaluation to
+            # the candidate rows inside the SAME select_window cut.
+            # Windows, covers and scanned-byte accounting are shared
+            # with the non-indexed path, so results stay
+            # byte-identical; a planner miss falls through to the
+            # vectorized evaluator below.
+            if (
+                self.index_fields
+                and where is not None
+                and mode == Q.MODE_DROP
+                and (agg is None or agg.get("op") == "count")
+            ):
+                got = await self._scan_filter_indexed(
+                    stage, start, end, start_after, prefix, limit,
+                    max_bytes, where, agg,
+                )
+                if got is not None:
+                    pos, more, sbytes, matched, partial = got
+                    cover = (
+                        stage.key_at(int(pos[-1]))
+                        if pos.size
+                        else None
+                    )
+                    entries = []
+                    if agg is None:
+                        for j in range(0, len(matched), 512):
+                            entries.extend(
+                                stage.entries_at(
+                                    matched[j : j + 512],
+                                    with_values,
+                                )
+                            )
+                            await asyncio.sleep(0)
+                    return (
+                        entries,
+                        more,
+                        cover,
+                        int(pos.size),
+                        int(sbytes),
+                        partial,
+                        "indexed",
+                    )
             need_build = bool(
                 Q.spec_fields(where, agg)
                 - set(stage._field_cols)
@@ -1854,15 +2138,19 @@ class LSMTree:
             # page: quarantine the attributed table so repair starts
             # NOW, then error retryably (the coordinator stream dies
             # and the client resumes elsewhere) — same contract as
-            # the unfiltered staged path.
-            self.quarantine_by_exception(
-                e,
-                [
-                    s.table
-                    for s in stage.sources
-                    if not isinstance(s, list)
-                ],
-            )
+            # the unfiltered staged path.  A corrupt INDEX RUN is
+            # contained separately (_quarantine_index_run): the data
+            # triplet is untouched, so it must NOT be quarantined
+            # off the run's path attribution.
+            if not getattr(e, "index_run_only", False):
+                self.quarantine_by_exception(
+                    e,
+                    [
+                        s.table
+                        for s in stage.sources
+                        if not isinstance(s, list)
+                    ],
+                )
             raise
         finally:
             if hold_list is not None:

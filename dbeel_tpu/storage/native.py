@@ -1091,4 +1091,44 @@ class NativeMergeStrategy(CompactionStrategy):
             ext=checksums.COMPACT_SUMS_FILE_EXT,
         )
 
+        if self.index_fields and n_out > 0:
+            # Index run (ISSUE 17): extracted from the SAME resident
+            # out_data/out_index buffers the C merge just filled —
+            # like the inline sidecar above, it adds zero data-file
+            # reads.
+            from . import secondary_index as si
+
+            irec = np.frombuffer(
+                out_index[: n_out * 16].tobytes(),
+                dtype=np.dtype(
+                    [
+                        ("offset", "<u8"),
+                        ("key_size", "<u4"),
+                        ("full_size", "<u4"),
+                    ]
+                ),
+            )
+            dview = memoryview(out_data)
+            offs = irec["offset"].tolist()
+            kss = irec["key_size"].tolist()
+            fss = irec["full_size"].tolist()
+            si.emit_run(
+                dir_path,
+                output_index,
+                self.index_fields,
+                (
+                    (
+                        offs[i],
+                        bytes(
+                            dview[
+                                offs[i] + 16 + kss[i] : offs[i]
+                                + fss[i]
+                            ]
+                        ),
+                    )
+                    for i in range(int(n_out))
+                ),
+                compact=True,
+            )
+
         return MergeResult(int(n_out), int(data_size), wrote_bloom)

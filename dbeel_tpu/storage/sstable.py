@@ -55,6 +55,17 @@ class SSTable:
             dir_path, file_name(index, BLOOM_FILE_EXT)
         )
         self.sums_path = checksums.sums_path(dir_path, index)
+        # Secondary index run + sidecar (may not exist): included in
+        # paths() so the run retires/quarantines in lockstep with its
+        # data triplet.
+        from .entry import FIDX_FILE_EXT, FIDX_SUMS_FILE_EXT
+
+        self.fidx_path = os.path.join(
+            dir_path, file_name(index, FIDX_FILE_EXT)
+        )
+        self.fidx_sums_path = os.path.join(
+            dir_path, file_name(index, FIDX_SUMS_FILE_EXT)
+        )
         # CRC sidecar (checksums.py): None = legacy/unverified table
         # (pre-checksum store, or a sidecar that failed its own
         # trailer CRC) — it opens read-only as ever, just without
@@ -129,6 +140,8 @@ class SSTable:
             self.index_path,
             self.bloom_path,
             self.sums_path,
+            self.fidx_path,
+            self.fidx_sums_path,
         )
 
     @property

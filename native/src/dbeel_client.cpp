@@ -1341,16 +1341,35 @@ int64_t dbeel_cli_scan_chunk(void* h, const char* ip, uint16_t port,
   return (int64_t)body.size();
 }
 
-int dbeel_cli_create_collection(void* h, const char* name,
-                                uint32_t rf) {
+// index_csv: comma-separated secondary-index field names (ISSUE 17),
+// or null/empty for none — keeps the exported ABI flat (no array
+// marshalling through ctypes).
+static int create_collection_impl(void* h, const char* name, uint32_t rf,
+                                  const char* index_csv) {
   Client* c = static_cast<Client*>(h);
+  std::vector<std::string> fields;
+  if (index_csv != nullptr) {
+    std::string csv(index_csv);
+    size_t pos = 0;
+    while (pos <= csv.size()) {
+      size_t comma = csv.find(',', pos);
+      if (comma == std::string::npos) comma = csv.size();
+      if (comma > pos) fields.push_back(csv.substr(pos, comma - pos));
+      pos = comma + 1;
+    }
+  }
   MpBuf m;
-  m.map_header(4);
+  m.map_header(fields.empty() ? 4 : 5);
   common_fields(&m, "create_collection", "", true);
   m.str("name");
   m.str(name);
   m.str("replication_factor");
   m.uint(rf);
+  if (!fields.empty()) {
+    m.str("index");
+    m.array_header((uint32_t)fields.size());
+    for (const auto& f : fields) m.str(f);
+  }
   std::vector<uint8_t> body;
   uint8_t rtype = 0;
   if (!round_trip(c, c->seed_ip, c->seed_port, m, &body, &rtype)) {
@@ -1362,6 +1381,17 @@ int dbeel_cli_create_collection(void* h, const char* name,
     return -2;
   }
   return 0;
+}
+
+int dbeel_cli_create_collection(void* h, const char* name,
+                                uint32_t rf) {
+  return create_collection_impl(h, name, rf, nullptr);
+}
+
+int dbeel_cli_create_collection_indexed(void* h, const char* name,
+                                        uint32_t rf,
+                                        const char* index_csv) {
+  return create_collection_impl(h, name, rf, index_csv);
 }
 
 // ---- pipelined single-ops (windowed; responses drain lazily) ----

@@ -42,6 +42,7 @@ if [ "${1:-}" = "--sanitize" ]; then
             tests/test_native_client.py \
             tests/test_memtable.py \
             tests/test_compaction_sidecar.py \
+            tests/test_secondary_index.py \
             -q -m 'not slow' \
             -p no:cacheprovider -p no:xdist -p no:randomly
 fi

@@ -407,6 +407,63 @@ def test_parity_flags_status_byte_drift(tmp_path):
     ), findings
 
 
+def test_parity_clean_again_on_fresh_copy_with_ddl_tail(tmp_path):
+    # The ISSUE-17 DDL tail (quotas-then-index) parses clean on an
+    # unmodified copy — the three new pins all agree on the real tree.
+    root = _copy_fixture(tmp_path)
+    assert wire_parity.check(Repo(root)) == []
+
+
+def test_parity_flags_ddl_tail_append_drift(tmp_path):
+    # Seeded drift: the peer-request encoder loses its index append
+    # while DDL_TAIL_SLOTS still promises two optional slots — a
+    # declared index would silently never reach peers.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/cluster/messages.py",
+        "        if index:\n            frame.append(list(index))\n",
+        "",
+        count=1,
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "DDL tail drift" in msgs and "appends 1" in msgs, findings
+
+
+def test_parity_flags_ddl_handler_slot_drift(tmp_path):
+    # Seeded drift: the peer CREATE_COLLECTION handler stops reading
+    # the index slot (request[5]) the encoder emits — the index DDL
+    # would apply on the coordinator but vanish on every peer.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "request[5] if len(request) > 5 else None",
+        "None",
+        count=1,
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "never reads request[5]" in msgs, findings
+
+
+def test_parity_flags_ddl_gossip_slot_drift(tmp_path):
+    # Same class of drift on the gossip plane: event[4] is the index
+    # tail of GossipEvent.CREATE_COLLECTION.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "event[4] if len(event) > 4 else None",
+        "None",
+        count=1,
+    )
+    findings = wire_parity.check(Repo(root))
+    msgs = "\n".join(f.message for f in findings)
+    assert "never reads event[4]" in msgs, findings
+
+
 # ---------------------------------------------------------------------
 # Yield-point hazards: known-good / known-bad snippets.
 # ---------------------------------------------------------------------
@@ -648,6 +705,46 @@ def test_stats_schema_dotted_cross_object_export_accepted(tmp_path):
         ),
     )
     assert stats_schema.check(Repo(root)) == []
+
+
+def test_stats_schema_covers_secondary_index_plane(tmp_path):
+    # ISSUE 17: secondary_index.py's IndexStats counters are
+    # increment-checked like compaction.py's — a counter bumped there
+    # but dropped from the get_stats.index schema must fire.
+    root = _stats_tree(tmp_path, "class Unused:\n    pass\n")
+    os.makedirs(os.path.join(root, "dbeel_tpu/storage"))
+    with open(
+        os.path.join(root, "dbeel_tpu/storage/secondary_index.py"),
+        "w",
+    ) as f:
+        f.write(
+            _src(
+                """
+                class IndexStats:
+                    def note_quarantine(self):
+                        self.runs_quarantined += 1
+
+                    def stats(self):
+                        return {}
+                """
+            )
+        )
+    findings = stats_schema.check(Repo(root))
+    assert any(
+        "runs_quarantined" in f.message for f in findings
+    ), findings
+
+
+def test_stats_schema_real_index_counters_exported():
+    # The real tree's IndexStats block exports every counter it bumps
+    # (the clean-tree assertion test_tree_is_clean covers this too,
+    # but pin the plane explicitly so a schema regression names it).
+    findings = [
+        f
+        for f in stats_schema.check(Repo(REPO_ROOT))
+        if "secondary_index" in f.path
+    ]
+    assert findings == [], findings
 
 
 def test_stats_schema_escape_comment(tmp_path):

@@ -665,7 +665,7 @@ def test_per_collection_quota_override_round_trip(tmp_dir):
             # override (what a restart replays).
             on_disk = {
                 name: quotas
-                for name, _rf, quotas in (
+                for name, _rf, quotas, _index in (
                     shard.get_collections_from_disk()
                 )
             }

@@ -1,0 +1,462 @@
+"""Persistent secondary indexes (ISSUE 17): per-SSTable fidx runs
+emitted inline by the single-pass flush/compaction writers, riding
+the compact-action journal, retired in lockstep with their data
+tables — and the scan planner that turns indexed predicates into
+candidate sets while staying BYTE-identical to the non-indexed
+evaluator (results, covers, scanned accounting, cursor resume).
+
+Crash/corruption contracts: a crash between the journal fsync and a
+partial rename set must never strand the output's index run behind
+its data table; a bit-flipped run must quarantine ALONE (retryably,
+via its CRC sidecar) without poisoning reads of the data triplet it
+was derived from.
+"""
+
+import asyncio
+import os
+import random
+
+import msgpack
+import pytest
+
+from dbeel_tpu import query as Q
+from dbeel_tpu.errors import CorruptedFile
+from dbeel_tpu.storage import checksums
+from dbeel_tpu.storage import secondary_index as si
+from dbeel_tpu.storage.compaction import (
+    HeapMergeStrategy,
+    compaction_stats,
+)
+from dbeel_tpu.storage.entry import (
+    COMPACT_ACTION_FILE_EXT,
+    file_name,
+)
+from dbeel_tpu.storage.lsm_tree import QUARANTINE_DIR, LSMTree
+from dbeel_tpu.storage.sstable import SSTable
+
+from conftest import run
+from test_scan_plane import _random_doc, _random_where
+
+FIELDS = ["n", "s"]
+
+
+async def _fill(tree, rng, n=600, key_space=900):
+    for i in range(n):
+        k = rng.randrange(key_space)
+        await tree.set_with_timestamp(
+            msgpack.packb(f"k{k:05d}"),
+            msgpack.packb(_random_doc(rng, i)),
+            1000 + i,
+        )
+
+
+async def _page_all(tree, where, agg, limit=128, max_bytes=1 << 20):
+    """Drain a filtered scan page by page (mid-scan cursor resume via
+    start_after=cover), collecting entries, per-page accounting and
+    eval paths."""
+    out, covers, paths, partials, sa = [], [], [], [], None
+    while True:
+        (
+            es, more, cover, srows, sbytes, partial, path,
+        ) = await tree.scan_filter_page(
+            0, 0, sa, None, limit, max_bytes, True,
+            where, agg, Q.MODE_DROP,
+        )
+        out.extend(es)
+        covers.append((cover, srows, sbytes))
+        paths.append(path)
+        if partial is not None:
+            partials.append(partial)
+        if not more:
+            return out, covers, paths, partials
+        sa = cover
+
+
+# ---------------------------------------------------------------------
+# Inline emission + maintenance accounting
+# ---------------------------------------------------------------------
+
+
+def test_flush_and_compact_emit_runs_inline(tmp_dir):
+    """Flush and compaction both emit fidx runs in the SAME pass as
+    the data: no extra data-byte reads (per-pass bytes_read delta
+    still equals the merge input bytes), and the maintenance cost is
+    reported as index_maintenance_amplification."""
+
+    async def main():
+        rng = random.Random(17001)
+        before = compaction_stats.stats()
+        idx_before = si.index_stats.stats()
+        d = tmp_dir + "/t"
+        tree = LSMTree.open_or_create(
+            d, capacity=256, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        try:
+            await _fill(tree, rng, n=500)
+            await tree.flush()
+            live = [i for i, _ in tree.sstable_indices_and_sizes()]
+            assert len(live) >= 2
+            for i in live:
+                fidx, fsums = si.run_paths(d, i)
+                assert os.path.exists(fidx), i
+                assert os.path.exists(fsums), i
+                assert si.load_run(d, i) is not None, i
+            await tree.compact(live, max(live) + 1, False)
+            out = max(live) + 1
+            now = [i for i, _ in tree.sstable_indices_and_sizes()]
+            assert now == [out]
+            # Lockstep retirement: input runs went with their tables.
+            for i in live:
+                assert not os.path.exists(si.run_paths(d, i)[0])
+            assert si.load_run(d, out) is not None
+            after = compaction_stats.stats()
+            idx_after = si.index_stats.stats()
+            # Zero extra data reads: this pass read exactly its
+            # inputs even though it also built the index run.
+            assert (
+                after["bytes_read"] - before["bytes_read"]
+                == after["merge_input_bytes"]
+                - before["merge_input_bytes"]
+            )
+            assert after["sidecar_posthoc"] == before["sidecar_posthoc"]
+            # Maintenance cost is measured and attributed.
+            assert (
+                after["index_bytes_written"]
+                > before["index_bytes_written"]
+            )
+            assert after["index_maintenance_amplification"] is not None
+            assert (
+                idx_after["runs_built"] > idx_before["runs_built"]
+            )
+            assert (
+                idx_after["runs_merged"] > idx_before["runs_merged"]
+            )
+        finally:
+            tree.close()
+
+    run(main(), timeout=60)
+
+
+# ---------------------------------------------------------------------
+# Crash safety: the run rides the SAME journaled rename set
+# ---------------------------------------------------------------------
+
+
+def test_crash_mid_compaction_index_rides_journal(tmp_dir):
+    """Crash after the journal fsync with only the data rename
+    applied — the worst intermediate state.  Recovery replays the
+    journal; because the compact_fidx renames ride the SAME action,
+    the live output can never end up with a data triplet but no
+    index run (or vice versa), and the indexed scan still matches
+    the golden path after reopen."""
+
+    async def main():
+        rng = random.Random(17002)
+        d = tmp_dir + "/t"
+        tree = LSMTree.open_or_create(
+            d, capacity=256, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        await _fill(tree, rng, n=400)
+        await tree.flush()
+        live = [i for i, _ in tree.sstable_indices_and_sizes()]
+        assert len(live) >= 2
+        tree.close()
+
+        out = max(live) + 1
+        srcs = [SSTable(d, i, None) for i in live]
+        strategy = HeapMergeStrategy()
+        strategy.index_fields = FIELDS
+        strategy.merge(srcs, d, out, None, False, 1 << 30)
+
+        def p(idx, ext):
+            return os.path.join(d, file_name(idx, ext))
+
+        assert os.path.exists(p(out, "compact_fidx"))
+        renames = [
+            [p(out, "compact_data"), p(out, "data")],
+            [p(out, "compact_index"), p(out, "index")],
+            [p(out, "compact_sums"), p(out, "sums")],
+            [p(out, "compact_fidx"), p(out, "fidx")],
+            [p(out, "compact_fidx_sums"), p(out, "fidx_sums")],
+        ]
+        deletes = [q for t in srcs for q in t.paths()]
+        for t in srcs:
+            t.close()
+        action_path = p(out, COMPACT_ACTION_FILE_EXT)
+        with open(action_path, "wb") as f:
+            f.write(
+                msgpack.packb(
+                    {"renames": renames, "deletes": deletes},
+                    use_bin_type=True,
+                )
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        # CRASH: only the data rename landed.
+        os.replace(*renames[0])
+
+        tree = LSMTree.open_or_create(
+            d, capacity=256, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        try:
+            assert not os.path.exists(action_path)
+            now = [i for i, _ in tree.sstable_indices_and_sizes()]
+            assert now == [out]
+            # The journaled renames carried the index run with the
+            # triplet: both live, inputs (and their runs) gone.
+            assert checksums.load(d, out) is not None
+            assert si.load_run(d, out) is not None
+            for i in live:
+                assert not os.path.exists(p(i, "data"))
+                assert not os.path.exists(si.run_paths(d, i)[0])
+            where = Q.validate_where(["cmp", "n", ">=", 0])
+            got = await _page_all(tree, where, None)
+            assert "indexed" in got[2] or got[2], got[2]
+            tree.index_fields = None
+            tree._drop_scan_stage()
+            golden = await _page_all(tree, where, None)
+            assert got[0] == golden[0]
+            assert got[1] == golden[1]
+        finally:
+            tree.close()
+
+    run(main(), timeout=60)
+
+
+# ---------------------------------------------------------------------
+# Corruption containment: run quarantines alone, retryably
+# ---------------------------------------------------------------------
+
+
+def test_bitflip_index_run_quarantines_retryably(tmp_dir):
+    """A bit-flipped fidx run fails its CRC sidecar: the FIRST
+    indexed scan errors retryably (CorruptedFile tagged
+    index_run_only), the run — and only the run — moves to
+    quarantine/, and the RETRY serves correct results off the data
+    triplet, which never stops serving point reads."""
+
+    async def main():
+        rng = random.Random(17003)
+        d = tmp_dir + "/t"
+        tree = LSMTree.open_or_create(
+            d, capacity=4096, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        docs = {}
+        for i in range(800):
+            k = f"k{i:05d}"
+            doc = {"n": i % 37, "s": f"user{i:04d}", "i": i}
+            docs[k] = doc
+            await tree.set_with_timestamp(
+                msgpack.packb(k), msgpack.packb(doc), 1000 + i
+            )
+        await tree.flush()
+        live = [i for i, _ in tree.sstable_indices_and_sizes()]
+        assert len(live) == 1
+        tidx = live[0]
+        tree.close()
+
+        # Flip one byte in the run body (past the magic).
+        fidx_p, _ = si.run_paths(d, tidx)
+        blob = bytearray(open(fidx_p, "rb").read())
+        blob[len(blob) // 2] ^= 0x40
+        with open(fidx_p, "wb") as f:
+            f.write(bytes(blob))
+
+        tree = LSMTree.open_or_create(
+            d, capacity=4096, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        try:
+            q_before = si.index_stats.stats()["runs_quarantined"]
+            where = Q.validate_where(["cmp", "n", "==", 5])
+            with pytest.raises(CorruptedFile) as ei:
+                await tree.scan_filter_page(
+                    0, 0, None, None, 1000, 1 << 20, True,
+                    where, None, Q.MODE_DROP,
+                )
+            assert getattr(ei.value, "index_run_only", False)
+            assert (
+                si.index_stats.stats()["runs_quarantined"]
+                == q_before + 1
+            )
+            # Wait for the executor move: run (and its sidecar) in
+            # quarantine/, data triplet untouched and live.
+            qdir = os.path.join(d, QUARANTINE_DIR)
+            for _ in range(100):
+                if not os.path.exists(fidx_p):
+                    break
+                await asyncio.sleep(0.02)
+            assert not os.path.exists(fidx_p)
+            assert os.path.exists(
+                os.path.join(qdir, os.path.basename(fidx_p))
+            )
+            assert os.path.exists(
+                os.path.join(d, file_name(tidx, "data"))
+            )
+            live_now = [
+                i for i, _ in tree.sstable_indices_and_sizes()
+            ]
+            assert live_now == [tidx], "data table was poisoned"
+
+            # RETRY: serves correct results without the run.
+            es, _m, _c, srows, _b, _p1, path = (
+                await tree.scan_filter_page(
+                    0, 0, None, None, 1000, 1 << 20, True,
+                    where, None, Q.MODE_DROP,
+                )
+            )
+            assert path != "indexed"
+            want = sorted(
+                k for k, doc in docs.items() if doc["n"] == 5
+            )
+            got = sorted(
+                msgpack.unpackb(e[0], raw=False) for e in es
+            )
+            assert got == want
+            # Point reads on the data triplet still verify + serve.
+            v = await tree.get(msgpack.packb("k00007"))
+            assert msgpack.unpackb(v, raw=False) == docs["k00007"]
+        finally:
+            tree.close()
+
+    run(main(), timeout=60)
+
+
+# ---------------------------------------------------------------------
+# Byte-identity: randomized specs, indexed vs non-indexed
+# ---------------------------------------------------------------------
+
+
+def test_randomized_specs_indexed_byte_identical(tmp_dir):
+    """The acceptance bar: on randomized adversarial specs over an
+    adversarial doc mix (bools, huge ints, NaN-ish floats, bytes with
+    embedded NULs, missing fields, non-scalars), paging the indexed
+    planner produces byte-identical entries, covers and scanned
+    accounting — including mid-scan cursor resume — to the same tree
+    scanned with indexes disabled.  The planner must actually engage
+    at least once, or the test is vacuous."""
+
+    async def main():
+        rng = random.Random(17004)
+        d = tmp_dir + "/t"
+        tree = LSMTree.open_or_create(
+            d, capacity=512, index_fields=FIELDS,
+            memtable_kind="sorted",
+        )
+        try:
+            await _fill(tree, rng, n=700)
+            await tree.flush()
+            live = [i for i, _ in tree.sstable_indices_and_sizes()]
+            await tree.compact(live, max(live) + 1, False)
+            # Post-compaction writes: the memtable source must stay
+            # all-candidates without breaking identity.
+            for i in range(60):
+                await tree.set_with_timestamp(
+                    msgpack.packb(f"k{rng.randrange(900):05d}"),
+                    msgpack.packb(_random_doc(rng, -i)),
+                    50000 + i,
+                )
+            hits_before = si.index_stats.stats()["planner_hits"]
+            for trial in range(14):
+                where = Q.validate_where(_random_where(rng))
+                agg = None
+                if trial % 4 == 3:
+                    agg = Q.validate_agg(
+                        {"op": "count", "group": 0}
+                    )
+                limit = rng.choice([64, 256])
+                max_bytes = rng.choice([4096, 1 << 20])
+                got = await _page_all(
+                    tree, where, agg, limit, max_bytes
+                )
+                tree.index_fields = None
+                tree._drop_scan_stage()
+                try:
+                    golden = await _page_all(
+                        tree, where, agg, limit, max_bytes
+                    )
+                finally:
+                    tree.index_fields = FIELDS
+                    tree._drop_scan_stage()
+                assert got[0] == golden[0], (trial, where)
+                assert got[1] == golden[1], (trial, where)
+                if agg is not None:
+
+                    def fold(partials):
+                        st = Q.AggState(agg)
+                        for p in partials:
+                            st.fold_partial(p)
+                        return st.result()
+
+                    assert fold(got[3]) == fold(golden[3]), (
+                        trial,
+                        where,
+                    )
+            assert (
+                si.index_stats.stats()["planner_hits"] > hits_before
+            ), "planner never engaged — identity test is vacuous"
+        finally:
+            tree.close()
+
+    run(main(), timeout=120)
+
+
+# ---------------------------------------------------------------------
+# DDL: index fields round-trip collection metadata like quotas
+# ---------------------------------------------------------------------
+
+
+def test_index_ddl_round_trips_metadata(tmp_dir):
+    """create_collection(index=[...]) sanitizes, persists in the
+    collection metadata file, reloads through the disk-discovery
+    scan (what a restart replays), and reaches the tree."""
+    from harness import ClusterNode, make_config
+    from dbeel_tpu.client import DbeelClient
+
+    async def main():
+        cfg = make_config(tmp_dir)
+        node = await ClusterNode(cfg).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=1.5
+        )
+        try:
+            await client.create_collection(
+                "idxd", replication_factor=1,
+                index=["score", "name", "score", "$key"],
+            )
+            shard = node.shards[0]
+            col = shard.collections["idxd"]
+            # Sanitized: deduped + sorted, junk ($key) out.
+            assert col.index_fields == ["name", "score"]
+            assert col.tree.index_fields == ["name", "score"]
+            on_disk = {
+                name: index
+                for name, _rf, _q, index in (
+                    shard.get_collections_from_disk()
+                )
+            }
+            assert on_disk["idxd"] == ["name", "score"]
+            raw = await client._send_to(
+                *node.db_address,
+                {"type": "get_collection", "name": "idxd"},
+            )
+            assert msgpack.unpackb(raw, raw=False)["index"] == [
+                "name",
+                "score",
+            ]
+            # get_stats exposes the index plane to both clients.
+            stats = await client.get_stats()
+            assert "runs_built" in stats["index"]
+            assert (
+                "index_maintenance_amplification"
+                in stats["compaction"]
+            )
+        finally:
+            client.close()
+            await node.stop()
+
+    run(main(), timeout=60)
