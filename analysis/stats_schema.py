@@ -65,6 +65,9 @@ _STATS_FUNCS = {
     "stats",
     "snapshot",
     "_native_path_stats",
+    # Elastic membership (PR 18): the get_stats.membership block is
+    # assembled by this helper.
+    "_membership_stats",
     "queued_by_node",
     "queued_total",
     "group_commit_stats",

@@ -1064,4 +1064,75 @@ def check(repo: Repo) -> List[Finding]:
                 f"client RESPONSE_ERR={py_err}",
             )
 
+    # -- elastic membership (ISSUE 18): vnode/epoch dialect pins -----
+    # NodeMetadata grew an optional trailing per-shard token-list slot
+    # and ClusterMetadata an optional trailing epoch.  Both tails are
+    # pinned three ways: the named tail-slot constants, the encoders'
+    # append counts, and the C client's kNodeTokensSlot agreeing with
+    # the Python base tuple length (a drifted index would make every
+    # vnode cluster invisible to C-routed traffic).
+    for cls, const_name in (
+        ("NodeMetadata", "NODE_WIRE_TAIL_SLOTS"),
+        ("ClusterMetadata", "CLUSTER_WIRE_TAIL_SLOTS"),
+    ):
+        tail = _module_int_constant(messages, const_name)
+        if tail is None:
+            add(
+                repo.messages_py,
+                1,
+                f"{const_name} constant missing — the {cls} optional "
+                "wire tail (vnode tokens / membership epoch) must be "
+                "a named, lint-compared constant",
+            )
+            continue
+        n_app = _fn_append_count(messages, cls, "to_wire")
+        if n_app != tail:
+            add(
+                repo.messages_py,
+                1,
+                f"membership tail drift: {cls}.to_wire appends "
+                f"{n_app} optional slots but {const_name} is {tail} "
+                "— ring tokens or the epoch would drop off the wire",
+            )
+    node_base = _fn_base_list_len(messages, "NodeMetadata", "to_wire")
+    c_tokens_slot = _c_constexpr(client_src, "kNodeTokensSlot")
+    if c_tokens_slot is None:
+        add(
+            repo.client_cpp,
+            1,
+            "kNodeTokensSlot constexpr missing — the vnode token-list "
+            "slot index must be a named, lint-compared constant",
+        )
+    elif node_base is not None and c_tokens_slot != node_base:
+        add(
+            repo.client_cpp,
+            1,
+            f"vnode dialect drift: C client parses ring tokens at "
+            f"metadata slot {c_tokens_slot} but NodeMetadata.to_wire "
+            f"emits a {node_base}-element base tuple — C-routed "
+            "clients would shatter the ring on a vnode cluster",
+        )
+    # The write-epoch fence field must stay end-to-end: the Python
+    # client stamps request["epoch"] and db_server reads it — either
+    # side dropping it silently disables the fence (checked per side;
+    # _request_fields unions, so probe each tree against an empty
+    # counterpart).
+    _empty = ast.parse("")
+    if "epoch" not in _request_fields(db_server, _empty):
+        add(
+            repo.db_server_py,
+            1,
+            "db_server no longer reads the 'epoch' request field — "
+            "the membership-epoch write fence would be silently "
+            "inert server-side",
+        )
+    if "epoch" not in _request_fields(_empty, client):
+        add(
+            repo.client_py,
+            1,
+            "the Python client no longer stamps the 'epoch' request "
+            "field on writes — stale-ring writes would land "
+            "unfenced during migration",
+        )
+
     return findings

@@ -21,10 +21,12 @@ Invariants checked at the end (exit 1 on violation):
      count are bounded across the whole run (threads must stay flat:
      the io_uring sync hub adds none per WAL).
 
-Optional phases: ``--disk-faults`` (bit flip + ENOSPC window) and
+Optional phases: ``--disk-faults`` (bit flip + ENOSPC window),
 ``--partition`` (asymmetric partition on one node during quorum
 writes → WAL-backed hints → heal by clean restart → all replicas
-byte-agree within the hint-drain SLO).
+byte-agree within the hint-drain SLO), and ``--churn`` (elastic
+membership: >= 3 add/remove/replace cycles on the vnode ring under
+open-loop load → zero acked loss, bounded p99, byte-agreement).
 
 Usage:  python chaos_soak.py [--duration 900] [--churn-period 75]
             [--down-time 18] [--report chaos_soak_report.json]
@@ -69,6 +71,13 @@ TRACE_SAMPLE = 256
 # watchdog's verdict and the cluster_stats rollup (and the per-phase
 # telemetry ring dumps land as CI artifacts beside the trace dumps).
 TELEMETRY_INTERVAL_MS = 2000
+# Elastic membership (ISSUE 18): every soak node runs a vnode ring —
+# ownership moves in many small arcs on membership changes, which is
+# the regime the --churn phase (and the token-aware digest scan)
+# exist to exercise.  Migration streaming is governor-paced; the rate
+# is generous so quick-mode convergence never stalls on the throttle.
+VNODES = 8
+MIGRATION_KEYS_PER_SEC = 4000
 
 
 def log(*a):
@@ -118,6 +127,8 @@ class Node:
             "--anti-entropy-interval", "5000",
             "--trace-sample", str(TRACE_SAMPLE),
             "--telemetry-interval", str(TELEMETRY_INTERVAL_MS),
+            "--vnodes", str(VNODES),
+            "--migration-keys-per-sec", str(MIGRATION_KEYS_PER_SEC),
         ]
         if seeds:
             argv += ["--seed-nodes", *seeds]
@@ -679,8 +690,18 @@ async def _replica_digest_scan(client, keys, conns=None):
     node_md = {m.name: m for m in md.nodes}
     ring = []
     for m in md.nodes:
-        for sid in m.ids:
-            ring.append((hash_string(f"{m.name}-{sid}"), m.name, sid))
+        tokens = getattr(m, "tokens", None)
+        for i, sid in enumerate(m.ids):
+            # Vnode dialect: nodes advertising token lists own one
+            # ring position per token; legacy nodes derive the single
+            # token from the shard name, exactly like the servers do.
+            if tokens is not None and i < len(tokens):
+                for tok in tokens[i]:
+                    ring.append((tok, m.name, sid))
+            else:
+                ring.append(
+                    (hash_string(f"{m.name}-{sid}"), m.name, sid)
+                )
     ring.sort()
     hashes = [r[0] for r in ring]
     own_conns = conns is None
@@ -1224,6 +1245,360 @@ async def overload_phase(nodes, report, quick):
     return ok_gate
 
 
+async def _await_member_count(probe, want, timeout):
+    """Poll the serving node's cluster metadata until it advertises
+    ``want`` members.  Returns (reached, last_seen) — callers report
+    a timeout rather than hard-failing on it: the membership gates
+    are loss/p99/convergence, not gossip timing."""
+    dl = time.time() + timeout
+    last = -1
+    while time.time() < dl:
+        try:
+            md = await probe.get_cluster_metadata()
+            last = len(md.nodes)
+            if last == want:
+                return True, last
+        except Exception:
+            pass
+        await asyncio.sleep(1.0)
+    return False, last
+
+
+async def membership_churn_phase(nodes, seeds, report, quick):
+    """--churn (elastic membership plane, ISSUE 18): >= 3 full
+    add/remove/replace membership cycles against the vnode ring,
+    under sustained OPEN-LOOP foreground load (ops launch on a fixed
+    schedule, never paced by responses — membership changes cannot
+    hide behind a slowed generator).  Each cycle: a brand-new node
+    joins (addition migration streams its arcs, governor-paced), a
+    base node is SIGKILLed while the newcomer holds its data
+    (removal migration — the newcomer IS the replacement), the base
+    node rejoins, and the newcomer scales back in.  Gates:
+      * ZERO acked-write loss: every open-loop write acked at W=2
+        during the churn reads back at consistency=RF at its acked
+        version or newer;
+      * foreground p99 of ACKED ops stays bounded vs the
+        SAME-SESSION closed-loop baseline (<= max(20x baseline p99,
+        1s)) — migration streaming must ride the governor instead of
+        starving the data plane;
+      * after the dust settles, all RF replicas of every journal key
+        byte-agree (token-aware digest scan, polled to a convergence
+        deadline);
+      * the serving node's membership epoch GREW with the changes
+        (>= 1 bump per cycle) and migrations actually ran — the
+        epoch fence and the get_stats membership block are live, not
+        decorative;
+      * every base node is alive at the end, every added node came
+        up."""
+    probe = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)], op_deadline_s=5.0
+    )
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)], op_deadline_s=8.0
+    )
+    col = client.collection(COLLECTION)
+    loop = asyncio.get_event_loop()
+    t_phase0 = time.time()
+
+    # ---- same-session foreground baseline (closed loop) --------------
+    base_dur = 3.0 if quick else 8.0
+    base_lat = []
+    base_ok = 0
+    base_stop = loop.time() + base_dur
+
+    async def base_worker(wid):
+        nonlocal base_ok
+        i = 0
+        while loop.time() < base_stop:
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                await asyncio.wait_for(
+                    col.set(
+                        f"mcb{wid}x{i}", {"v": i},
+                        consistency=Consistency.fixed(2),
+                    ),
+                    10,
+                )
+                base_lat.append(time.perf_counter() - t0)
+                base_ok += 1
+            except Exception:
+                pass
+
+    t0 = time.time()
+    await asyncio.gather(*[base_worker(w) for w in range(4)])
+    base_wall = max(0.001, time.time() - t0)
+    sustainable = base_ok / base_wall
+    base_lat.sort()
+    base_p99 = (
+        base_lat[int(0.99 * (len(base_lat) - 1))]
+        if base_lat
+        else 0.05
+    )
+    log(
+        f"MEMBERSHIP: baseline {sustainable:,.0f} ops/s, "
+        f"p99 {base_p99 * 1000:.1f} ms"
+    )
+
+    md0 = await probe.get_cluster_metadata()
+    epoch0 = md0.epoch
+
+    # ---- open-loop foreground load across every cycle ----------------
+    # Half the sustainable rate: enough pressure that a starved data
+    # plane shows up in p99, low enough that the generator itself
+    # never becomes the bottleneck on a 2-core CI host.
+    rate = max(25.0, min(sustainable * 0.5, 300.0))
+    journal = {}  # key -> last acked monotone version
+    lat = []
+    fg_errors: dict = {}
+    stop_load = asyncio.Event()
+
+    async def one_op(i):
+        key = f"mc{i % 500}"
+        t0 = time.perf_counter()
+        try:
+            await asyncio.wait_for(
+                col.set(
+                    key, {"v": i},
+                    consistency=Consistency.fixed(2),
+                ),
+                20,
+            )
+            lat.append(time.perf_counter() - t0)
+            prev = journal.get(key, -1)
+            if i > prev:
+                journal[key] = i
+        except Exception as e:
+            cls = classify_error(e) or "other"
+            fg_errors[cls] = fg_errors.get(cls, 0) + 1
+
+    async def generator():
+        inflight = set()
+        seq = 0
+        carry = 0.0
+        tick = 0.02
+        while not stop_load.is_set():
+            carry += rate * tick
+            n = int(carry)
+            carry -= n
+            for _ in range(n):
+                if len(inflight) >= 800:
+                    break  # bounded client memory; counted as p99 risk
+                seq += 1
+                t = asyncio.ensure_future(one_op(seq))
+                inflight.add(t)
+                t.add_done_callback(inflight.discard)
+            await asyncio.sleep(tick)
+        if inflight:
+            await asyncio.wait(inflight, timeout=25)
+
+    gen_task = asyncio.create_task(generator())
+
+    # ---- add / remove / replace cycles -------------------------------
+    cycles = 3 if quick else 4
+    settle = 3.0 if quick else 6.0
+    down = 4.0 if quick else 10.0
+    join_to = 20.0 if quick else 60.0
+    adds = removes = replaces = 0
+    restart_failures = 0
+    member_wait_timeouts = 0
+    events = []
+    for j in range(cycles):
+        extra = Node(50 + j)  # ports clear of base + scale-churn nodes
+        log(f"MEMBERSHIP: cycle {j + 1}/{cycles} — add {extra.name}")
+        extra.start(seeds)
+        if not await wait_port(extra.db_port):
+            log(f"MEMBERSHIP: {extra.name} never came up!")
+            restart_failures += 1
+            extra.kill()
+            continue
+        adds += 1
+        reached, _ = await _await_member_count(
+            probe, N_NODES + 1, join_to
+        )
+        member_wait_timeouts += 0 if reached else 1
+        await asyncio.sleep(settle)  # addition migration under load
+
+        victim = nodes[1 + (j % (N_NODES - 1))]
+        log(f"MEMBERSHIP: remove (SIGKILL) {victim.name}")
+        victim.kill()
+        removes += 1
+        await asyncio.sleep(down)  # death gossip + removal migration
+
+        log(f"MEMBERSHIP: replace — restart {victim.name}")
+        victim.start(seeds)
+        if await wait_port(victim.db_port):
+            replaces += 1
+        else:
+            log(f"MEMBERSHIP: {victim.name} failed to come back!")
+            restart_failures += 1
+        reached, _ = await _await_member_count(
+            probe, N_NODES + 1, join_to
+        )
+        member_wait_timeouts += 0 if reached else 1
+
+        log(f"MEMBERSHIP: scale-in — SIGKILL {extra.name}")
+        extra.kill()
+        removes += 1
+        reached, _ = await _await_member_count(
+            probe, N_NODES, join_to * 2
+        )
+        member_wait_timeouts += 0 if reached else 1
+        await asyncio.sleep(settle)
+        events.append(
+            {
+                "added": extra.name,
+                "removed": victim.name,
+                "replaced_by": extra.name,
+                "rejoined": victim.name,
+            }
+        )
+
+    stop_load.set()
+    await gen_task
+    window_s = time.time() - t_phase0
+
+    lat.sort()
+    churn_p99 = (
+        lat[int(0.99 * (len(lat) - 1))] if lat else float("inf")
+    )
+    p99_bound = max(20 * base_p99, 1.0)
+    p99_ok = churn_p99 <= p99_bound
+
+    md1 = await probe.get_cluster_metadata()
+    epoch1 = md1.epoch
+    epoch_ok = (epoch1 - epoch0) >= cycles
+
+    # ---- zero acked-write loss ---------------------------------------
+    lost = []
+    for key, version in sorted(journal.items()):
+        try:
+            got = await asyncio.wait_for(
+                col.get(key, consistency=Consistency.fixed(RF)), 20
+            )
+            if got["v"] < version:
+                lost.append(
+                    (key, f"acked v{version}, read v{got['v']}")
+                )
+        except Exception as e:
+            lost.append(
+                (key, f"acked v{version}: {repr(e)[:80]}")
+            )
+    if lost:
+        log("MEMBERSHIP ACKED-WRITE LOSS:", lost[:10])
+
+    # ---- replicas byte-agree after the dust settles ------------------
+    t_conv0 = time.time()
+    conv_deadline = t_conv0 + (120 if quick else 180)
+    scan_conns: dict = {}
+    try:
+        while True:
+            divergent = await _replica_digest_scan(
+                probe, sorted(journal), scan_conns
+            )
+            if not divergent or time.time() > conv_deadline:
+                break
+            log(
+                f"MEMBERSHIP: {len(divergent)} keys divergent; "
+                "waiting on anti-entropy ..."
+            )
+            await asyncio.sleep(5)
+    finally:
+        for c in scan_conns.values():
+            c.close_pool()
+    convergence_s = round(time.time() - t_conv0, 1)
+
+    # ---- membership stats block + migration evidence -----------------
+    membership_block = None
+    migrations_started = 0
+    keys_migrated = 0
+    fence_refusals = 0
+    for n in nodes:
+        if not n.alive():
+            continue
+        cl = None
+        try:
+            cl = await DbeelClient.from_seed_nodes(
+                [("127.0.0.1", n.db_port)], op_deadline_s=5.0
+            )
+            mb = (await cl.get_stats()).get("membership")
+            if mb:
+                if membership_block is None:
+                    membership_block = mb
+                migrations_started += mb.get(
+                    "migrations_started", 0
+                )
+                keys_migrated += mb.get("keys_migrated", 0)
+                fence_refusals += mb.get("fence_refusals", 0)
+        except Exception as e:
+            log(f"membership stats from {n.name} failed: {e!r}")
+        finally:
+            if cl is not None:
+                cl.close()
+    stats_block_ok = bool(membership_block) and {
+        "epoch",
+        "vnodes",
+        "arcs_owned",
+        "migrations_active",
+        "keys_migrated",
+        "fence_refusals",
+    } <= set(membership_block or ())
+    migrations_seen = migrations_started > 0
+
+    nodes_alive = all(n.alive() for n in nodes)
+    ok_gate = (
+        nodes_alive
+        and not lost
+        and not divergent
+        and p99_ok
+        and epoch_ok
+        and migrations_seen
+        and stats_block_ok
+        and restart_failures == 0
+        and adds == cycles
+    )
+    report["churn"] = {
+        "window_s": round(window_s, 1),
+        "cycles": cycles,
+        "adds": adds,
+        "removes": removes,
+        "replaces": replaces,
+        "events": events,
+        "member_wait_timeouts": member_wait_timeouts,
+        "restart_failures": restart_failures,
+        "open_loop_ops_per_s": round(rate, 1),
+        "fg_acked": len(lat),
+        "fg_errors_by_class": fg_errors,
+        "baseline_p99_ms": round(base_p99 * 1000, 1),
+        "churn_p99_ms": (
+            round(churn_p99 * 1000, 1)
+            if churn_p99 != float("inf")
+            else None
+        ),
+        "p99_bound_ms": round(p99_bound * 1000, 1),
+        "p99_ok": p99_ok,
+        "journal_keys": len(journal),
+        "acked_writes_lost": len(lost),
+        "loss_samples": lost[:10],
+        "divergent_keys": len(divergent),
+        "convergence_s": convergence_s,
+        "epoch_initial": epoch0,
+        "epoch_final": epoch1,
+        "epoch_ok": epoch_ok,
+        "migrations_started": migrations_started,
+        "keys_migrated": keys_migrated,
+        "fence_refusals": fence_refusals,
+        "stats_membership_block": stats_block_ok,
+        "migrations_seen": migrations_seen,
+        "nodes_alive": nodes_alive,
+        "pass": ok_gate,
+    }
+    log("MEMBERSHIP churn:", json.dumps(report["churn"])[:800])
+    probe.close()
+    client.close()
+    return ok_gate
+
+
 async def scan_phase(nodes, seeds, acks, report, quick):
     """--scan (streaming scan plane, ISSUE 12; filtered stream,
     ISSUE 13): full-collection scans AND predicate-pushdown scans
@@ -1433,6 +1808,15 @@ async def main():
         "get_stats overload block",
     )
     ap.add_argument(
+        "--churn", action="store_true",
+        help="after the base kill/restart loop: >= 3 full add/remove/"
+        "replace membership cycles on the vnode ring under open-loop "
+        "foreground load; assert zero acked-write loss, foreground "
+        "p99 bounded vs the same-session baseline, replicas byte-"
+        "agree within the convergence deadline, and the membership "
+        "epoch + migration counters moved",
+    )
+    ap.add_argument(
         "--scan", action="store_true",
         help="after churn: full-collection streaming scans while one "
         "node SIGKILLs and heals mid-stream — scans must keep "
@@ -1592,6 +1976,19 @@ async def main():
         health_phases["scan"] = await collect_health(
             nodes, "scan", args.trace_dump_dir
         )
+    if args.churn:
+        ok = (
+            await membership_churn_phase(
+                nodes, seeds, report, args.quick
+            )
+        ) and ok
+        await collect_traces(nodes, "membership", args.trace_dump_dir)
+        health_phases["membership"] = await collect_health(
+            nodes, "membership", args.trace_dump_dir
+        )
+        # Let hinted handoff / anti-entropy settle the churn phase's
+        # writes before the final whole-journal divergence scan.
+        await asyncio.sleep(min(args.quiet_window, 10.0))
     ok = (await final_checks(nodes, acks, report)) and ok
     # Tracing plane (ISSUE 9): where did the slow tail's time go?
     final_dumps = await collect_traces(

@@ -233,6 +233,7 @@ class DbeelClient:
         self._ring: List[_RingShard] = []
         self._ring_hashes: List[int] = []
         self._collections: dict = {}
+        self._cluster_epoch = 0
         self._pooled = pooled
         self._pool: dict = {}  # (host, port) -> [(reader, writer)]
         self._pipeline_window = pipeline_window
@@ -295,21 +296,34 @@ class DbeelClient:
     def _apply_metadata(self, metadata: ClusterMetadata) -> None:
         ring: List[_RingShard] = []
         for node in metadata.nodes:
-            for sid in node.ids:
-                ring.append(
-                    _RingShard(
-                        node_name=node.name,
-                        hash=hash_string(f"{node.name}-{sid}"),
-                        ip=node.ip,
-                        db_port=node.db_port + sid,
+            for i, sid in enumerate(node.ids):
+                # Vnode dialect (ISSUE 18): a node that advertises
+                # per-shard token lists gets one ring entry per token;
+                # nodes without the trailing element (old peers)
+                # imply the legacy single-token derivation.
+                if node.tokens is not None and i < len(node.tokens):
+                    tokens = node.tokens[i]
+                else:
+                    tokens = [hash_string(f"{node.name}-{sid}")]
+                for h in tokens:
+                    ring.append(
+                        _RingShard(
+                            node_name=node.name,
+                            hash=h,
+                            ip=node.ip,
+                            db_port=node.db_port + sid,
+                        )
                     )
-                )
-        ring.sort(key=lambda s: s.hash)
+        ring.sort(key=lambda s: (s.hash, s.node_name))
         self._ring = ring
         self._ring_hashes = [s.hash for s in ring]
         self._collections = {
             name: rf for name, rf in metadata.collections
         }
+        # Membership epoch of the view this ring came from: stamped on
+        # writes so a server mid-migration can refuse (retryably) ops
+        # routed with a stale ring instead of misplacing them.
+        self._cluster_epoch = metadata.epoch
 
     # -- raw protocol --------------------------------------------------
 
@@ -473,6 +487,16 @@ class DbeelClient:
         last_error: Optional[Exception] = None
         while True:
             replicas = self._shards_for_key(key_hash, max(1, rf))
+            # Epoch fence (ISSUE 18): writes carry the membership epoch
+            # of the ring view that routed them, re-stamped every round
+            # so the post-resync retry carries the refreshed epoch.  A
+            # server mid-migration refuses (retryably) a write stamped
+            # with an older epoch instead of placing it by a dead view.
+            if self._cluster_epoch and request.get("type") in (
+                "set",
+                "delete",
+            ):
+                request["epoch"] = self._cluster_epoch
             not_owned = False
             # Sticky per-round transport flag (C walk parity,
             # dbeel_client.cpp): once any replica was unreachable the
@@ -722,6 +746,11 @@ class DbeelClient:
             }
             if consistency is not None:
                 request["consistency"] = consistency
+            if is_set and self._cluster_epoch:
+                # Same epoch fence as the single-op path; fenced
+                # sub-ops come back retryable and fall into the
+                # single-op walk, which resyncs and re-stamps.
+                request["epoch"] = self._cluster_epoch
             self._stamp_qos(request)
             if isinstance(trace_id, int) and trace_id > 0:
                 # Tracing plane: the whole batch frame records one

@@ -28,6 +28,22 @@ from ..utils.murmur import murmur3_32
 # both encoders' append counts and both shard.py handlers' slot reads.
 DDL_TAIL_SLOTS = 2
 
+# NodeMetadata carries this many optional trailing slots after its
+# 6-element base arity: the per-shard vnode token lists (ISSUE 18),
+# aligned with ``ids``.  Appended only when some shard owns more than
+# one token, so a --vnodes 1 node's wire form stays byte-identical to
+# the legacy dialect and old peers keep parsing it.  Pinned by
+# analysis/wire_parity against to_wire's append count and the C
+# client's slot index.
+NODE_WIRE_TAIL_SLOTS = 1
+
+# ClusterMetadata carries this many optional trailing slots after its
+# 2-element base arity: the serving node's membership epoch
+# (ISSUE 18) — clients stamp it on writes so a migration can fence
+# stale coordinators retryably.  Old arity decodes as epoch 0
+# (= never fenced).
+CLUSTER_WIRE_TAIL_SLOTS = 1
+
 
 @dataclass(frozen=True)
 class NodeMetadata:
@@ -37,9 +53,14 @@ class NodeMetadata:
     ids: List[int]
     gossip_port: int
     db_port: int
+    # Vnode dialect (ISSUE 18): per-shard ring token lists aligned
+    # with ``ids``.  None means the legacy single-token-per-shard
+    # derivation (hash_string(f"{name}-{sid}")) — what every pre-vnode
+    # peer implies by omitting the element.
+    tokens: Optional[List[List[int]]] = None
 
     def to_wire(self) -> list:
-        return [
+        w = [
             self.name,
             self.ip,
             self.remote_shard_base_port,
@@ -47,10 +68,21 @@ class NodeMetadata:
             self.gossip_port,
             self.db_port,
         ]
+        # Optional trailing slot (NODE_WIRE_TAIL_SLOTS): appended only
+        # when some shard owns more than one token, so single-token
+        # nodes stay byte-identical to the legacy dialect.
+        if self.tokens is not None and any(
+            len(t) != 1 for t in self.tokens
+        ):
+            w.append([list(t) for t in self.tokens])
+        return w
 
     @classmethod
     def from_wire(cls, w: list) -> "NodeMetadata":
-        return cls(w[0], w[1], w[2], list(w[3]), w[4], w[5])
+        tokens = None
+        if len(w) > 6 and w[6] is not None:
+            tokens = [list(t) for t in w[6]]
+        return cls(w[0], w[1], w[2], list(w[3]), w[4], w[5], tokens)
 
     def __hash__(self):
         return hash(self.name)
@@ -60,18 +92,25 @@ class NodeMetadata:
 class ClusterMetadata:
     nodes: List[NodeMetadata]
     collections: List[Tuple[str, int]]  # (name, replication_factor)
+    # Membership epoch of the serving node (ISSUE 18): optional
+    # trailing slot (CLUSTER_WIRE_TAIL_SLOTS); 0 from old peers.
+    epoch: int = 0
 
     def to_wire(self) -> list:
-        return [
+        w = [
             [n.to_wire() for n in self.nodes],
             [[name, rf] for name, rf in self.collections],
         ]
+        if self.epoch:
+            w.append(self.epoch)
+        return w
 
     @classmethod
     def from_wire(cls, w: list) -> "ClusterMetadata":
         return cls(
             [NodeMetadata.from_wire(n) for n in w[0]],
             [(c[0], c[1]) for c in w[1]],
+            w[2] if len(w) > 2 and w[2] is not None else 0,
         )
 
 

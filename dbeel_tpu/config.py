@@ -66,6 +66,16 @@ class Config:
     # beyond the cap the repair is skipped and anti-entropy catches
     # the divergence).  0 = uncapped.
     read_repair_max_per_sec: int = 256
+    # ---- Elastic membership plane (PR 18) ----------------------------
+    # Ring tokens per shard (virtual nodes).  1 keeps the reference's
+    # one-token-per-shard ring (and the legacy gossip/peers arity);
+    # higher values split each shard's ownership into many small arcs
+    # so a join/leave migrates many bounded ranges and per-shard load
+    # evens out for QoS.
+    vnodes: int = 1
+    # Migration streaming rate ceiling in keys/sec per shard, applied
+    # per batch on top of the governor's bg gate; 0 = unpaced.
+    migration_keys_per_sec: int = 0
 
     # ---- Overload-control plane (PR 5) -------------------------------
     # Per-shard load governor thresholds on the admitted-work total
@@ -306,6 +316,20 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = uncapped)",
     )
     p.add_argument(
+        "--vnodes",
+        type=int,
+        default=d.vnodes,
+        help="ring tokens per shard (virtual nodes); 1 = the legacy "
+        "one-token-per-shard ring and wire arity",
+    )
+    p.add_argument(
+        "--migration-keys-per-sec",
+        type=int,
+        default=d.migration_keys_per_sec,
+        help="migration streaming rate ceiling in keys/sec per shard "
+        "(0 = unpaced; the governor bg gate still applies)",
+    )
+    p.add_argument(
         "--overload-soft-ops",
         type=int,
         default=d.overload_soft_ops,
@@ -508,6 +532,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         hint_drain_interval_ms=ns.hint_drain_interval_ms,
         hint_drain_keys_per_sec=ns.hint_drain_keys_per_sec,
         read_repair_max_per_sec=ns.read_repair_max_per_sec,
+        vnodes=ns.vnodes,
+        migration_keys_per_sec=ns.migration_keys_per_sec,
         overload_soft_ops=ns.overload_soft_ops,
         overload_hard_ops=ns.overload_hard_ops,
         overload_compaction_debt=ns.overload_compaction_debt,

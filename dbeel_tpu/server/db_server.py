@@ -194,6 +194,31 @@ def extract_key(my_shard: MyShard, map_: dict, replica_index: int) -> bytes:
     return key
 
 
+def _check_membership_epoch(my_shard: MyShard, request: dict) -> None:
+    """Epoch fence (elastic membership plane): a write stamped with a
+    membership epoch older than this shard's — WHILE a migration is
+    live — was routed by an outdated ring view and may land on an arc
+    that is mid-handoff; refuse it retryably (`not-owned` class) so
+    the client resyncs metadata and re-routes.  Unstamped writes (old
+    clients, the C client) are never fenced — for them the ownership
+    check + anti-entropy remain the convergence story, exactly as
+    before this plane existed.  Once the last migration drains the
+    fence lifts even for stale stamps: a long-converged cluster must
+    not refuse a client that simply hasn't polled metadata lately."""
+    epoch = request.get("epoch")
+    if (
+        isinstance(epoch, int)
+        and epoch > 0
+        and epoch < my_shard.membership_epoch
+        and my_shard._migration_tasks
+    ):
+        my_shard.fence_refusals += 1
+        raise KeyNotOwnedByShard(
+            f"stale membership epoch {epoch} < "
+            f"{my_shard.membership_epoch} during migration"
+        )
+
+
 async def handle_request(
     my_shard: MyShard, request: dict
 ) -> Optional[bytes]:
@@ -326,6 +351,7 @@ async def handle_request(
         replica_index = request.get("replica_index") or 0
         col = my_shard.get_collection(collection_name)
         key = extract_key(my_shard, request, replica_index)
+        _check_membership_epoch(my_shard, request)
         rf = col.replication_factor
 
         if rtype == "set":
@@ -394,6 +420,7 @@ async def handle_request(
                 rf - replica_index - 1,
                 expected,
                 op_status=op_status,
+                key_hash=hash_bytes(key),
             )
             try:
                 await asyncio.wait_for(
@@ -531,6 +558,7 @@ async def handle_request(
                 rf - replica_index - 1,
                 ShardResponse.GET,
                 op_status=op_status,
+                key_hash=hash_bytes(key),
             )
             try:
                 if local_value is _NO_LOCAL_READ:
@@ -636,6 +664,11 @@ async def _handle_multi(
     )
     replica_index = request.get("replica_index") or 0
     col = my_shard.get_collection(collection_name)
+    if is_set:
+        # Frame-level fence: the epoch stamps the client's ring VIEW,
+        # which routed the whole batch — a stale view refuses the
+        # frame, not individual sub-ops.
+        _check_membership_epoch(my_shard, request)
     rf = col.replication_factor
     consistency = request.get("consistency")
     if not isinstance(consistency, int):
@@ -703,6 +736,35 @@ async def _handle_multi(
     return msgpack.packb(results, use_bin_type=True)
 
 
+def _group_keyed_by_replica_set(
+    my_shard: MyShard, keyed: list, number_of_nodes: int
+) -> list:
+    """Group multi-op sub-ops by their keys' replica sets (elastic
+    membership plane): under vnodes one coordinator shard owns many
+    arcs, and keys on different arcs fan to DIFFERENT downstream
+    replica nodes — one peer frame per distinct replica set keeps
+    placement exact.  With one token per shard every owned key shares
+    the shard's lone arc, so this collapses to a single group: the
+    legacy one-frame-per-batch behavior, byte for byte.  Returns
+    ``[(items, anchor_key_hash), ...]`` in first-seen order."""
+    groups: dict = {}
+    order: list = []
+    for item in keyed:
+        kh = hash_bytes(item[1])
+        names = tuple(
+            n
+            for n, _c in my_shard._replica_connections(
+                number_of_nodes, kh
+            )
+        )
+        g = groups.get(names)
+        if g is None:
+            g = groups[names] = (list(), kh)
+            order.append(g)
+        g[0].append(item)
+    return order
+
+
 async def _multi_set_keyed(
     my_shard: MyShard,
     collection_name: str,
@@ -736,21 +798,31 @@ async def _multi_set_keyed(
     try:
         local = local_batch()
         if rf > 1:
-            remote = my_shard.send_request_to_replicas(
-                ShardRequest.multi_set(
-                    collection_name,
-                    [[k, v, ts] for k, v, ts in entries],
-                    deadline_ms=int(time.time() * 1000) + timeout_ms,
-                    trace_id=_trace_id_for_peers(ctx),
-                    qos=peer_qos,
-                ),
-                consistency - 1,
-                rf - replica_index - 1,
-                ShardResponse.MULTI_SET,
-                op_status=op_status,
-            )
+            remotes = [
+                my_shard.send_request_to_replicas(
+                    ShardRequest.multi_set(
+                        collection_name,
+                        [
+                            [key, value, timestamp]
+                            for _i, key, value in items
+                        ],
+                        deadline_ms=int(time.time() * 1000)
+                        + timeout_ms,
+                        trace_id=_trace_id_for_peers(ctx),
+                        qos=peer_qos,
+                    ),
+                    consistency - 1,
+                    rf - replica_index - 1,
+                    ShardResponse.MULTI_SET,
+                    op_status=op_status,
+                    key_hash=anchor,
+                )
+                for items, anchor in _group_keyed_by_replica_set(
+                    my_shard, keyed, rf - replica_index - 1
+                )
+            ]
             await asyncio.wait_for(
-                asyncio.gather(local, remote), timeout_ms / 1000
+                asyncio.gather(local, *remotes), timeout_ms / 1000
             )
         else:
             await asyncio.wait_for(local, timeout_ms / 1000)
@@ -789,6 +861,7 @@ async def _multi_get_keyed(
     ctx = trace_mod.current()
     if ctx is not None:
         ctx.mark("prep")
+    group_results: list = []  # (items, replica_lists) per group
     try:
         # suspect_guard whenever the local read may be the ONLY
         # evidence (consistency=1 — including RF>1 with 0 remote acks
@@ -800,28 +873,41 @@ async def _multi_get_keyed(
         if rf > 1:
             # Full-entry round only: the digest prediction is a
             # per-key byte-compare trick and does not compose with
-            # one-frame-per-peer batching (ARCHITECTURE.md).
-            remote = my_shard.send_request_to_replicas(
-                ShardRequest.multi_get(
-                    collection_name,
-                    keys,
-                    deadline_ms=int(time.time() * 1000) + timeout_ms,
-                    trace_id=_trace_id_for_peers(ctx),
-                    qos=peer_qos,
-                ),
-                consistency - 1,
-                number_of_nodes,
-                ShardResponse.MULTI_GET,
-                op_status=op_status,
+            # one-frame-per-peer batching (ARCHITECTURE.md).  One
+            # frame per distinct replica set (vnodes: keys on
+            # different arcs read different replica nodes).
+            groups = _group_keyed_by_replica_set(
+                my_shard, keyed, number_of_nodes
             )
-            local_map, replica_lists = await asyncio.wait_for(
-                asyncio.gather(local, remote), timeout_ms / 1000
+            remotes = [
+                my_shard.send_request_to_replicas(
+                    ShardRequest.multi_get(
+                        collection_name,
+                        [key for _i, key in items],
+                        deadline_ms=int(time.time() * 1000)
+                        + timeout_ms,
+                        trace_id=_trace_id_for_peers(ctx),
+                        qos=peer_qos,
+                    ),
+                    consistency - 1,
+                    number_of_nodes,
+                    ShardResponse.MULTI_GET,
+                    op_status=op_status,
+                    key_hash=anchor,
+                )
+                for items, anchor in groups
+            ]
+            local_map, *per_group = await asyncio.wait_for(
+                asyncio.gather(local, *remotes), timeout_ms / 1000
             )
+            group_results = [
+                (items, lists)
+                for (items, _anchor), lists in zip(groups, per_group)
+            ]
         else:
             local_map = await asyncio.wait_for(
                 local, timeout_ms / 1000
             )
-            replica_lists = []
     except asyncio.TimeoutError:
         err = _quorum_error(my_shard, "multi_get", op_status)
         my_shard.metrics.record_error(classify_error(err))
@@ -832,36 +918,38 @@ async def _multi_get_keyed(
     finally:
         if ctx is not None:
             ctx.mark("quorum" if rf > 1 else "local")
-    aligned = [
-        r
-        for r in replica_lists
-        if isinstance(r, (list, tuple)) and len(r) == len(keys)
-    ]
-    for j, (i, key) in enumerate(keyed):
+    if rf > 1:
+        for items, replica_lists in group_results:
+            aligned = [
+                r
+                for r in replica_lists
+                if isinstance(r, (list, tuple))
+                and len(r) == len(items)
+            ]
+            for j, (i, key) in enumerate(items):
+                local_value = local_map.get(key)
+                try:
+                    win = _merge_quorum_get(
+                        my_shard,
+                        collection_name,
+                        col,
+                        key,
+                        local_value,
+                        [r[j] for r in aligned],
+                        number_of_nodes,
+                    )
+                    results[i] = [0, win]
+                except KeyNotFound as e:
+                    results[i] = [1, e.to_wire()]
+                except CorruptedFile as e:
+                    # Suspect miss (quarantine pending repair):
+                    # retryable per-sub-op error; the client re-runs
+                    # it through the single-op replica walk.
+                    my_shard.metrics.record_error(classify_error(e))
+                    results[i] = [1, e.to_wire()]
+        return
+    for i, key in keyed:
         local_value = local_map.get(key)
-        if rf > 1:
-            try:
-                win = _merge_quorum_get(
-                    my_shard,
-                    collection_name,
-                    col,
-                    key,
-                    local_value,
-                    [r[j] for r in aligned],
-                    number_of_nodes,
-                )
-                results[i] = [0, win]
-                continue
-            except KeyNotFound as e:
-                results[i] = [1, e.to_wire()]
-                continue
-            except CorruptedFile as e:
-                # Suspect miss (quarantine pending repair): retryable
-                # per-sub-op error; the client re-runs it through the
-                # single-op replica walk.
-                my_shard.metrics.record_error(classify_error(e))
-                results[i] = [1, e.to_wire()]
-                continue
         if local_value is None and col.tree.reads_suspect:
             e = CorruptedFile(
                 "local miss is suspect: quarantined table pending "
@@ -932,6 +1020,7 @@ async def _digest_quorum_round(
                 expected,
                 ShardResponse.GET_DIGEST,
                 op_status=op_status,
+                key_hash=hash_bytes(key),
             ),
             timeout_s,
         )
@@ -1077,6 +1166,7 @@ async def _read_repair(
                 number_of_acks=0,
                 number_of_nodes=number_of_nodes,
                 expected_kind=ShardResponse.SET,
+                key_hash=hash_bytes(key),
             )
         my_shard.flow.notify(FlowEvent.READ_REPAIR)
     except Exception as e:
@@ -1204,6 +1294,9 @@ async def _serve_coord(my_shard: MyShard, coord: tuple):
                     if is_delete
                     else ShardResponse.SET,
                     op_status=op_status,
+                    key_hash=(
+                        hash_bytes(key) if key else None
+                    ),
                 )
                 if defer is not None:
                     # wal-sync: the coordinator's own replica-0 write
@@ -1290,6 +1383,7 @@ async def _finish_coord_get(
         b"",  # no constant ack for gets: always unpack
         ShardResponse.GET,
         op_status=op_status,
+        key_hash=hash_bytes(key) if key else None,
     )
     try:
         values = await asyncio.wait_for(

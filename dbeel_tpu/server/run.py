@@ -193,6 +193,14 @@ async def run_shard(
     await discover_collections(my_shard)
     await discover_nodes(my_shard)
 
+    # Pick up migration journals a crash left behind — after discovery
+    # (targets re-resolve by name against the ring we just built),
+    # before serving (the resumed window's epoch fence must be up
+    # before the first client write lands).
+    from .migration import resume_migrations
+
+    await resume_migrations(my_shard)
+
     from .db_server import bind_db_server
 
     # Bind listeners before declaring the shard started, so a client

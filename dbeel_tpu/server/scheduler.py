@@ -106,7 +106,7 @@ class ShareScheduler:
 
     # -- background side ----------------------------------------------
     @asynccontextmanager
-    async def bg_slice(self):
+    async def bg_slice(self, gated: bool = True):
         """Wrap one background unit of work; idles afterwards in
         proportion to the unit's duration while foreground stays busy.
         Work an attached BgThrottle already paid for mid-unit (its
@@ -114,9 +114,17 @@ class ShareScheduler:
         self-throttling merge pays the share ratio twice.  (Concurrent
         units on other trees can tick the same scheduler inside this
         window — the subtraction then errs toward less throttling,
-        never more.)"""
+        never more.)
+
+        ``gated=False`` skips the overload-gate delay (not the payback
+        throttle): for work that slices ONE logical job into many
+        small units (migration pages), the gate is paid once by the
+        first unit — re-paying the full bounded delay per page would
+        multiply it by the page count and starve the job under a
+        sustained soft-overload signal (e.g. a near-full memtable with
+        no traffic to trigger the flush)."""
         gate = self.overload_gate
-        if gate is not None:
+        if gated and gate is not None:
             # Soft-overload delay BEFORE the unit runs: shedding
             # order is background first, serving last.
             await gate()

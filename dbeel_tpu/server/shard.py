@@ -11,6 +11,7 @@ handling and hash-range migration planning.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import json
 import logging
 import os
@@ -90,6 +91,19 @@ def is_between(item: int, start: int, end: int) -> bool:
     if end < start:
         return item >= start or item < end
     return start <= item < end
+
+
+def vnode_tokens(shard_name: str, vnodes: int) -> List[int]:
+    """Ring tokens for one shard under the virtual-node ring
+    (ISSUE 18).  Token 0 is the legacy ``hash_string(shard_name)`` so
+    a vnode node keeps its old primary position and a --vnodes 1 ring
+    is bit-identical to the reference's; token k >= 1 salts the shard
+    name, so any two cluster members derive the same token list from
+    the (name, vnodes) pair alone."""
+    tokens = [hash_string(shard_name)]
+    for k in range(1, max(1, vnodes)):
+        tokens.append(hash_string(f"{shard_name}#v{k}"))
+    return tokens
 
 
 ShardConnection = Union[LocalShardConnection, RemoteShardConnection]
@@ -201,10 +215,11 @@ class MyShard:
         # write).
         self.departed_shards: Dict[str, List[Shard]] = {}
         self.departed_at: Dict[str, float] = {}
-        # Rotated live+departed walk order, rebuilt lazily on ring or
-        # departed-set changes: _hint_departed runs on EVERY fan-out
-        # while a node is down and must not pay a sort per request.
-        self._merged_walk_cache: Optional[List[Shard]] = None
+        # Hash-sorted live+departed ring + its hash list, rebuilt
+        # lazily on ring or departed-set changes: _hint_departed runs
+        # on EVERY fan-out while a node is down and must not pay a
+        # sort per request.
+        self._merged_walk_cache: Optional[tuple] = None
         # Failure-aware request plane: nodes the failure detector (or
         # Dead gossip) declared dead.  Fan-outs treat these peers as
         # immediately failed instead of stalling into connect/read
@@ -379,11 +394,47 @@ class MyShard:
         # name-keyed dedup silently eats re-announcements from nodes
         # that crash and come back).
         self.boot_id = secrets.token_hex(4)
+        # Elastic membership plane (ISSUE 18): this shard's virtual-
+        # node ring tokens, the per-node ownership epoch every arc is
+        # fenced under (any ring change bumps it; a migration plan
+        # stamped with an older epoch aborts between batches, and a
+        # write stamped with an older epoch is refused retryably while
+        # a migration is live), the in-flight migration task set the
+        # fence cancels, and the get_stats.membership counters.
+        self.tokens = vnode_tokens(self.shard_name, config.vnodes)
+        self.membership_epoch = 1
+        self._migration_tasks: set = set()
+        self.migrations_started = 0
+        self.migrations_resumed = 0
+        self.migrations_cancelled = 0
+        self.keys_migrated = 0
+        self.bytes_migrated = 0
+        self.fence_refusals = 0
+        if config.vnodes > 1:
+            self._expand_vnode_ring()
         self.sort_consistent_hash_ring()
 
     # ------------------------------------------------------------------
     # Ring (shards.rs:657-670)
     # ------------------------------------------------------------------
+
+    def _expand_vnode_ring(self) -> None:
+        """Expand THIS node's ring entries to --vnodes tokens each
+        (__init__ receives one entry per local shard).  The extra
+        entries share the physical shard's name and connection —
+        identity on the ring is by NAME; the hash is just a token.
+        Remote nodes' entries are expanded by add_shards_of_nodes from
+        their gossiped token lists instead."""
+        expanded: List[Shard] = []
+        for s in self.shards:
+            if s.node_name != self.config.name:
+                expanded.append(s)
+                continue
+            for tok in vnode_tokens(s.name, self.config.vnodes):
+                expanded.append(
+                    Shard(s.node_name, s.name, s.connection, hash=tok)
+                )
+        self.shards = expanded
 
     def sort_consistent_hash_ring(self) -> None:
         """Ascending by hash, rotated so hashes >= self.hash come first —
@@ -408,6 +459,21 @@ class MyShard:
         dp = getattr(self, "dataplane", None)
         if dp is None:
             return
+        if len(getattr(self, "tokens", ()) or ()) > 1:
+            # Vnodes: this shard's ownership is a UNION of arcs the
+            # native single-range check can't express — keyed-op
+            # ownership gates run in Python (the C plane punts with
+            # own_mode 0).
+            dp.set_ownership(0)
+            return
+        if getattr(self, "_migration_tasks", None):
+            # Epoch fence engaged: a live migration means stale-epoch
+            # writes must be refused at the Python dispatcher — the C
+            # plane doesn't read the epoch field, so it punts keyed
+            # ops while the fence is up (restored when the last
+            # migration task drains).
+            dp.set_ownership(0)
+            return
         ring = self._sorted_hashes
         if len(ring) < 2:
             dp.set_ownership(1)
@@ -423,19 +489,36 @@ class MyShard:
 
     def add_shards_of_nodes(self, nodes: List[NodeMetadata]) -> None:
         for node in nodes:
+            # Vnode dialect: a peer that gossips token lists gets one
+            # ring entry per token, all sharing the shard's pooled
+            # connection; a legacy (or --vnodes 1) peer omits them and
+            # keeps the single derived token — mixed clusters agree
+            # on ownership because every member walks the same union
+            # of advertised tokens.
+            tokens_by_sid = {}
+            if node.tokens is not None and len(node.tokens) == len(
+                node.ids
+            ):
+                tokens_by_sid = dict(zip(node.ids, node.tokens))
             for sid in node.ids:
                 address = f"{node.ip}:{node.remote_shard_base_port + sid}"
-                self.shards.append(
-                    Shard(
-                        node_name=node.name,
-                        name=f"{node.name}-{sid}",
-                        # Ring entries are long-lived: pool their
-                        # request streams (replication fan-out latency).
-                        connection=RemoteShardConnection.from_config(
-                            address, self.config, pooled=True
-                        ),
-                    )
+                name = f"{node.name}-{sid}"
+                # Ring entries are long-lived: pool their request
+                # streams (replication fan-out latency).
+                connection = RemoteShardConnection.from_config(
+                    address, self.config, pooled=True
                 )
+                for tok in tokens_by_sid.get(sid) or [
+                    hash_string(name)
+                ]:
+                    self.shards.append(
+                        Shard(
+                            node_name=node.name,
+                            name=name,
+                            connection=connection,
+                            hash=int(tok),
+                        )
+                    )
         self.sort_consistent_hash_ring()
 
     def owns_key(self, key_hash: int, replica_index: int = 0) -> bool:
@@ -467,7 +550,10 @@ class MyShard:
             if s.node_name in nodes:
                 continue
             if found == replica_index:
-                return s.hash == self.hash
+                # Identity by NAME, not token: under vnodes this
+                # shard appears once per token and any of its entries
+                # selected by the walk means ownership.
+                return s.name == self.shard_name
             found += 1
             nodes.add(s.node_name)
         return False
@@ -517,7 +603,7 @@ class MyShard:
         while i == 0 or index != start_shard_index:
             shard = shards[index]
             if shard.node_name not in nodes:
-                if shard.hash == self.hash:
+                if shard.name == self.shard_name:
                     return True
                 found += 1
                 if found == replication_factor:
@@ -591,18 +677,23 @@ class MyShard:
     def get_node_metadata(self) -> NodeMetadata:
         # All shards of THIS node — local queues in single-process mode,
         # same-node remote entries in the per-core process launcher.
-        ids = [
-            int(s.name.rsplit("-", 1)[1])
-            for s in self.shards
-            if s.node_name == self.config.name
-        ]
+        # Under vnodes a shard appears once per ring token: dedup to
+        # physical shard ids and advertise the per-shard token lists
+        # (an optional trailing wire slot old peers ignore).
+        mine: Dict[int, set] = {}
+        for s in self.shards:
+            if s.node_name == self.config.name:
+                sid = int(s.name.rsplit("-", 1)[1])
+                mine.setdefault(sid, set()).add(s.hash)
+        ids = sorted(mine)
         return NodeMetadata(
             name=self.config.name,
             ip=self.config.ip,
             remote_shard_base_port=self.config.remote_shard_port,
-            ids=sorted(ids),
+            ids=ids,
             gossip_port=self.config.gossip_port,
             db_port=self.config.port,
+            tokens=[sorted(mine[sid]) for sid in ids],
         )
 
     def get_nodes(self) -> List[NodeMetadata]:
@@ -617,6 +708,11 @@ class MyShard:
                 (name, c.replication_factor)
                 for name, c in self.collections.items()
             ],
+            # Clients stamp this epoch on writes; a migration-time
+            # fence refuses older stamps retryably (the refused
+            # client resyncs metadata — picking up the new epoch AND
+            # the new ring — and retries).
+            epoch=self.membership_epoch,
         )
 
     # ------------------------------------------------------------------
@@ -852,13 +948,16 @@ class MyShard:
         # and deadline-drop counters, AIMD window shape, and the
         # slow-peer outbound-queue sheds summed over ring peers.
         overload = self.governor.stats()
+        # One term per physical connection: vnode entries share their
+        # shard's connection and must not multiply the sums.
+        peer_conns = {
+            id(s.connection): s.connection for s in self.shards
+        }.values()
         overload["peer_queue_sheds"] = sum(
-            getattr(s.connection, "shed_count", 0)
-            for s in self.shards
+            getattr(c, "shed_count", 0) for c in peer_conns
         )
         overload["peer_pipelined_ops"] = sum(
-            getattr(s.connection, "pipelined_ops", 0)
-            for s in self.shards
+            getattr(c, "pipelined_ops", 0) for c in peer_conns
         )
         windows = [
             conn.window
@@ -887,6 +986,11 @@ class MyShard:
             "nodes_known": len(self.nodes),
             "ring_size": len(self.shards),
             "dead_nodes": sorted(self.dead_nodes),
+            # Elastic membership plane (ISSUE 18): ownership epoch
+            # (stamped per owned arc below — every arc shares the
+            # node epoch by construction, any ring change bumps all),
+            # migration lifecycle counters and the fence refusals.
+            "membership": self._membership_stats(),
             "hints_queued": self.hint_log.queued_by_node(),
             # Replica-convergence plane (PR 4): hinted handoff,
             # quorum read-repair, background anti-entropy.
@@ -977,6 +1081,42 @@ class MyShard:
             "telemetry": self.telemetry.stats_block(),
             "health": self.telemetry.health_block(),
             "collections": collections,
+        }
+
+    def _membership_stats(self) -> dict:
+        """get_stats.membership: the elastic-membership block.  The
+        numeric leaves flatten into the telemetry ring (rates like
+        keys_migrated_per_s and the migration_stall watchdog read
+        them); arc_epochs is a list (dropped by flatten_stats) —
+        observability for humans and the churn soak, not a trend."""
+        max_rf = max(
+            (
+                c.replication_factor
+                for c in self.collections.values()
+            ),
+            default=1,
+        )
+        try:
+            arc_epochs = [
+                [start, end, self.membership_epoch]
+                for start, end, _peers in self.replica_arcs(max_rf)
+            ]
+        except Exception:  # pragma: no cover - stats must not raise
+            arc_epochs = []
+        return {
+            "epoch": self.membership_epoch,
+            "vnodes": self.config.vnodes,
+            "tokens_self": len(self.tokens),
+            "ring_tokens": len(self.shards),
+            "arcs_owned": len(arc_epochs),
+            "arc_epochs": arc_epochs,
+            "migrations_started": self.migrations_started,
+            "migrations_resumed": self.migrations_resumed,
+            "migrations_cancelled": self.migrations_cancelled,
+            "migrations_active": len(self._migration_tasks),
+            "keys_migrated": self.keys_migrated,
+            "bytes_migrated": self.bytes_migrated,
+            "fence_refusals": self.fence_refusals,
         }
 
     def absorb_health_digest(self, digest) -> None:
@@ -1218,13 +1358,19 @@ class MyShard:
 
     def sibling_connections(self) -> List[ShardConnection]:
         """Other shards of this node: asyncio queues when co-located in
-        one process, loopback TCP in the per-core process launcher."""
-        return [
-            s.connection
-            for s in self.shards
-            if s.node_name == self.config.name
-            and s.name != self.shard_name
-        ]
+        one process, loopback TCP in the per-core process launcher.
+        One connection per PHYSICAL shard (vnode entries share it)."""
+        seen: set = set()
+        out: List[ShardConnection] = []
+        for s in self.shards:
+            if (
+                s.node_name == self.config.name
+                and s.name != self.shard_name
+                and s.name not in seen
+            ):
+                seen.add(s.name)
+                out.append(s.connection)
+        return out
 
     async def _send_sibling_message(self, conn, message: list) -> None:
         if isinstance(conn, LocalShardConnection):
@@ -1439,12 +1585,14 @@ class MyShard:
         number_of_nodes: int,
         expected_kind: str,
         op_status: Optional[dict] = None,
+        key_hash: Optional[int] = None,
     ) -> List:
         """Send to the first ``number_of_nodes`` distinct-node remote
-        shards on the ring; return after ``number_of_acks`` successes,
-        drain the rest in the background.  Failed mutations become
-        hints for the unreachable node.  ``op_status`` (when given)
-        collects failure context for the caller's error frame:
+        shards on the ring (anchored at ``key_hash`` when given — see
+        ``_replica_connections``); return after ``number_of_acks``
+        successes, drain the rest in the background.  Failed mutations
+        become hints for the unreachable node.  ``op_status`` (when
+        given) collects failure context for the caller's error frame:
         ``peer_dead`` / ``peer_unreachable`` flags."""
         self._hint_departed(number_of_nodes, lambda: request)
         return await self._fan_out_to_replicas(
@@ -1455,6 +1603,9 @@ class MyShard:
             lambda: request,
             number_of_acks,
             number_of_nodes,
+            connections=self._replica_connections(
+                number_of_nodes, key_hash
+            ),
             op_status=op_status,
         )
 
@@ -1466,6 +1617,7 @@ class MyShard:
         expected_ack: bytes,
         expected_kind: str,
         op_status: Optional[dict] = None,
+        key_hash: Optional[int] = None,
     ) -> List:
         """send_request_to_replicas for a PRE-PACKED peer frame (the
         native coordinator's output): the frame bytes go out verbatim
@@ -1479,7 +1631,9 @@ class MyShard:
         the always-available fallback."""
         hint_request_fn = lambda: msgs.unpack_message(framed[4:])  # noqa: E731
         self._hint_departed(number_of_nodes, hint_request_fn)
-        connections = self._replica_connections(number_of_nodes)
+        connections = self._replica_connections(
+            number_of_nodes, key_hash
+        )
         if op_status is not None:
             # The walk targets, for PeerDead-vs-Timeout attribution
             # at the op deadline (db_server._quorum_error) — recorded
@@ -1548,67 +1702,132 @@ class MyShard:
         live fan-out may be zero nodes) still hints that primary.
         Slightly over-hints when a departed node sits just past the
         natural set (harmless: replay is an idempotent strictly-newer
-        push, and cap+TTL bound it); a departed natural replica
-        beyond the wrap can still be missed — anti-entropy is the
-        backstop for that tail."""
+        push, and cap+TTL bound it).  The walk is anchored at each
+        KEY's hash (bisect into the merged ring), not at this
+        coordinator's rotation front — under vnodes a departed node's
+        many arcs each resolve to their true per-arc replica slots."""
         if (
             not self.departed_shards
             or self.config.hint_ttl_ms <= 0
         ):
             return
-        kind = None
-        request: Optional[list] = None
-        # The merged walk: live + departed ring entries in rotated
-        # order — the replica set of the unshrunk ring.  Cached:
-        # rebuilt only when the ring or the departed set changes.
+        request = hint_request_fn()
+        kind = request[1] if len(request) > 1 else None
+        if kind in (ShardRequest.SET, ShardRequest.DELETE):
+            keys = [bytes(request[3])]
+        elif kind == ShardRequest.MULTI_SET:
+            keys = [bytes(k) for k, _v, _t in request[3]]
+        else:
+            return  # reads never hint
+        # The merged ring: live + departed token entries, hash-sorted
+        # with a parallel hash list for per-key bisect — the replica
+        # walk of the UNSHRUNK ring, anchored at each key's own hash
+        # (under vnodes a departed node owns many small arcs, and the
+        # coordinator's rotation order says nothing about which arc a
+        # key lands in).  Cached: rebuilt only when the ring or the
+        # departed set changes.
         merged = self._merged_walk_cache
         if merged is None:
-            merged = list(self.shards)
+            entries = list(self.shards)
             for shards in self.departed_shards.values():
-                merged.extend(shards)
-            threshold = self.hash
-            merged.sort(key=lambda s: (s.hash < threshold, s.hash))
+                entries.extend(shards)
+            entries.sort(key=lambda s: (s.hash, s.name))
+            merged = (entries, [s.hash for s in entries])
             self._merged_walk_cache = merged
+        entries, hashes = merged
+        if not entries:
+            return
         budget = number_of_nodes + len(self.departed_shards)
-        nodes: set = set()
-        for s in merged:
-            if len(nodes) >= budget:
-                break
-            if s.node_name == self.config.name or s.node_name in nodes:
-                continue
-            nodes.add(s.node_name)
-            if s.node_name in self.departed_shards:
-                if request is None:
-                    request = hint_request_fn()
-                    kind = request[1] if len(request) > 1 else None
-                    if kind not in (
-                        ShardRequest.SET,
-                        ShardRequest.DELETE,
-                        ShardRequest.MULTI_SET,
-                    ):
-                        return  # reads never hint
-                # Deliberately NOT op_status["peer_dead"]: the live
-                # fan-out may satisfy the quorum fine — a later
-                # deadline expiry on a merely-slow LIVE peer must
-                # report Timeout, not PeerDead (the flag is set only
-                # where a requested target actually failed).
-                self._record_hint(s.node_name, request)
+        targets: set = set()
+        for key in keys:
+            start = bisect.bisect_left(
+                hashes, hash_bytes(key)
+            ) % len(entries)
+            nodes: set = set()
+            for off in range(len(entries)):
+                if len(nodes) >= budget:
+                    break
+                s = entries[(start + off) % len(entries)]
+                if (
+                    s.node_name == self.config.name
+                    or s.node_name in nodes
+                ):
+                    continue
+                nodes.add(s.node_name)
+                if s.node_name in self.departed_shards:
+                    targets.add(s.node_name)
+        # Deliberately NOT op_status["peer_dead"]: the live fan-out
+        # may satisfy the quorum fine — a later deadline expiry on a
+        # merely-slow LIVE peer must report Timeout, not PeerDead
+        # (the flag is set only where a requested target actually
+        # failed).  MULTI_SET hints the whole batch to every departed
+        # target its keys touch (harmless over-hint: replay is an
+        # idempotent strictly-newer push).
+        for name in sorted(targets):
+            self._record_hint(name, request)
 
-    def _replica_connections(self, number_of_nodes: int) -> List[tuple]:
+    def _replica_connections(
+        self,
+        number_of_nodes: int,
+        key_hash: Optional[int] = None,
+    ) -> List[tuple]:
         """First ``number_of_nodes`` distinct-OTHER-node shards on the
-        rotated ring (the replica walk, shards.rs:463-497)."""
+        ring (the replica walk, shards.rs:463-497).  With ``key_hash``
+        the walk is anchored at the key's own ring position (bisect
+        into the hash-sorted ring) — required under vnodes, where a
+        key may route to this shard via a secondary token and the
+        rotation front (anchored at the PRIMARY token) would pick the
+        wrong replica set.  Without it, the legacy rotation-front walk
+        (identical to the anchored walk when every shard has one
+        token and the key landed on this shard's own arc).
+
+        The anchored walk collects the key's full distinct-node order
+        and rotates PAST this node before truncating: a coordinator
+        serving at replica_index>0 must fan to the replicas AFTER it
+        in ring order (the earlier ones already failed the client),
+        exactly what the rotation-front walk did for one token."""
         nodes: set = set()
         connections: List[tuple] = []
-        for s in self.shards:
-            # Replicas live on OTHER nodes (same-node shards may be
-            # remote connections under the per-core process launcher).
-            if s.node_name == self.config.name or s.node_name in nodes:
+        if key_hash is None:
+            for s in self.shards:
+                # Replicas live on OTHER nodes (same-node shards may
+                # be remote connections under the per-core process
+                # launcher).
+                if (
+                    s.node_name == self.config.name
+                    or s.node_name in nodes
+                ):
+                    continue
+                nodes.add(s.node_name)
+                connections.append((s.node_name, s.connection))
+                if len(connections) >= number_of_nodes:
+                    break
+            return connections
+        ring = self._hash_sorted
+        if not ring:
+            return connections
+        start = bisect.bisect_left(
+            self._sorted_hashes, key_hash
+        ) % len(ring)
+        ordered: List[tuple] = []  # full distinct-node walk order
+        self_idx = None
+        for off in range(len(ring)):
+            s = ring[(start + off) % len(ring)]
+            if s.node_name in nodes:
                 continue
             nodes.add(s.node_name)
-            connections.append((s.node_name, s.connection))
-            if len(connections) >= number_of_nodes:
-                break
-        return connections
+            if s.node_name == self.config.name:
+                self_idx = len(ordered)
+            ordered.append((s.node_name, s.connection))
+        if self_idx is not None:
+            ordered = (
+                ordered[self_idx + 1:] + ordered[:self_idx]
+            )
+        return [
+            (n, c)
+            for n, c in ordered[:number_of_nodes]
+            if n != self.config.name
+        ]
 
     def _register_inflight(self, name: str, fut) -> None:
         self._inflight_by_node.setdefault(name, set()).add(fut)
@@ -2579,6 +2798,10 @@ class MyShard:
                     self.nodes[node.name] = node
                     self.add_shards_of_nodes([node])
                     self.persist_peers()
+                    # Membership changed: bump the epoch and cancel
+                    # any in-flight migration (it re-plans below from
+                    # the NEW ring).
+                    self._fence_membership_change()
                 # State transition resets the opposite epidemic
                 # counters (sources are name#boot_id salted).
                 self._reset_gossip_counters(
@@ -2664,14 +2887,16 @@ class MyShard:
             # them too, so its in-flight ops dead-event (hint +
             # release) now instead of riding the C read timeout.
             self.quorum_fanout.drop_node(
-                [
-                    s.connection.address
-                    for s in self.shards
-                    if s.node_name == node_name
-                    and isinstance(
-                        s.connection, RemoteShardConnection
-                    )
-                ]
+                sorted(
+                    {
+                        s.connection.address
+                        for s in self.shards
+                        if s.node_name == node_name
+                        and isinstance(
+                            s.connection, RemoteShardConnection
+                        )
+                    }
+                )
             )
         # Allow the node's next Alive announcement through the gossip
         # dedup immediately (see the matching reset in
@@ -2690,8 +2915,17 @@ class MyShard:
             s for s in self.shards if s.node_name != node_name
         ]
         self.sort_consistent_hash_ring()
+        # Membership changed: bump the epoch and cancel any in-flight
+        # migration before re-planning from the shrunk ring below.
+        self._fence_membership_change()
+        closed: set = set()
         for s in removed:
-            if isinstance(s.connection, RemoteShardConnection):
+            # Vnode rings carry one entry per token sharing ONE
+            # pooled connection: close it once.
+            if isinstance(
+                s.connection, RemoteShardConnection
+            ) and id(s.connection) not in closed:
+                closed.add(id(s.connection))
                 s.connection.close_pool()
         log.info(
             "after death of %s: %d nodes, %d shards",
@@ -2711,200 +2945,223 @@ class MyShard:
         self, removed_shards: List[Shard]
     ) -> None:
         assert removed_shards
-        actions: List[Tuple[str, List[RangeAndAction]]] = []
-        # Per-collection skips use `continue`, not `return`: the reference
-        # returns out of the whole planning loop here
-        # (/root/reference/src/shards.rs:869-876), which silently aborts
-        # migration for every collection after an rf=1 one in iteration
-        # order — a durability hole with mixed-RF collections. Fixed
-        # deliberately (documented in PARITY.md).
-        for name, collection in list(self.collections.items()):
-            rf = collection.replication_factor
-            if rf <= 1:
-                continue
-            if len(self.nodes) + 1 < rf:
-                continue
-            migrate_to = self.get_last_owning_shard(
-                self.shards, self.hash, rf
-            )
-            if migrate_to is None:
-                continue
-            start = self.shards[-1].hash
-            # REFERENCE BUG (the fourth documented one, PARITY.md):
-            # the reference (shards.rs:889-920) only sends when a
-            # removed shard sat in the FORWARD span (me, migrate_to],
-            # and then truncates the range to the absorbed slice
-            # (new_pred, closest-removed-below-me] when the dead node
-            # was also my ring predecessor.  Two holes: (a) with the
-            # dead node both behind me and in my replica walk, the
-            # new tail owner receives the absorbed slice but never my
-            # original primary slice; (b) with the dead node ONLY
-            # behind me, no send fires at all although the absorbed
-            # slice's walk shifted and its new tail owner holds
-            # nothing.  Found by tests/test_membership_fuzz.py
-            # invariant D.  Exactly one node dies per DEAD event, so
-            # the single gained owner of every affected slice is
-            # migrate_to (the new rf-th distinct node): when any
-            # removed shard lies in (new_pred, me] (absorption) or in
-            # (me, migrate_to] (walk shift), send the FULL new
-            # primary range (new_pred, me] there — slices migrate_to
-            # already held merge idempotently (LWW).  The two arcs
-            # are tested separately, not as one (new_pred,
-            # migrate_to] span: with few nodes the walk can wrap far
-            # enough that migrate_to IS my new predecessor, and the
-            # single-span test degenerates to an empty arc.
-            if not any(
-                is_between(s.hash, start, self.hash)
-                or is_between(s.hash, self.hash, migrate_to.hash)
-                for s in removed_shards
-            ):
-                continue
-            actions.append(
-                (
-                    name,
-                    [
-                        RangeAndAction(
-                            start,
-                            self.hash,
-                            MigrationAction.SEND,
-                            migrate_to.connection,
-                        )
-                    ],
-                )
-            )
-        self.spawn_migration_tasks(actions, delay=None)
+        old_ring = list(self.shards) + list(removed_shards)
+        self.spawn_migration_tasks(
+            self._plan_collection_actions(
+                old_ring, list(self.shards)
+            ),
+            delay=None,
+        )
 
     def migrate_data_on_node_addition(
         self, added_shards: List[Shard]
     ) -> None:
         assert added_shards
-        all_actions: List[Tuple[str, List[RangeAndAction]]] = []
         added_names = {s.name for s in added_shards}
+        old_ring = [
+            s for s in self.shards if s.name not in added_names
+        ]
+        self.spawn_migration_tasks(
+            self._plan_collection_actions(
+                old_ring, list(self.shards)
+            ),
+            delay=NEW_NODE_MIGRATION_DELAY_S,
+        )
+
+    def _plan_collection_actions(
+        self,
+        old_ring: List[Shard],
+        new_ring: List[Shard],
+    ) -> List[Tuple[str, List[RangeAndAction]]]:
+        """Per-collection migration plans for one ring transition.
+        Plans depend only on the replication factor, so collections
+        sharing an rf share one RangeAndAction list (the executor
+        treats it read-only).  Per-collection skips use `continue`,
+        not `return`: the reference returns out of the whole planning
+        loop (shards.rs:869-876), silently aborting every collection
+        after an rf=1 one — a durability hole with mixed-RF
+        collections, fixed deliberately (PARITY.md)."""
+        actions: List[Tuple[str, List[RangeAndAction]]] = []
+        plans: Dict[int, List[RangeAndAction]] = {}
         for name, collection in list(self.collections.items()):
             rf = collection.replication_factor
             if rf <= 1:
+                # rf=1 data lives only at its primary: no replica set
+                # to rebuild.
                 continue
-            if len(self.nodes) + 1 < rf:
-                continue
-            col_actions: List[RangeAndAction] = []
-            last_owning = self.get_last_owning_shard(
-                self.shards, self.hash, rf
-            )
-            if last_owning is None:
-                continue
-            prev_hashes = [
-                s.hash
-                for s in reversed(self.shards)
-                if s.name not in added_names
-            ]
-            if not prev_hashes:
-                continue
-            previous_shard_hash = prev_hashes[0]
-
-            # The executor dispatches each key to the FIRST matching
-            # range (migration.py process), so steps 1 and 2 must emit
-            # DISJOINT ranges.  Added shards that landed between my
-            # predecessor and me split my old primary range
-            # (prev, me]: after the add I own only (A_max, me], and
-            # each behind-me added shard owns its slice of the rest.
-            between = [
-                s
-                for s in added_shards
-                if is_between(s.hash, previous_shard_hash, self.hash)
-            ]
-            between.sort(
-                key=lambda s: (s.hash - previous_shard_hash)
-                & 0xFFFFFFFF
-            )
-            my_range_start = (
-                between[-1].hash if between else previous_shard_hash
-            )
-
-            # Step 1: send my (new) primary range to the closest added
-            # shard within this shard's replica span — it became one
-            # of that range's replicas.  The range is (A_max, me], NOT
-            # the reference's (prev, me] (shards.rs:978-994): the
-            # slices behind A_max now belong to the added node's
-            # behind-me shards (step 2) and the forward-span shard is
-            # not in their walk (same node as A_max, which already
-            # represents it) — the reference's wider range both
-            # over-sends unowned data and, under first-match dispatch,
-            # shadows the step-2 slices.
-            in_span = [
-                s
-                for s in added_shards
-                if is_between(s.hash, self.hash, last_owning.hash)
-                or s.hash == last_owning.hash
-            ]
-            if in_span:
-                migrate_to = min(
-                    in_span,
-                    key=lambda s: (s.hash - self.hash) & 0xFFFFFFFF,
+            if rf not in plans:
+                plans[rf] = self._plan_arc_diff(
+                    old_ring, new_ring, rf
                 )
-                col_actions.append(
-                    RangeAndAction(
-                        my_range_start,
-                        self.hash,
-                        MigrationAction.SEND,
-                        migrate_to.connection,
+            if plans[rf]:
+                actions.append((name, plans[rf]))
+        return actions
+
+    @staticmethod
+    def _ring_walk(
+        ring: List[Shard],
+        hashes: List[int],
+        point: int,
+        rf: int,
+    ) -> List[Shard]:
+        """Distinct-node replica walk of a hash-sorted ``ring`` (with
+        its parallel ``hashes`` list) anchored at ``point``: the first
+        shard of each of the first min(rf, n_nodes) distinct nodes
+        at/after the point on the wrapping ring — the same walk
+        owns_key, the clients, and anti-entropy derive ownership
+        from."""
+        n = len(ring)
+        if n == 0:
+            return []
+        start = bisect.bisect_left(hashes, point) % n
+        nodes: set = set()
+        out: List[Shard] = []
+        for off in range(n):
+            s = ring[(start + off) % n]
+            if s.node_name in nodes:
+                continue
+            nodes.add(s.node_name)
+            out.append(s)
+            if len(out) >= rf:
+                break
+        return out
+
+    def _plan_arc_diff(
+        self,
+        old_ring: List[Shard],
+        new_ring: List[Shard],
+        rf: int,
+    ) -> List[RangeAndAction]:
+        """This shard's migration plan for the ring transition
+        old_ring -> new_ring at replication factor ``rf``, as the
+        arc-by-arc ownership diff (supersedes the hand-derived
+        one-token special cases that accumulated four documented
+        reference-bug fixes — the general form IS the fix, and it is
+        what makes vnode rings plannable at all).
+
+        The union of both rings' token hashes partitions the ring
+        into arcs (U[i-1], U[i]]; no token of either ring lies
+        strictly inside an arc, so each arc's replica walk is
+        constant across the arc and can be evaluated once at its end
+        point.  Per arc, diff the old and new distinct-node replica
+        sets:
+
+        - SEND: exactly one view streams each gained node its copy —
+          the DESIGNATED SENDER, the first shard in the old walk
+          whose node survives into the new set (deterministic across
+          views: every node computes the same walks from the same
+          membership).  This view emits only when that sender is
+          itself.
+        - DELETE: a view evacuates an arc its node lost only when its
+          own entry was the node's serving shard for that arc in the
+          old ring (other shards of the node never held the data).
+
+        One membership event changes one node, so per arc per view at
+        most ONE action fires (a designated sender's node survives,
+        hence never deletes the same arc) — the executor's
+        first-match dispatch over disjoint arcs stays exact.
+        Consecutive arcs with identical actions merge (never across
+        an actionless gap — widening a SEND range would plant
+        unowned slices on the target; never across the wrap)."""
+        old_sorted = sorted(old_ring, key=lambda s: (s.hash, s.name))
+        new_sorted = sorted(new_ring, key=lambda s: (s.hash, s.name))
+        old_hashes = [s.hash for s in old_sorted]
+        new_hashes = [s.hash for s in new_sorted]
+        union = sorted(set(old_hashes) | set(new_hashes))
+        if len(union) < 2:
+            return []  # single-point ring: no ownership to move
+        arcs: List[tuple] = []  # (start, end, sig) per union arc
+        for i, point in enumerate(union):
+            start = union[i - 1]  # i=0 wraps: (U[-1], U[0]]
+            old_sel = self._ring_walk(
+                old_sorted, old_hashes, point, rf
+            )
+            new_sel = self._ring_walk(
+                new_sorted, new_hashes, point, rf
+            )
+            old_nodes = {s.node_name for s in old_sel}
+            new_nodes = {s.node_name for s in new_sel}
+            sig: List[tuple] = []
+            sender = next(
+                (s for s in old_sel if s.node_name in new_nodes),
+                None,
+            )
+            if sender is not None and sender.name == self.shard_name:
+                for tgt_node in sorted(new_nodes - old_nodes):
+                    tgt = next(
+                        s
+                        for s in new_sel
+                        if s.node_name == tgt_node
                     )
+                    sig.append((MigrationAction.SEND, tgt))
+            if self.config.name in old_nodes - new_nodes:
+                mine = next(
+                    (
+                        s
+                        for s in old_sel
+                        if s.node_name == self.config.name
+                    ),
+                    None,
                 )
-
-            # Step 2: I am the only holder of (prev, me], so I stream
-            # each behind-me added shard the slice it now owns as
-            # primary: (prev, A1] -> A1, (A1, A2] -> A2, ...
-            #
-            # REFERENCE BUG (the third documented one, PARITY.md): the
-            # reference chains only BETWEEN added shards
-            # (shards.rs:996-1026, `tuple_windows`), claiming the
-            # "farthest" one is covered by the previous shard's step 1
-            # — but prev's step 1 sends its OWN primary range
-            # (prevprev, prev], never (prev, A1].  A new shard thus
-            # never receives the primary range it took over: reads at
-            # consistency=1 routed to it see missing keys until read
-            # repair / anti-entropy backfill.  Found by
-            # tests/test_membership_fuzz.py invariant B.
-            if between:
-                starts = [previous_shard_hash] + [
-                    s.hash for s in between[:-1]
+                if mine is not None and mine.name == self.shard_name:
+                    sig.append((MigrationAction.DELETE, None))
+            arcs.append((start, point, sig))
+        # Merge runs of consecutive arcs with the same non-empty
+        # signature (compare by action + target NAME: the same node's
+        # serving entry is one object across arcs).
+        merged: List[tuple] = []
+        for start, end, sig in arcs:
+            if (
+                sig
+                and merged
+                and merged[-1][2]
+                and merged[-1][1] == start
+                and [
+                    (a, t.name if t is not None else None)
+                    for a, t in merged[-1][2]
                 ]
-                for start, b in zip(starts, between):
-                    col_actions.append(
+                == [
+                    (a, t.name if t is not None else None)
+                    for a, t in sig
+                ]
+            ):
+                merged[-1] = (merged[-1][0], end, sig)
+            else:
+                merged.append((start, end, sig))
+        plan: List[RangeAndAction] = []
+        for start, end, sig in merged:
+            for action, tgt in sig:
+                if action == MigrationAction.SEND:
+                    plan.append(
                         RangeAndAction(
-                            start,
-                            b.hash,
-                            MigrationAction.SEND,
-                            b.connection,
+                            start, end, action, tgt.connection
                         )
                     )
+                else:
+                    plan.append(RangeAndAction(start, end, action))
+        return plan
 
-            # Step 3: delete ranges this shard no longer owns.
-            seen: set = set()
-            for i in range(len(self.shards) - 1, -1, -1):
-                shard = self.shards[i]
-                if shard.name in added_names:
-                    continue
-                seen.add(shard.name)
-                if len(seen) == rf:
-                    break
-                if not self.is_owning_shard(i, rf):
-                    prev_index = (
-                        len(self.shards) - 1 if i == 0 else i - 1
-                    )
-                    col_actions.append(
-                        RangeAndAction(
-                            self.shards[prev_index].hash,
-                            shard.hash,
-                            MigrationAction.DELETE,
-                        )
-                    )
+    def _fence_membership_change(self) -> None:
+        """A membership change landed: bump the epoch (writes stamped
+        with the previous ring view refuse retryably while migration
+        is live) and cancel any in-flight migration plans — they were
+        computed against a ring that no longer exists, and finishing
+        them would double-stream arcs the caller is about to re-plan
+        from the CURRENT ring."""
+        self.membership_epoch += 1
+        for task in list(self._migration_tasks):
+            if not task.done():
+                task.cancel()
+                self.migrations_cancelled += 1
+        self._migration_tasks.clear()
 
-            if col_actions:
-                all_actions.append((name, col_actions))
-
-        self.spawn_migration_tasks(
-            all_actions, delay=NEW_NODE_MIGRATION_DELAY_S
-        )
+    def _migration_task_done(self, task) -> None:
+        self._migration_tasks.discard(task)
+        if not self._migration_tasks:
+            # Last migration drained: lift the epoch fence and restore
+            # the native ownership fast path (punted to Python while
+            # the fence was up).
+            self._refresh_dataplane_ownership()
 
     def spawn_migration_tasks(
         self,
@@ -2913,21 +3170,37 @@ class MyShard:
     ) -> None:
         from .migration import migrate_actions
 
+        epoch = self.membership_epoch
+        spawned = False
         for collection_name, ranges in actions:
             col = self.collections.get(collection_name)
             if col is None:
                 continue
+            self.migrations_started += 1
 
             async def run(name=collection_name, tree=col.tree, r=ranges):
                 if delay:
                     await asyncio.sleep(delay)
                 try:
-                    await migrate_actions(self, name, tree, r)
+                    await migrate_actions(
+                        self, name, tree, r, plan_epoch=epoch
+                    )
+                except asyncio.CancelledError:
+                    # Fenced by a newer membership change — counted
+                    # there; the replacement plan owns the arcs now.
+                    pass
                 except Exception as e:
                     log.error("error migrating %s: %s", name, e)
                 self.flow.notify(FlowEvent.DONE_MIGRATION)
 
-            self.spawn(run())
+            task = self.spawn(run())
+            self._migration_tasks.add(task)
+            task.add_done_callback(self._migration_task_done)
+            spawned = True
+        if spawned:
+            # Epoch fence up: punt keyed ops to the Python dispatcher
+            # (which reads the epoch stamp) for the migration window.
+            self._refresh_dataplane_ownership()
 
     # ------------------------------------------------------------------
 

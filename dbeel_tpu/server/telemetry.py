@@ -175,6 +175,10 @@ _RATES = {
     "qos_sheds_standard_per_s": ("qos.classes.standard.shed",),
     "qos_sheds_batch_per_s": ("qos.classes.batch.shed",),
     "qos_quota_refusals_per_s": ("qos.quota_refusals",),
+    # Elastic membership (ISSUE 18): bulk-handoff throughput — how
+    # fast migration streams keys to new owners (paced by bg_slice +
+    # --migration-keys-per-sec).
+    "keys_migrated_per_s": ("membership.keys_migrated",),
 }
 
 # QoS classes the class_starvation watchdog rule walks (mirrors
@@ -368,6 +372,11 @@ SCAN_STORM_SHEDS_PER_S = 5.0
 # operator why their bulk load stalled); for interactive it would be
 # a priority inversion — severity escalates to crit.
 CLASS_STARVATION_SHEDS_PER_S = 2.0
+# Migration stall (elastic membership, ISSUE 18): migrations active
+# but the keys_migrated counter flat for this many consecutive
+# windows — a wedged target stream, a starved executor, or a
+# mis-sized --migration-keys-per-sec holding the handoff at zero.
+MIGRATION_STALL_WINDOWS = 3
 
 _FINDING_LOG_PERIOD_S = 1.0
 
@@ -544,6 +553,30 @@ class HealthWatchdog:
                     f"{shed_rate:.0f}/s with zero admitted over the "
                     "window",
                 )
+
+        # migration_stall: a migration claims to be running but moved
+        # zero keys across consecutive windows.  DELETE-only plans
+        # legitimately move nothing, so the rule also requires that
+        # nothing was migrated yet this boot OR something had been
+        # moving before — both shapes mean "active and not
+        # progressing".
+        active = values.get("membership.migrations_active", 0)
+        km = ring.series(
+            "membership.keys_migrated", MIGRATION_STALL_WINDOWS + 1
+        )
+        if (
+            active
+            and active >= 1
+            and len(km) >= MIGRATION_STALL_WINDOWS + 1
+            and all(b == a for a, b in zip(km, km[1:]))
+        ):
+            add(
+                "migration_stall",
+                "warn",
+                active,
+                f"{active:.0f} migration task(s) active with "
+                f"keys_migrated unmoved for {len(km) - 1} windows",
+            )
 
         # trace_ring_churn: the flight recorder turned over completely
         # within one telemetry window — slow-tail evidence is being

@@ -113,8 +113,16 @@ struct MpBuf {
 
 // ------------------------- msgpack decode ----------------------------
 // Minimal reader for the metadata response shape:
-//   [[ [name, ip, remote_port, [ids...], gossip_port, db_port], ...],
-//    [[name, rf], ...]]
+//   [[ [name, ip, remote_port, [ids...], gossip_port, db_port,
+//       [[token...]...]? ], ...],
+//    [[name, rf], ...], epoch?]
+// The per-node 7th slot (kNodeTokensSlot) is the vnode dialect:
+// per-shard ring token lists aligned with ids, appended only by nodes
+// whose shards own more than one token; absent means the legacy
+// one-token-per-shard derivation hash("name-sid").  The trailing
+// cluster epoch is ignored here: this client does not stamp write
+// epochs (it re-syncs on KeyNotOwnedByShard instead, and unstamped
+// writes are never epoch-fenced by the server).
 
 struct MpRd {
   const uint8_t* p;
@@ -399,6 +407,12 @@ bool read_all_deadline(int fd, uint8_t* p, size_t n,
 constexpr uint8_t kResponseErr = 0;
 constexpr uint8_t kResponseOk = 1;
 
+// Index of the optional per-shard ring-token-list element in a
+// NodeMetadata wire tuple (vnode dialect).  MUST match the Python
+// side's base tuple length in messages.NodeMetadata.to_wire — the
+// wire-parity lint pins it.
+constexpr uint32_t kNodeTokensSlot = 6;
+
 // One round trip: u16-LE length-prefixed request; u32-LE
 // length-prefixed response whose length INCLUDES the trailing type
 // byte (0=Err, 1=Ok payload, 2=plain OK).  Returns false on transport
@@ -527,17 +541,45 @@ int sync_metadata_from(Client* c, const std::string& ip,
     for (uint32_t j = 0; j < n_ids; j++) ids[j] = r.integer();
     (void)r.integer();  // gossip_port
     int64_t db_port = r.integer();
-    for (uint32_t extra = 6; extra < f; extra++) (void)r.integer();
-    for (int64_t sid : ids) {
-      std::string label = name + "-" + std::to_string(sid);
-      RingShard s;
-      s.hash = dbeel_murmur3_32(
-          reinterpret_cast<const uint8_t*>(label.data()),
-          label.size(), 0);
-      s.node_name = name;
-      s.ip = ip;
-      s.db_port = (uint16_t)(db_port + sid);
-      ring.push_back(std::move(s));
+    // Vnode dialect: optional per-shard token lists aligned with
+    // ids.  Missing/short lists fall back to the legacy single
+    // token per shard.
+    std::vector<std::vector<uint32_t>> tokens;
+    uint32_t extra = 6;
+    if (extra < f && extra == kNodeTokensSlot && !r.fail) {
+      if (r.p < r.end && *r.p == 0xc0) {
+        r.nil();
+      } else {
+        uint32_t n_lists = r.array_header();
+        tokens.resize(r.fail ? 0 : n_lists);
+        for (uint32_t j = 0; j < n_lists && !r.fail; j++) {
+          uint32_t n_tok = r.array_header();
+          for (uint32_t k = 0; k < n_tok && !r.fail; k++)
+            tokens[j].push_back((uint32_t)r.integer());
+        }
+      }
+      extra++;
+    }
+    for (; extra < f; extra++) (void)r.integer();
+    for (size_t si = 0; si < ids.size(); si++) {
+      int64_t sid = ids[si];
+      std::vector<uint32_t> hashes;
+      if (si < tokens.size() && !tokens[si].empty()) {
+        hashes = tokens[si];
+      } else {
+        std::string label = name + "-" + std::to_string(sid);
+        hashes.push_back(dbeel_murmur3_32(
+            reinterpret_cast<const uint8_t*>(label.data()),
+            label.size(), 0));
+      }
+      for (uint32_t h : hashes) {
+        RingShard s;
+        s.hash = h;
+        s.node_name = name;
+        s.ip = ip;
+        s.db_port = (uint16_t)(db_port + sid);
+        ring.push_back(std::move(s));
+      }
     }
   }
   if (r.fail || ring.empty()) {
@@ -546,7 +588,10 @@ int sync_metadata_from(Client* c, const std::string& ip,
   }
   std::sort(ring.begin(), ring.end(),
             [](const RingShard& a, const RingShard& b) {
-              return a.hash < b.hash;
+              // (hash, node_name) — same tie-break as the Python
+              // client's ring sort.
+              return a.hash != b.hash ? a.hash < b.hash
+                                      : a.node_name < b.node_name;
             });
   c->ring = std::move(ring);
   return 0;

@@ -116,6 +116,43 @@ OVERLOAD_KEYS = {
     "pass",
 }
 
+# Elastic membership plane (ISSUE 18): the --churn phase — >= 3
+# add/remove/replace cycles on the vnode ring under open-loop load,
+# gated on zero acked loss, bounded p99 vs the same-session baseline,
+# post-churn byte-agreement, and live epoch/migration counters.
+CHURN_KEYS = {
+    "window_s",
+    "cycles",
+    "adds",
+    "removes",
+    "replaces",
+    "events",
+    "member_wait_timeouts",
+    "restart_failures",
+    "open_loop_ops_per_s",
+    "fg_acked",
+    "fg_errors_by_class",
+    "baseline_p99_ms",
+    "churn_p99_ms",
+    "p99_bound_ms",
+    "p99_ok",
+    "journal_keys",
+    "acked_writes_lost",
+    "loss_samples",
+    "divergent_keys",
+    "convergence_s",
+    "epoch_initial",
+    "epoch_final",
+    "epoch_ok",
+    "migrations_started",
+    "keys_migrated",
+    "fence_refusals",
+    "stats_membership_block",
+    "migrations_seen",
+    "nodes_alive",
+    "pass",
+}
+
 # QoS plane (ISSUE 14): the two-class overload sub-phase — equal
 # offered load per class; the high class holds its goodput share
 # while the low class sheds first.
@@ -133,9 +170,9 @@ OVERLOAD_CLASS_KEYS = {
 
 @pytest.mark.slow
 def test_chaos_soak_quick_schema(tmp_dir):
-    # The quick soak plus the --disk-faults phase runs ~2-3 min —
-    # past the conftest 110s per-test watchdog; re-arm the alarm
-    # (same handler) for this test's real horizon.
+    # The quick soak plus the fault/overload/scan/membership phases
+    # runs ~4-6 min — past the conftest 110s per-test watchdog;
+    # re-arm the alarm (same handler) for this test's real horizon.
     import signal
 
     if hasattr(signal, "SIGALRM"):
@@ -150,6 +187,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
             "--partition",
             "--overload",
             "--scan",
+            "--churn",
             "--report",
             report_path,
         ],
@@ -226,6 +264,24 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert sc["filtered_vs_quorum_disagreements"] == []
     assert sc["filtered_count_verb"] == sc["filtered_final_entries"]
     assert sc["stats_filter_block"]["specs_served"] is not None
+    # --churn phase schema (elastic membership plane, ISSUE 18):
+    # >= 3 add/remove/replace cycles on the vnode ring under open-loop
+    # load; zero acked loss, bounded p99, post-churn byte-agreement,
+    # and a moving epoch + live membership stats block.
+    ch = report["churn"]
+    missing = CHURN_KEYS - set(ch)
+    assert not missing, missing
+    assert ch["cycles"] >= 3
+    assert ch["adds"] == ch["cycles"]
+    assert ch["acked_writes_lost"] == 0, ch["loss_samples"]
+    assert ch["divergent_keys"] == 0
+    assert ch["p99_ok"] is True, ch
+    assert ch["epoch_final"] > ch["epoch_initial"]
+    assert ch["migrations_started"] > 0
+    assert ch["keys_migrated"] > 0
+    assert ch["stats_membership_block"] is True
+    assert ch["nodes_alive"] is True
+    assert ch["pass"] is True, ch
     # Tracing plane (ISSUE 9): the trace block must be present with
     # dumps from the (still alive) nodes; dominant_stages is a list
     # of [stage, share] pairs (may be empty when nothing was slow).
@@ -241,6 +297,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
     hb = report["health"]
     assert set(hb) == {"phases", "final"}
     assert "churn" in hb["phases"]
+    assert "membership" in hb["phases"]
     for label, block in {**hb["phases"], "final": hb["final"]}.items():
         missing = HEALTH_BLOCK_KEYS - set(block)
         assert not missing, (label, missing)

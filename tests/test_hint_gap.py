@@ -1,27 +1,31 @@
-"""Hint targeting gap (ISSUE 5 satellite, PR 4 follow-up).
+"""Hint targeting under departure (ISSUE 5 satellite, reworked by the
+elastic-membership PR).
 
-``MyShard._hint_departed`` approximates a mutation's replica set by
-walking the COORDINATOR's rotated merged (live+departed) ring with a
-budget of ``number_of_nodes + len(departed)`` distinct nodes.  When a
-departed node's natural replica slot for the key lies beyond that
-walk (the coordinator serves at replica_index>0 and other distinct
-nodes fill the budget first — "beyond the merged-walk wrap"), the
-write is NOT hinted.  This file pins the gap deterministically and
-proves the designed backstop: the key's arc is in the coordinator's
-EXACT owned-range union (replica_arcs) with the departed node as an
-arc peer, so anti-entropy pushes the diverged key once the node
-returns.
+``MyShard._hint_departed`` used to approximate a mutation's replica
+set by walking the COORDINATOR's rotated merged (live+departed) ring:
+when a departed node's natural replica slot for the key lay beyond
+that walk (the coordinator serves at replica_index>0 and other
+distinct nodes fill the budget first), the write was NOT hinted — a
+gap this file used to pin, with anti-entropy as the backstop.
+
+The walk is now anchored at each KEY's hash (per-key bisect into the
+merged ring), which CLOSES the gap: the departed node's slot is found
+wherever it sits relative to the key, not relative to the
+coordinator.  That anchoring is load-bearing under virtual nodes,
+where a departed node owns many small arcs and the coordinator's
+rotation front says nothing about which arc a key lands in.  This
+file pins both: the closed gap, and the per-arc targeting on a vnode
+ring.
 """
 
 import time
 
 import msgpack
-import pytest
 
 from dbeel_tpu.config import Config
 from dbeel_tpu.cluster.local_comm import LocalShardConnection
 from dbeel_tpu.cluster.messages import NodeMetadata, ShardRequest
-from dbeel_tpu.server.shard import MyShard
+from dbeel_tpu.server.shard import MyShard, vnode_tokens
 from dbeel_tpu.storage.page_cache import PageCache
 from dbeel_tpu.utils.murmur import hash_bytes
 
@@ -31,11 +35,14 @@ NODES = ["alpha", "bravo", "cacti", "delta", "echon"]
 RF = 3
 
 
-def _build_view(name):
-    """One MyShard view for ``name`` in a 5-node x 1-shard ring."""
+def _build_view(name, vnodes=1, nodes=NODES):
+    """One MyShard view for ``name`` in a len(nodes) x 1-shard ring."""
     from dbeel_tpu.server.shard import Shard
 
-    config = Config(name=name)
+    # dir="" keeps the HintLog memory-only: the default dir is a
+    # real shared path and a persisted hints-0.log from an earlier
+    # run would dedup this test's recordings.
+    config = Config(name=name, vnodes=vnodes, dir="")
     conn = LocalShardConnection(0)
     own = Shard(node_name=name, name=f"{name}-0", connection=conn)
     view = MyShard(config, 0, [own], PageCache(8), conn)
@@ -48,8 +55,13 @@ def _build_view(name):
                 ids=[0],
                 gossip_port=30000,
                 db_port=10000,
+                tokens=(
+                    [vnode_tokens(f"{other}-0", vnodes)]
+                    if vnodes > 1
+                    else None
+                ),
             )
-            for other in NODES
+            for other in nodes
             if other != name
         ]
     )
@@ -76,15 +88,25 @@ def _natural_walk(view, key_hash, rf):
     return nodes
 
 
-def _find_gap_case():
+def _depart(view, x):
+    """handle_dead_node's hint bookkeeping, minus gossip: park X's
+    ring entries for hint targeting and shrink the live ring."""
+    removed = [s for s in view.shards if s.node_name == x]
+    view.departed_shards[x] = removed
+    view.departed_at[x] = time.time()
+    view.shards = [s for s in view.shards if s.node_name != x]
+    view.sort_consistent_hash_ring()
+    return removed
+
+
+def _find_beyond_front_case():
     """Search (coordinator A, departed X, key) where the key's
     natural set is [X, ?, A] (A coordinates at replica_index=2, live
     fan-out = 0 nodes) and X is NOT the first distinct node of A's
-    merged rotated walk — the configuration _hint_departed misses."""
+    rotation-front walk — the configuration the old coordinator-
+    anchored walk missed."""
     for a_name in NODES:
         view = _build_view(a_name)
-        # First distinct non-A node in A's rotated (coordinator)
-        # walk — the only node a budget-1 merged walk can reach.
         first_merged = next(
             s.node_name
             for s in view.shards
@@ -103,94 +125,49 @@ def _find_gap_case():
     return None
 
 
-def test_departed_natural_replica_beyond_wrap_is_not_hinted():
-    """Pin the documented gap: a mutation whose departed FIRST
-    natural replica sits beyond the coordinator's merged-walk budget
-    records no hint (the write's divergence is invisible to hinted
-    handoff)."""
+def test_departed_natural_replica_beyond_rotation_front_is_hinted():
+    """The closed gap: a mutation whose departed FIRST natural
+    replica sits beyond the coordinator's rotation front still
+    records its hint, because the walk is anchored at the key."""
 
     async def main():
-        case = _find_gap_case()
-        assert case is not None, "no gap configuration found"
+        case = _find_beyond_front_case()
+        assert case is not None, "no beyond-front configuration found"
         view, a_name, x, key, h = case
-        # X departs: detector-removed, ring entries parked for hint
-        # targeting (handle_dead_node's bookkeeping, minus gossip).
-        removed = [s for s in view.shards if s.node_name == x]
-        view.departed_shards[x] = removed
-        view.departed_at[x] = time.time()
-        view.shards = [
-            s for s in view.shards if s.node_name != x
-        ]
-        view.sort_consistent_hash_ring()
+        _depart(view, x)
 
         request = ShardRequest.set("c", key, b"v", 1)
         # A serves the key at replica_index=2 (the other live natural
-        # replica already acked upstream): live fan-out budget is 0.
+        # replica already acked upstream): live fan-out budget is 0,
+        # yet the departed natural PRIMARY must be hinted.
         view._hint_departed(0, lambda: request)
-        assert not view.hint_log.has(x), (
-            "the gap closed?! update this pin AND the _hint_departed "
-            "docstring"
+        assert view.hint_log.has(x), (
+            "key-anchored hint walk missed the departed natural "
+            "primary"
         )
-        # Control: a departed node that IS within the merged-walk
-        # budget gets its hint (the mechanism itself works).
-        first_live = next(
-            s.node_name
-            for s in view.shards
-            if s.node_name != a_name
-        )
-        if first_live != x:
-            view2, a2, x2, key2, h2 = _find_gap_case()
-            removed2 = [
-                s for s in view2.shards if s.node_name == x2
-            ]
-            # Depart the FIRST merged-walk node instead: hinted.
-            fm = next(
-                s.node_name
-                for s in view2.shards
-                if s.node_name != a2
-            )
-            fm_shards = [
-                s for s in view2.shards if s.node_name == fm
-            ]
-            view2.departed_shards[fm] = fm_shards
-            view2.departed_at[fm] = time.time()
-            view2.shards = [
-                s for s in view2.shards if s.node_name != fm
-            ]
-            view2.sort_consistent_hash_ring()
-            view2._hint_departed(
-                0, lambda: ShardRequest.set("c", key2, b"v", 1)
-            )
-            assert view2.hint_log.has(fm)
 
-        # THE BACKSTOP (why the gap is tolerated): once X returns,
-        # the key's arc is in A's exact owned-range union with X as
-        # an arc peer — anti-entropy's digest exchange pushes the
-        # diverged key to X without any hint.
-        view.shards.extend(removed)
-        view.departed_shards.pop(x, None)
+        # Anti-entropy still covers the arc once X returns (belt and
+        # suspenders: hints are best-effort, AE is the floor).
+        view.shards.extend(view.departed_shards.pop(x))
         view.sort_consistent_hash_ring()
         covered = False
         for start, end, peers in view.replica_arcs(RF):
             if MyShard._in_ae_range(h, start, end):
                 covered = any(s.node_name == x for s in peers)
                 break
-        assert covered, (
-            "anti-entropy would NOT backstop the gap — replica_arcs "
-            "must select the departed node as a peer of the key's arc"
-        )
+        assert covered
 
     run(main())
 
 
 def test_gap_key_is_in_owned_union_while_node_departed():
-    """Even DURING the outage the coordinator still owns the key's
-    arc (it serves it at replica_index<=rf-1 on the shrunk ring), so
-    its periodic anti-entropy keeps covering the range — the gap is
-    a lost HINT, never a lost owner."""
+    """During the outage the coordinator still owns the key's arc (it
+    serves it at replica_index<=rf-1 on the shrunk ring), so its
+    periodic anti-entropy keeps covering the range — hints accelerate
+    convergence, ownership never depended on them."""
 
     async def main():
-        case = _find_gap_case()
+        case = _find_beyond_front_case()
         assert case is not None
         view, a_name, x, key, h = case
         view.shards = [
@@ -202,5 +179,87 @@ def test_gap_key_is_in_owned_union_while_node_departed():
             for start, end, _peers in view.replica_arcs(RF)
         )
         assert owned
+
+    run(main())
+
+
+def test_vnode_multi_arc_hint_targeting_is_per_key():
+    """Regression (elastic-membership PR): under virtual nodes a
+    departed node owns MANY small arcs.  Keying the hint walk on the
+    coordinator's node hash gave every key the same verdict; the
+    per-key bisect must instead hint exactly the keys whose natural
+    replica set contains the departed node — and stay silent for the
+    rest."""
+
+    async def main():
+        vnodes = 8
+        a_name = NODES[0]
+        x = NODES[2]
+        view = _build_view(a_name, vnodes=vnodes)
+
+        # Emulate A coordinating as the key's PRIMARY (the client
+        # routed here, fan-out = rf-1 other nodes).  Ground truth is
+        # X's slot in the key's full distinct-node walk BEFORE the
+        # departure: inside the natural rf set a hint is MANDATORY;
+        # the contract allows one slack slot past it (walk budget is
+        # fan-out + #departed, covering replica_index>0 coordinators),
+        # so silence is guaranteed only beyond slot rf+1.
+        expect_hint = []
+        expect_silent = []
+        distinct_arcs = set()
+        for i in range(4000):
+            key = msgpack.packb(f"mk{i}", use_bin_type=True)
+            h = hash_bytes(key)
+            walk = _natural_walk(view, h, len(NODES))
+            if walk[0] != a_name or x not in walk:
+                continue
+            slot = walk.index(x)
+            if slot < RF:
+                # Track how many distinct ring positions the hinted
+                # keys cover, to prove this exercises MULTIPLE arcs
+                # rather than one lucky range.
+                import bisect
+
+                pos = bisect.bisect_left(
+                    view._sorted_hashes, h
+                ) % len(view._hash_sorted)
+                distinct_arcs.add(pos)
+                expect_hint.append(key)
+            elif slot > RF:
+                expect_silent.append(key)
+            if (
+                len(expect_hint) >= 20
+                and len(expect_silent) >= 20
+                and len(distinct_arcs) >= 4
+            ):
+                break
+        assert len(distinct_arcs) >= 3, (
+            "test setup too weak: hinted keys land in fewer than 3 "
+            "ring positions — raise the key count"
+        )
+        assert expect_silent, (
+            "test setup too weak: no key places the departed node "
+            "beyond the slack slot"
+        )
+
+        _depart(view, x)
+
+        for key in expect_hint + expect_silent:
+            before = view.hint_log.queued_by_node().get(x, 0)
+            view._hint_departed(
+                RF - 1,
+                lambda k=key: ShardRequest.set("c", k, b"v", 1),
+            )
+            after = view.hint_log.queued_by_node().get(x, 0)
+            if key in expect_hint:
+                assert after == before + 1, (
+                    f"key {key!r}: natural replica of departed {x} "
+                    f"but no hint recorded"
+                )
+            else:
+                assert after == before, (
+                    f"key {key!r}: {x} is NOT in its replica set but "
+                    f"a hint was recorded"
+                )
 
     run(main())

@@ -260,6 +260,72 @@ def test_parity_flags_scan_arity_drift_in_c_shard_plane(tmp_path):
     ), findings
 
 
+def test_parity_flags_membership_tail_drift(tmp_path):
+    # Elastic membership: the optional NodeMetadata token-list tail is
+    # pinned by NODE_WIRE_TAIL_SLOTS vs the encoder's append count —
+    # seeding the constant is drift.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/cluster/messages.py",
+        "NODE_WIRE_TAIL_SLOTS = 1",
+        "NODE_WIRE_TAIL_SLOTS = 2",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "membership tail drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_vnode_token_slot_drift_in_c(tmp_path):
+    # The C client parses ring tokens at kNodeTokensSlot, which must
+    # equal NodeMetadata.to_wire's base tuple length — a drifted index
+    # would shatter the ring for C-routed traffic on a vnode cluster.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_client.cpp",
+        "constexpr uint32_t kNodeTokensSlot = 6;",
+        "constexpr uint32_t kNodeTokensSlot = 7;",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "vnode dialect drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_dropped_epoch_fence_read(tmp_path):
+    # db_server dropping the 'epoch' request read silently disables
+    # the migration write fence server-side.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/db_server.py",
+        'epoch = request.get("epoch")',
+        'epoch = request.get("deadline_ms")',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "no longer reads the 'epoch'" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_dropped_epoch_stamp_in_client(tmp_path):
+    # The Python client not stamping 'epoch' on writes leaves stale-
+    # ring writes unfenced during migration.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/client/__init__.py",
+        'request["epoch"] = self._cluster_epoch',
+        "pass",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "no longer stamps the 'epoch'" in f.message for f in findings
+    ), findings
+
+
 def test_parity_flags_qos_index_drift(tmp_path):
     # QoS plane (ISSUE 14): the class element rides exactly one slot
     # past the trace id on every data verb — a seeded Python-side

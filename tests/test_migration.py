@@ -1,7 +1,8 @@
 """Elastic re-partitioning tests, mirroring /root/reference/tests/
 migration.rs behaviorally: on node addition, ranges stream to the new
-owner and no-longer-owned ranges are tombstoned; on node death, data
-re-replicates to restore RF."""
+owner (no-longer-owned ranges are tombstoned only under
+DBEEL_MIGRATION_DELETE=1 — see migration.py on the reversion hazard);
+on node death, data re-replicates to restore RF."""
 
 import asyncio
 
@@ -207,3 +208,100 @@ def test_node_addition_migrates_and_node_death_restores_rf(tmp_dir):
         await node3.stop()
 
     run(main(), timeout=120)
+
+
+def test_stale_epoch_write_refused_retryably_then_accepted(tmp_dir):
+    """Epoch fence (elastic membership plane): while a migration is
+    in flight, a write stamped with an older membership epoch is
+    refused with the retryable not-owned class; the client's normal
+    resync-and-retry picks up the new epoch and the write lands.
+    Unstamped writes (old clients, the C client) are never fenced."""
+
+    async def main():
+        import pytest
+
+        from dbeel_tpu import errors
+        from dbeel_tpu.server.db_server import handle_request
+
+        node = await ClusterNode(make_config(tmp_dir)).start()
+        blocker = None
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "f", replication_factor=1
+            )
+            await col.set("k0", 1)
+
+            shard = node.shards[0]
+            stale = client._cluster_epoch
+            assert stale == shard.membership_epoch > 0
+
+            # Simulate a membership change with a live migration: bump
+            # the epoch and park an in-flight task in the fence set.
+            # The ownership refresh matters: it is what makes the
+            # native fast path punt keyed ops to the Python dispatcher
+            # (where the fence lives) while a migration is active.
+            blocker = asyncio.ensure_future(asyncio.sleep(60))
+            shard.membership_epoch += 1
+            shard._migration_tasks.add(blocker)
+            shard._refresh_dataplane_ownership()
+
+            # Raw stale-stamped write: refused, and the refusal's
+            # taxonomy class is retryable (the client contract).
+            with pytest.raises(errors.KeyNotOwnedByShard) as ei:
+                await handle_request(
+                    shard,
+                    {
+                        "type": "set",
+                        "collection": "f",
+                        "key": "k1",
+                        "value": 2,
+                        "epoch": stale,
+                    },
+                )
+            assert errors.is_retryable_class(
+                errors.classify_error(ei.value)
+            )
+            assert shard.fence_refusals == 1
+
+            # Unstamped write (pre-epoch dialect): never fenced.
+            await handle_request(
+                shard,
+                {
+                    "type": "set",
+                    "collection": "f",
+                    "key": "k2",
+                    "value": 3,
+                },
+            )
+
+            # The full client path self-heals: refusal -> metadata
+            # resync (new epoch) -> re-stamped retry accepted.
+            await col.set("k3", 4)
+            assert client._cluster_epoch == shard.membership_epoch
+            assert shard.fence_refusals == 2
+            assert await col.get("k3") == 4
+
+            # Fence lifts when the last migration drains: stale
+            # stamps pass again (long-converged cluster, lazy client).
+            shard._migration_tasks.discard(blocker)
+            shard._refresh_dataplane_ownership()
+            await handle_request(
+                shard,
+                {
+                    "type": "set",
+                    "collection": "f",
+                    "key": "k4",
+                    "value": 5,
+                    "epoch": stale,
+                },
+            )
+            assert shard.fence_refusals == 2
+        finally:
+            if blocker is not None:
+                blocker.cancel()
+            await node.stop()
+
+    run(main())

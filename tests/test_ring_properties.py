@@ -27,24 +27,57 @@ from dbeel_tpu.client import DbeelClient
 from dbeel_tpu.cluster.local_comm import LocalShardConnection
 from dbeel_tpu.cluster.messages import NodeMetadata
 from dbeel_tpu.config import Config
-from dbeel_tpu.server.shard import MyShard, Shard, is_between
+from dbeel_tpu.server.shard import (
+    MyShard,
+    Shard,
+    is_between,
+    vnode_tokens,
+)
 from dbeel_tpu.storage.page_cache import PageCache
 from dbeel_tpu.utils.murmur import hash_string
 
 from conftest import run
 
 
-def _build_cluster(rng):
+def _node_metadata(name, cnt, vnodes):
+    """NodeMetadata as the node would gossip it: token lists appear
+    only when --vnodes > 1 (the wire dialect's optional trailing
+    element), so single-token nodes exercise the legacy arity."""
+    tokens = None
+    if vnodes > 1:
+        tokens = [
+            vnode_tokens(f"{name}-{sid}", vnodes)
+            for sid in range(cnt)
+        ]
+    return NodeMetadata(
+        name=name,
+        ip="127.0.0.1",
+        remote_shard_base_port=20000,
+        ids=list(range(cnt)),
+        gossip_port=30000,
+        db_port=10000,
+        tokens=tokens,
+    )
+
+
+def _build_cluster(rng, vnodes_by_node=None):
     """Random cluster: 2-5 nodes x 1-4 shards; returns one MyShard view
-    per shard (each node's shards are Local to that node's views)."""
+    per shard (each node's shards are Local to that node's views).
+    ``vnodes_by_node`` maps node index -> --vnodes for that node
+    (default 1 everywhere), so mixed single-token/vnode clusters can
+    be built the way gossip would build them."""
     n_nodes = rng.randint(2, 5)
     nodes = {
         f"node{chr(97 + i)}{rng.randrange(1000)}": rng.randint(1, 4)
         for i in range(n_nodes)
     }
+    vn = {
+        name: (vnodes_by_node or {}).get(i, 1)
+        for i, name in enumerate(nodes)
+    }
     views = []
     for node_name, n_shards in nodes.items():
-        config = Config(name=node_name)
+        config = Config(name=node_name, vnodes=vn[node_name])
         connections = [
             LocalShardConnection(i) for i in range(n_shards)
         ]
@@ -60,17 +93,11 @@ def _build_cluster(rng):
             view = MyShard(
                 config, sid, shards, PageCache(8), connections[sid]
             )
-            # Add every other node's shards as remote ring entries.
+            # Add every other node's shards as remote ring entries,
+            # carrying each node's own token dialect.
             view.add_shards_of_nodes(
                 [
-                    NodeMetadata(
-                        name=other,
-                        ip="127.0.0.1",
-                        remote_shard_base_port=20000,
-                        ids=list(range(cnt)),
-                        gossip_port=30000,
-                        db_port=10000,
-                    )
+                    _node_metadata(other, cnt, vn[other])
                     for other, cnt in nodes.items()
                     if other != node_name
                 ]
@@ -132,6 +159,191 @@ def test_server_owners_match_client_replica_walk(seed):
                     f"hash {h} replica {r}: client routes to "
                     f"{view.shard_name} but it rejects ownership"
                 )
+
+    run(main())
+
+
+def _arc_containing(arcs, key_hash):
+    """The (start, end, selected) arc owning ``key_hash``.  Arc bounds
+    come back +1-shifted half-open [start, end), which is exactly the
+    raw-ownership interval (prev, cur] — so the RAW hash tests
+    directly against them.  A single arc with start == end covers the
+    whole ring."""
+    for start, end, selected in arcs:
+        if start == end or is_between(key_hash, start, end):
+            return start, end, selected
+    raise AssertionError(f"no arc contains hash {key_hash}")
+
+
+@pytest.mark.parametrize("vnodes", [1, 8, 64])
+@pytest.mark.parametrize("seed", range(4))
+def test_replica_walk_matches_all_arcs(seed, vnodes):
+    """The per-key distinct-node walk (owns_key, which mirrors the
+    client walk) and the whole-ring arc decomposition (all_arcs, which
+    migration planning / anti-entropy / the scan plane consume) are
+    two derivations of the SAME ownership function — for every key the
+    walk's replica SET must equal the covering arc's selected set at
+    any vnode count.  (Sets, not sequences: the arc merge collapses
+    adjacent arcs whose walks pick the same shards in different
+    orders.)"""
+
+    async def main():
+        rng = random.Random(seed)
+        nodes, views = _build_cluster(
+            rng, vnodes_by_node={i: vnodes for i in range(5)}
+        )
+        rf = rng.randint(1, len(nodes))
+        arcs = views[0].all_arcs(rf)
+        # Every view computes the identical decomposition: the ring is
+        # shared state, the arcs are a pure function of it.
+        for v in views[1:]:
+            assert [
+                (s, e, {x.name for x in sel})
+                for s, e, sel in v.all_arcs(rf)
+            ] == [
+                (s, e, {x.name for x in sel}) for s, e, sel in arcs
+            ]
+        for _ in range(50):
+            h = rng.randrange(1 << 32)
+            _s, _e, selected = _arc_containing(arcs, h)
+            walk_names = set()
+            for r in range(len(selected)):
+                owners = [v for v in views if v.owns_key(h, r)]
+                assert len(owners) == 1, (
+                    f"hash {h} replica {r}: "
+                    f"{[o.shard_name for o in owners]}"
+                )
+                walk_names.add(owners[0].shard_name)
+            assert walk_names == {s.name for s in selected}, (
+                f"hash {h}: walk {walk_names} vs arc "
+                f"{ {s.name for s in selected} }"
+            )
+
+    run(main())
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_mixed_single_token_and_vnode_cluster_agrees(seed):
+    """Mixed-version cluster: some nodes advertise vnode token lists,
+    others the legacy single token (omitted wire element).  Every
+    member — old or new — walks the same union of advertised tokens,
+    so primary ownership still tiles the ring exactly and the client
+    walk still matches server-side ownership at every replica index."""
+
+    async def main():
+        rng = random.Random(seed)
+        # Odd-indexed nodes stay on the legacy single token.
+        nodes, views = _build_cluster(
+            rng,
+            vnodes_by_node={
+                i: (8 if i % 2 == 0 else 1) for i in range(5)
+            },
+        )
+        n_nodes = len(nodes)
+        vn = {
+            name: (8 if i % 2 == 0 else 1)
+            for i, name in enumerate(nodes)
+        }
+
+        client = DbeelClient([])
+        from dbeel_tpu.cluster.messages import ClusterMetadata
+
+        client._apply_metadata(
+            ClusterMetadata(
+                nodes=[
+                    _node_metadata(name, cnt, vn[name])
+                    for name, cnt in nodes.items()
+                ],
+                collections=[],
+            )
+        )
+
+        by_shard = {
+            (v.config.name, v.id): v for v in views
+        }
+        for _ in range(60):
+            h = rng.randrange(1 << 32)
+            owners = [v for v in views if v.owns_key(h, 0)]
+            assert len(owners) == 1, (
+                f"hash {h}: {[o.shard_name for o in owners]}"
+            )
+            walk = client._shards_for_key(h, n_nodes)
+            for r, client_shard in enumerate(walk):
+                view = by_shard[
+                    (
+                        client_shard.node_name,
+                        client_shard.db_port - 10000,
+                    )
+                ]
+                assert view.owns_key(h, r), (
+                    f"hash {h} replica {r}: client routes to "
+                    f"{view.shard_name} but it rejects ownership"
+                )
+
+    run(main())
+
+
+def _fixed_cluster(vnodes, n_nodes=4):
+    """Deterministic cluster (one shard per node) for the spread
+    bounds — random shard counts would skew per-node load by design."""
+    names = [f"spread-node-{i}" for i in range(n_nodes)]
+    views = []
+    for name in names:
+        config = Config(name=name, vnodes=vnodes)
+        conn = LocalShardConnection(0)
+        view = MyShard(
+            config,
+            0,
+            [Shard(node_name=name, name=f"{name}-0", connection=conn)],
+            PageCache(8),
+            conn,
+        )
+        view.add_shards_of_nodes(
+            [
+                _node_metadata(other, 1, vnodes)
+                for other in names
+                if other != name
+            ]
+        )
+        views.append(view)
+    return views
+
+
+def _primary_share_by_node(view):
+    """Fraction of the 2^32 hash space each node primarily owns."""
+    total = float(1 << 32)
+    share: dict = {}
+    for start, end, selected in view.all_arcs(1):
+        length = (end - start) % (1 << 32) or (1 << 32)
+        node = selected[0].node_name
+        share[node] = share.get(node, 0.0) + length / total
+    return share
+
+
+def test_vnode_arc_count_and_load_spread_bounds():
+    """More tokens -> more, smaller arcs -> tighter per-node load.
+    Pinned: (a) the arc count never exceeds the token count (merging
+    only shrinks it), (b) at --vnodes 64 every node's primary share
+    sits within 2x of fair, and (c) the 64-token spread is strictly
+    tighter than the same nodes' single-token spread."""
+
+    async def main():
+        def spread(views):
+            share = _primary_share_by_node(views[0])
+            fair = 1.0 / len(views)
+            return share, max(share.values()) / fair
+
+        v1 = _fixed_cluster(1)
+        v64 = _fixed_cluster(64)
+
+        assert len(v1[0].all_arcs(2)) <= 4
+        assert len(v64[0].all_arcs(2)) <= 4 * 64
+
+        share64, ratio64 = spread(v64)
+        _share1, ratio1 = spread(v1)
+        assert len(share64) == 4  # every node owns SOMETHING
+        assert ratio64 < 2.0, share64
+        assert ratio64 < ratio1, (ratio64, ratio1)
 
     run(main())
 

@@ -317,6 +317,51 @@ def test_watchdog_shed_storm_dead_climb_trace_churn():
     assert dog.stats()["findings_by_kind"]["shed_storm"] == 1
 
 
+def test_watchdog_migration_stall_and_rate():
+    """Elastic membership (ISSUE 18): keys_migrated_per_s derives
+    from the membership counter, and migration_stall fires only when
+    a migration is ACTIVE with keys_migrated unmoved across
+    MIGRATION_STALL_WINDOWS consecutive windows."""
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    # Progressing migration: rate > 0, no stall at any prefix.
+    for i, km in enumerate((0, 400, 800)):
+        _sample(
+            ring, float(i),
+            **{
+                "membership.migrations_active": 1,
+                "membership.keys_migrated": km,
+            },
+        )
+        assert "migration_stall" not in _kinds(dog.evaluate(ring))
+    assert ring.rates()["keys_migrated_per_s"] == 400.0
+    # Counter freezes while still active: stall needs the FULL run of
+    # unmoved windows (3), not the first flat sample.
+    for i in range(tm.MIGRATION_STALL_WINDOWS):
+        _sample(
+            ring, 3.0 + i,
+            **{
+                "membership.migrations_active": 1,
+                "membership.keys_migrated": 800,
+            },
+        )
+        kinds = _kinds(dog.evaluate(ring))
+        if i < tm.MIGRATION_STALL_WINDOWS - 1:
+            assert "migration_stall" not in kinds, i
+        else:
+            assert "migration_stall" in kinds
+    # Same flat counter with the migration DRAINED: no finding — a
+    # finished plan is not a stalled one.
+    _sample(
+        ring, 9.0,
+        **{
+            "membership.migrations_active": 0,
+            "membership.keys_migrated": 800,
+        },
+    )
+    assert "migration_stall" not in _kinds(dog.evaluate(ring))
+
+
 def test_watchdog_log_rate_limited(caplog):
     ring = tm.TelemetryRing(capacity=8)
     dog = tm.HealthWatchdog()
