@@ -68,6 +68,9 @@ _STATS_FUNCS = {
     # Elastic membership (PR 18): the get_stats.membership block is
     # assembled by this helper.
     "_membership_stats",
+    # Atomic plane (ISSUE 19): the get_stats.atomic block is
+    # assembled by this helper.
+    "_atomic_stats",
     "queued_by_node",
     "queued_total",
     "group_commit_stats",

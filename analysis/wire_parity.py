@@ -464,6 +464,42 @@ _QOS_TRACE_PUNT_RE = re.compile(
     r"\s*;\s*if\s*\(\s*trace_v\s*>\s*0\s*\)\s*return\s*-1\s*;"
 )
 
+# The native data plane's atomic-verb punt (atomic plane, ISSUE 19):
+# conditional writes MUST take the interpreted path — the
+# membership-epoch fence, the per-arc decider lock, and the post-boot
+# barrier all live there, so a native fast-path absorbing these verbs
+# would silently bypass every guarantee the atomic plane makes.  The
+# punt is pinned as explicit recognition (slice_eq on both verbs, then
+# return -1) so a future fast-path widening cannot claim them by
+# accident.
+_ATOMIC_PUNT_RE = re.compile(
+    r'is_atomic\s*=\s*slice_eq\(type_s,\s*type_n,\s*"cas"\)\s*\|\|'
+    r'\s*slice_eq\(type_s,\s*type_n,\s*"atomic_batch"\)\s*;\s*'
+    r"if\s*\(\s*is_atomic\s*\)\s*return\s+-1\s*;"
+)
+
+
+def _module_str_collection(
+    tree: ast.AST, name: str
+) -> "Optional[Set[str]]":
+    """String elements of a module-level ``NAME = ("a", "b", ...)``
+    tuple/set/list constant (None when the constant is missing)."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, (ast.Tuple, ast.Set, ast.List))
+        ):
+            out: Set[str] = set()
+            for elt in node.value.elts:
+                val = const_str(elt)
+                if val is not None:
+                    out.add(val)
+            return out
+    return None
+
 
 def check(repo: Repo) -> List[Finding]:
     findings: List[Finding] = []
@@ -1133,6 +1169,77 @@ def check(repo: Repo) -> List[Finding]:
             "the Python client no longer stamps the 'epoch' request "
             "field on writes — stale-ring writes would land "
             "unfenced during migration",
+        )
+
+    # -- atomic plane (ISSUE 19): CAS/BATCH verb dialect pins --------
+    # Conditional writes are only correct because three server-side
+    # mechanisms (the epoch fence, the per-arc decider lock, the
+    # post-restart barrier) sit on the interpreted path.  Pin the
+    # dialect three ways: the native plane's explicit punt, both
+    # clients' verb reachability, and the fence-stamp op set.
+    if _ATOMIC_PUNT_RE.search(strip_c_comments(native_src)) is None:
+        add(
+            repo.native_cpp,
+            1,
+            "native data plane lost the explicit cas/atomic_batch "
+            "punt (slice_eq on both verbs then return -1) — a "
+            "native fast path absorbing conditional writes would "
+            "bypass the epoch fence, the per-arc decider lock, and "
+            "the post-restart barrier",
+        )
+    for verb in ("cas", "atomic_batch"):
+        if verb not in client_ops:
+            add(
+                repo.db_server_py,
+                1,
+                f"db_server.py no longer dispatches the {verb!r} "
+                "verb — the atomic plane lost its server entry "
+                "point",
+            )
+    py_emitted = _client_emitted_types(client)
+    for verb in ("cas", "atomic_batch"):
+        if verb not in py_emitted:
+            add(
+                repo.client_py,
+                1,
+                f"Python client no longer emits the {verb!r} verb — "
+                "conditional writes must stay reachable from both "
+                "clients",
+            )
+    if "cas" not in client_c_tokens:
+        add(
+            repo.client_cpp,
+            1,
+            "C client no longer emits the 'cas' verb "
+            "(dbeel_cli_cas) — conditional writes must stay "
+            "reachable from both clients",
+        )
+    for fld in ("expect_ts", "expect_value", "expect_absent"):
+        if fld not in _request_fields(db_server, _empty):
+            add(
+                repo.db_server_py,
+                1,
+                f"db_server no longer reads the {fld!r} CAS "
+                "expectation field — a conditional write would "
+                "commit unconditionally",
+            )
+    stamped = _module_str_collection(client, "_EPOCH_STAMPED_OPS")
+    if stamped is None:
+        add(
+            repo.client_py,
+            1,
+            "_EPOCH_STAMPED_OPS module constant missing — the set "
+            "of epoch-fenced client ops must stay a named, "
+            "lint-pinned literal",
+        )
+    elif not {"set", "delete", "cas", "atomic_batch"} <= stamped:
+        add(
+            repo.client_py,
+            1,
+            f"_EPOCH_STAMPED_OPS shrank to {sorted(stamped)!r} — "
+            "set/delete/cas/atomic_batch must all carry the "
+            "membership-epoch stamp or mid-migration writes land "
+            "unfenced",
         )
 
     return findings

@@ -935,6 +935,220 @@ async def main_scan_filter(args):
     print("SCAN_FILTER_REPORT " + json.dumps(report))
 
 
+async def main_cas(args):
+    """--cas (atomic plane, ISSUE 19): same-session CAS cost profile
+    against a running server.
+
+    Phase A: plain-set baseline (the LWW floor CAS must be judged
+    against).  Phase B: UNCONTENDED CAS — each worker chains
+    expect_value updates on its own key, so the delta vs phase A is
+    the pure decide cost (owner read + arc lock + replication).
+    Phase C: the contention knee — 1/4/16 writers incrementing ONE
+    hot key through the compliant read→cas→on-conflict-re-read loop;
+    reports acked increments/s, the conflict ratio, attempts per
+    acked increment, and the acked p99 of the WHOLE retry cycle (the
+    price a real hot-key workload pays).  Correctness is asserted in
+    passing: the hot counter's final value must equal total acked
+    increments.  --json-out writes the BENCH_r19.json artifact.
+
+    One opportunistic device_capture probe rides the phase (the
+    tunnel-proof benching discipline)."""
+    import subprocess
+
+    from dbeel_tpu.errors import (
+        CasConflict,
+        CollectionAlreadyExists,
+        KeyNotFound,
+    )
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)]
+    )
+    try:
+        await client.create_collection(
+            args.collection, args.replication_factor
+        )
+    except CollectionAlreadyExists:
+        pass
+    col = client.collection(args.collection)
+    dur = args.cas_duration
+    loop = asyncio.get_event_loop()
+    report = {
+        "duration_per_cell_s": dur,
+        "clients": args.clients,
+        "value_size": args.value_size,
+    }
+
+    probe = {}
+    if os.environ.get("DBEEL_BENCH_NO_PROBE"):
+        probe["skipped"] = True
+    else:
+        try:
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            rc = subprocess.call(
+                [
+                    sys.executable, "device_capture.py",
+                    "--probe-timeout", "45",
+                ],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env,
+                timeout=900,
+            )
+            probe["rc"] = rc
+            probe["tunnel"] = "alive" if rc == 0 else "dead"
+        except Exception as e:  # pragma: no cover - best-effort
+            probe["error"] = str(e)[:200]
+            probe["tunnel"] = "dead"
+    report["device_probe"] = probe
+
+    value = {"blob": "x" * args.value_size}
+    # Fresh keys per run: expect_absent creates and the final-count
+    # assertion both assume nothing is left over from a prior run.
+    run = f"{int(time.time()) % 1000000}"
+
+    # ---- A: plain-set baseline --------------------------------------
+    async def timed_cell(worker_fn, n_workers):
+        lat = []
+        stop_at = loop.time() + dur
+        counts = await asyncio.gather(
+            *[worker_fn(w, stop_at, lat) for w in range(n_workers)]
+        )
+        return sum(counts), lat
+
+    async def set_worker(w, stop_at, lat):
+        i = ok = 0
+        while loop.time() < stop_at:
+            i += 1
+            t0 = time.perf_counter()
+            await col.set(f"casb{w}x{i}", value)
+            lat.append(time.perf_counter() - t0)
+            ok += 1
+        return ok
+
+    ok, lat = await timed_cell(set_worker, args.clients)
+    report["set_baseline"] = {
+        "ops_per_s": round(ok / dur, 1),
+        "p99_ms": round(
+            sorted(lat)[int(0.99 * (len(lat) - 1))] * 1000, 3
+        ) if lat else None,
+    }
+    print(
+        f"set baseline: {report['set_baseline']['ops_per_s']:,.0f} "
+        f"ops/s  {percentiles(lat)}"
+    )
+
+    # ---- B: uncontended CAS chains ----------------------------------
+    async def chain_worker(w, stop_at, lat):
+        key = f"caschain{run}w{w}"
+        cur = value | {"w": w, "i": 0}
+        t0 = time.perf_counter()
+        await col.cas(key, cur, expect_absent=True)
+        lat.append(time.perf_counter() - t0)
+        ok = 1
+        while loop.time() < stop_at:
+            nxt = value | {"w": w, "i": cur["i"] + 1}
+            t0 = time.perf_counter()
+            await col.cas(key, nxt, expect_value=cur)
+            lat.append(time.perf_counter() - t0)
+            cur = nxt
+            ok += 1
+        return ok
+
+    ok, lat = await timed_cell(chain_worker, args.clients)
+    report["cas_uncontended"] = {
+        "ops_per_s": round(ok / dur, 1),
+        "p99_ms": round(
+            sorted(lat)[int(0.99 * (len(lat) - 1))] * 1000, 3
+        ) if lat else None,
+        "vs_set_baseline": round(
+            (ok / dur) / max(report["set_baseline"]["ops_per_s"], 1e-9),
+            3,
+        ),
+    }
+    print(
+        f"cas uncontended: "
+        f"{report['cas_uncontended']['ops_per_s']:,.0f} ops/s "
+        f"({report['cas_uncontended']['vs_set_baseline']:.2f}x of "
+        f"plain set)  {percentiles(lat)}"
+    )
+
+    # ---- C: hot-key contention knee ---------------------------------
+    report["contention_knee"] = []
+    for n_writers in (1, 4, 16):
+        hot = f"cashot{run}w{n_writers}"
+        attempts = [0]
+        conflicts = [0]
+
+        async def hot_worker(w, stop_at, lat):
+            acked = 0
+            while loop.time() < stop_at:
+                t_cycle = time.perf_counter()
+                while True:
+                    cur = None
+                    try:
+                        cur = await col.get(hot)
+                    except KeyNotFound:
+                        pass
+                    attempts[0] += 1
+                    try:
+                        if cur is None:
+                            await col.cas(
+                                hot, {"n": 1},
+                                expect_absent=True,
+                            )
+                        else:
+                            await col.cas(
+                                hot, {"n": cur["n"] + 1},
+                                expect_value=cur,
+                            )
+                        break
+                    except CasConflict:
+                        conflicts[0] += 1
+                        if loop.time() >= stop_at:
+                            return acked
+                lat.append(time.perf_counter() - t_cycle)
+                acked += 1
+            return acked
+
+        acked, lat = await timed_cell(hot_worker, n_writers)
+        final = (await col.get(hot))["n"]
+        cell = {
+            "writers": n_writers,
+            "acked_increments_per_s": round(acked / dur, 1),
+            "acked_p99_ms": round(
+                sorted(lat)[int(0.99 * (len(lat) - 1))] * 1000, 3
+            ) if lat else None,
+            "attempts_per_acked": round(
+                attempts[0] / max(acked, 1), 3
+            ),
+            "conflict_ratio": round(
+                conflicts[0] / max(attempts[0], 1), 4
+            ),
+            "final_count": final,
+            "acked_total": acked,
+            "zero_lost_updates": final == acked,
+        }
+        assert cell["zero_lost_updates"], (
+            f"hot key {hot}: final {final} != acked {acked}"
+        )
+        report["contention_knee"].append(cell)
+        print(
+            f"knee w={n_writers}: "
+            f"{cell['acked_increments_per_s']:,.0f} incr/s, "
+            f"{cell['attempts_per_acked']:.2f} attempts/acked, "
+            f"conflict ratio {cell['conflict_ratio']:.3f}, "
+            f"acked p99 {cell['acked_p99_ms']}ms"
+        )
+
+    print("CAS_REPORT " + json.dumps(report))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    client.close()
+
+
 async def main_scan_filter_indexed(args):
     """--scan-filter-indexed (secondary indexes, ISSUE 17):
     same-session A/B of the persisted-index scan planner against
@@ -1652,6 +1866,22 @@ def main():
         "BENCH_r17.json artifact",
     )
     ap.add_argument(
+        "--cas",
+        action="store_true",
+        help="atomic-plane phase (ISSUE 19): same-session plain-set "
+        "baseline, uncontended CAS chains, and the hot-key "
+        "contention knee (1/4/16 writers on one key via the "
+        "read-cas-retry loop) — acked increments/s, conflict ratio, "
+        "attempts per acked op, and the zero-lost-updates check.  "
+        "--json-out writes the BENCH_r19.json artifact",
+    )
+    ap.add_argument(
+        "--cas-duration",
+        type=float,
+        default=6.0,
+        help="seconds per --cas cell",
+    )
+    ap.add_argument(
         "--telemetry-overhead",
         action="store_true",
         help="telemetry-plane A/B phase: lockstep set/get throughput "
@@ -1738,6 +1968,8 @@ def main():
         asyncio.run(main_knee_worker(args))
     elif args.telemetry_overhead:
         asyncio.run(main_telemetry_overhead(args))
+    elif args.cas:
+        asyncio.run(main_cas(args))
     elif args.scan_filter_indexed:
         asyncio.run(main_scan_filter_indexed(args))
     elif args.scan_filter:

@@ -53,7 +53,11 @@ from dbeel_tpu.client import Consistency, DbeelClient  # noqa: E402
 from dbeel_tpu.cluster.remote_comm import (  # noqa: E402
     RemoteShardConnection,
 )
-from dbeel_tpu.errors import ERROR_CLASSES, classify_error  # noqa: E402
+from dbeel_tpu.errors import (  # noqa: E402
+    ERROR_CLASSES,
+    CasConflict,
+    classify_error,
+)
 from dbeel_tpu.cluster.messages import ShardRequest  # noqa: E402
 from dbeel_tpu.utils.murmur import hash_bytes  # noqa: E402
 
@@ -1766,6 +1770,399 @@ async def scan_phase(nodes, seeds, acks, report, quick):
     return ok_gate
 
 
+async def cas_phase(nodes, seeds, report, quick):
+    """--cas (atomic plane, ISSUE 19): the lost-update gate.  N
+    closed-loop clients drive counter increments THROUGH the CAS
+    plane (read -> cas(expect_value=current) -> on conflict re-read
+    and retry) plus an expect_absent uniqueness workload, while the
+    cluster takes a replica SIGKILL, an asymmetric partition + heal,
+    and one membership add/remove cycle.  Every counter value embeds
+    a per-client slot map ``{"n": total, "by": {wid: count}}`` so the
+    gate is exact even for AMBIGUOUS outcomes (timeout after the
+    decider may or may not have applied):
+      * zero lost updates:  by[wid] >= unambiguously-acked[wid];
+      * zero double-applies: by[wid] <= acked[wid] + ambiguous[wid];
+      * internal consistency: n == sum(by.values()) on every counter;
+      * uniqueness: per key at most ONE acked expect_absent winner,
+        an acked winner's value is what reads back, and whatever
+        reads back was written by an acked-or-ambiguous claimant;
+      * all RF replicas byte-agree after convergence;
+      * contention was real (server cas_conflicts moved) and the
+        get_stats atomic block is live."""
+    cons = Consistency.fixed(2)
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)], op_deadline_s=10.0
+    )
+    col = client.collection(COLLECTION)
+
+    # Baseline atomic counters (the soak may run other phases first).
+    async def _atomic_totals():
+        tot = {"cas_served": 0, "cas_conflicts": 0,
+               "batches_committed": 0, "batches_refused": 0}
+        block_keys = None
+        for n in nodes:
+            if not n.alive():
+                continue
+            for sid in range(SHARDS):
+                try:
+                    s = await client.get_stats(
+                        "127.0.0.1", n.db_port + sid
+                    )
+                    blk = s.get("atomic") or {}
+                    if blk and block_keys is None:
+                        block_keys = set(blk)
+                    for k in tot:
+                        tot[k] += blk.get(k, 0)
+                except Exception:
+                    pass
+        return tot, block_keys
+
+    atomic0, _ = await _atomic_totals()
+
+    n_clients = 4 if quick else 6
+    n_counters = 4 if quick else 8
+    counters = [f"casctr{i}" for i in range(n_counters)]
+    n_uniq = 16 if quick else 40
+    uniq_keys = [f"casuniq{i:03d}" for i in range(n_uniq)]
+
+    acked = [dict((c, 0) for c in counters) for _ in range(n_clients)]
+    ambiguous = [
+        dict((c, 0) for c in counters) for _ in range(n_clients)
+    ]
+    conflicts_seen = [0] * n_clients
+    uniq_acked: dict = {}       # key -> [wid, ...] acked winners
+    uniq_ambiguous: dict = {}   # key -> [wid, ...] unknown outcomes
+    stop = asyncio.Event()
+
+    async def ctr_worker(wid):
+        rng = random.Random(7000 + wid)
+        while not stop.is_set():
+            key = rng.choice(counters)
+            me = str(wid)
+            try:
+                cur = None
+                try:
+                    cur = await asyncio.wait_for(
+                        col.get(key, consistency=cons), 15
+                    )
+                except Exception as e:
+                    if "KeyNotFound" not in repr(e):
+                        raise
+                if cur is None:
+                    new = {"n": 1, "by": {me: 1}}
+                    await asyncio.wait_for(
+                        col.cas(
+                            key, new, expect_absent=True,
+                            consistency=cons,
+                        ),
+                        15,
+                    )
+                else:
+                    by = dict(cur["by"])
+                    by[me] = by.get(me, 0) + 1
+                    new = {"n": cur["n"] + 1, "by": by}
+                    await asyncio.wait_for(
+                        col.cas(
+                            key, new, expect_value=cur,
+                            consistency=cons,
+                        ),
+                        15,
+                    )
+                acked[wid][key] += 1
+            except CasConflict:
+                # A decided refusal: definitively NOT applied — the
+                # compliant retry is simply the next loop iteration's
+                # fresh read.
+                conflicts_seen[wid] += 1
+            except Exception:
+                # Timeout / not-owned walk exhaustion / overload
+                # AFTER the decider may have applied: the slot map
+                # settles the truth at the end of the phase.
+                ambiguous[wid][key] += 1
+                await asyncio.sleep(0.3)
+            await asyncio.sleep(0)
+
+    async def uniq_worker(wid, order):
+        for key in order:
+            if stop.is_set():
+                return
+            try:
+                await asyncio.wait_for(
+                    col.cas(
+                        key, wid, expect_absent=True,
+                        consistency=cons,
+                    ),
+                    15,
+                )
+                uniq_acked.setdefault(key, []).append(wid)
+            except CasConflict:
+                pass  # somebody else holds it: the designed outcome
+            except Exception:
+                uniq_ambiguous.setdefault(key, []).append(wid)
+                await asyncio.sleep(0.2)
+            await asyncio.sleep(0.05 if quick else 0.1)
+
+    workers = [
+        asyncio.create_task(ctr_worker(w)) for w in range(n_clients)
+    ]
+    for w in range(n_clients):
+        order = list(uniq_keys)
+        random.Random(8000 + w).shuffle(order)
+        workers.append(asyncio.create_task(uniq_worker(w, order)))
+
+    # ---- fault schedule under the CAS load ---------------------------
+    settle = 3.0 if quick else 6.0
+    await asyncio.sleep(settle)  # contention baseline, no faults
+
+    # 1. Replica SIGKILL + restart: deciders die mid-stream; standby
+    #    deciders may only stand in once the walk predecessors are
+    #    marked Dead, and the restarted decider sits out its barrier.
+    victim = nodes[2]
+    log(f"CAS: SIGKILL {victim.name}")
+    victim.kill()
+    await asyncio.sleep(6.0 if quick else 12.0)
+    victim.start(seeds)
+    await wait_port(victim.db_port)
+    await asyncio.sleep(settle)
+
+    # 2. Asymmetric partition on another node + clean-restart heal:
+    #    decided-but-unacked CAS outcomes must ride the hint log.
+    victim = nodes[1]
+    peer_addrs = [
+        f"127.0.0.1:{n.remote_port + sid}"
+        for n in nodes
+        if n is not victim
+        for sid in range(SHARDS)
+    ]
+    log(f"CAS: partitioning {victim.name} (asymmetric blackhole)")
+    victim.kill()
+    victim.start(
+        seeds,
+        extra_env={
+            "DBEEL_REMOTE_FAULTS": ",".join(
+                f"{a}=blackhole" for a in peer_addrs
+            ),
+            "DBEEL_REMOTE_FAULTS_DELAY_S": "3",
+        },
+        extra_argv=[
+            "--remote-shard-connect-timeout", "1000",
+            "--remote-shard-read-timeout", "2000",
+            "--remote-shard-write-timeout", "2000",
+        ],
+    )
+    await wait_port(victim.db_port)
+    await asyncio.sleep(8.0 if quick else 16.0)
+    log(f"CAS: healing {victim.name} (clean restart)")
+    victim.kill()
+    victim.start(seeds)
+    await wait_port(victim.db_port)
+    await asyncio.sleep(settle)
+
+    # 3. One membership churn cycle: arcs move, the epoch fence and
+    #    mid-migration not-owned refusals hit live CAS traffic.
+    extra = Node(70)
+    log(f"CAS: membership cycle — add {extra.name}")
+    extra.start(seeds)
+    cycle_ok = await wait_port(extra.db_port)
+    if cycle_ok:
+        probe = await DbeelClient.from_seed_nodes(
+            [("127.0.0.1", nodes[0].db_port)], op_deadline_s=5.0
+        )
+        await _await_member_count(
+            probe, N_NODES + 1, 20.0 if quick else 60.0
+        )
+        await asyncio.sleep(settle)  # addition migration under CAS
+        log(f"CAS: membership cycle — scale {extra.name} back in")
+        extra.kill()
+        await _await_member_count(
+            probe, N_NODES, 40.0 if quick else 120.0
+        )
+        probe.close()
+    await asyncio.sleep(settle)
+
+    stop.set()
+    await asyncio.gather(*workers, return_exceptions=True)
+
+    # ---- ring reconvergence: every node re-advertises the base ring --
+    # An asymmetric false removal (a CPU-starved node dropping a peer
+    # that never dropped it) heals via gossip re-announce, but racing
+    # the digest scan / the caller's base-workload verify against that
+    # heal turns a ring-view transient into phantom "lost" reads
+    # refused with not-owned.  Wait it out, per node, bounded.
+    ring_ok = True
+    for n in nodes:
+        try:
+            pr = await DbeelClient.from_seed_nodes(
+                [("127.0.0.1", n.db_port)], op_deadline_s=5.0
+            )
+            reached, last = await _await_member_count(
+                pr, N_NODES, 60.0 if quick else 120.0
+            )
+            pr.close()
+            if not reached:
+                ring_ok = False
+                log(f"CAS: {n.name} ring stuck at {last} members")
+        except Exception as e:
+            ring_ok = False
+            log(f"CAS: ring probe {n.name} failed: {e!r}")
+
+    # ---- convergence: replicas byte-agree on every phase key ---------
+    all_keys = counters + uniq_keys
+    t0 = time.time()
+    conv_deadline = t0 + (90 if quick else 180)
+    scan_conns: dict = {}
+    try:
+        while True:
+            divergent = await _replica_digest_scan(
+                client, all_keys, scan_conns
+            )
+            if not divergent or time.time() > conv_deadline:
+                break
+            log(
+                f"CAS: {len(divergent)} keys divergent; waiting on "
+                "hints/anti-entropy ..."
+            )
+            await asyncio.sleep(4)
+    finally:
+        for c in scan_conns.values():
+            c.close_pool()
+    convergence_s = round(time.time() - t0, 1)
+
+    # ---- the lost-update / double-apply gate -------------------------
+    lost = []       # acked increments missing from the slot map
+    doubled = []    # slot counts above acked + ambiguous
+    internal = []   # n != sum(by)
+    final_counts = {}
+    for key in counters:
+        try:
+            val = await asyncio.wait_for(
+                col.get(key, consistency=Consistency.fixed(RF)), 20
+            )
+        except Exception as e:
+            if "KeyNotFound" in repr(e) and not any(
+                acked[w][key] for w in range(n_clients)
+            ):
+                continue  # never successfully created
+            lost.append((key, f"unreadable: {repr(e)[:80]}"))
+            continue
+        by = val.get("by", {})
+        final_counts[key] = val.get("n")
+        if val.get("n") != sum(by.values()):
+            internal.append((key, val.get("n"), dict(by)))
+        for w in range(n_clients):
+            applied = by.get(str(w), 0)
+            if applied < acked[w][key]:
+                lost.append(
+                    (key, f"w{w} acked {acked[w][key]}, "
+                          f"applied {applied}")
+                )
+            if applied > acked[w][key] + ambiguous[w][key]:
+                doubled.append(
+                    (key, f"w{w} applied {applied} > acked "
+                          f"{acked[w][key]} + ambiguous "
+                          f"{ambiguous[w][key]}")
+                )
+
+    uniq_double_acks = [
+        (k, ws) for k, ws in uniq_acked.items() if len(ws) > 1
+    ]
+    uniq_lost = []
+    uniq_foreign = []
+    uniq_winners = 0
+    for key in uniq_keys:
+        try:
+            got = await asyncio.wait_for(
+                col.get(key, consistency=Consistency.fixed(RF)), 20
+            )
+        except Exception as e:
+            if "KeyNotFound" in repr(e):
+                if uniq_acked.get(key):
+                    uniq_lost.append(
+                        (key, f"acked by w{uniq_acked[key]}, "
+                              "reads absent")
+                    )
+                continue
+            uniq_lost.append((key, f"unreadable: {repr(e)[:80]}"))
+            continue
+        uniq_winners += 1
+        ok_writers = set(uniq_acked.get(key, [])) | set(
+            uniq_ambiguous.get(key, [])
+        )
+        if uniq_acked.get(key) and got != uniq_acked[key][0]:
+            uniq_lost.append(
+                (key, f"acked winner w{uniq_acked[key][0]}, "
+                      f"reads {got!r}")
+            )
+        elif got not in ok_writers:
+            uniq_foreign.append((key, got))
+
+    atomic1, atomic_block_keys = await _atomic_totals()
+    conflicts_server = (
+        atomic1["cas_conflicts"] - atomic0["cas_conflicts"]
+    )
+    stats_block_ok = bool(atomic_block_keys) and {
+        "cas_served",
+        "cas_conflicts",
+        "batches_committed",
+        "batches_refused",
+        "barrier_remaining_ms",
+    } <= (atomic_block_keys or set())
+
+    total_acked = sum(
+        acked[w][c] for w in range(n_clients) for c in counters
+    )
+    total_ambiguous = sum(
+        ambiguous[w][c] for w in range(n_clients) for c in counters
+    )
+    nodes_alive = all(n.alive() for n in nodes)
+    ok = (
+        nodes_alive
+        and ring_ok
+        and not lost
+        and not doubled
+        and not internal
+        and not divergent
+        and not uniq_double_acks
+        and not uniq_lost
+        and not uniq_foreign
+        and total_acked > 0
+        and conflicts_server > 0
+        and stats_block_ok
+    )
+    report["cas"] = {
+        "clients": n_clients,
+        "counters": n_counters,
+        "uniq_keys": n_uniq,
+        "acked_increments": total_acked,
+        "ambiguous_outcomes": total_ambiguous,
+        "client_conflicts": sum(conflicts_seen),
+        "server_cas_conflicts": conflicts_server,
+        "server_cas_served": (
+            atomic1["cas_served"] - atomic0["cas_served"]
+        ),
+        "final_counts": final_counts,
+        "lost_updates": len(lost),
+        "lost_samples": lost[:10],
+        "double_applies": len(doubled),
+        "double_samples": doubled[:10],
+        "internal_mismatches": len(internal),
+        "uniq_winners": uniq_winners,
+        "uniq_double_acks": len(uniq_double_acks),
+        "uniq_lost": len(uniq_lost),
+        "uniq_lost_samples": uniq_lost[:10],
+        "uniq_foreign_values": len(uniq_foreign),
+        "divergent_keys": len(divergent),
+        "convergence_s": convergence_s,
+        "stats_atomic_block": stats_block_ok,
+        "ring_reconverged": ring_ok,
+        "nodes_alive": nodes_alive,
+        "pass": ok,
+    }
+    log("CAS:", json.dumps(report["cas"])[:900])
+    client.close()
+    return ok
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -1815,6 +2212,15 @@ async def main():
         "p99 bounded vs the same-session baseline, replicas byte-"
         "agree within the convergence deadline, and the membership "
         "epoch + migration counters moved",
+    )
+    ap.add_argument(
+        "--cas", action="store_true",
+        help="after churn: N clients drive CAS-retry counter "
+        "increments and an expect_absent uniqueness workload through "
+        "a replica kill, a partition heal, and one membership cycle; "
+        "assert zero lost updates, zero double-applies, at most one "
+        "acked winner per unique key, and replica byte-agreement "
+        "after convergence",
     )
     ap.add_argument(
         "--scan", action="store_true",
@@ -1976,6 +2382,17 @@ async def main():
         health_phases["scan"] = await collect_health(
             nodes, "scan", args.trace_dump_dir
         )
+    if args.cas:
+        ok = (
+            await cas_phase(nodes, seeds, report, args.quick)
+        ) and ok
+        await collect_traces(nodes, "cas", args.trace_dump_dir)
+        health_phases["cas"] = await collect_health(
+            nodes, "cas", args.trace_dump_dir
+        )
+        # Let lingering decided-but-unacked hints drain before any
+        # later phase's divergence scan.
+        await asyncio.sleep(min(args.quiet_window, 10.0))
     if args.churn:
         ok = (
             await membership_churn_phase(

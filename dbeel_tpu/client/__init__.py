@@ -23,8 +23,10 @@ import msgpack
 
 from ..errors import (
     ERROR_CLASS_OVERLOAD,
+    ERROR_CLASS_PEER_DEAD,
     ERROR_CLASS_QUOTA,
     BadFieldType,
+    CasConflict,
     ConnectionError_,
     DbeelError,
     KeyNotFound,
@@ -42,6 +44,14 @@ from ..utils.murmur import hash_bytes, hash_string
 RESPONSE_ERR = 0
 RESPONSE_OK = 1
 RESPONSE_BYTES = 2
+
+# Ops that carry the membership-epoch write fence (ISSUE 18/19): every
+# mutation routed by the client's ring view.  Conditional writes MUST
+# stamp it — a CAS decided against a mid-migration stale view is
+# exactly the lost-update the atomic plane exists to prevent.
+# Lint-pinned (analysis/wire_parity.py) so the set cannot silently
+# shrink.
+_EPOCH_STAMPED_OPS = ("set", "delete", "cas", "atomic_batch")
 
 
 class Consistency:
@@ -456,6 +466,16 @@ class DbeelClient:
         d = min(cls.BACKOFF_CAP_S, cls.BACKOFF_BASE_S * (1 << shift))
         return d * (0.5 + 0.5 * rng.random())
 
+    def _retry_reserve_s(self) -> float:
+        """Minimum budget a NEW attempt needs to be worth dialing.
+        The backoff pause is capped at the remaining budget, so
+        without this floor the last retry of every deadline-bounded
+        sequence launches with ~0 ms left — guaranteed wasted work
+        that the server's deadline check answers with a generic
+        Timeout, downgrading the meaningful refusal (Overloaded,
+        QuotaExceeded) the earlier rounds surfaced as last_error."""
+        return min(0.1, self._op_deadline_s / 4)
+
     def _stamp_qos(self, request: dict) -> None:
         """QoS stamp (class + tenant) on a data-op frame — one place
         so every transport (walk, scan chunks, multi frames) stamps
@@ -485,6 +505,22 @@ class DbeelClient:
         )
         attempt = 0
         last_error: Optional[Exception] = None
+        # Conditional writes are NOT blindly replayable: past the
+        # decider's decide point a failed exchange may have committed,
+        # and replaying the same expectations would either lose to the
+        # op's own applied outcome (mis-reporting a committed write as
+        # a definitive CasConflict) or double-apply it.  The server
+        # keeps every PRE-decide refusal on distinguishable kinds
+        # (KeyNotOwnedByShard, Overloaded, QuotaExceeded, PeerDead)
+        # and folds every post-decide failure into plain Timeout — so
+        # only those kinds (plus a connect-refused dial, provably
+        # undelivered) walk on and retry; everything else surfaces
+        # as-is, and the caller resolves ambiguity by re-reading (rmw
+        # does; so does the chaos gate's ambiguous bucket).
+        conditional = request.get("type") in (
+            "cas",
+            "atomic_batch",
+        )
         while True:
             replicas = self._shards_for_key(key_hash, max(1, rf))
             # Epoch fence (ISSUE 18): writes carry the membership epoch
@@ -492,9 +528,9 @@ class DbeelClient:
             # so the post-resync retry carries the refreshed epoch.  A
             # server mid-migration refuses (retryably) a write stamped
             # with an older epoch instead of placing it by a dead view.
-            if self._cluster_epoch and request.get("type") in (
-                "set",
-                "delete",
+            if (
+                self._cluster_epoch
+                and request.get("type") in _EPOCH_STAMPED_OPS
             ):
                 request["epoch"] = self._cluster_epoch
             not_owned = False
@@ -521,6 +557,15 @@ class DbeelClient:
                         ),
                         budget,
                     )
+                except CasConflict:
+                    # Atomic plane (ISSUE 19): a lost CAS race is a
+                    # DECIDED outcome, not an infrastructure failure
+                    # — no other replica can answer differently, and
+                    # a blind replay of the same expectations would
+                    # just lose again.  Surface it immediately; the
+                    # rmw helper re-reads and retries with fresh
+                    # expectations.
+                    raise
                 except KeyNotOwnedByShard as e:
                     # Stale ring: resync and retry (lib.rs:392-409).
                     last_error = e
@@ -535,6 +580,10 @@ class DbeelClient:
                             f"op deadline ({self._op_deadline_s:.1f}s)"
                             " exhausted"
                         )
+                    if conditional:
+                        # The conditional may have been decided in
+                        # flight: surface the ambiguity.
+                        raise transport_error
                     break
                 except (
                     DbeelError,
@@ -546,6 +595,21 @@ class DbeelClient:
                     # coordinator's quorum-timeout, or an application
                     # error; the next replica may answer.
                     last_error = e
+                    if conditional and not (
+                        isinstance(e, ConnectionRefusedError)
+                        or (
+                            isinstance(e, DbeelError)
+                            and classify_error(e)
+                            in (
+                                ERROR_CLASS_OVERLOAD,
+                                ERROR_CLASS_QUOTA,
+                                ERROR_CLASS_PEER_DEAD,
+                            )
+                        )
+                    ):
+                        # Possibly decided in flight (or a definitive
+                        # refusal): no replay — see the contract above.
+                        raise
                     if not isinstance(e, DbeelError) or (
                         is_retryable_class(classify_error(e))
                     ):
@@ -565,7 +629,10 @@ class DbeelClient:
                 if last_error is not None
                 else None
             )
-            if not retryable or loop.time() >= deadline:
+            if (
+                not retryable
+                or loop.time() >= deadline - self._retry_reserve_s()
+            ):
                 break
             if not_owned or not isinstance(last_error, DbeelError):
                 # Ring is stale (wrong owner) or nodes vanished
@@ -588,9 +655,15 @@ class DbeelClient:
                 # the refill — skip ahead in the backoff schedule
                 # (the jittered cap still bounds the pause).
                 backoff_attempt += 2
+            # Leave the retry reserve intact: a pause that drains the
+            # budget just moves the wasted ~0-budget dial after the
+            # sleep instead of skipping it.
             pause = min(
                 self._backoff_s(backoff_attempt, self._rng),
-                max(0.0, deadline - loop.time()),
+                max(
+                    0.0,
+                    deadline - self._retry_reserve_s() - loop.time(),
+                ),
             )
             if pause > 0:
                 await asyncio.sleep(pause)
@@ -654,7 +727,7 @@ class DbeelClient:
                     ) and not is_retryable_class(classify_error(e)):
                         raise  # benign/final (bad cursor, no such collection)
                     continue
-            if loop.time() >= deadline:
+            if loop.time() >= deadline - self._retry_reserve_s():
                 break
             if not isinstance(last_error, DbeelError):
                 try:
@@ -672,9 +745,13 @@ class DbeelClient:
                 # dry): the cursor survives — back off harder before
                 # resuming.
                 backoff_attempt += 2
+            # Leave the retry reserve intact (see _sharded_request).
             pause = min(
                 self._backoff_s(backoff_attempt, self._rng),
-                max(0.0, deadline - loop.time()),
+                max(
+                    0.0,
+                    deadline - self._retry_reserve_s() - loop.time(),
+                ),
             )
             if pause > 0:
                 await asyncio.sleep(pause)
@@ -1244,6 +1321,157 @@ class DbeelCollection:
             key, request, self.replication_factor
         )
 
+    # -- atomic conditional writes (ISSUE 19) -------------------------
+
+    _NO_EXPECT = object()
+
+    async def cas(
+        self,
+        key: Any,
+        value: Any = None,
+        *,
+        delete: bool = False,
+        expect_ts: Optional[int] = None,
+        expect_value: Any = _NO_EXPECT,
+        expect_absent: bool = False,
+        consistency=None,
+        trace_id: Optional[int] = None,
+    ) -> int:
+        """Conditional write: set ``key`` to ``value`` (or tombstone
+        it with ``delete=True``) only if the key's current state at
+        its arc owner matches EVERY expectation given — ``expect_ts``
+        (the exact current server timestamp), ``expect_value`` (the
+        exact current decoded value), ``expect_absent`` (no live
+        entry).  At least one expectation is required.  Returns the
+        decided server timestamp on success; raises ``CasConflict``
+        when an expectation mismatched (the decided state is intact —
+        re-read and retry with fresh expectations, or use ``rmw``).
+
+        The op is serialized at the key's arc owner, fenced by the
+        membership epoch (a mid-migration stale view refuses
+        retryably and this client resyncs + retries), and the decided
+        outcome replicates as an ordinary LWW write.  Guarantees
+        require quorum consistency (the default) and break if raw
+        ``set``/``delete`` races the same key."""
+        request: dict = {
+            "type": "cas",
+            "collection": self.name,
+            "key": key,
+        }
+        if delete:
+            request["delete"] = True
+        else:
+            request["value"] = value
+        if expect_absent:
+            request["expect_absent"] = True
+        if expect_ts is not None:
+            request["expect_ts"] = int(expect_ts)
+        if expect_value is not DbeelCollection._NO_EXPECT:
+            request["expect_value"] = expect_value
+        if consistency is not None:
+            request["consistency"] = Consistency.resolve(
+                consistency, self.replication_factor
+            )
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
+        raw = await self.client._sharded_request(
+            key, request, self.replication_factor
+        )
+        decided = msgpack.unpackb(raw, raw=False)
+        return int(decided["ts"])
+
+    async def rmw(
+        self,
+        key: Any,
+        fn,
+        *,
+        max_retries: int = 64,
+        consistency=None,
+    ) -> Any:
+        """Read-modify-write retry loop over ``cas``: read the
+        current value (None when absent), apply ``fn(current) ->
+        new_value``, and commit conditionally on the state read —
+        ``expect_absent`` for absent keys, ``expect_value`` for live
+        ones.  On ``CasConflict`` (a concurrent writer won the race)
+        re-read and re-apply, up to ``max_retries`` times.  Returns
+        the committed new value.
+
+        ``expect_value`` carries the usual ABA caveat: ``fn`` should
+        produce values that never repeat a previous state (counters,
+        version-stamped documents) for exactly-once semantics."""
+        last: Optional[Exception] = None
+        for _attempt in range(max_retries):
+            try:
+                current = await self.get(
+                    key, consistency=consistency
+                )
+            except KeyNotFound:
+                current = None
+            new_value = fn(current)
+            try:
+                if current is None:
+                    await self.cas(
+                        key,
+                        new_value,
+                        expect_absent=True,
+                        consistency=consistency,
+                    )
+                else:
+                    await self.cas(
+                        key,
+                        new_value,
+                        expect_value=current,
+                        consistency=consistency,
+                    )
+                return new_value
+            except CasConflict as e:
+                last = e
+                continue
+        raise last if last is not None else Timeout("rmw")
+
+    async def atomic_batch(
+        self,
+        ops: Sequence[dict],
+        consistency=None,
+        trace_id: Optional[int] = None,
+    ) -> int:
+        """All-or-nothing conditional multi-key batch on ONE ring
+        arc.  Each op is a dict: ``{"key": k}`` plus either
+        ``"value"`` or ``"delete": True``, plus any of the cas
+        expectation fields (``expect_ts`` / ``expect_value`` /
+        ``expect_absent``; an op with none is unconditional within
+        the batch).  Every key must hash to the same ring arc —
+        batches spanning arcs are refused as a client error.  All
+        conditions are evaluated against a consistent read under the
+        arc's decider lock; on success the whole batch commits
+        through one WAL group-commit ticket with one decided
+        timestamp (returned), on any mismatch the whole batch refuses
+        with ``CasConflict``."""
+        ops = [dict(op) for op in ops]
+        if not ops:
+            raise BadFieldType("ops: empty atomic batch")
+        for op in ops:
+            if "key" not in op:
+                raise BadFieldType("ops: op without a key")
+        request: dict = {
+            "type": "atomic_batch",
+            "collection": self.name,
+            "ops": ops,
+        }
+        if consistency is not None:
+            request["consistency"] = Consistency.resolve(
+                consistency, self.replication_factor
+            )
+        if isinstance(trace_id, int) and trace_id > 0:
+            request["trace"] = trace_id
+        # Routed by the FIRST key: the server verifies all keys share
+        # its arc, and a stale-ring miss walks/resyncs as usual.
+        raw = await self.client._sharded_request(
+            ops[0]["key"], request, self.replication_factor
+        )
+        decided = msgpack.unpackb(raw, raw=False)
+        return int(decided["ts"])
+
 
 class DbeelClientSync:
     """Blocking convenience wrapper (the reference ships a 49-line
@@ -1299,6 +1527,17 @@ class SyncCollection:
 
     def get(self, key, consistency=None):
         return self._c._run(self._col.get(key, consistency))
+
+    def cas(self, key, value=None, **kw):
+        return self._c._run(self._col.cas(key, value, **kw))
+
+    def rmw(self, key, fn, **kw):
+        return self._c._run(self._col.rmw(key, fn, **kw))
+
+    def atomic_batch(self, ops, consistency=None):
+        return self._c._run(
+            self._col.atomic_batch(ops, consistency)
+        )
 
     def scan(
         self, prefix=None, limit=None, max_bytes=None, filter=None

@@ -25,6 +25,10 @@ from ..storage import native as native_mod
 _GET_BUF_INITIAL = 256 << 10
 _GET_BUF_MAX = 64 << 20
 
+# Sentinel: "no expect_value armed" must be distinguishable from an
+# expected value of None (a legitimate stored document).
+_CAS_NO_EXPECT = object()
+
 
 def _bind(lib) -> None:
     if getattr(lib, "_cli_bound", False):
@@ -83,6 +87,23 @@ def _bind(lib) -> None:
         ctypes.c_int,
         ctypes.c_uint32,
     ]
+    if hasattr(lib, "dbeel_cli_cas"):  # atomic plane (ISSUE 19)
+        lib.dbeel_cli_cas.restype = ctypes.c_int
+        lib.dbeel_cli_cas.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            u8p,
+            ctypes.c_uint32,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            u8p,
+            ctypes.c_uint32,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_uint32,
+        ]
     lib.dbeel_cli_get.restype = ctypes.c_int64
     lib.dbeel_cli_get.argtypes = [
         ctypes.c_void_p,
@@ -835,6 +856,58 @@ class NativeDbeelClient:
                 except KeyNotFound:
                     out.append(None)
         return out
+
+    def cas(
+        self,
+        collection: str,
+        key: Any,
+        value: Any = None,
+        delete: bool = False,
+        expect_value: Any = _CAS_NO_EXPECT,
+        expect_ts: Optional[int] = None,
+        expect_absent: bool = False,
+        consistency: int = 0,
+        rf: int = 1,
+    ) -> bool:
+        """Conditional write through the C walk (atomic plane, ISSUE
+        19): commit ``value`` (or a delete) only if the key's current
+        state matches the armed expectation.  Returns True on commit,
+        False on a CAS conflict (re-read, then retry with fresh
+        expectations); raises on infrastructure errors.  Raises on a
+        stale .so without the CAS ABI."""
+        if not hasattr(self._lib, "dbeel_cli_cas"):
+            raise DbeelError("native library predates dbeel_cli_cas")
+        k = self._enc(key)
+        v = None if delete else self._enc(value)
+        ev = (
+            None
+            if expect_value is _CAS_NO_EXPECT
+            else self._enc(expect_value)
+        )
+        rc = self._lib.dbeel_cli_cas(
+            self._h,
+            collection.encode(),
+            (ctypes.c_uint8 * len(k)).from_buffer_copy(k),
+            len(k),
+            (ctypes.c_uint8 * len(v)).from_buffer_copy(v)
+            if v is not None
+            else None,
+            len(v) if v is not None else 0,
+            1 if delete else 0,
+            (ctypes.c_uint8 * len(ev)).from_buffer_copy(ev)
+            if ev is not None
+            else None,
+            len(ev) if ev is not None else 0,
+            1 if expect_absent else 0,
+            -1 if expect_ts is None else int(expect_ts),
+            consistency,
+            rf,
+        )
+        if rc == 0:
+            return True
+        if rc == -3:
+            return False
+        raise DbeelError(self._err())
 
     def delete(
         self,

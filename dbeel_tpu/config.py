@@ -76,6 +76,14 @@ class Config:
     # Migration streaming rate ceiling in keys/sec per shard, applied
     # per batch on top of the governor's bg gate; 0 = unpaced.
     migration_keys_per_sec: int = 0
+    # ---- Atomic plane (ISSUE 19) -------------------------------------
+    # Post-restart refusal window for conditional writes (cas /
+    # atomic_batch): a freshly-booted shard refuses to DECIDE them
+    # (retryably, `overload` class) until the window expires, so a
+    # decider that died and came back before the failure detector's
+    # Alive edge propagated cannot race a fallback decider that is
+    # still serving on its behalf.  0 disables the barrier.
+    cas_boot_barrier_ms: int = 3_000
 
     # ---- Overload-control plane (PR 5) -------------------------------
     # Per-shard load governor thresholds on the admitted-work total
@@ -330,6 +338,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(0 = unpaced; the governor bg gate still applies)",
     )
     p.add_argument(
+        "--cas-boot-barrier-ms",
+        type=int,
+        dest="cas_boot_barrier_ms",
+        default=d.cas_boot_barrier_ms,
+        help="post-restart window during which conditional writes "
+        "(cas/atomic_batch) are refused retryably, closing the "
+        "split-decider race with a fallback decider (0 disables)",
+    )
+    p.add_argument(
         "--overload-soft-ops",
         type=int,
         default=d.overload_soft_ops,
@@ -534,6 +551,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         read_repair_max_per_sec=ns.read_repair_max_per_sec,
         vnodes=ns.vnodes,
         migration_keys_per_sec=ns.migration_keys_per_sec,
+        cas_boot_barrier_ms=ns.cas_boot_barrier_ms,
         overload_soft_ops=ns.overload_soft_ops,
         overload_hard_ops=ns.overload_hard_ops,
         overload_compaction_debt=ns.overload_compaction_debt,

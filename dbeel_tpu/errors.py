@@ -76,6 +76,12 @@ QuotaExceeded = _mk(
     "retry after backoff — tokens refill continuously at the "
     "configured per-tenant rate (QoS plane).",
 )
+CasConflict = _mk(
+    "CasConflict",
+    "A conditional write's expectation did not match the key's "
+    "current state at the arc owner (atomic plane); re-read and "
+    "retry with fresh expectations — the decided state is intact.",
+)
 
 _BY_KIND = {
     cls.kind: cls
@@ -113,6 +119,12 @@ ERROR_CLASS_OVERLOAD = "overload"
 # distinct from `overload` because the SHARD is healthy: only this
 # tenant is over its configured rate.
 ERROR_CLASS_QUOTA = "quota"
+# Atomic plane (ISSUE 19): a cas/atomic_batch expectation lost the
+# race against a concurrent decided write.  Retryable by CONTRACT —
+# but unlike the infrastructure classes the client must re-read and
+# recompute its expectations first (the rmw helper does exactly
+# that); blind resubmission would just lose again.
+ERROR_CLASS_CONFLICT = "conflict"
 ERROR_CLASS_OTHER = "other"
 ERROR_CLASSES = (
     ERROR_CLASS_COORDINATOR_DEAD,
@@ -123,6 +135,7 @@ ERROR_CLASSES = (
     ERROR_CLASS_DEGRADED,
     ERROR_CLASS_OVERLOAD,
     ERROR_CLASS_QUOTA,
+    ERROR_CLASS_CONFLICT,
     ERROR_CLASS_OTHER,
 )
 
@@ -164,6 +177,8 @@ def classify_error(exc: BaseException) -> "str | None":
             return ERROR_CLASS_OVERLOAD
         if kind == "QuotaExceeded":
             return ERROR_CLASS_QUOTA
+        if kind == "CasConflict":
+            return ERROR_CLASS_CONFLICT
         if kind in _CONNECTION_KINDS:
             return ERROR_CLASS_COORDINATOR_DEAD
         return ERROR_CLASS_OTHER
@@ -197,6 +212,10 @@ def is_retryable_class(error_class: "str | None") -> bool:
         # Quota refusals refill with time: back off and retry — the
         # same transient contract as shedding, scoped to one tenant.
         ERROR_CLASS_QUOTA,
+        # A lost CAS race is retryable AFTER a re-read: the rmw
+        # helper recomputes expectations; generic retry loops must
+        # not replay the same expectation blindly.
+        ERROR_CLASS_CONFLICT,
     )
 
 

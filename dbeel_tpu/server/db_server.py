@@ -22,6 +22,7 @@ import msgpack
 
 from ..errors import (
     BadFieldType,
+    CasConflict,
     CorruptedFile,
     DbeelError,
     KeyNotFound,
@@ -103,7 +104,17 @@ def _wall_deadline_ms(request: dict, timeout_ms: int) -> int:
 # operator must be able to see into — and command — an overloaded
 # node, and DDL is rare enough to never be the overload source.
 _SHEDDABLE_OPS = frozenset(
-    {"set", "get", "delete", "multi_set", "multi_get"}
+    {
+        "set",
+        "get",
+        "delete",
+        "multi_set",
+        "multi_get",
+        # Atomic plane (ISSUE 19): conditional writes are data ops —
+        # sheddable, deadline-droppable, QoS-laned like any set.
+        "cas",
+        "atomic_batch",
+    }
 )
 
 
@@ -448,6 +459,16 @@ async def handle_request(
 
     if rtype in ("multi_set", "multi_get"):
         return await _handle_multi(my_shard, request, timestamp, rtype)
+
+    if rtype == "cas":
+        # Atomic plane (ISSUE 19): conditional single-key write,
+        # decided at the key's arc owner under the per-arc lock.
+        return await _handle_cas(my_shard, request)
+
+    if rtype == "atomic_batch":
+        # Atomic plane (ISSUE 19): all-or-nothing multi-key
+        # conditional batch on ONE ring arc.
+        return await _handle_atomic_batch(my_shard, request)
 
     if rtype in ("scan", "scan_next"):
         # Streaming scan plane (PR 12): one governor-admitted chunk
@@ -964,6 +985,598 @@ async def _multi_get_keyed(
             results[i] = [1, KeyNotFound(repr(key)).to_wire()]
         else:
             results[i] = [0, bytes(local_value[0])]
+
+
+# ---------------------------------------------------------------------
+# Atomic plane (ISSUE 19): epoch-fenced CAS + per-arc atomic batches.
+#
+# A conditional write DECIDES at exactly one replica — the key's arc
+# owner (replica index 0 on the walk, or the first live stand-in when
+# everything ahead is marked Dead) — under a per-(collection, arc)
+# asyncio.Lock, so read-compare-decide sequences on an arc can never
+# interleave.  The decider reads the key's current state at the op's
+# consistency (R mirrors W, so quorum-consistency CAS observes every
+# prior quorum-decided write even on a decider whose local tree is
+# behind), compares the client's expectations, and on a match commits
+# a fresh LWW timestamp that replicates as ORDINARY set/delete/
+# multi_set peer frames — hinted handoff, read repair and
+# anti-entropy converge replicas with no new peer verbs.  The
+# membership-epoch fence applies exactly as it does to plain writes
+# (re-checked under the lock: a migration may start while the op
+# queues), and frames always serve on this interpreted path — the C
+# planes punt the cas/atomic_batch verbs by construction (lint-pinned)
+# so the fence and the lock cannot be bypassed.
+#
+# Caveats (documented in ARCHITECTURE.md): mixing raw LWW sets with
+# CAS on the same key forfeits the CAS guarantees, and expect_value
+# has the usual ABA limitation.
+# ---------------------------------------------------------------------
+
+# Ops per atomic_batch frame.  Small by design: the batch holds the
+# arc lock across its quorum read + commit, so a huge batch would
+# head-of-line-block every other conditional write on the arc.
+ATOMIC_BATCH_MAX_OPS = 128
+
+_NO_EXPECT = object()
+
+
+def _atomic_decider_gate(
+    my_shard: MyShard, key_hash: int, replica_index: int
+) -> None:
+    """Single-decider election for conditional writes.  The natural
+    decider is replica index 0 on the key's walk; a later replica may
+    stand in ONLY while every node ahead of it is marked Dead (the
+    client walked here because the primary was unreachable).  Two
+    LIVE deciders on one arc would each serialize CAS locally and
+    could ack conflicting outcomes — the split brain the arc lock
+    exists to prevent.  A freshly-restarted decider additionally sits
+    out the boot barrier, so its comeback cannot race a stand-in that
+    has not yet seen its Alive edge."""
+    if replica_index > 0:
+        alive = [
+            n
+            for n in my_shard.preceding_replica_nodes(key_hash)
+            if n not in my_shard.dead_nodes
+        ]
+        if alive:
+            raise KeyNotOwnedByShard(
+                f"conditional write at replica_index {replica_index}"
+                f" refused: preceding replica(s) {alive} are alive"
+            )
+    barrier_s = my_shard.atomic_barrier_remaining_s()
+    if barrier_s > 0:
+        raise Overloaded(
+            "conditional-write decider barrier: "
+            f"{int(barrier_s * 1000)}ms remaining after restart"
+        )
+
+
+def _cas_mismatch(
+    request: dict, current, require: bool = True
+) -> Optional[str]:
+    """None when the map's expectations match the key's current
+    state, else the conflict detail.  ``request`` is the client's cas
+    request map OR one atomic_batch op map (same expectation fields
+    by design); ``current`` is the decider's merged (value_bytes, ts)
+    view — the value may be the tombstone — or None for
+    never-written.  With ``require`` (the cas verb) at least one
+    expectation field is demanded; batch ops may be unconditional
+    (they still commit-or-refuse with the whole batch)."""
+    live = current is not None and bytes(current[0]) != TOMBSTONE
+    cur_ts = None if current is None else current[1]
+    checked = False
+    if request.get("expect_absent"):
+        checked = True
+        if live:
+            return f"expected absent, but live at ts {cur_ts}"
+    expect_ts = request.get("expect_ts")
+    if isinstance(expect_ts, int):
+        checked = True
+        if cur_ts != expect_ts:
+            return f"expected ts {expect_ts}, current ts {cur_ts}"
+    expect_value = request.get("expect_value", _NO_EXPECT)
+    if expect_value is not _NO_EXPECT:
+        checked = True
+        if not live:
+            return "expected a live value, but key is absent"
+        if bytes(current[0]) != _encode_field(expect_value):
+            return "expected value does not match current value"
+    if not checked and require:
+        raise MissingField("expect_ts|expect_value|expect_absent")
+    return None
+
+
+async def _handle_cas(my_shard: MyShard, request: dict) -> bytes:
+    ctx = trace_mod.current()
+    collection_name = _extract(request, "collection")
+    timeout_ms = request.get("timeout") or DEFAULT_SET_TIMEOUT_MS
+    replica_index = request.get("replica_index") or 0
+    col = my_shard.get_collection(collection_name)
+    key = extract_key(my_shard, request, replica_index)
+    _check_membership_epoch(my_shard, request)
+    key_hash = hash_bytes(key)
+    _atomic_decider_gate(my_shard, key_hash, replica_index)
+    rf = col.replication_factor
+    consistency = request.get("consistency")
+    if not isinstance(consistency, int):
+        consistency = rf
+    consistency = min(consistency, rf)
+    number_of_nodes = rf - replica_index - 1
+    is_delete = bool(request.get("delete"))
+    value = (
+        TOMBSTONE
+        if is_delete
+        else _encode_field(_extract(request, "value"))
+    )
+    deadline = asyncio.get_event_loop().time() + timeout_ms / 1000
+    op_status: dict = {}
+    if ctx is not None:
+        ctx.mark("prep")
+    async with my_shard.atomic_lock(collection_name, key_hash):
+        # Fence re-check under the lock: a migration (and its epoch
+        # bump) may have landed while this op queued behind another
+        # conditional write.
+        _check_membership_epoch(my_shard, request)
+        try:
+            current = await _atomic_read_current(
+                my_shard,
+                collection_name,
+                col,
+                key,
+                consistency,
+                number_of_nodes,
+                deadline,
+                request,
+                timeout_ms,
+                op_status,
+                ctx,
+            )
+        except asyncio.TimeoutError as e:
+            raise _quorum_error(my_shard, "cas", op_status) from e
+        if ctx is not None:
+            ctx.mark("read")
+        detail = _cas_mismatch(request, current)
+        if detail is not None:
+            my_shard.cas_conflicts += 1
+            raise CasConflict(f"cas on {key!r}: {detail}")
+        # Decide with a fresh LWW timestamp strictly above the
+        # observed current, so the outcome replicates as an ordinary
+        # WINNING set/delete everywhere.
+        decided_ts = now_nanos()
+        if current is not None and decided_ts <= current[1]:
+            decided_ts = current[1] + 1
+        await _replicate_decided(
+            my_shard,
+            collection_name,
+            col,
+            request,
+            key,
+            value,
+            is_delete,
+            decided_ts,
+            consistency,
+            number_of_nodes,
+            deadline,
+            timeout_ms,
+            op_status,
+            "cas",
+            ctx,
+        )
+    my_shard.cas_served += 1
+    return msgpack.packb({"ts": decided_ts}, use_bin_type=True)
+
+
+def _live_arc_peers(
+    my_shard: MyShard, number_of_nodes: int, key_hash: int
+) -> int:
+    """How many of the arc's walk-after-self replicas are NOT marked
+    Dead right now — the response floor a decider's read must reach.
+    Dead-marked peers fast-fail inside the fan-out (they cannot hold
+    a write the failure detector hasn't already handed to hints), so
+    they are excluded from the floor; every live-marked peer must
+    actually answer or the conditional write refuses retryably."""
+    if number_of_nodes <= 0:
+        return 0
+    peers = my_shard._replica_connections(
+        number_of_nodes, key_hash
+    )
+    return sum(
+        1
+        for name, _c in peers
+        if name not in my_shard.dead_nodes
+    )
+
+
+async def _atomic_read_current(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    key: bytes,
+    consistency: int,
+    number_of_nodes: int,
+    deadline: float,
+    request: dict,
+    timeout_ms: int,
+    op_status: dict,
+    ctx,
+):
+    """The decider's merged view of one key: local entry + a read of
+    EVERY live replica on the arc.  A first-ack quorum read is not
+    enough here: after a decider handover the newest committed write
+    may live on exactly one surviving replica, and deciding against
+    any view that might exclude it mints a NEWER timestamp on stale
+    state — a silent lost update.  So the read demands an answer from
+    every walk peer not marked Dead and raises TimeoutError (mapped
+    to a retryable quorum refusal by the caller) when one is missing.
+    Returns the max-timestamp (value_bytes, ts) — tombstones included
+    — or None when no consulted replica has an entry."""
+    local = col.tree.get_entry(
+        key, suspect_guard=consistency == 1
+    )
+    budget = max(
+        0.001, deadline - asyncio.get_event_loop().time()
+    )
+    live = _live_arc_peers(
+        my_shard, number_of_nodes, hash_bytes(key)
+    )
+    if live > 0:
+        remote = my_shard.send_request_to_replicas(
+            ShardRequest.get(
+                collection_name,
+                key,
+                deadline_ms=_wall_deadline_ms(request, timeout_ms),
+                trace_id=_trace_id_for_peers(ctx),
+                qos=_qos_for_peers(request),
+            ),
+            live,
+            number_of_nodes,
+            ShardResponse.GET,
+            op_status=op_status,
+            key_hash=hash_bytes(key),
+        )
+        local_value, values = await asyncio.wait_for(
+            asyncio.gather(local, remote), budget
+        )
+        if len(values) < live:
+            raise asyncio.TimeoutError(
+                "atomic read: live replica did not answer"
+            )
+    else:
+        local_value = await asyncio.wait_for(local, budget)
+        values = []
+    entries = [
+        (bytes(v[0]), v[1]) for v in values if v is not None
+    ]
+    if local_value is not None:
+        entries.append((bytes(local_value[0]), local_value[1]))
+    if not entries:
+        return None
+    return max(entries, key=lambda e: e[1])
+
+
+async def _replicate_decided(
+    my_shard: MyShard,
+    collection_name: str,
+    col,
+    request: dict,
+    key: bytes,
+    value: bytes,
+    is_delete: bool,
+    decided_ts: int,
+    consistency: int,
+    number_of_nodes: int,
+    deadline: float,
+    timeout_ms: int,
+    op_status: dict,
+    opname: str,
+    ctx,
+) -> None:
+    """Commit + replicate one DECIDED conditional write exactly like
+    an ordinary set/delete: the local LWW apply overlapped with plain
+    SET/DELETE peer frames, so hinted handoff and anti-entropy
+    converge replicas with no new peer verbs.  A quorum timeout HERE
+    leaves the op ambiguous to the client (decided but unacked), the
+    same contract as a timed-out plain set — clients resolve by
+    re-reading.  Unlike a plain set, the remote ack count is
+    ENFORCED: the fan-out resolves with whatever acks it got when
+    replicas run out, and acking a conditional write held by the
+    decider alone would let a later decider (after this node dies)
+    rebuild the chain from a state that never saw it."""
+
+    async def local_write():
+        if not await col.tree.set_with_timestamp(
+            key, value, decided_ts, stale_abort=True
+        ):
+            await my_shard.apply_if_newer(
+                col.tree, key, value, decided_ts
+            )
+
+    budget = max(
+        0.001, deadline - asyncio.get_event_loop().time()
+    )
+    if number_of_nodes > 0:
+        peer_deadline = _wall_deadline_ms(request, timeout_ms)
+        peer_qos = _qos_for_peers(request)
+        remote_request = (
+            ShardRequest.delete(
+                collection_name, key, decided_ts,
+                deadline_ms=peer_deadline,
+                trace_id=_trace_id_for_peers(ctx),
+                qos=peer_qos,
+            )
+            if is_delete
+            else ShardRequest.set(
+                collection_name, key, value, decided_ts,
+                deadline_ms=peer_deadline,
+                trace_id=_trace_id_for_peers(ctx),
+                qos=peer_qos,
+            )
+        )
+        expected = (
+            ShardResponse.DELETE if is_delete else ShardResponse.SET
+        )
+        need_remote = min(consistency - 1, number_of_nodes)
+        remote = my_shard.send_request_to_replicas(
+            remote_request,
+            need_remote,
+            number_of_nodes,
+            expected,
+            op_status=op_status,
+            key_hash=hash_bytes(key),
+        )
+        try:
+            _local, acks = await asyncio.wait_for(
+                asyncio.gather(local_write(), remote), budget
+            )
+            if len(acks) < need_remote:
+                raise asyncio.TimeoutError(
+                    f"{opname}: {len(acks)}/{need_remote} "
+                    "replica acks"
+                )
+        except asyncio.TimeoutError as e:
+            # POST-decide failure: always a plain Timeout, never the
+            # richer _quorum_error kinds.  Clients key retry safety
+            # off the kind — Overloaded/PeerDead/not-owned are only
+            # ever raised BEFORE a decide (safe to replay), Timeout
+            # after a conditional op means decided-but-unacked: the
+            # client must surface ambiguity, not blindly replay
+            # expectations its own (possibly applied) decide already
+            # invalidated.
+            raise Timeout(opname) from e
+        finally:
+            if ctx is not None:
+                ctx.mark("quorum")
+    else:
+        try:
+            await asyncio.wait_for(local_write(), budget)
+        except asyncio.TimeoutError as e:
+            raise Timeout(opname) from e
+        finally:
+            if ctx is not None:
+                ctx.mark("local")
+
+
+async def _handle_atomic_batch(
+    my_shard: MyShard, request: dict
+) -> bytes:
+    ctx = trace_mod.current()
+    collection_name = _extract(request, "collection")
+    ops = _extract(request, "ops")
+    if not isinstance(ops, (list, tuple)) or not ops:
+        raise BadFieldType("ops")
+    if len(ops) > ATOMIC_BATCH_MAX_OPS:
+        raise BadFieldType(
+            f"ops: atomic batch above {ATOMIC_BATCH_MAX_OPS}"
+        )
+    timeout_ms = request.get("timeout") or DEFAULT_SET_TIMEOUT_MS
+    replica_index = request.get("replica_index") or 0
+    col = my_shard.get_collection(collection_name)
+    _check_membership_epoch(my_shard, request)
+    rf = col.replication_factor
+    consistency = request.get("consistency")
+    if not isinstance(consistency, int):
+        consistency = rf
+    consistency = min(consistency, rf)
+    number_of_nodes = rf - replica_index - 1
+
+    parsed: list = []  # (key_bytes, value_bytes, op_map)
+    for i, op in enumerate(ops):
+        if not isinstance(op, dict):
+            raise BadFieldType("ops")
+        if i == 0:
+            # Ownership is anchored on ops[0] — the key the client
+            # routed the whole batch by.
+            key = extract_key(my_shard, op, replica_index)
+        else:
+            # The other keys are validated by the arc-span check
+            # below; an individual owns_key refusal here would turn
+            # an unfixable key-choice error into a retryable
+            # not-owned, and the client would resync forever.
+            key = _encode_field(_extract(op, "key"))
+        if op.get("delete"):
+            value = TOMBSTONE
+        elif "value" in op:
+            value = _encode_field(op["value"])
+        else:
+            raise MissingField("value")
+        parsed.append((key, value, op))
+    # The commit unit is ONE ring arc: every key must resolve to the
+    # same replica set (under vnodes, keys on different arcs fan to
+    # different nodes — a spanning "atomic" batch would be two
+    # independent commits wearing one name).  Refused as a client
+    # error, not a conflict: no retry can fix the key choice.
+    groups = _group_keyed_by_replica_set(
+        my_shard,
+        [(i, key) for i, (key, _v, _op) in enumerate(parsed)],
+        number_of_nodes,
+    )
+    # Downstream-connection groups alone can collapse two distinct
+    # arcs (walks (self, X) and (X, self) both fan to just X from
+    # here) — those have DIFFERENT deciders, so also require every
+    # key's walk prefix before this node to agree.
+    walk_prefixes = {
+        tuple(my_shard.preceding_replica_nodes(hash_bytes(key)))
+        for key, _v, _op in parsed
+    }
+    if len(groups) > 1 or len(walk_prefixes) > 1:
+        raise BadFieldType(
+            "ops: atomic batch keys span multiple ring arcs"
+        )
+    anchor = groups[0][1]
+    _atomic_decider_gate(my_shard, anchor, replica_index)
+    deadline = asyncio.get_event_loop().time() + timeout_ms / 1000
+    op_status: dict = {}
+    if ctx is not None:
+        ctx.mark("prep")
+    keys = [key for key, _v, _op in parsed]
+    async with my_shard.atomic_lock(collection_name, anchor):
+        _check_membership_epoch(my_shard, request)
+        local = col.tree.multi_get(
+            keys, suspect_guard=consistency == 1
+        )
+        budget = max(
+            0.001, deadline - asyncio.get_event_loop().time()
+        )
+        aligned: list = []
+        live = _live_arc_peers(my_shard, number_of_nodes, anchor)
+        try:
+            if live > 0:
+                # Same full-live-arc read discipline as single-key
+                # CAS: every walk peer not marked Dead must answer
+                # (with a well-formed row list), else the whole batch
+                # refuses retryably — conditions evaluated against a
+                # partial view could approve an op a missed replica
+                # already superseded.
+                remote = my_shard.send_request_to_replicas(
+                    ShardRequest.multi_get(
+                        collection_name,
+                        keys,
+                        deadline_ms=_wall_deadline_ms(
+                            request, timeout_ms
+                        ),
+                        trace_id=_trace_id_for_peers(ctx),
+                        qos=_qos_for_peers(request),
+                    ),
+                    live,
+                    number_of_nodes,
+                    ShardResponse.MULTI_GET,
+                    op_status=op_status,
+                    key_hash=anchor,
+                )
+                local_map, replica_lists = await asyncio.wait_for(
+                    asyncio.gather(local, remote), budget
+                )
+                aligned = [
+                    r
+                    for r in replica_lists
+                    if isinstance(r, (list, tuple))
+                    and len(r) == len(keys)
+                ]
+                if len(aligned) < live:
+                    raise asyncio.TimeoutError(
+                        "atomic batch read: live replica did "
+                        "not answer"
+                    )
+            else:
+                local_map = await asyncio.wait_for(local, budget)
+        except asyncio.TimeoutError as e:
+            raise _quorum_error(
+                my_shard, "atomic_batch", op_status
+            ) from e
+        if ctx is not None:
+            ctx.mark("read")
+        # Evaluate EVERY condition against the merged view before
+        # touching anything: the batch commits or refuses whole.
+        max_ts = 0
+        for j, (key, _value, op) in enumerate(parsed):
+            entries = []
+            lv = local_map.get(key)
+            if lv is not None:
+                entries.append((bytes(lv[0]), lv[1]))
+            for r in aligned:
+                v = r[j]
+                if v is not None:
+                    entries.append((bytes(v[0]), v[1]))
+            current = (
+                max(entries, key=lambda e: e[1])
+                if entries
+                else None
+            )
+            if current is not None:
+                max_ts = max(max_ts, current[1])
+            detail = _cas_mismatch(op, current, require=False)
+            if detail is not None:
+                my_shard.batches_refused += 1
+                raise CasConflict(
+                    f"atomic_batch op {j} on {key!r}: {detail}"
+                )
+        decided_ts = max(now_nanos(), max_ts + 1)
+        entries = [
+            (key, value, decided_ts)
+            for key, value, _op in parsed
+        ]
+
+        async def local_batch():
+            # One memtable set_batch application, one WAL
+            # append_batch group-commit ticket per chunk — the same
+            # commit unit the plain multi_set path rides.
+            rejected = await col.tree.set_batch_with_timestamp(
+                entries, stale_abort=True
+            )
+            for k, v, ts in rejected:
+                await my_shard.apply_if_newer(col.tree, k, v, ts)
+
+        budget = max(
+            0.001, deadline - asyncio.get_event_loop().time()
+        )
+        try:
+            if number_of_nodes > 0:
+                # Enforced ack floor, like _replicate_decided: the
+                # fan-out resolves with whatever it got, and a batch
+                # durable only on the decider is invisible to the
+                # next decider's full-live-arc read once this node
+                # dies.
+                need_remote = min(consistency - 1, number_of_nodes)
+                remote = my_shard.send_request_to_replicas(
+                    ShardRequest.multi_set(
+                        collection_name,
+                        [[k, v, decided_ts] for k, v, _t in entries],
+                        deadline_ms=_wall_deadline_ms(
+                            request, timeout_ms
+                        ),
+                        trace_id=_trace_id_for_peers(ctx),
+                        qos=_qos_for_peers(request),
+                    ),
+                    need_remote,
+                    number_of_nodes,
+                    ShardResponse.MULTI_SET,
+                    op_status=op_status,
+                    key_hash=anchor,
+                )
+                _local, acks = await asyncio.wait_for(
+                    asyncio.gather(local_batch(), remote), budget
+                )
+                if len(acks) < need_remote:
+                    raise asyncio.TimeoutError(
+                        f"atomic_batch: {len(acks)}/{need_remote}"
+                        " replica acks"
+                    )
+            else:
+                await asyncio.wait_for(local_batch(), budget)
+        except asyncio.TimeoutError as e:
+            # POST-decide: plain Timeout only (decided but unacked)
+            # — see _replicate_decided for the retry-safety contract.
+            raise Timeout("atomic_batch") from e
+        finally:
+            if ctx is not None:
+                ctx.mark(
+                    "quorum" if number_of_nodes > 0 else "local"
+                )
+    my_shard.batches_committed += 1
+    return msgpack.packb(
+        {"ts": decided_ts, "applied": len(parsed)},
+        use_bin_type=True,
+    )
 
 
 def _digest_reads_enabled() -> bool:

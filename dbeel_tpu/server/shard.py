@@ -410,6 +410,22 @@ class MyShard:
         self.keys_migrated = 0
         self.bytes_migrated = 0
         self.fence_refusals = 0
+        # Atomic plane (ISSUE 19): per-arc serialization locks for
+        # conditional writes (cas / atomic_batch), keyed by
+        # (collection, replica-set tuple) — arcs are finite, so the
+        # dict is bounded; plain LWW sets never take them.  The boot
+        # barrier refuses to DECIDE conditional writes for a short
+        # window after restart (split-decider race: a fallback decider
+        # may still be serving this arc until the Alive edge
+        # propagates).  Counters feed get_stats.atomic.
+        self._atomic_locks: dict = {}
+        self._atomic_barrier_until = (
+            time.monotonic() + config.cas_boot_barrier_ms / 1000.0
+        )
+        self.cas_served = 0
+        self.cas_conflicts = 0
+        self.batches_committed = 0
+        self.batches_refused = 0
         if config.vnodes > 1:
             self._expand_vnode_ring()
         self.sort_consistent_hash_ring()
@@ -557,6 +573,76 @@ class MyShard:
             found += 1
             nodes.add(s.node_name)
         return False
+
+    # ------------------------------------------------------------------
+    # Atomic plane (ISSUE 19): decider election + per-arc locks
+    # ------------------------------------------------------------------
+
+    def preceding_replica_nodes(self, key_hash: int) -> List[str]:
+        """Distinct-node walk order BEFORE this node for a key's hash
+        (the exact mirror of owns_key's forward walk).  The CAS
+        fallback-decider gate: a coordinator may DECIDE a conditional
+        write at replica_index>0 only when every node ahead of it on
+        the key's walk is marked Dead — otherwise two live deciders
+        could serialize the same key independently (split brain)."""
+        ring = self._hash_sorted
+        if len(ring) < 2:
+            return []
+        start = bisect.bisect_left(
+            self._sorted_hashes, key_hash
+        ) % len(ring)
+        seen: set = set()
+        preceding: List[str] = []
+        for off in range(len(ring)):
+            s = ring[(start + off) % len(ring)]
+            if s.node_name in seen:
+                continue
+            if s.name == self.shard_name:
+                return preceding
+            seen.add(s.node_name)
+            preceding.append(s.node_name)
+        return preceding
+
+    def atomic_barrier_remaining_s(self) -> float:
+        """Seconds left in the post-boot conditional-write refusal
+        window (0 when expired or disabled)."""
+        return max(
+            0.0, self._atomic_barrier_until - time.monotonic()
+        )
+
+    def atomic_lock(self, collection_name: str, key_hash: int):
+        """The per-arc serialization lock for conditional writes:
+        every cas/atomic_batch whose key(s) land on the same
+        (collection, replica-set) arc decides under ONE asyncio.Lock,
+        so read-compare-decide sequences on a key can never
+        interleave on this decider.  Keyed by the DISTINCT-NODE
+        replica set (not the raw token) so two tokens of one arc
+        share a lock."""
+        names = tuple(
+            n
+            for n, _c in self._replica_connections(
+                len(self.nodes) or 1, key_hash
+            )
+        )
+        lock_key = (collection_name, names)
+        lock = self._atomic_locks.get(lock_key)
+        if lock is None:
+            lock = self._atomic_locks[lock_key] = asyncio.Lock()
+        return lock
+
+    def _atomic_stats(self) -> dict:
+        """get_stats.atomic: conditional-write counters.  The numeric
+        leaves flatten into the telemetry ring (cas_conflicts_per_s
+        and the cas_conflict_storm watchdog read them)."""
+        return {
+            "cas_served": self.cas_served,
+            "cas_conflicts": self.cas_conflicts,
+            "batches_committed": self.batches_committed,
+            "batches_refused": self.batches_refused,
+            "barrier_remaining_ms": int(
+                self.atomic_barrier_remaining_s() * 1000
+            ),
+        }
 
     @staticmethod
     def get_last_owning_shard(
@@ -991,6 +1077,10 @@ class MyShard:
             # node epoch by construction, any ring change bumps all),
             # migration lifecycle counters and the fence refusals.
             "membership": self._membership_stats(),
+            # Atomic plane (ISSUE 19): conditional-write counters —
+            # cas decides/conflicts, batch commits/refusals, and the
+            # post-boot decider barrier's remaining window.
+            "atomic": self._atomic_stats(),
             "hints_queued": self.hint_log.queued_by_node(),
             # Replica-convergence plane (PR 4): hinted handoff,
             # quorum read-repair, background anti-entropy.
@@ -2763,24 +2853,43 @@ class MyShard:
         nodes = list(self.nodes.values())
         random.shuffle(nodes)
         targets = nodes[: self.config.gossip_fanout]
-        loop = asyncio.get_event_loop()
         for node in targets:
-            try:
-                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                sock.setblocking(False)
-                if hasattr(loop, "sock_sendto"):
-                    await loop.sock_sendto(
-                        sock, buf, (node.ip, node.gossip_port)
-                    )
-                else:
-                    # py3.10: loop.sock_sendto doesn't exist.  A UDP
-                    # sendto on a non-blocking socket never blocks —
-                    # it either queues the datagram or drops it
-                    # (EAGAIN), and gossip is fire-and-forget.
-                    sock.sendto(buf, (node.ip, node.gossip_port))
-                sock.close()
-            except OSError as e:
-                log.error("gossip send to %s failed: %s", node.name, e)
+            await self._gossip_send(buf, node)
+
+    async def _gossip_send(self, buf: bytes, node) -> None:
+        loop = asyncio.get_event_loop()
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.setblocking(False)
+            if hasattr(loop, "sock_sendto"):
+                await loop.sock_sendto(
+                    sock, buf, (node.ip, node.gossip_port)
+                )
+            else:
+                # py3.10: loop.sock_sendto doesn't exist.  A UDP
+                # sendto on a non-blocking socket never blocks —
+                # it either queues the datagram or drops it
+                # (EAGAIN), and gossip is fire-and-forget.
+                sock.sendto(buf, (node.ip, node.gossip_port))
+            sock.close()
+        except OSError as e:
+            log.error("gossip send to %s failed: %s", node.name, e)
+
+    async def gossip_to_node(self, event: list, node) -> None:
+        """Unicast a gossip frame straight at one node, bypassing the
+        random epidemic fanout.  The one caller that needs this is the
+        DEAD path: ``handle_dead_node`` pops the victim from
+        ``self.nodes`` BEFORE the event is gossiped, so the normal
+        fanout can never select the accused — a falsely-removed (but
+        alive) node would otherwise never hear its own death
+        certificate, never fire the self-defense ALIVE re-announce,
+        and the asymmetric membership split would be permanent."""
+        buf = msgs.serialize_gossip_message(
+            f"{self.config.name}#{self.boot_id}",
+            event,
+            self.last_node_digest,
+        )
+        await self._gossip_send(buf, node)
 
     async def handle_gossip_event(self, event: list) -> bool:
         """Returns True when the event should continue propagating
@@ -2832,7 +2941,15 @@ class MyShard:
                 )
                 another_gossip_sent = True
             else:
+                # Grab the victim's address BEFORE removal: every
+                # processor forwards the accusation straight to the
+                # accused so a false positive can self-defend (the
+                # epidemic fanout only targets ``self.nodes``, which
+                # no longer contains it).
+                victim = self.nodes.get(node_name)
                 await self.handle_dead_node(node_name)
+                if victim is not None:
+                    self.spawn(self.gossip_to_node(event, victim))
         elif kind == GossipEvent.CREATE_COLLECTION:
             try:
                 await self.create_collection(

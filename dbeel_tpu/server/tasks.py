@@ -995,6 +995,20 @@ async def run_failure_detector(my_shard: MyShard) -> None:
     interval = my_shard.config.failure_detection_interval_ms / 1000
     while True:
         await asyncio.sleep(interval)
+        # Membership anti-entropy: periodically re-gossip our own
+        # ALIVE.  A peer that falsely removed us (CPU-starved ping
+        # timeout, UDP loss) reset our ALIVE dedup counter inside
+        # handle_dead_node, so the next re-announce is accepted and
+        # re-adds us — without this, an asymmetric removal only heals
+        # if the DEAD accusation happens to reach us (self-defense),
+        # and a lost datagram makes the split permanent.  Healthy
+        # peers absorb the duplicate through the gossip dedup.
+        try:
+            await my_shard.gossip(
+                msgs.GossipEvent.alive(my_shard.get_node_metadata())
+            )
+        except Exception as e:
+            log.error("alive re-announce failed: %s", e)
         candidates = [
             n for n in my_shard.nodes.values() if n.ids
         ]
@@ -1040,6 +1054,12 @@ async def run_failure_detector(my_shard: MyShard) -> None:
                     ShardEvent.gossip(event)
                 )
                 await my_shard.gossip(event)
+                # The accusation must reach the accused: the victim
+                # was just popped from my_shard.nodes, so the fanout
+                # above can never select it.  Unicast the death
+                # certificate so a false positive can self-defend
+                # with an ALIVE re-announce.
+                await my_shard.gossip_to_node(event, node)
             except Exception as e2:
                 log.error("failed to gossip node death: %s", e2)
 

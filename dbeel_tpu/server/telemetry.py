@@ -179,6 +179,11 @@ _RATES = {
     # fast migration streams keys to new owners (paced by bg_slice +
     # --migration-keys-per-sec).
     "keys_migrated_per_s": ("membership.keys_migrated",),
+    # Atomic plane (ISSUE 19): conditional-write losses per second —
+    # each one is a client whose expectation lost the race and must
+    # re-read.  A sustained rate means hot-key contention (see the
+    # cas_conflict_storm watchdog rule).
+    "cas_conflicts_per_s": ("atomic.cas_conflicts",),
 }
 
 # QoS classes the class_starvation watchdog rule walks (mirrors
@@ -377,6 +382,13 @@ CLASS_STARVATION_SHEDS_PER_S = 2.0
 # windows — a wedged target stream, a starved executor, or a
 # mis-sized --migration-keys-per-sec holding the handoff at zero.
 MIGRATION_STALL_WINDOWS = 3
+# CAS conflict storm (atomic plane, ISSUE 19): conditional writes
+# losing at a sustained rate — many writers fighting over one hot
+# key.  Each conflict is a full re-read + retry round trip, so past
+# this rate the rmw helpers burn most of their budget spinning; the
+# fix is application-side (shard the counter, batch the updates),
+# which is why this is a named finding and not a shed.
+CAS_CONFLICT_STORM_PER_S = 10.0
 
 _FINDING_LOG_PERIOD_S = 1.0
 
@@ -553,6 +565,26 @@ class HealthWatchdog:
                     f"{shed_rate:.0f}/s with zero admitted over the "
                     "window",
                 )
+
+        # cas_conflict_storm (atomic plane): conditional writes are
+        # losing at a sustained rate — hot-key contention.  The
+        # plane is healthy (every conflict is a correctly-refused
+        # lost update), but clients are spinning on re-read/retry;
+        # the finding names the contention so the operator fixes the
+        # access pattern instead of suspecting the database.
+        cas_conf = rates.get("cas_conflicts_per_s")
+        if (
+            cas_conf is not None
+            and cas_conf > CAS_CONFLICT_STORM_PER_S
+        ):
+            add(
+                "cas_conflict_storm",
+                "warn",
+                cas_conf,
+                f"conditional writes losing {cas_conf:.0f}/s (> "
+                f"{CAS_CONFLICT_STORM_PER_S:.0f}) — hot-key CAS "
+                "contention; shard the key or batch the updates",
+            )
 
         # migration_stall: a migration claims to be running but moved
         # zero keys across consecutive windows.  DELETE-only plans

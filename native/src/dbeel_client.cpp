@@ -417,9 +417,17 @@ constexpr uint32_t kNodeTokensSlot = 6;
 // length-prefixed response whose length INCLUDES the trailing type
 // byte (0=Err, 1=Ok payload, 2=plain OK).  Returns false on transport
 // failure (the caller reconnects once).
-bool round_trip(Client* c, const std::string& ip, uint16_t port,
-                const MpBuf& req, std::vector<uint8_t>* body,
-                uint8_t* rtype) {
+// maybe_delivered (optional): set to true the moment request bytes
+// were written to a connected socket — past that point a failure no
+// longer proves the server did not process the request, so the
+// internal stale-keepalive replay is SKIPPED and the caller must
+// treat the op's outcome as unknown.  Conditional writes (cas) pass
+// it: blindly replaying expectations past a possible decide either
+// loses to the op's own applied outcome (a committed write
+// mis-reported as a CAS conflict) or double-applies it.
+bool round_trip_ex(Client* c, const std::string& ip, uint16_t port,
+                   const MpBuf& req, std::vector<uint8_t>* body,
+                   uint8_t* rtype, bool* maybe_delivered) {
   if (req.b.size() > 0xFFFF) {
     // The request header is u16-LE: an oversized frame would truncate
     // the length and desync the whole connection.  Mirror the Python
@@ -434,10 +442,15 @@ bool round_trip(Client* c, const std::string& ip, uint16_t port,
     uint8_t hdr[2] = {(uint8_t)(req.b.size() & 0xff),
                       (uint8_t)(req.b.size() >> 8)};
     uint8_t len4[4];
-    if (!write_all(fd, hdr, 2) ||
-        !write_all(fd, req.b.data(), req.b.size()) ||
+    bool wrote_any = write_all(fd, hdr, 2);
+    if (wrote_any && maybe_delivered) *maybe_delivered = true;
+    if (!wrote_any || !write_all(fd, req.b.data(), req.b.size()) ||
         !read_all(fd, len4, 4)) {
       drop_conn(c, ip, port);  // stale keepalive conn: retry fresh
+      if (maybe_delivered && *maybe_delivered) {
+        c->last_error = "transport failure after send to " + ip;
+        return false;  // outcome unknown: no replay
+      }
       continue;
     }
     uint32_t n = (uint32_t)len4[0] | ((uint32_t)len4[1] << 8) |
@@ -450,6 +463,10 @@ bool round_trip(Client* c, const std::string& ip, uint16_t port,
     body->resize(n);
     if (!read_all(fd, body->data(), n)) {
       drop_conn(c, ip, port);
+      if (maybe_delivered && *maybe_delivered) {
+        c->last_error = "transport failure after send to " + ip;
+        return false;  // outcome unknown: no replay
+      }
       continue;
     }
     *rtype = body->back();
@@ -458,6 +475,12 @@ bool round_trip(Client* c, const std::string& ip, uint16_t port,
   }
   c->last_error = "transport failure to " + ip;
   return false;
+}
+
+bool round_trip(Client* c, const std::string& ip, uint16_t port,
+                const MpBuf& req, std::vector<uint8_t>* body,
+                uint8_t* rtype) {
+  return round_trip_ex(c, ip, port, req, body, rtype, nullptr);
 }
 
 // Parse an Err body ([kind, message] msgpack array of strings).
@@ -798,6 +821,155 @@ int keyed_request(Client* c, const char* type,
     (void)sync_metadata_deadline(c, deadline);
     const uint64_t nowv = now_ms();
     if (nowv < deadline) {  // guard the uint64 underflow past deadline
+      uint64_t pause = backoff_ms(c, attempt);
+      const uint64_t remaining = deadline - nowv;
+      if (pause > remaining) pause = remaining;
+      if (pause > 0) sleep_ms(pause);
+    }
+  }
+}
+
+// Conditional-write walk (atomic plane, ISSUE 19).  Same shape as
+// keyed_request with two differences: the frame carries the CAS
+// expectation fields, and a CasConflict answer is FINAL — it is the
+// op's decided outcome (the expectation lost against the key's
+// current state), not an infrastructure failure, so it returns
+// immediately instead of walking on or backing off.  The caller must
+// re-read before retrying: the old expectation can never win again.
+int cas_request(Client* c, const std::string& collection,
+                const uint8_t* key, uint32_t klen, const uint8_t* value,
+                uint32_t vlen, bool is_delete,
+                const uint8_t* expect_value, uint32_t evlen,
+                bool expect_absent, int64_t expect_ts, int consistency,
+                uint32_t rf) {
+  uint32_t key_hash = dbeel_murmur3_32(key, klen, 0);
+  int last_rc = -2;
+  const uint64_t deadline = now_ms() + c->op_deadline_ms;
+  const uint64_t wall_deadline = wall_ms() + c->op_deadline_ms;
+  for (int attempt = 0;; attempt++) {
+    auto replicas = shards_for_key(c, key_hash, rf ? rf : 1);
+    bool not_owned = false;
+    bool transport_failed = false;
+    // Overloaded covers both governor sheds AND the server's
+    // post-restart conditional-write barrier — both drain on their
+    // own, so both retry after backoff.
+    bool shed = false;
+    for (size_t ri = 0; ri < replicas.size(); ri++) {
+      if (now_ms() >= deadline && ri > 0) {
+        transport_failed = true;
+        break;
+      }
+      MpBuf m;
+      // type, collection, keepalive, key, hash, replica_index,
+      // deadline_ms, value-or-delete (+ armed expectations,
+      // + consistency when requested, + trace id, + qos stamps).
+      uint32_t fields = 8 + (expect_absent ? 1 : 0) +
+                        (expect_ts >= 0 ? 1 : 0) +
+                        (expect_value ? 1 : 0) +
+                        (consistency > 0 ? 1 : 0) +
+                        (c->trace_id ? 1 : 0) + qos_field_count(c);
+      m.map_header(fields);
+      common_fields(&m, "cas", collection, true);
+      append_qos_fields(c, &m);
+      m.str("key");
+      m.raw(key, klen);  // raw msgpack blob straight into the map
+      if (is_delete) {
+        m.str("delete");
+        m.boolean(true);
+      } else {
+        m.str("value");
+        m.raw(value, vlen);
+      }
+      if (expect_absent) {
+        m.str("expect_absent");
+        m.boolean(true);
+      }
+      if (expect_ts >= 0) {
+        m.str("expect_ts");
+        m.uint((uint64_t)expect_ts);
+      }
+      if (expect_value) {
+        m.str("expect_value");
+        m.raw(expect_value, evlen);
+      }
+      if (consistency > 0) {
+        m.str("consistency");
+        m.uint((uint64_t)consistency);
+      }
+      m.str("hash");
+      m.uint(key_hash);
+      m.str("replica_index");
+      m.uint((uint64_t)ri);
+      m.str("deadline_ms");
+      m.uint(wall_deadline);
+      if (c->trace_id) {
+        m.str("trace");
+        m.uint(c->trace_id++);
+      }
+      std::vector<uint8_t> body;
+      uint8_t rtype = 0;
+      bool maybe_delivered = false;
+      if (!round_trip_ex(c, replicas[ri]->ip, replicas[ri]->db_port,
+                         m, &body, &rtype, &maybe_delivered)) {
+        if (maybe_delivered) {
+          // Request bytes reached a connected socket: the decider
+          // may have committed the op before the exchange died.
+          // Replaying the same expectations (here or on the next
+          // replica) could double-apply or mis-report a committed
+          // write as a conflict — surface the ambiguity; the caller
+          // resolves it by re-reading.
+          return -2;
+        }
+        transport_failed = true;  // dial failed: provably undelivered
+        last_rc = -2;
+        continue;  // next replica (the decider gate arbitrates)
+      }
+      if (rtype != kResponseErr) {
+        return 0;  // decided and committed at the arc owner
+      }
+      std::string msg;
+      std::string kind = error_kind(body, &msg);
+      if (kind == "CasConflict") {
+        c->last_error = kind + ": " + msg;
+        return -3;  // decided outcome — never walk on past it
+      }
+      if (kind == "KeyNotOwnedByShard") {
+        not_owned = true;
+        break;  // stale ring or decider refusal: resync and retry
+      }
+      if (kind == "Overloaded" || kind == "QuotaExceeded" ||
+          kind == "PeerDead") {
+        // Provably PRE-decide refusals (the server folds every
+        // post-decide failure into plain Timeout): safe to retry
+        // after backoff — sheds and barrier windows drain, dead
+        // peers get detected.
+        shed = true;
+        last_rc = -2;
+        c->last_error = kind + ": " + msg;
+        continue;  // the next replica may be the live decider
+      }
+      // Anything else — Timeout (possibly decided but unacked) or a
+      // definitive refusal (bad request, cross-arc keys): FINAL.
+      c->last_error = kind + ": " + msg;
+      return -2;
+    }
+    if (!not_owned && !transport_failed && !shed) {
+      if (last_rc == -2 && c->last_error.empty()) {
+        c->last_error = "no replica reachable";
+      }
+      return last_rc;
+    }
+    if (now_ms() >= deadline) {
+      if (not_owned) {
+        c->last_error = "KeyNotOwnedByShard after resync";
+      } else if (c->last_error.empty()) {
+        c->last_error = "op deadline exhausted";
+      }
+      return -2;
+    }
+    (void)sync_metadata_deadline(c, deadline);
+    const uint64_t nowv = now_ms();
+    if (nowv < deadline) {
       uint64_t pause = backoff_ms(c, attempt);
       const uint64_t remaining = deadline - nowv;
       if (pause > remaining) pause = remaining;
@@ -1595,6 +1767,35 @@ int dbeel_cli_set(void* h, const char* collection, const uint8_t* key,
                   int consistency, uint32_t rf) {
   return keyed_request(static_cast<Client*>(h), "set", collection, key,
                        klen, value, vlen, consistency, rf, nullptr);
+}
+
+// Conditional write (atomic plane, ISSUE 19).  key / value /
+// expect_value: raw msgpack blobs.  is_delete != 0 makes the decided
+// outcome a tombstone (value ignored, may be null).  At least one
+// expectation must be armed: expect_value non-null, expect_ts >= 0
+// (negative disarms), or expect_absent != 0.  Returns 0 ok, -3 CAS
+// conflict (the expectation did not match the key's current state —
+// re-read, then retry with fresh expectations; last_error carries the
+// server's detail), -2 error (last_error set).
+int dbeel_cli_cas(void* h, const char* collection, const uint8_t* key,
+                  uint32_t klen, const uint8_t* value, uint32_t vlen,
+                  int is_delete, const uint8_t* expect_value,
+                  uint32_t evlen, int expect_absent, int64_t expect_ts,
+                  int consistency, uint32_t rf) {
+  Client* c = static_cast<Client*>(h);
+  if (!is_delete && value == nullptr) {
+    c->last_error = "cas: value required unless is_delete is set";
+    return -2;
+  }
+  if (expect_value == nullptr && expect_ts < 0 && !expect_absent) {
+    c->last_error =
+        "cas: arm one expectation "
+        "(expect_value / expect_ts / expect_absent)";
+    return -2;
+  }
+  return cas_request(c, collection, key, klen, value, vlen,
+                     is_delete != 0, expect_value, evlen,
+                     expect_absent != 0, expect_ts, consistency, rf);
 }
 
 int dbeel_cli_delete(void* h, const char* collection,

@@ -3069,6 +3069,15 @@ int64_t dbeel_dp_handle(void* h, const uint8_t* frame, uint32_t len,
   const bool is_get = slice_eq(type_s, type_n, "get");
   const bool is_mset = slice_eq(type_s, type_n, "multi_set");
   const bool is_mget = slice_eq(type_s, type_n, "multi_get");
+  // Atomic plane (ISSUE 19): conditional writes ALWAYS punt to the
+  // interpreted path — the membership-epoch fence, the per-arc
+  // decider lock and the post-boot barrier live there, and a native
+  // shortcut would bypass all three.  Recognized EXPLICITLY (and
+  // lint-pinned, analysis/wire_parity.py) so a future fast-path
+  // widening cannot absorb these verbs by accident.
+  const bool is_atomic = slice_eq(type_s, type_n, "cas") ||
+                         slice_eq(type_s, type_n, "atomic_batch");
+  if (is_atomic) return -1;
   if (!is_set && !is_del && !is_get && !is_mset && !is_mget)
     return -1;
   const int64_t verb =

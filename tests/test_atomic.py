@@ -1,0 +1,503 @@
+"""Atomic conditional writes (atomic plane, ISSUE 19): CAS and
+per-arc atomic batches decide at the key's arc owner under the
+per-arc lock and the membership-epoch fence; decided outcomes
+replicate as ordinary LWW writes so hinted handoff and anti-entropy
+converge replicas with no new peer machinery."""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from dbeel_tpu import errors
+from dbeel_tpu.client import Consistency, DbeelClient
+from dbeel_tpu.errors import CasConflict
+from dbeel_tpu.flow_events import FlowEvent
+from dbeel_tpu.utils.murmur import hash_bytes
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+
+KEY_ENC = lambda k: msgpack.packb(k, use_bin_type=True)  # noqa: E731
+
+# Tests exercise semantics, not restart races: the post-boot decider
+# barrier is disabled except where it is the thing under test.
+NO_BARRIER = dict(cas_boot_barrier_ms=0)
+
+
+def test_cas_semantics_single_node(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, **NO_BARRIER)
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "a", replication_factor=1
+            )
+
+            # expect_absent creates; a decided server ts comes back.
+            ts1 = await col.cas("k", {"v": 1}, expect_absent=True)
+            assert isinstance(ts1, int) and ts1 > 0
+            assert await col.get("k") == {"v": 1}
+
+            # Losing expectations refuse with CasConflict and leave
+            # the decided state intact.
+            with pytest.raises(CasConflict):
+                await col.cas("k", {"v": 9}, expect_absent=True)
+            with pytest.raises(CasConflict):
+                await col.cas("k", {"v": 9}, expect_value={"v": 0})
+            with pytest.raises(CasConflict):
+                await col.cas("k", {"v": 9}, expect_ts=ts1 - 1)
+            assert await col.get("k") == {"v": 1}
+
+            # Matching expectations commit; ts strictly advances.
+            ts2 = await col.cas("k", {"v": 2}, expect_value={"v": 1})
+            assert ts2 > ts1
+            ts3 = await col.cas("k", {"v": 3}, expect_ts=ts2)
+            assert ts3 > ts2
+            assert await col.get("k") == {"v": 3}
+
+            # Conditional delete; the tombstone is "absent" to CAS.
+            await col.cas("k", delete=True, expect_value={"v": 3})
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("k")
+            await col.cas("k", "reborn", expect_absent=True)
+            assert await col.get("k") == "reborn"
+
+            # No expectation at all is a client error, not a write.
+            with pytest.raises(errors.MissingField):
+                await col.cas("k", "x")
+
+            # Counters ride the get_stats.atomic block.
+            atomic = (await client.get_stats())["atomic"]
+            assert atomic["cas_served"] >= 5
+            assert atomic["cas_conflicts"] >= 3
+            assert atomic["barrier_remaining_ms"] == 0
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_cas_conflict_taxonomy_and_wire_roundtrip():
+    """The conflict class is retryable BY CONTRACT (after a re-read;
+    the rmw helper is the compliant retry), reconstructs typed from
+    the wire, and never claims the not-owned or overload classes that
+    drive resync/backoff behavior."""
+    e = CasConflict("cas on b'k': expected absent")
+    cls = errors.classify_error(e)
+    assert cls == errors.ERROR_CLASS_CONFLICT
+    assert errors.is_retryable_class(cls)
+    back = errors.from_wire(
+        msgpack.unpackb(
+            msgpack.packb(e.to_wire(), use_bin_type=True), raw=False
+        )
+    )
+    assert isinstance(back, CasConflict)
+    assert errors.classify_error(back) == errors.ERROR_CLASS_CONFLICT
+
+
+def test_rmw_concurrent_increments_lose_nothing(tmp_dir):
+    """The lost-update test in miniature: concurrent rmw increments
+    through the CAS plane must all land (final counter == total
+    committed increments) — raw LWW sets would silently drop the
+    losers of every race."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, **NO_BARRIER)
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "c", replication_factor=1
+            )
+            n_workers, n_incr = 8, 10
+
+            async def worker():
+                for _ in range(n_incr):
+                    await col.rmw(
+                        "counter",
+                        lambda cur: (cur or 0) + 1,
+                        max_retries=500,
+                    )
+
+            await asyncio.gather(
+                *(worker() for _ in range(n_workers))
+            )
+            assert await col.get("counter") == n_workers * n_incr
+            atomic = (await client.get_stats())["atomic"]
+            assert atomic["cas_served"] >= n_workers * n_incr
+        finally:
+            await node.stop()
+
+    run(main(), timeout=60)
+
+
+def test_atomic_batch_commits_or_refuses_whole(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, **NO_BARRIER)
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "b", replication_factor=1
+            )
+
+            # All-absent batch commits whole, one decided ts.
+            ts = await col.atomic_batch(
+                [
+                    {"key": "x", "value": 1, "expect_absent": True},
+                    {"key": "y", "value": 2, "expect_absent": True},
+                ]
+            )
+            assert isinstance(ts, int) and ts > 0
+            assert await col.get("x") == 1
+            assert await col.get("y") == 2
+
+            # ONE failing condition refuses the WHOLE batch — the
+            # passing op must not land either.
+            with pytest.raises(CasConflict):
+                await col.atomic_batch(
+                    [
+                        {"key": "x", "value": 10, "expect_value": 1},
+                        {
+                            "key": "z",
+                            "value": 30,
+                            "expect_value": "nope",
+                        },
+                    ]
+                )
+            assert await col.get("x") == 1
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("z")
+
+            # Mixed batch: conditional update + unconditional set +
+            # conditional delete, committed as a unit with a shared
+            # decided ts on every entry.
+            ts2 = await col.atomic_batch(
+                [
+                    {"key": "x", "value": 11, "expect_value": 1},
+                    {"key": "z", "value": 31},
+                    {"key": "y", "delete": True, "expect_value": 2},
+                ]
+            )
+            assert ts2 > ts
+            assert await col.get("x") == 11
+            assert await col.get("z") == 31
+            with pytest.raises(errors.KeyNotFound):
+                await col.get("y")
+            tree = node.shards[0].collections["b"].tree
+            for k in ("x", "z", "y"):
+                entry = await tree.get_entry(KEY_ENC(k))
+                assert entry is not None and entry[1] == ts2, k
+
+            # Client-side shape refusals: empty batch, keyless op.
+            with pytest.raises(errors.BadFieldType):
+                await col.atomic_batch([])
+            with pytest.raises(errors.BadFieldType):
+                await col.atomic_batch([{"value": 1}])
+
+            atomic = (await client.get_stats())["atomic"]
+            assert atomic["batches_committed"] == 2
+            assert atomic["batches_refused"] == 1
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_batch_arc_span_refused_and_decider_gate(tmp_dir):
+    """Three nodes, RF=2: an atomic batch whose keys live on
+    different ring arcs is refused as a non-retryable client error
+    (two independent commits cannot wear one 'atomic' name), while a
+    same-arc batch commits; and a conditional write arriving at
+    replica_index > 0 is refused while any preceding replica is
+    alive (single-decider election) but accepted once the walk's
+    predecessors are Dead."""
+
+    async def main():
+        from dbeel_tpu.server.db_server import handle_request
+
+        cfg = make_config(tmp_dir, **NO_BARRIER)
+        seed = f"{cfg.ip}:{cfg.remote_shard_port}"
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[seed]
+        )
+        cfg3 = next_node_config(cfg, 2, tmp_dir).replace(
+            seed_nodes=[seed]
+        )
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        node3 = await ClusterNode(cfg3).start()
+        await alive
+        nodes = [node1, node2, node3]
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node1.db_address]
+            )
+            col = await client.create_collection(
+                "s", replication_factor=2
+            )
+            for n in nodes:
+                while "s" not in n.shards[0].collections:
+                    await asyncio.sleep(0.01)
+
+            def replica_names(key):
+                return tuple(
+                    s.node_name
+                    for s in client._shards_for_key(
+                        hash_bytes(KEY_ENC(key)), 2
+                    )
+                )
+
+            # Probe keys until we hold a same-arc pair and a
+            # cross-arc pair (guaranteed to exist on 3 nodes).
+            by_arc = {}
+            for i in range(200):
+                by_arc.setdefault(
+                    replica_names(f"k{i:03}"), []
+                ).append(f"k{i:03}")
+                arcs = [a for a, ks in by_arc.items() if len(ks) >= 2]
+                if arcs and len(by_arc) >= 2:
+                    break
+            same_arc = next(
+                ks for ks in by_arc.values() if len(ks) >= 2
+            )[:2]
+            other_arc = next(
+                ks[0]
+                for a, ks in by_arc.items()
+                if a != replica_names(same_arc[0])
+            )
+
+            # Same arc: commits as one unit.
+            await col.atomic_batch(
+                [
+                    {
+                        "key": same_arc[0],
+                        "value": 1,
+                        "expect_absent": True,
+                    },
+                    {
+                        "key": same_arc[1],
+                        "value": 2,
+                        "expect_absent": True,
+                    },
+                ]
+            )
+            assert await col.get(same_arc[0]) == 1
+            assert await col.get(same_arc[1]) == 2
+
+            # Spanning arcs: refused, nothing lands anywhere.
+            with pytest.raises(errors.DbeelError) as ei:
+                await col.atomic_batch(
+                    [
+                        {"key": same_arc[0], "value": 99},
+                        {"key": other_arc, "value": 99},
+                    ]
+                )
+            assert not errors.is_retryable_class(
+                errors.classify_error(ei.value)
+            )
+            assert await col.get(same_arc[0]) == 1
+            with pytest.raises(errors.KeyNotFound):
+                await col.get(other_arc)
+
+            # Decider election: the key's SECOND replica must refuse
+            # a conditional write while the first is alive...
+            key = same_arc[0]
+            walk = replica_names(key)
+            secondary = next(
+                n
+                for n in nodes
+                if n.config.name == walk[1]
+            )
+            shard2 = secondary.shards[0]
+            req = {
+                "type": "cas",
+                "collection": "s",
+                "key": key,
+                "value": 7,
+                "expect_value": 1,
+                "replica_index": 1,
+            }
+            with pytest.raises(errors.KeyNotOwnedByShard) as ei:
+                await handle_request(shard2, dict(req))
+            assert errors.is_retryable_class(
+                errors.classify_error(ei.value)
+            )
+            # ...and stand in once every preceding replica is Dead.
+            shard2.dead_nodes.add(walk[0])
+            try:
+                raw = await handle_request(shard2, dict(req))
+                decided = msgpack.unpackb(raw, raw=False)
+                assert decided["ts"] > 0
+            finally:
+                shard2.dead_nodes.discard(walk[0])
+            entry = await shard2.collections["s"].tree.get_entry(
+                KEY_ENC(key)
+            )
+            assert msgpack.unpackb(entry[0], raw=False) == 7
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(main(), timeout=90)
+
+
+def test_cas_boot_barrier_refuses_then_lifts(tmp_dir):
+    """A freshly-(re)started decider sits out the boot barrier:
+    conditional writes refuse with the retryable overload class until
+    the window passes, so a restarted primary cannot race a stand-in
+    decider that has not yet observed its Alive edge."""
+
+    async def main():
+        from dbeel_tpu.server.db_server import handle_request
+
+        node = await ClusterNode(
+            make_config(tmp_dir, cas_boot_barrier_ms=700)
+        ).start()
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "bb", replication_factor=1
+            )
+            shard = node.shards[0]
+            req = {
+                "type": "cas",
+                "collection": "bb",
+                "key": "k",
+                "value": 1,
+                "expect_absent": True,
+            }
+            if shard.atomic_barrier_remaining_s() > 0:
+                with pytest.raises(errors.Overloaded) as ei:
+                    await handle_request(shard, dict(req))
+                assert errors.is_retryable_class(
+                    errors.classify_error(ei.value)
+                )
+                assert (
+                    (await client.get_stats())["atomic"][
+                        "barrier_remaining_ms"
+                    ]
+                    > 0
+                )
+            while shard.atomic_barrier_remaining_s() > 0:
+                await asyncio.sleep(0.05)
+            raw = await handle_request(shard, dict(req))
+            assert msgpack.unpackb(raw, raw=False)["ts"] > 0
+            assert await col.get("k") == 1
+
+            # Plain writes were never barred — the barrier is an
+            # atomic-plane-only refusal.
+            await col.set("plain", 2)
+            assert await col.get("plain") == 2
+        finally:
+            await node.stop()
+
+    run(main(), timeout=30)
+
+
+def test_decided_cas_converges_via_hints_after_replica_kill(tmp_dir):
+    """A CAS decided while one replica is down replicates later via
+    hinted handoff exactly like a plain write — same bytes, same
+    decided timestamp — because the decided outcome rides ordinary
+    SET peer frames (no new peer verbs, no special-cased repair)."""
+
+    async def main():
+        cfg = make_config(
+            tmp_dir,
+            anti_entropy_interval_ms=0,
+            failure_detection_interval_ms=50,
+            hint_drain_interval_ms=200,
+            **NO_BARRIER,
+        )
+        node1 = await ClusterNode(cfg).start()
+        alive = node1.flow_event(0, FlowEvent.ALIVE_NODE_GOSSIP)
+        cfg2 = next_node_config(cfg, 1, tmp_dir).replace(
+            seed_nodes=[node1.seed_address]
+        )
+        node2 = await ClusterNode(cfg2).start()
+        await alive
+        client = await DbeelClient.from_seed_nodes(
+            [node1.db_address], op_deadline_s=5.0
+        )
+        created = [
+            n.flow_event(0, FlowEvent.COLLECTION_CREATED)
+            for n in (node1, node2)
+        ]
+        col = await client.create_collection(
+            "cv", replication_factor=2
+        )
+        await asyncio.wait_for(asyncio.gather(*created), 10)
+        try:
+            # Seed while both replicas are up.
+            await col.cas(
+                "doc",
+                {"rev": 1},
+                expect_absent=True,
+                consistency=Consistency.ALL,
+            )
+
+            removed = node1.flow_event(
+                0, FlowEvent.DEAD_NODE_REMOVED
+            )
+            await node2.crash()
+            await asyncio.wait_for(removed, 15)
+
+            # Decide at the surviving replica (W=1): the unreachable
+            # one gets a hint, not a lost update.
+            ts = await col.cas(
+                "doc",
+                {"rev": 2},
+                expect_value={"rev": 1},
+                consistency=Consistency.fixed(1),
+            )
+            assert node1.shards[0].hint_log.has(cfg2.name)
+
+            # Keep rejoin-side migration out of the picture: the
+            # hint replay alone must deliver the decided write.
+            node2 = await ClusterNode(cfg2).start()
+            for shard in node2.shards:
+                shard.migrate_data_on_node_addition = (
+                    lambda *_a, **_k: None
+                )
+            vtree = node2.shards[0].collections["cv"].tree
+            entry = None
+            for _ in range(150):
+                entry = await vtree.get_entry(KEY_ENC("doc"))
+                if entry is not None and entry[1] == ts:
+                    break
+                await asyncio.sleep(0.1)
+            assert entry is not None, "hint never replayed"
+            assert entry[1] == ts, "replayed ts != decided ts"
+            assert msgpack.unpackb(entry[0], raw=False) == {
+                "rev": 2
+            }
+            # Byte agreement with the decider's replica.
+            e1 = await node1.shards[0].collections[
+                "cv"
+            ].tree.get_entry(KEY_ENC("doc"))
+            assert (bytes(e1[0]), e1[1]) == (
+                bytes(entry[0]),
+                entry[1],
+            )
+        finally:
+            client.close()
+            for n in (node1, node2):
+                await n.stop()
+
+    run(main(), timeout=60)

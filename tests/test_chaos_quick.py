@@ -153,6 +153,38 @@ CHURN_KEYS = {
     "pass",
 }
 
+# Atomic plane (ISSUE 19): the --cas phase — CAS-retry counter
+# increments + expect_absent uniqueness through a replica kill, a
+# partition heal, and one membership cycle; zero lost updates, zero
+# double-applies, byte-agreed replicas.
+CAS_KEYS = {
+    "clients",
+    "counters",
+    "uniq_keys",
+    "acked_increments",
+    "ambiguous_outcomes",
+    "client_conflicts",
+    "server_cas_conflicts",
+    "server_cas_served",
+    "final_counts",
+    "lost_updates",
+    "lost_samples",
+    "double_applies",
+    "double_samples",
+    "internal_mismatches",
+    "uniq_winners",
+    "uniq_double_acks",
+    "uniq_lost",
+    "uniq_lost_samples",
+    "uniq_foreign_values",
+    "divergent_keys",
+    "convergence_s",
+    "stats_atomic_block",
+    "ring_reconverged",
+    "nodes_alive",
+    "pass",
+}
+
 # QoS plane (ISSUE 14): the two-class overload sub-phase — equal
 # offered load per class; the high class holds its goodput share
 # while the low class sheds first.
@@ -170,13 +202,13 @@ OVERLOAD_CLASS_KEYS = {
 
 @pytest.mark.slow
 def test_chaos_soak_quick_schema(tmp_dir):
-    # The quick soak plus the fault/overload/scan/membership phases
-    # runs ~4-6 min — past the conftest 110s per-test watchdog;
-    # re-arm the alarm (same handler) for this test's real horizon.
+    # The quick soak plus the fault/overload/scan/membership/cas
+    # phases runs ~5-8 min — past the conftest 110s per-test
+    # watchdog; re-arm the alarm (same handler) for the real horizon.
     import signal
 
     if hasattr(signal, "SIGALRM"):
-        signal.alarm(890)
+        signal.alarm(1190)
     report_path = os.path.join(tmp_dir, "report.json")
     proc = subprocess.run(
         [
@@ -188,13 +220,14 @@ def test_chaos_soak_quick_schema(tmp_dir):
             "--overload",
             "--scan",
             "--churn",
+            "--cas",
             "--report",
             report_path,
         ],
         cwd=REPO,
         capture_output=True,
         text=True,
-        timeout=900,
+        timeout=1200,
     )
     assert os.path.exists(report_path), proc.stdout[-2000:]
     with open(report_path) as f:
@@ -282,6 +315,26 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert ch["stats_membership_block"] is True
     assert ch["nodes_alive"] is True
     assert ch["pass"] is True, ch
+    # --cas phase schema (atomic plane, ISSUE 19): the lost-update
+    # gate — every unambiguously acked increment is present in the
+    # per-client slot map, nothing applied more times than acked +
+    # ambiguous, at most one acked winner per unique key, and the
+    # replicas byte-agree after convergence.
+    cs = report["cas"]
+    missing = CAS_KEYS - set(cs)
+    assert not missing, missing
+    assert cs["acked_increments"] > 0
+    assert cs["lost_updates"] == 0, cs["lost_samples"]
+    assert cs["double_applies"] == 0, cs["double_samples"]
+    assert cs["internal_mismatches"] == 0
+    assert cs["uniq_double_acks"] == 0
+    assert cs["uniq_lost"] == 0, cs["uniq_lost_samples"]
+    assert cs["uniq_foreign_values"] == 0
+    assert cs["divergent_keys"] == 0
+    assert cs["server_cas_conflicts"] > 0
+    assert cs["stats_atomic_block"] is True
+    assert cs["nodes_alive"] is True
+    assert cs["pass"] is True, cs
     # Tracing plane (ISSUE 9): the trace block must be present with
     # dumps from the (still alive) nodes; dominant_stages is a list
     # of [stage, share] pairs (may be empty when nothing was slow).
@@ -298,6 +351,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert set(hb) == {"phases", "final"}
     assert "churn" in hb["phases"]
     assert "membership" in hb["phases"]
+    assert "cas" in hb["phases"]
     for label, block in {**hb["phases"], "final": hb["final"]}.items():
         missing = HEALTH_BLOCK_KEYS - set(block)
         assert not missing, (label, missing)

@@ -598,7 +598,10 @@ def test_gets_correct_after_native_compaction(tmp_dir, arun):
         finally:
             await node.stop()
 
-    arun(body())
+    # Four flush cycles + a full-tree compaction: the same 30s whole-
+    # body budget its multi-flush siblings run under (the 10s default
+    # flaked on slow CI disks).
+    arun(body(), timeout=30)
 
 
 

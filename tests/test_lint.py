@@ -965,3 +965,109 @@ def test_strip_c_comments_preserves_strings_and_lines():
     assert out.count("\n") == src.count("\n")
     assert '"a//b"' in out
     assert "not a string" not in out
+
+
+# ---------------------------------------------------------------------
+# Wire parity: atomic plane (ISSUE 19) drift seeds.
+# ---------------------------------------------------------------------
+
+
+def test_parity_flags_cas_punt_lost_in_native(tmp_path):
+    # A native fast path that absorbs conditional writes bypasses the
+    # epoch fence, the decider lock and the boot barrier at once.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_native.cpp",
+        'slice_eq(type_s, type_n, "atomic_batch");',
+        'slice_eq(type_s, type_n, "atomic_batches");',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "punt" in f.message and "cas" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_cas_verb_lost_in_server(tmp_path):
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/db_server.py",
+        'if rtype == "cas":',
+        'if rtype == "caz":',
+    )
+    # The sheddable-op registry ALSO names the verb and would keep
+    # the harvest satisfied on its own.
+    _edit(
+        root,
+        "dbeel_tpu/server/db_server.py",
+        '        "cas",\n',
+        '        "caz",\n',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "'cas'" in f.message and "server entry" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_cas_verb_lost_in_python_client(tmp_path):
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/client/__init__.py",
+        '"type": "cas",',
+        '"type": "caz",',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "'cas'" in f.message and "Python client" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_cas_verb_lost_in_c_client(tmp_path):
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "native/src/dbeel_client.cpp",
+        'common_fields(&m, "cas", collection, true);',
+        'common_fields(&m, "set", collection, true);',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "C client" in f.message and "'cas'" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_cas_expect_field_lost_in_server(tmp_path):
+    # Dropping an expectation read turns a conditional write into an
+    # unconditional one — the worst possible silent failure here.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/db_server.py",
+        'request.get("expect_ts")',
+        'request.get("expectedts")',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "expect_ts" in f.message and "unconditionally" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_cas_epoch_stamp_lost_in_client(tmp_path):
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/client/__init__.py",
+        '_EPOCH_STAMPED_OPS = ("set", "delete", "cas", '
+        '"atomic_batch")',
+        '_EPOCH_STAMPED_OPS = ("set", "delete")',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "_EPOCH_STAMPED_OPS" in f.message for f in findings
+    ), findings

@@ -305,3 +305,110 @@ def test_stale_epoch_write_refused_retryably_then_accepted(tmp_dir):
             await node.stop()
 
     run(main())
+
+
+def test_stale_epoch_cas_refused_retryably_then_self_heals(tmp_dir):
+    """Epoch fence x atomic plane (ISSUE 19): a CAS stamped with an
+    older membership epoch while a migration is live refuses with the
+    retryable not-owned class BEFORE deciding anything — a decider
+    routed by an outdated ring view must not serialize conditional
+    writes for an arc that is mid-handoff.  The full client self-heals
+    exactly as for plain writes: refusal -> metadata resync -> the
+    re-stamped CAS decides and commits."""
+
+    async def main():
+        import pytest
+
+        from dbeel_tpu import errors
+        from dbeel_tpu.server.db_server import handle_request
+
+        node = await ClusterNode(
+            make_config(tmp_dir, cas_boot_barrier_ms=0)
+        ).start()
+        blocker = None
+        try:
+            client = await DbeelClient.from_seed_nodes(
+                [node.db_address]
+            )
+            col = await client.create_collection(
+                "fc", replication_factor=1
+            )
+            await col.cas("doc", {"rev": 1}, expect_absent=True)
+
+            shard = node.shards[0]
+            stale = client._cluster_epoch
+            assert stale == shard.membership_epoch > 0
+
+            blocker = asyncio.ensure_future(asyncio.sleep(60))
+            shard.membership_epoch += 1
+            shard._migration_tasks.add(blocker)
+            shard._refresh_dataplane_ownership()
+
+            # Raw stale-stamped CAS: fence fires before the decider
+            # reads or writes anything — the key keeps rev 1 and no
+            # conflict is counted (the fence is not a CAS outcome).
+            conflicts_before = shard.cas_conflicts
+            with pytest.raises(errors.KeyNotOwnedByShard) as ei:
+                await handle_request(
+                    shard,
+                    {
+                        "type": "cas",
+                        "collection": "fc",
+                        "key": "doc",
+                        "value": {"rev": 99},
+                        "expect_value": {"rev": 1},
+                        "epoch": stale,
+                    },
+                )
+            assert errors.is_retryable_class(
+                errors.classify_error(ei.value)
+            )
+            assert shard.fence_refusals == 1
+            assert shard.cas_conflicts == conflicts_before
+            assert await col.get("doc") == {"rev": 1}
+
+            # Same fence guards the batch unit.
+            with pytest.raises(errors.KeyNotOwnedByShard):
+                await handle_request(
+                    shard,
+                    {
+                        "type": "atomic_batch",
+                        "collection": "fc",
+                        "ops": [{"key": "doc", "value": {"rev": 99}}],
+                        "epoch": stale,
+                    },
+                )
+            assert shard.fence_refusals == 2
+
+            # Full client path self-heals: the fenced CAS resyncs
+            # metadata, re-stamps the CURRENT epoch and decides.
+            ts = await col.cas(
+                "doc", {"rev": 2}, expect_value={"rev": 1}
+            )
+            assert ts > 0
+            assert client._cluster_epoch == shard.membership_epoch
+            assert shard.fence_refusals == 3
+            assert await col.get("doc") == {"rev": 2}
+
+            # Fence lifts with the last migration: stale stamps pass.
+            shard._migration_tasks.discard(blocker)
+            shard._refresh_dataplane_ownership()
+            await handle_request(
+                shard,
+                {
+                    "type": "cas",
+                    "collection": "fc",
+                    "key": "doc",
+                    "value": {"rev": 3},
+                    "expect_value": {"rev": 2},
+                    "epoch": stale,
+                },
+            )
+            assert shard.fence_refusals == 3
+            assert await col.get("doc") == {"rev": 3}
+        finally:
+            if blocker is not None:
+                blocker.cancel()
+            await node.stop()
+
+    run(main(), timeout=30)

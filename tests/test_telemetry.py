@@ -773,3 +773,28 @@ def test_scan_rates_derive_from_counters():
     assert rates["scan_chunks_per_s"] == 10.0
     assert rates["scan_bytes_per_s"] == 2048.0
     assert rates["scan_sheds_per_s"] == 2.0
+
+
+def test_cas_conflict_rate_derives_from_counter():
+    # Atomic plane (ISSUE 19): the conflict counter becomes a rate.
+    ring = tm.TelemetryRing(capacity=8)
+    _sample(ring, 0.0, **{"atomic.cas_conflicts": 0})
+    _sample(ring, 2.0, **{"atomic.cas_conflicts": 30})
+    assert ring.rates()["cas_conflicts_per_s"] == 15.0
+
+
+def test_watchdog_cas_conflict_storm_fires_and_clears():
+    # Sustained CAS losses mean a hot key is being fought over —
+    # every losing client re-reads and retries, multiplying load.
+    ring = tm.TelemetryRing(capacity=8)
+    dog = tm.HealthWatchdog()
+    _sample(ring, 0.0, **{"atomic.cas_conflicts": 0})
+    _sample(ring, 1.0, **{"atomic.cas_conflicts": 40})  # 40/s
+    findings = dog.evaluate(ring)
+    assert "cas_conflict_storm" in _kinds(findings)
+    storm = next(
+        f for f in findings if f["kind"] == "cas_conflict_storm"
+    )
+    assert storm["severity"] == "warn"
+    _sample(ring, 2.0, **{"atomic.cas_conflicts": 41})  # 1/s
+    assert "cas_conflict_storm" not in _kinds(dog.evaluate(ring))
