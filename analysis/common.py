@@ -172,6 +172,10 @@ class Repo:
         return self.path("dbeel_tpu", "server", "scan.py")
 
     @property
+    def watch_py(self) -> str:
+        return self.path("dbeel_tpu", "server", "watch.py")
+
+    @property
     def query_py(self) -> str:
         return self.path("dbeel_tpu", "query.py")
 

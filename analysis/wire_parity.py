@@ -894,6 +894,83 @@ def check(repo: Repo) -> List[Finding]:
             "BOTH clients",
         )
 
+    # -- watch/CDC plane (ISSUE 20): feed arity + cursor pins --------
+    # The WATCH_FEED peer frame has a FIXED arity: the encoder's
+    # element count must equal shard.py's _WATCH_PEER_ARITY (what
+    # the handler indexes).  The C planes carry NO watch tokens —
+    # they punt the verb to the interpreted path (registry symmetry
+    # + the unknown-wire-string check above keep it that way), so
+    # unlike SCAN there is no third arity copy to pin.
+    watch_tree = ast.parse(read_file(repo.watch_py))
+    watch_arity = _module_int_constant(shard, "_WATCH_PEER_ARITY")
+    if watch_arity is None:
+        add(
+            repo.shard_py,
+            1,
+            "_WATCH_PEER_ARITY constant missing — the watch_feed "
+            "peer-frame arity must be a named, lint-compared "
+            "constant",
+        )
+    else:
+        enc = arities.get("WATCH_FEED")
+        if enc is not None and enc != watch_arity:
+            add(
+                repo.messages_py,
+                1,
+                f"watch_feed peer-frame arity drift: encoder emits "
+                f"{enc} elements but shard.py's _WATCH_PEER_ARITY "
+                f"is {watch_arity}",
+            )
+    # The watch cursor travels through the CLIENT and back: the
+    # packed field count is pinned between watch.py's encoder and
+    # its _CURSOR_ARITY (what decode_cursor accepts), and the
+    # Python client's read-only position peek must speak the same
+    # version token or its monotonicity audit goes silently blind.
+    wcursor_arity = _module_int_constant(
+        watch_tree, "_CURSOR_ARITY"
+    )
+    wcursor_enc = _function_list_literal_len(
+        watch_tree, "encode_cursor"
+    )
+    if wcursor_arity is None:
+        add(
+            repo.watch_py,
+            1,
+            "_CURSOR_ARITY constant missing — the watch-cursor "
+            "shape must be a named, lint-compared constant",
+        )
+    elif wcursor_enc is not None and wcursor_enc != wcursor_arity:
+        add(
+            repo.watch_py,
+            1,
+            f"watch-cursor arity drift: encode_cursor packs "
+            f"{wcursor_enc} fields but _CURSOR_ARITY is "
+            f"{wcursor_arity} — a freshly-minted cursor would be "
+            "rejected on resume",
+        )
+    wcursor_version = _module_str_constant(
+        watch_tree, "CURSOR_VERSION"
+    )
+    if wcursor_version is None:
+        add(
+            repo.watch_py,
+            1,
+            "CURSOR_VERSION constant missing — the watch-cursor "
+            "dialect must be a named, lint-compared constant",
+        )
+    elif (
+        f'"{wcursor_version}"' not in read_file(repo.client_py)
+        and f"'{wcursor_version}'" not in read_file(repo.client_py)
+    ):
+        add(
+            repo.client_py,
+            1,
+            f"watch-cursor version drift: the client's position "
+            f"peek no longer recognizes {wcursor_version!r} — "
+            "Watcher's monotonicity audit would silently pass on "
+            "every stream",
+        )
+
     # -- DDL plane (ISSUEs 15/17): quotas-then-index tail dialect ----
     # create_collection frames (peer request AND gossip event) carry
     # up to DDL_TAIL_SLOTS optional trailing elements after the base

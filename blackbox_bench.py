@@ -1364,6 +1364,342 @@ async def main_scan_filter_indexed(args):
         shutil.rmtree(d, ignore_errors=True)
 
 
+async def main_watch(args):
+    """--watch (Watch/CDC plane, ISSUE 20): commit→delivery latency
+    and the idle-subscriber interference gate, same-session.
+
+    Phase A: point-set goodput baseline with zero watchers attached
+    (the hot collection's native fast path is pre-suspended first so
+    A and C both measure the interpreted write path — attaching a
+    watcher suspends it anyway, and an A/B across different planes
+    would be meaningless).
+    Phase B: commit→delivery — one measuring subscriber tails the
+    written collection while a paced writer stamps a send time into
+    every doc; p50/p99 of (delivery − send), measured with 1 / 64 /
+    1024 TOTAL attached subscribers.  The extras are IDLE: they
+    long-poll a second, never-written collection, so the cells
+    isolate the cost of merely-attached watchers (registry,
+    long-poll parks, per-collection wakeups) — not event fan-out.
+    Phase C: the interference gate — the SAME closed-loop set
+    workload as A with the 1024 idle watchers still parked.
+    Acceptance: goodput within 10%% of the no-watcher baseline.
+
+    One opportunistic device_capture probe rides the phase (the
+    tunnel-proof benching discipline)."""
+    import subprocess
+    import time as _time
+
+    from dbeel_tpu.errors import CollectionAlreadyExists
+
+    client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)]
+    )
+    rf = args.replication_factor or 1
+    hot = args.collection + "hot"
+    quiet = args.collection + "idle"
+    for name in (hot, quiet):
+        try:
+            await client.create_collection(name, rf)
+        except CollectionAlreadyExists:
+            pass
+    hotcol = client.collection(hot)
+    dur = args.watch_duration
+    loop = asyncio.get_event_loop()
+    value = {"blob": "x" * args.value_size}
+    report = {
+        "duration_per_cell_s": dur,
+        "clients": args.clients,
+        "value_size": args.value_size,
+        "idle_poll": {"wait_ms": 1000, "interval_s": "6-10 jittered"},
+    }
+
+    probe = {}
+    if os.environ.get("DBEEL_BENCH_NO_PROBE"):
+        probe["skipped"] = True
+    else:
+        try:
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            rc = subprocess.call(
+                [
+                    sys.executable, "device_capture.py",
+                    "--probe-timeout", "45",
+                ],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env,
+                timeout=900,
+            )
+            probe["rc"] = rc
+            probe["tunnel"] = "alive" if rc == 0 else "dead"
+        except Exception as e:  # pragma: no cover - best-effort
+            probe["error"] = str(e)[:200]
+            probe["tunnel"] = "dead"
+    report["device_probe"] = probe
+
+    # Pre-suspend the hot collection's native plane: one throwaway
+    # watch chunk is enough (sticky), so phase A's writes take the
+    # same interpreted path phase C's will.
+    pre = hotcol.watcher(wait_ms=0)
+    await pre.next_events()
+
+    async def set_goodput(dur_s):
+        """Closed-loop sets from args.clients workers: (ops/s,
+        p99 ms, errors).  Timeouts/sheds count as errors, not
+        crashes — under heavy watcher load they ARE the
+        interference signal."""
+        lat = []
+        errs = [0]
+        stop_at = loop.time() + dur_s
+
+        async def one(wid):
+            i = 0
+            while loop.time() < stop_at:
+                i += 1
+                t1 = _time.perf_counter()
+                try:
+                    await hotcol.set(f"g{wid}-{i:07d}", value)
+                except Exception:
+                    errs[0] += 1
+                    continue
+                lat.append(_time.perf_counter() - t1)
+
+        await asyncio.gather(
+            *(one(w) for w in range(args.clients))
+        )
+        lat.sort()
+        p99 = (
+            lat[int(0.99 * (len(lat) - 1))] * 1000 if lat else 0.0
+        )
+        return len(lat) / dur_s, round(p99, 3), errs[0]
+
+    base_rate, base_p99, base_errs = await set_goodput(dur)
+    report["baseline_set"] = {
+        "ops_per_s": round(base_rate, 1),
+        "p99_ms": base_p99,
+        "errors": base_errs,
+    }
+    print(
+        f"baseline set (no watchers): {base_rate:,.0f} ops/s  "
+        f"p99 {base_p99:.2f}ms"
+    )
+
+    # ---- idle-watcher pool (attach incrementally per cell) ----------
+    # Each idle subscriber holds a registered watch on the quiet
+    # collection and re-polls on a jittered ~8 s cadence (well under
+    # the 60 s registration TTL).  A hot re-poll loop would be
+    # dishonest here: with the harness and server sharing this
+    # host's cores, 1024 watchers re-polling the instant each 2 s
+    # park expires measure harness self-interference, not server
+    # cost — and the resulting shed/retry connection storm can SYN-
+    # flood the listener.  One pooled client per 64 watchers keeps
+    # connection reuse sane.
+    import random as _random
+
+    idle_clients: list = []
+    idle_stop = asyncio.Event()
+    idle_tasks: list = []
+
+    async def idle_loop(w):
+        while not idle_stop.is_set():
+            try:
+                await w.next_events()
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            try:
+                await asyncio.wait_for(
+                    idle_stop.wait(), 6.0 + 4.0 * _random.random()
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    async def subs_gauge():
+        """Registered-subscriber count summed over the node's
+        shards (`get_stats.watch.subscribers`)."""
+        total = 0
+        for sid in range(args.shards or 1):
+            try:
+                st = await client.get_stats(
+                    args.host, args.port + sid
+                )
+                total += (st.get("watch") or {}).get(
+                    "subscribers", 0
+                )
+            except Exception:
+                pass
+        return total
+
+    async def ensure_idle(n):
+        while len(idle_tasks) < n:
+            batch = min(64, n - len(idle_tasks))
+            cl = await DbeelClient.from_seed_nodes(
+                [(args.host, args.port)], op_deadline_s=30.0
+            )
+            idle_clients.append(cl)
+            icol = cl.collection(quiet)
+            ws = [
+                icol.watcher(wait_ms=1000) for _ in range(batch)
+            ]
+            # First poll registers the subscriber and parks at tail.
+            for w in ws:
+                idle_tasks.append(
+                    asyncio.create_task(idle_loop(w))
+                )
+            # Registration is real work (a cursor round trip each);
+            # on a small host a 1024-watcher attach storm can starve
+            # everything else for tens of seconds.  Gate each batch
+            # on the server-side subscriber gauge so cells start
+            # with the pool actually parked, not mid-stampede.
+            target = len(idle_tasks)
+            settle = loop.time() + 120
+            while loop.time() < settle:
+                if await subs_gauge() >= target:
+                    break
+                await asyncio.sleep(0.5)
+
+    # The measuring subscriber gets its own client with a patient
+    # op deadline: at the 1024-watcher cell the harness and server
+    # share this host's cores, and a register round queued behind
+    # hundreds of idle polls is congestion to MEASURE, not a
+    # failure to retry into.
+    meas_client = await DbeelClient.from_seed_nodes(
+        [(args.host, args.port)], op_deadline_s=60.0
+    )
+    meas_hotcol = meas_client.collection(hot)
+
+    async def delivery_cell(n_total):
+        await ensure_idle(n_total - 1)
+        await asyncio.sleep(1.0)  # pool settles into its parks
+        w = meas_hotcol.watcher(wait_ms=1000)
+        for attempt in range(5):
+            try:
+                await w.next_events()  # register + position at tail
+                break
+            except Exception:
+                # Attach-storm aftershock: the register round can
+                # still time out right after a big ensure_idle.
+                if attempt == 4:
+                    raise
+                await asyncio.sleep(2.0)
+        lats: list = []
+        done = asyncio.Event()
+
+        async def tail():
+            while not done.is_set():
+                try:
+                    events = await asyncio.wait_for(
+                        w.next_events(), 10
+                    )
+                except asyncio.TimeoutError:
+                    continue
+                now = _time.perf_counter()
+                for _k, v, _ts, _fl in events:
+                    if isinstance(v, dict) and "t" in v:
+                        lats.append(now - v["t"])
+
+        tail_task = asyncio.create_task(tail())
+        sent = 0
+        werrs = 0
+        stop_at = loop.time() + dur
+        while loop.time() < stop_at:
+            try:
+                await meas_hotcol.set(
+                    f"d{n_total}-{sent:06d}",
+                    {"t": _time.perf_counter(), "pad": "x" * 32},
+                )
+                sent += 1
+            except Exception:
+                werrs += 1
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(1.5)  # let the last deliveries land
+        done.set()
+        try:
+            await asyncio.wait_for(tail_task, 15)
+        except asyncio.TimeoutError:
+            tail_task.cancel()
+        lats.sort()
+        cell = {
+            "subscribers_total": n_total,
+            "idle_watchers": n_total - 1,
+            "writes_sent": sent,
+            "write_errors": werrs,
+            "events_timed": len(lats),
+            "p50_ms": round(
+                lats[len(lats) // 2] * 1000, 3
+            ) if lats else None,
+            "p99_ms": round(
+                lats[int(0.99 * (len(lats) - 1))] * 1000, 3
+            ) if lats else None,
+        }
+        print(
+            f"delivery @ {n_total} subscribers: "
+            f"{cell['events_timed']}/{sent} timed  "
+            f"p50 {cell['p50_ms']}ms  p99 {cell['p99_ms']}ms"
+        )
+        return cell
+
+    # ---- Phases B+C interleaved: delivery cells, and the goodput
+    # interference point right after each pool size is attached
+    # (watchers cannot detach before their TTL, so the pool only
+    # grows — measure on the way up).
+    cells = []
+    interference = []
+    for n in (1, 64, 1024):
+        cells.append(await delivery_cell(n))
+        if n > 1:
+            on_rate, on_p99, on_errs = await set_goodput(dur)
+            ratio = on_rate / max(1e-9, base_rate)
+            point = {
+                "idle_watchers": len(idle_tasks),
+                "ops_per_s": round(on_rate, 1),
+                "p99_ms": on_p99,
+                "errors": on_errs,
+                "vs_baseline": round(ratio, 3),
+                "within_10pct": ratio >= 0.9,
+            }
+            interference.append(point)
+            print(
+                f"set with {len(idle_tasks)} idle watchers: "
+                f"{on_rate:,.0f} ops/s  p99 {on_p99:.2f}ms  "
+                f"(x{ratio:.3f} vs baseline, within_10pct="
+                f"{ratio >= 0.9})"
+            )
+    report["delivery_latency"] = cells
+    report["goodput_interference"] = interference[-1]
+    report["goodput_interference_curve"] = interference
+    try:
+        report["host_nproc"] = os.cpu_count()
+    except Exception:
+        pass
+
+    idle_stop.set()
+    await asyncio.sleep(0.1)
+    for t in idle_tasks:
+        t.cancel()
+    await asyncio.gather(*idle_tasks, return_exceptions=True)
+    # Per-shard watch blocks: subscribers register on whichever
+    # shard coordinates their chunks, so the gauge only sums up
+    # across all of them.
+    blocks = []
+    for sid in range(args.shards or 1):
+        try:
+            st = await client.get_stats(args.host, args.port + sid)
+            blocks.append(st.get("watch"))
+        except Exception as e:
+            blocks.append({"error": str(e)[:120]})
+    report["server_watch_blocks"] = blocks
+    print(f"server watch blocks: {blocks}")
+    print("WATCH_REPORT " + json.dumps(report))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"wrote {args.json_out}")
+    meas_client.close()
+    for cl in idle_clients:
+        cl.close()
+    client.close()
+
+
 async def main_scan(args):
     """--scan (streaming scan plane, ISSUE 12): the two acceptance
     gates, same-session.  (1) Throughput: stream the whole keyspace
@@ -1781,6 +2117,11 @@ def main_compaction(args):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--shards", type=int, default=1,
+        help="server shard count (consecutive ports from --port); "
+        "the --watch phase sums per-shard subscriber gauges",
+    )
     ap.add_argument("--port", type=int, default=10000)
     ap.add_argument("--clients", type=int, default=20)
     ap.add_argument("--requests", type=int, default=5000)
@@ -1915,6 +2256,21 @@ def main():
         "knee verdict as JSON (the BENCH_r14.json artifact)",
     )
     ap.add_argument(
+        "--watch",
+        action="store_true",
+        help="watch/CDC phase (ISSUE 20): commit→delivery p50/p99 "
+        "with 1/64/1024 attached subscribers (extras idle on a "
+        "quiet collection), plus the interference gate — point-set "
+        "goodput with 1024 idle watchers parked vs the no-watcher "
+        "baseline (acceptance: within 10%%)",
+    )
+    ap.add_argument(
+        "--watch-duration",
+        type=float,
+        default=6.0,
+        help="seconds per --watch cell",
+    )
+    ap.add_argument(
         "--compaction",
         action="store_true",
         help="single-pass compaction phase (ISSUE 15): same-session "
@@ -1968,6 +2324,8 @@ def main():
         asyncio.run(main_knee_worker(args))
     elif args.telemetry_overhead:
         asyncio.run(main_telemetry_overhead(args))
+    elif args.watch:
+        asyncio.run(main_watch(args))
     elif args.cas:
         asyncio.run(main_cas(args))
     elif args.scan_filter_indexed:

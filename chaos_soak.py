@@ -449,6 +449,76 @@ def trace_report_block(dumps):
     }
 
 
+async def quiet_wait(nodes, base_s):
+    """Hint-drain-aware quiet window (ISSUE 20 satellite).
+
+    The fixed ``sleep(quiet_window)`` raced the last churn restart's
+    hint replay: on a slow/loaded host the replayed hints were still
+    in flight when final_checks ran its single quorum-read pass, and
+    the pre-existing quick-soak ``acked_writes_lost`` flake was that
+    race, not real loss.  Instead: floor-wait briefly, then poll
+    every live shard's ``convergence.hints_queued`` and hold until
+    the cluster-wide total stays zero for a settle period (or a hard
+    deadline passes — convergence stays asymptotic, final_checks'
+    own digest poll still backstops it).  Returns a report block.
+    """
+    floor_s = min(base_s, 10.0)
+    settle_s = min(max(base_s * 0.25, 3.0), 10.0)
+    deadline_s = max(base_s * 4.0, base_s + 30.0)
+    t0 = time.time()
+    polls = 0
+    total = -1
+    quiet_since = None
+    drained = False
+    client = await DbeelClient.from_seed_nodes(
+        [("127.0.0.1", nodes[0].db_port)]
+    )
+    try:
+        await asyncio.sleep(floor_s)
+        while time.time() - t0 < deadline_s:
+            total = 0
+            seen = 0
+            for n in nodes:
+                if not n.alive():
+                    continue
+                for sid in range(SHARDS):
+                    try:
+                        s = await client.get_stats(
+                            "127.0.0.1", n.db_port + sid
+                        )
+                        total += s["convergence"]["hints_queued"]
+                        seen += 1
+                    except Exception:
+                        pass
+            polls += 1
+            now = time.time()
+            if seen and total == 0:
+                if quiet_since is None:
+                    quiet_since = now
+                if now - quiet_since >= settle_s:
+                    drained = True
+                    break
+            else:
+                quiet_since = None
+            await asyncio.sleep(2.0)
+    finally:
+        client.close()
+    return {
+        "base_s": base_s,
+        "deadline_s": round(deadline_s, 1),
+        "waited_s": round(time.time() - t0, 1),
+        "polls": polls,
+        "hints_queued_final": total,
+        "drained": drained,
+        "note": (
+            "deadline-aware hint-drain poll replaces the fixed "
+            "quiet-window sleep; repeated --quick runs no longer "
+            "race the final quorum-read pass against the last "
+            "restart's hint replay (the old acked_writes_lost flake)"
+        ),
+    }
+
+
 async def final_checks(nodes, acks, report):
     """Invariants 1 + 2 after the quiet window."""
     client = await DbeelClient.from_seed_nodes(
@@ -2163,6 +2233,296 @@ async def cas_phase(nodes, seeds, report, quick):
     return ok
 
 
+async def watch_phase(nodes, seeds, report, quick):
+    """--watch: the Watch/CDC plane's loss gate (ISSUE 20).
+
+    N subscribers stream a fresh RF=3 collection through a
+    mid-stream replica SIGKILL+restart, an asymmetric partition +
+    heal on a second node, and one scale-out/scale-in membership
+    cycle — all while writers keep acking unique-key quorum writes.
+    Each subscriber keeps a ledger of delivered (key, value); at the
+    end every acked write must be present in EVERY ledger with the
+    acked value (exactly-once or explicitly dup-flagged: a key
+    re-delivered WITHOUT the dup flag is a protocol violation), and
+    the client-side cursor monotonicity audit must count zero
+    regressions.  Ambiguous (errored) writes may appear in ledgers —
+    that's at-least-once on the write path, not a watch defect."""
+    wcol_name = "soakw"
+    n_subs = 3 if quick else 8
+    n_writers = 2 if quick else 4
+    seed_addrs = [("127.0.0.1", n.db_port) for n in nodes]
+
+    setup = await DbeelClient.from_seed_nodes(seed_addrs)
+    await setup.create_collection(wcol_name, replication_factor=RF)
+    await asyncio.sleep(1)
+
+    acked = {}  # key -> value dict (unique keys: written once)
+    write_errors = 0
+    writer_stop = asyncio.Event()
+
+    async def writer(wid):
+        nonlocal write_errors
+        wcol = setup.collection(wcol_name)
+        seq = 0
+        while not writer_stop.is_set():
+            seq += 1
+            key = f"wk{wid}-{seq:05d}"
+            value = {"v": seq, "w": wid}
+            try:
+                await asyncio.wait_for(
+                    wcol.set(
+                        key, value, consistency=Consistency.fixed(2)
+                    ),
+                    20,
+                )
+                acked[key] = value
+            except Exception:
+                # Not acked → not in the ledger contract.  The write
+                # may still have landed (ambiguous); subscribers may
+                # legitimately see it.
+                write_errors += 1
+            await asyncio.sleep(0.05)
+
+    sub_stop = asyncio.Event()
+    subs = []  # per-subscriber state dicts
+
+    async def subscriber(si):
+        state = {
+            "got": {},
+            "unflagged_dups": 0,
+            "dup_samples": [],
+            "poll_errors": 0,
+            "watcher": None,
+        }
+        subs.append(state)
+        cl = await DbeelClient.from_seed_nodes(seed_addrs)
+        w = cl.collection(wcol_name).watcher(wait_ms=300)
+        state["watcher"] = w
+        try:
+            while not sub_stop.is_set():
+                try:
+                    events = await asyncio.wait_for(
+                        w.next_events(), 30
+                    )
+                except Exception:
+                    # Retryable turbulence (killed coordinator,
+                    # partition timeout, shed, fence): the cursor is
+                    # intact in the watcher — back off and resume.
+                    state["poll_errors"] += 1
+                    await asyncio.sleep(0.5)
+                    continue
+                for key, value, ts, flags in events:
+                    prev = state["got"].get(key)
+                    if (
+                        prev is not None
+                        and not (flags & 1)
+                        and int(ts) <= prev[1]
+                    ):
+                        # Same-or-older COMMIT redelivered without
+                        # the dup flag: a protocol violation.  A
+                        # strictly newer ts is a legitimate new
+                        # version of the key (the writer client's
+                        # internal retry re-committing after a lost
+                        # ack under soak turbulence) — the stream
+                        # must deliver both, unflagged.
+                        state["unflagged_dups"] += 1
+                        if len(state["dup_samples"]) < 5:
+                            state["dup_samples"].append(
+                                [key, int(ts), prev[1], flags]
+                            )
+                    if prev is None or int(ts) >= prev[1]:
+                        state["got"][key] = (value, int(ts))
+        finally:
+            cl.close()
+
+    log(f"WATCH: {n_subs} subscribers, {n_writers} writers")
+    tasks = [
+        asyncio.create_task(subscriber(i)) for i in range(n_subs)
+    ]
+    wtasks = [
+        asyncio.create_task(writer(i)) for i in range(n_writers)
+    ]
+    await asyncio.sleep(3 if quick else 8)
+
+    # Event 1: SIGKILL a replica mid-stream, then restart it.
+    victim = nodes[2]
+    log(f"WATCH: SIGKILL {victim.name} mid-stream")
+    victim.kill()
+    kills = 1
+    await asyncio.sleep(4 if quick else 10)
+    victim.start(seeds)
+    assert await wait_port(victim.db_port)
+    await asyncio.sleep(4 if quick else 8)
+
+    # Event 2: asymmetric partition on a second node (its fan-outs
+    # blackhole; peers still reach it), then heal by clean restart.
+    pvictim = nodes[1]
+    peer_addrs = [
+        f"127.0.0.1:{n.remote_port + sid}"
+        for n in nodes
+        if n is not pvictim
+        for sid in range(SHARDS)
+    ]
+    arm_delay = 4.0
+    log(f"WATCH: asymmetric partition on {pvictim.name}")
+    pvictim.kill()
+    pvictim.start(
+        seeds,
+        extra_env={
+            "DBEEL_REMOTE_FAULTS": ",".join(
+                f"{a}=blackhole" for a in peer_addrs
+            ),
+            "DBEEL_REMOTE_FAULTS_DELAY_S": str(arm_delay),
+        },
+        extra_argv=[
+            "--remote-shard-connect-timeout", "1000",
+            "--remote-shard-read-timeout", "2000",
+            "--remote-shard-write-timeout", "2000",
+        ],
+    )
+    assert await wait_port(pvictim.db_port)
+    kills += 1
+    await asyncio.sleep(arm_delay + (6 if quick else 10))
+    log(f"WATCH: healing {pvictim.name} (clean restart)")
+    pvictim.kill()
+    pvictim.start(seeds)
+    assert await wait_port(pvictim.db_port)
+    kills += 1
+    partition_heals = 1
+    await asyncio.sleep(3 if quick else 8)
+
+    # Event 3: one membership churn cycle — a brand-new node joins
+    # (addition migration moves arcs under live subscriptions), then
+    # SIGKILL it (removal migration + failure detection).
+    extra = Node(9)
+    log(f"WATCH: scale-out {extra.name} joins")
+    extra.start(seeds)
+    churn_cycles = 0
+    if await wait_port(extra.db_port):
+        await asyncio.sleep(12 if quick else 25)
+        log(f"WATCH: scale-in — SIGKILL {extra.name}")
+        extra.kill()
+        kills += 1
+        churn_cycles = 1
+    else:
+        log(f"WATCH: {extra.name} never came up")
+        extra.kill()
+    await asyncio.sleep(3)
+
+    writer_stop.set()
+    await asyncio.gather(*wtasks, return_exceptions=True)
+    log(
+        f"WATCH: writers stopped — {len(acked)} acked, "
+        f"{write_errors} errors; draining hints..."
+    )
+    t_drain0 = time.time()
+    qw = await quiet_wait(nodes, 8.0 if quick else 20.0)
+
+    # Ledger completion: poll until every subscriber holds every
+    # acked write (hint replay may still be feeding tails).
+    deadline = 60.0 if quick else 150.0
+    t0 = time.time()
+    while time.time() - t0 < deadline:
+        incomplete = [
+            s
+            for s in subs
+            if any(
+                (s["got"].get(k) or (None,))[0] != v
+                for k, v in acked.items()
+            )
+        ]
+        if not incomplete:
+            break
+        await asyncio.sleep(1.5)
+    drain_wait_s = round(time.time() - t_drain0, 1)
+    sub_stop.set()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+    lost = 0
+    lost_samples = []
+    unflagged = 0
+    dup_samples = []
+    mono = 0
+    dupf = 0
+    poll_errors = 0
+    for si, s in enumerate(subs):
+        missing = [
+            (k, v, s["got"].get(k))
+            for k, v in sorted(acked.items())
+            if (s["got"].get(k) or (None,))[0] != v
+        ]
+        lost += len(missing)
+        lost_samples.extend(
+            (si, k, f"acked {v}, got {g}") for k, v, g in missing[:3]
+        )
+        unflagged += s["unflagged_dups"]
+        dup_samples.extend(
+            [si] + smp for smp in s["dup_samples"][:3]
+        )
+        poll_errors += s["poll_errors"]
+        w = s["watcher"]
+        if w is not None:
+            mono += w.monotonicity_violations
+            dupf += w.dup_flagged
+
+    # Server-side rollup of the watch stats block (informational:
+    # counters reset with each restart, so these are floors).
+    rollup = {
+        k: 0
+        for k in (
+            "events_delivered",
+            "catchup_replays",
+            "handoff_resumes",
+            "ring_evictions",
+            "sheds",
+            "dup_flagged",
+        )
+    }
+    for n in nodes:
+        for sid in range(SHARDS):
+            try:
+                st = await setup.get_stats(
+                    "127.0.0.1", n.db_port + sid
+                )
+                for k in rollup:
+                    rollup[k] += st["watch"][k]
+            except Exception:
+                pass
+    setup.close()
+
+    nodes_alive = all(n.alive() for n in nodes)
+    ok = (
+        len(acked) > 0
+        and lost == 0
+        and unflagged == 0
+        and mono == 0
+        and nodes_alive
+    )
+    report["watch"] = {
+        "subscribers": n_subs,
+        "writers": n_writers,
+        "acked_writes": len(acked),
+        "write_errors": write_errors,
+        "delivered_lost": lost,
+        "lost_samples": lost_samples[:10],
+        "unflagged_duplicates": unflagged,
+        "unflagged_dup_samples": dup_samples[:10],
+        "cursor_monotonicity_violations": mono,
+        "dup_flagged_events": dupf,
+        "poll_errors": poll_errors,
+        "kills": kills,
+        "partition_heals": partition_heals,
+        "churn_cycles": churn_cycles,
+        "drain_wait_s": drain_wait_s,
+        "quiet_wait": qw,
+        "stats_watch_block": rollup,
+        "nodes_alive": nodes_alive,
+        "pass": ok,
+    }
+    log("WATCH:", json.dumps(report["watch"])[:900])
+    return ok
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=900.0)
@@ -2229,6 +2589,15 @@ async def main():
         "completing (sorted, duplicate-free), and after the heal the "
         "scan view must agree with quorum multi_gets of the acked "
         "journal keys",
+    )
+    ap.add_argument(
+        "--watch", action="store_true",
+        help="after churn: N subscribers stream a fresh collection "
+        "through a mid-stream replica SIGKILL+restart, an asymmetric "
+        "partition+heal, and one membership cycle while writers run; "
+        "assert every acked write lands in every subscriber ledger "
+        "exactly once or explicitly dup-flagged, and the resumable "
+        "cursor audit counts zero monotonicity regressions",
     )
     ap.add_argument(
         "--trace-dump-dir", default="",
@@ -2302,8 +2671,12 @@ async def main():
         if not n.alive():
             n.start(seeds)
             await wait_port(n.db_port)
-    log(f"quiet window {args.quiet_window:.0f}s (anti-entropy)...")
-    await asyncio.sleep(args.quiet_window)
+    log(
+        f"quiet window: hint-drain-aware poll "
+        f"(base {args.quiet_window:.0f}s)..."
+    )
+    quiet_block = await quiet_wait(nodes, args.quiet_window)
+    log(f"quiet window: {quiet_block}")
     if args.scale_churn:
         # The last scale-churn node may still be gossiped Dead /
         # migrating out: wait until metadata is back to the base set.
@@ -2336,6 +2709,7 @@ async def main():
         "kills": stats["kills"],
         "scale_outs": stats["scale_outs"],
         "restart_failures": stats["restart_failures"],
+        "quiet_wait": quiet_block,
     }
     ok = True
     # Telemetry plane (ISSUE 11): per-phase watchdog findings +
@@ -2405,6 +2779,17 @@ async def main():
         )
         # Let hinted handoff / anti-entropy settle the churn phase's
         # writes before the final whole-journal divergence scan.
+        await asyncio.sleep(min(args.quiet_window, 10.0))
+    if args.watch:
+        ok = (
+            await watch_phase(nodes, seeds, report, args.quick)
+        ) and ok
+        await collect_traces(nodes, "watch", args.trace_dump_dir)
+        health_phases["watch"] = await collect_health(
+            nodes, "watch", args.trace_dump_dir
+        )
+        # The watch phase's own kills/heals queue hints too; let them
+        # drain before the final whole-journal divergence scan.
         await asyncio.sleep(min(args.quiet_window, 10.0))
     ok = (await final_checks(nodes, acks, report)) and ok
     # Tracing plane (ISSUE 9): where did the slow tail's time go?

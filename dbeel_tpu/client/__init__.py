@@ -760,6 +760,97 @@ class DbeelClient:
             "no node reachable for scan"
         )
 
+    # -- watch/CDC streams --------------------------------------------
+
+    async def _watch_chunk_request(self, request: dict) -> dict:
+        """One watch/watch_next chunk with the scan plane's walk
+        discipline — the cursor is self-contained, so the stream
+        resumes on ANY node after a coordinator death or shed — plus
+        the epoch-fence leg: a retryable ``KeyNotOwnedByShard``
+        (cursor stamped before the current churn) resyncs metadata
+        before retrying the SAME cursor, which the next coordinator
+        re-stamps once its migration settles."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + self._op_deadline_s
+        request = dict(request)
+        request["deadline_ms"] = int(
+            (time.time() + self._op_deadline_s) * 1000
+        )
+        self._stamp_qos(request)
+        attempt = 0
+        last_error: Optional[Exception] = None
+        while True:
+            targets = [
+                (s.ip, s.db_port) for s in self._ring
+            ] or list(self._seeds)
+            if len(targets) > 1:
+                rot = self._rng.randrange(len(targets))
+                targets = targets[rot:] + targets[:rot]
+            for host, port in targets:
+                budget = deadline - loop.time()
+                if budget <= 0:
+                    break
+                request["timeout"] = max(
+                    100, min(5000, int(budget * 1000))
+                )
+                try:
+                    raw = await asyncio.wait_for(
+                        self._send_to(host, port, request), budget
+                    )
+                    return msgpack.unpackb(raw, raw=False)
+                except asyncio.TimeoutError:
+                    last_error = Timeout(
+                        f"watch chunk deadline "
+                        f"({self._op_deadline_s:.1f}s) exhausted"
+                    )
+                    break
+                except (
+                    DbeelError,
+                    OSError,
+                    asyncio.IncompleteReadError,
+                ) as e:
+                    last_error = e
+                    if isinstance(
+                        e, DbeelError
+                    ) and not is_retryable_class(classify_error(e)):
+                        raise  # benign/final (bad cursor, no such collection)
+                    continue
+            if loop.time() >= deadline - self._retry_reserve_s():
+                break
+            if not isinstance(
+                last_error, DbeelError
+            ) or isinstance(last_error, KeyNotOwnedByShard):
+                # Transport loss OR the epoch fence: refresh the
+                # ring/epoch view before the next walk.
+                try:
+                    await asyncio.wait_for(
+                        self.sync_metadata(),
+                        max(0.05, deadline - loop.time()),
+                    )
+                except (DbeelError, OSError, asyncio.TimeoutError):
+                    pass
+            backoff_attempt = attempt
+            if last_error is not None and classify_error(
+                last_error
+            ) in (ERROR_CLASS_OVERLOAD, ERROR_CLASS_QUOTA):
+                # Shed (slow-subscriber byte budget, subscriber cap,
+                # or hard overload): the cursor survives — back off
+                # harder before polling again.
+                backoff_attempt += 2
+            pause = min(
+                self._backoff_s(backoff_attempt, self._rng),
+                max(
+                    0.0,
+                    deadline - self._retry_reserve_s() - loop.time(),
+                ),
+            )
+            if pause > 0:
+                await asyncio.sleep(pause)
+            attempt += 1
+        raise last_error if last_error else ConnectionError_(
+            "no node reachable for watch"
+        )
+
     # -- batched multi-ops --------------------------------------------
 
     # Per-frame bounds: the request framing is u16-LE, so a batch
@@ -1246,6 +1337,71 @@ class DbeelCollection:
             if isinstance(trace_id, int) and trace_id > 0:
                 request["trace"] = trace_id
 
+    def watcher(
+        self,
+        filter: Optional[Any] = None,
+        wait_ms: int = 1000,
+        sub_id: Optional[str] = None,
+        cursor: Optional[bytes] = None,
+    ) -> "Watcher":
+        """Chunk-level handle on a change stream (watch/CDC plane,
+        ISSUE 20): ``await w.next_events()`` returns one chunk of
+        events, ``w.cursor`` is the resumable token after every
+        chunk — persist it and pass it back as ``cursor=`` to resume
+        the exact stream (on ANY node) after a client restart."""
+        return Watcher(
+            self.client,
+            self.name,
+            filter=filter,
+            wait_ms=wait_ms,
+            sub_id=sub_id,
+            cursor=cursor,
+        )
+
+    async def watch(
+        self,
+        filter: Optional[Any] = None,
+        wait_ms: int = 1000,
+        sub_id: Optional[str] = None,
+        cursor: Optional[bytes] = None,
+    ):
+        """Change stream (watch/CDC plane, ISSUE 20): an async
+        generator yielding ``(key, value, ts, flags)`` for every
+        acked mutation from NOW on (or from ``cursor`` when
+        resuming) — ``value is None`` is a delete, ``flags & 1`` an
+        explicitly flagged possible duplicate (catch-up/handoff
+        replay; never silent).  Delivery is state-compacting
+        (newest version per key per chunk) and loss-free across
+        coordinator death, partitions, and membership churn; the
+        stream long-polls ``wait_ms`` per empty round and backs off
+        adaptively between empty chunks.
+
+        ``filter`` is the PR 13 predicate dialect, evaluated
+        replica-side; a filtered stream delivers matching live
+        versions only (no deletes)."""
+        w = self.watcher(
+            filter=filter,
+            wait_ms=wait_ms,
+            sub_id=sub_id,
+            cursor=cursor,
+        )
+        streak = 0
+        while True:
+            events = await w.next_events()
+            if events:
+                streak = 0
+                for ev in events:
+                    yield ev
+            else:
+                # The server already parked wait_ms on its LOCAL
+                # ring; this client-side backoff only paces polls
+                # when events live on remote arcs or the stream is
+                # idle.
+                streak = min(streak + 1, 6)
+                await asyncio.sleep(
+                    min(1.0, 0.05 * (2 ** streak))
+                )
+
     async def count(
         self,
         prefix: Optional[bytes] = None,
@@ -1473,6 +1629,114 @@ class DbeelCollection:
         return int(decided["ts"])
 
 
+class Watcher:
+    """Client half of one watch subscription: issues watch /
+    watch_next chunks through the any-node walk, tracks the
+    resumable cursor, decodes events, and audits the server's
+    per-replica ``(boot_epoch, seq)`` positions for monotonicity
+    (``monotonicity_violations`` stays 0 on a correct stream — the
+    chaos gate's ledger leans on this)."""
+
+    def __init__(
+        self,
+        client: "DbeelClient",
+        collection: str,
+        filter: Optional[Any] = None,
+        wait_ms: int = 1000,
+        sub_id: Optional[str] = None,
+        cursor: Optional[bytes] = None,
+    ):
+        self._client = client
+        self._wait_ms = int(wait_ms)
+        self.cursor: Optional[bytes] = cursor
+        self.chunks = 0
+        self.events_seen = 0
+        self.dup_flagged = 0
+        self.monotonicity_violations = 0
+        self._positions: dict = {}
+        if cursor is not None:
+            self._request = {
+                "type": "watch_next",
+                "cursor": bytes(cursor),
+            }
+        else:
+            self._request = {
+                "type": "watch",
+                "collection": collection,
+            }
+            if filter is not None:
+                from .. import query as _query
+
+                w, _ = _query.build_spec(filter, None)
+                self._request["spec"] = _query.pack_spec(w, None)
+            if sub_id:
+                self._request["sub_id"] = str(sub_id)
+        if self._wait_ms > 0:
+            self._request["wait_ms"] = self._wait_ms
+
+    @staticmethod
+    def _cursor_positions(raw) -> dict:
+        """Per-replica (boot_epoch, seq) positions out of the opaque
+        w1 cursor — a READ-ONLY peek for auditing; the token itself
+        stays opaque client state."""
+        try:
+            w = msgpack.unpackb(bytes(raw), raw=False)
+            if (
+                not isinstance(w, list)
+                or len(w) != 6
+                or w[0] != "w1"
+            ):
+                return {}
+            return {
+                g[0]: (int(g[2]), int(g[3]))
+                for g in w[5]
+                if int(g[2]) >= 0
+            }
+        except Exception:
+            return {}
+
+    async def next_events(self) -> list:
+        """One chunk: a list of (key, value, ts, flags) with decoded
+        documents (value None = delete, flags bit 0 = dup-flagged).
+        Empty list = no new events this round (the server long-polled
+        ``wait_ms`` on its local ring first)."""
+        chunk = await self._client._watch_chunk_request(
+            self._request
+        )
+        cursor = chunk.get("cursor")
+        events = []
+        for key, value, ts, flags in chunk.get("events") or ():
+            self.events_seen += 1
+            if flags & 1:
+                self.dup_flagged += 1
+            events.append(
+                (
+                    msgpack.unpackb(key, raw=False),
+                    msgpack.unpackb(value, raw=False)
+                    if value
+                    else None,
+                    ts,
+                    flags,
+                )
+            )
+        if cursor:
+            pos = self._cursor_positions(cursor)
+            for name, p in pos.items():
+                old = self._positions.get(name)
+                if old is not None and p < old:
+                    self.monotonicity_violations += 1
+            self._positions.update(pos)
+            self.cursor = bytes(cursor)
+            self._request = {
+                "type": "watch_next",
+                "cursor": self.cursor,
+            }
+            if self._wait_ms > 0:
+                self._request["wait_ms"] = self._wait_ms
+        self.chunks += 1
+        return events
+
+
 class DbeelClientSync:
     """Blocking convenience wrapper (the reference ships a 49-line
     synchronous python client, /root/reference/dbeel.py — this is its
@@ -1563,3 +1827,44 @@ class SyncCollection:
 
     def delete(self, key, consistency=None):
         self._c._run(self._col.delete(key, consistency))
+
+    def watcher(
+        self, filter=None, wait_ms=1000, sub_id=None, cursor=None
+    ):
+        return SyncWatcher(
+            self._c,
+            self._col.watcher(
+                filter=filter,
+                wait_ms=wait_ms,
+                sub_id=sub_id,
+                cursor=cursor,
+            ),
+        )
+
+
+class SyncWatcher:
+    """Blocking wrapper over Watcher: each ``next_events()`` call
+    pulls one chunk (possibly empty after the server's long-poll)."""
+
+    def __init__(self, sync_client, watcher):
+        self._c = sync_client
+        self._w = watcher
+
+    def next_events(self):
+        return self._c._run(self._w.next_events())
+
+    @property
+    def cursor(self):
+        return self._w.cursor
+
+    @property
+    def monotonicity_violations(self):
+        return self._w.monotonicity_violations
+
+    @property
+    def dup_flagged(self):
+        return self._w.dup_flagged
+
+    @property
+    def events_seen(self):
+        return self._w.events_seen

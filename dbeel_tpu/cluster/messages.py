@@ -179,6 +179,7 @@ class ShardRequest:
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
     SCAN = "scan"
+    WATCH_FEED = "watch_feed"
     REARM = "rearm"
     TELEMETRY_DIGEST = "telemetry_digest"
 
@@ -462,6 +463,46 @@ class ShardRequest:
         ]
 
     @staticmethod
+    def watch_feed(
+        collection: str,
+        boot_epoch: int,
+        after_seq: int,
+        ranges: list,
+        limit: int,
+        max_bytes: int,
+        spec: Optional[bytes] = None,
+        qos: int = 2,
+    ) -> list:
+        """Watch-plane feed page (ISSUE 20): up to ``limit`` change
+        events / ``max_bytes`` emitted bytes from the replica's
+        in-memory change ring, events strictly AFTER ``after_seq`` of
+        ring boot ``boot_epoch``, filtered to ``collection``, to key
+        hashes inside the half-open wrap ``ranges`` ([[start, end),
+        ...] — the coordinator partitions the ring's arcs across its
+        chosen replicas so feeds never systematically overlap), and
+        optionally to a packed filter ``spec`` evaluated replica-side
+        (query compute plane dialect).  The response's status flag
+        tells the coordinator whether the position is still on the
+        ring (0) or fell off / predates this boot (1: catch up from
+        durable state via the scan machinery, dup-flagged).
+
+        ``qos`` is the subscriber's traffic-class id (batch by
+        default — a million watchers must not starve point ops).
+        Arity is lint-pinned (shard._WATCH_PEER_ARITY)."""
+        return [
+            "request",
+            ShardRequest.WATCH_FEED,
+            collection,
+            boot_epoch,
+            after_seq,
+            ranges,
+            limit,
+            max_bytes,
+            spec,
+            qos,
+        ]
+
+    @staticmethod
     def range_push(collection: str, entries: list) -> list:
         """Anti-entropy batch apply: the receiver applies each
         (key, value, ts) ONLY when newer than its own newest for that
@@ -487,6 +528,7 @@ class ShardResponse:
     RANGE_PULL = "range_pull"
     RANGE_PUSH = "range_push"
     SCAN = "scan"
+    WATCH_FEED = "watch_feed"
     REARM = "rearm"
     TELEMETRY_DIGEST = "telemetry_digest"
     ERROR = "error"
@@ -603,6 +645,28 @@ class ShardResponse:
             scanned_rows,
             scanned_bytes,
             agg,
+        ]
+
+    @staticmethod
+    def watch_feed(
+        events: list,
+        boot_epoch: int,
+        tail_seq: int,
+        status: int,
+    ) -> list:
+        # One watch feed page: [[key, value, ts, seq], ...] ascending
+        # by seq; ``boot_epoch``/``tail_seq`` = the ring's current
+        # position (the subscriber's next cursor), ``status`` 0 = the
+        # requested position was served from the ring, 1 = it fell
+        # off (or predates this boot) — the coordinator must catch up
+        # from durable state with dup-flagging before tailing again.
+        return [
+            "response",
+            ShardResponse.WATCH_FEED,
+            events,
+            boot_epoch,
+            tail_seq,
+            status,
         ]
 
     @staticmethod

@@ -153,6 +153,22 @@ class Config:
     # survives, the client backs off and resumes).  0 disables the cap.
     scan_max_concurrent: int = 4
 
+    # ---- Watch/CDC streaming plane (ISSUE 20) ------------------------
+    # Per-shard change-feed ring capacity (events; oldest evict).  A
+    # subscriber whose cursor falls off the ring catches up from
+    # durable state via the scan machinery with every replayed event
+    # dup-flagged.
+    watch_ring: int = 4096
+    # Active watch subscribers per shard before new watch chunks shed
+    # with the retryable Overloaded error (the cursor survives, the
+    # client backs off and resumes).  0 disables the cap.
+    watch_max_subscribers: int = 1024
+    # Byte budget per watch chunk (one WATCH/WATCH_NEXT response
+    # frame) — also the refill rate of each subscriber's per-second
+    # byte bucket, so one slow-but-greedy watcher sheds instead of
+    # wedging the shard.
+    watch_bytes_per_slice: int = 256 << 10
+
     # ---- Multi-tenant QoS plane (ISSUE 14) ---------------------------
     # Per-tenant token-bucket quotas, enforced at dispatch with the
     # retryable QuotaExceeded error.  The rate is the DEFAULT each
@@ -453,6 +469,30 @@ def build_parser() -> argparse.ArgumentParser:
         "with the retryable Overloaded error (0 disables the cap)",
     )
     p.add_argument(
+        "--watch-ring",
+        type=int,
+        default=d.watch_ring,
+        help="per-shard change-feed ring capacity (events; oldest "
+        "evict — a cursor off the ring catches up from durable state "
+        "with dup-flagging)",
+    )
+    p.add_argument(
+        "--watch-max-subscribers",
+        type=int,
+        default=d.watch_max_subscribers,
+        help="active watch subscribers per shard before new watch "
+        "chunks shed with the retryable Overloaded error (0 disables "
+        "the cap)",
+    )
+    p.add_argument(
+        "--watch-bytes-per-slice",
+        type=int,
+        default=d.watch_bytes_per_slice,
+        help="byte budget per watch chunk and per-subscriber "
+        "per-second byte-bucket refill (slow watchers shed instead "
+        "of wedging the shard)",
+    )
+    p.add_argument(
         "--tenant-ops-per-sec",
         type=int,
         default=d.tenant_ops_per_sec,
@@ -567,6 +607,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> Config:
         metrics_port=ns.metrics_port,
         scan_bytes_per_slice=ns.scan_bytes_per_slice,
         scan_max_concurrent=ns.scan_max_concurrent,
+        watch_ring=ns.watch_ring,
+        watch_max_subscribers=ns.watch_max_subscribers,
+        watch_bytes_per_slice=ns.watch_bytes_per_slice,
         tenant_ops_per_sec=ns.tenant_ops_per_sec,
         tenant_bytes_per_sec=ns.tenant_bytes_per_sec,
         gc_grace_ms=ns.gc_grace_ms,

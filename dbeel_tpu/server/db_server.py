@@ -480,6 +480,15 @@ async def handle_request(
         # and resumes where it left.
         return await my_shard.scan_plane.handle(request, rtype)
 
+    if rtype in ("watch", "watch_next"):
+        # Watch/CDC streaming plane (ISSUE 20): one chunk of change
+        # events per frame with a self-contained resumable cursor in
+        # EVERY chunk — the stream survives coordinator death, sheds
+        # (retryable Overloaded; the cursor is client-held state),
+        # arc handoff (durable-state catch-up, dup-flagged) and the
+        # membership-epoch fence (retryable not-owned → resync).
+        return await my_shard.watch_plane.handle(request, rtype)
+
     if rtype == "get":
         ctx = trace_mod.current()
         collection_name = _extract(request, "collection")

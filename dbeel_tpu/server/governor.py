@@ -255,6 +255,15 @@ class LoadGovernor:
         for conn in shard.db_connections:
             ops += len(conn.pending) + len(conn.parked)
             ops += len(getattr(conn, "inflight", ()))
+        # Watch chunks parked in an empty-ring long-poll are idle
+        # (an event-wait, not queued CPU work) but still count as
+        # in-flight on their connections; exclude them so a large
+        # idle-subscriber pool cannot read as hard overload and
+        # shed real traffic.  Watch has its own admission: the
+        # subscriber cap and per-subscriber byte buckets.
+        wp = getattr(shard, "watch_plane", None)
+        if wp is not None:
+            ops = max(0, ops - wp.parked_chunks)
         mem_fill = 0.0
         appends_fill = 0.0
         flush_backlog = False

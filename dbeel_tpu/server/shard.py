@@ -286,6 +286,12 @@ class MyShard:
         from .scan import ScanPlane
 
         self.scan_plane = ScanPlane(self, config)
+        # Watch/CDC streaming plane (ISSUE 20): bounded per-shard
+        # change ring fed at the WAL group-commit release point +
+        # resumable coordinator fan-out with durable-state catch-up.
+        from .watch import WatchPlane
+
+        self.watch_plane = WatchPlane(self, config)
         # Continuous telemetry plane (PR 11): per-shard time-series
         # ring + health watchdog.  Constructed unconditionally so the
         # get_stats schema never depends on the knob; sampling only
@@ -844,6 +850,15 @@ class MyShard:
         tree.on_quarantine = (
             lambda _tree, n=name: self._on_tree_quarantine(n)
         )
+        # Watch/CDC plane (ISSUE 20): every acked mutation — client
+        # writes, replica applies, decided CAS outcomes, RANGE_PUSH
+        # and hint replays — releases through the tree's commit
+        # chokepoints, so this one hook is the complete change feed.
+        tree.on_commit = (
+            lambda key, value, ts, n=name: self.watch_plane.publish(
+                n, key, value, ts
+            )
+        )
         if self.degraded:
             tree.read_only = True
         return tree
@@ -1131,6 +1146,7 @@ class MyShard:
             # Streaming scan plane (PR 12): chunk/byte/cursor/shed
             # counters + the active-chunks gauge.
             "scan": self.scan_plane.stats(),
+            "watch": self.watch_plane.stats(),
             # Multi-tenant QoS plane (ISSUE 14): per-class admitted/
             # shed/window/level lanes + per-tenant token balances and
             # throttle counters — reachable through BOTH clients like
@@ -2179,6 +2195,14 @@ class MyShard:
     # (analysis/wire_parity.py; native kScanPeerArity).
     _SCAN_PEER_ARITY = 12
 
+    # WATCH_FEED peer frame arity (watch/CDC plane, ISSUE 20):
+    # [request, watch_feed, collection, boot_epoch, after_seq,
+    #  ranges, limit, max_bytes, spec, qos].  Feed pages ride pooled
+    # round trips like SCAN.  Lint-pinned against the encoder
+    # (analysis/wire_parity.py); the C planes have no watch tokens —
+    # an old .so falls through to this interpreted branch.
+    _WATCH_PEER_ARITY = 10
+
     @classmethod
     def peer_qos_class(cls, request) -> int:
         """QoS class a coordinator stamped on this data-op peer frame
@@ -2505,6 +2529,52 @@ class MyShard:
                 bool(request[9]),
             )
             return ShardResponse.scan(entries, more)
+        if kind == ShardRequest.WATCH_FEED:
+            # Watch/CDC plane (ISSUE 20): one change-ring page —
+            # events strictly after the coordinator's (boot, seq)
+            # position, filtered to the collection / hash ranges /
+            # optional spec.  Served off the in-memory ring with an
+            # O(1) empty fast path (no storage I/O, no bg_slice);
+            # clamps mirror SCAN's so peer-supplied sizes never
+            # become allocation levers.  An unknown collection is
+            # answered as an empty at-tail page (status 0): watch
+            # interest can reach a replica before the collection's
+            # create gossip does.
+            from . import qos as qos_mod
+
+            self.qos.note_peer(
+                qos_mod.class_of(request[9])
+                if len(request) > 9
+                else qos_mod.QOS_BATCH
+            )
+            # Watched collections must not serve writes natively:
+            # sticky-suspend this replica's fast path the moment
+            # feed interest lands (see WatchPlane.suspend_native).
+            self.watch_plane.suspend_native(request[2])
+            ranges = (
+                [[int(r[0]), int(r[1])] for r in request[5]]
+                if request[5]
+                else None
+            )
+            limit = max(1, min(int(request[6]), 65536))
+            max_bytes = max(
+                4096, min(int(request[7]), 16 << 20)
+            )
+            spec = request[8] if len(request) > 8 else None
+            events, boot_epoch, tail_seq, status = (
+                self.watch_plane.feed_page(
+                    request[2],
+                    int(request[3]),
+                    int(request[4]),
+                    ranges,
+                    limit,
+                    max_bytes,
+                    bytes(spec) if spec is not None else None,
+                )
+            )
+            return ShardResponse.watch_feed(
+                events, boot_epoch, tail_seq, status
+            )
         if kind == ShardRequest.RANGE_PUSH:
             col = self.collections.get(request[2])
             if col is None:

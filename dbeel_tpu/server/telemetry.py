@@ -149,6 +149,10 @@ _GAUGES = {
     "level": "overload.level",
     "degraded": "durability.degraded_mode",
     "hint_backlog": "convergence.hints_queued",
+    # Watch/CDC plane (ISSUE 20): how far the slowest live
+    # subscriber's locally-served position trails this shard's
+    # change ring head.
+    "watch_lag_events": "watch.lag_events",
 }
 
 # Counter paths turned into per-second rates between the last two
@@ -168,6 +172,9 @@ _RATES = {
     # rate — rows the vectorized filter evaluated per second
     # (scanned, not returned; the work the governor bills).
     "scan_rows_filtered_per_s": ("scan.filter.rows_scanned",),
+    # Watch/CDC plane (ISSUE 20): delivered change-event throughput
+    # of the streaming fan-out.
+    "watch_events_per_s": ("watch.events_delivered",),
     # QoS plane (ISSUE 14): per-class shed rates — under overload
     # batch's rate should lead and interactive's stay ~0 until a
     # strictly higher offered load (the class-priority contract).
@@ -357,6 +364,11 @@ def _round(v: Optional[float], digits: int = 2) -> Optional[float]:
 # spec; see ARCHITECTURE "Continuous telemetry" for the prose table).
 SHED_STORM_PER_S = 10.0  # sustained sheds/s in the newest window
 HINT_GROWTH_WINDOWS = 3  # consecutive strictly-growing samples
+# Watch lag: a subscriber's position falling strictly further behind
+# the change ring head over N consecutive windows — the watcher is
+# too slow (or stopped polling) and is heading for ring eviction +
+# a durable-state catch-up replay.
+WATCH_LAG_WINDOWS = 3
 DEAD_FRAC_WARN = 0.2  # below the governor's soft bar: pre-warning
 DEAD_CLIMB_WINDOWS = 3
 STICKY_DEGRADED_WINDOWS = 2
@@ -474,6 +486,26 @@ class HealthWatchdog:
                 hb[-1],
                 f"hint backlog grew {hb[0]:.0f} -> {hb[-1]:.0f} over "
                 f"{len(hb) - 1} windows",
+            )
+
+        # watch_lag_growing: the slowest live subscriber's position
+        # fell strictly further behind the change ring head over N
+        # consecutive windows (watch/CDC plane, ISSUE 20) — it will
+        # fall off the ring and pay a flagged catch-up replay unless
+        # it speeds up (or its byte budget is raised).
+        wl = ring.series("watch.lag_events", WATCH_LAG_WINDOWS + 1)
+        if (
+            len(wl) >= WATCH_LAG_WINDOWS + 1
+            and wl[-1] > 0
+            and all(b > a for a, b in zip(wl, wl[1:]))
+        ):
+            add(
+                "watch_lag_growing",
+                "warn",
+                wl[-1],
+                f"watch subscriber lag grew {wl[0]:.0f} -> "
+                f"{wl[-1]:.0f} events over "
+                f"{len(wl) - 1} windows",
             )
 
         # odirect_fallback: the C streamers silently degraded to

@@ -228,6 +228,13 @@ class LSMTree:
         # repair pull.
         self.on_disk_error: Optional[Callable] = None
         self.on_quarantine: Optional[Callable] = None
+        # Change-feed hook (ISSUE 20): fired once per acked mutation
+        # at the WAL group-commit release point — after the append's
+        # sync ticket releases, before the caller sees success — with
+        # (key, value, timestamp).  Stale-aborted inserts never fire
+        # (they were not applied).  Wired by the owning shard's watch
+        # plane; None when no watch plane observes this tree.
+        self.on_commit: Optional[Callable] = None
         self.durability = {
             "checksum_failures": 0,
             "quarantined_tables": 0,
@@ -878,6 +885,8 @@ class LSMTree:
                 f"WAL append failed: {e}"
             ) from e
         self._appends_since_swap += 1
+        if self.on_commit is not None:
+            self.on_commit(key, value, timestamp)
         # Flush on capacity DISTINCT keys (reference semantics,
         # lsm_tree.rs:747-755) — or on capacity APPENDS: an
         # update-heavy workload hammering fewer than ``capacity`` hot
@@ -945,6 +954,9 @@ class LSMTree:
                     f"WAL batch append failed: {e}"
                 ) from e
             self._appends_since_swap += applied
+            if self.on_commit is not None:
+                for k, v, ts in chunk:
+                    self.on_commit(k, v, ts)
             if (
                 self._active.is_full()
                 or self._appends_since_swap >= self.capacity

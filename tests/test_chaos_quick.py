@@ -31,9 +31,48 @@ REPORT_KEYS = {
     "acked_keys_checked",
     "acked_writes_lost",
     "divergent_keys",
+    "quiet_wait",
     "resources",
     "trace",
     "health",
+    "pass",
+}
+
+# Hint-drain-aware quiet window (ISSUE 20 satellite): the block that
+# replaced the fixed sleep — pinned so the deadline-poll mechanics
+# stay observable in the report.
+QUIET_WAIT_KEYS = {
+    "base_s",
+    "deadline_s",
+    "waited_s",
+    "polls",
+    "hints_queued_final",
+    "drained",
+    "note",
+}
+
+# Watch/CDC plane (ISSUE 20): the per-subscriber ledger gate — every
+# acked write delivered to every subscriber exactly once or
+# explicitly dup-flagged, through kill + partition + churn.
+WATCH_KEYS = {
+    "subscribers",
+    "writers",
+    "acked_writes",
+    "write_errors",
+    "delivered_lost",
+    "lost_samples",
+    "unflagged_duplicates",
+    "unflagged_dup_samples",
+    "cursor_monotonicity_violations",
+    "dup_flagged_events",
+    "poll_errors",
+    "kills",
+    "partition_heals",
+    "churn_cycles",
+    "drain_wait_s",
+    "quiet_wait",
+    "stats_watch_block",
+    "nodes_alive",
     "pass",
 }
 
@@ -221,6 +260,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
             "--scan",
             "--churn",
             "--cas",
+            "--watch",
             "--report",
             report_path,
         ],
@@ -335,6 +375,31 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert cs["stats_atomic_block"] is True
     assert cs["nodes_alive"] is True
     assert cs["pass"] is True, cs
+    # Hint-drain-aware quiet window (ISSUE 20 satellite): repeated
+    # --quick runs used to flake acked_writes_lost when the fixed
+    # sleep raced the last restart's hint replay; the deadline poll
+    # must report its mechanics.
+    qw = report["quiet_wait"]
+    missing = QUIET_WAIT_KEYS - set(qw)
+    assert not missing, missing
+    assert qw["polls"] >= 1
+    assert qw["waited_s"] <= qw["deadline_s"] + 5
+    # Watch/CDC plane (ISSUE 20): the loss gate — every acked write
+    # delivered to every subscriber ledger exactly once or
+    # explicitly dup-flagged, through the kill, the partition heal
+    # and the membership cycle; cursor positions never regressed.
+    wt = report["watch"]
+    missing = WATCH_KEYS - set(wt)
+    assert not missing, missing
+    assert wt["acked_writes"] > 0
+    assert wt["delivered_lost"] == 0, wt["lost_samples"]
+    assert wt["unflagged_duplicates"] == 0
+    assert wt["cursor_monotonicity_violations"] == 0
+    assert wt["kills"] >= 3
+    assert wt["partition_heals"] >= 1
+    assert wt["stats_watch_block"]["events_delivered"] > 0
+    assert wt["nodes_alive"] is True
+    assert wt["pass"] is True, wt
     # Tracing plane (ISSUE 9): the trace block must be present with
     # dumps from the (still alive) nodes; dominant_stages is a list
     # of [stage, share] pairs (may be empty when nothing was slow).
@@ -352,6 +417,7 @@ def test_chaos_soak_quick_schema(tmp_dir):
     assert "churn" in hb["phases"]
     assert "membership" in hb["phases"]
     assert "cas" in hb["phases"]
+    assert "watch" in hb["phases"]
     for label, block in {**hb["phases"], "final": hb["final"]}.items():
         missing = HEALTH_BLOCK_KEYS - set(block)
         assert not missing, (label, missing)

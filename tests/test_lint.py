@@ -41,6 +41,7 @@ _PARITY_FILES = [
     "dbeel_tpu/server/dataplane.py",
     "dbeel_tpu/server/metrics.py",
     "dbeel_tpu/server/scan.py",
+    "dbeel_tpu/server/watch.py",
     "dbeel_tpu/client/__init__.py",
     "native/src/dbeel_native.cpp",
     "native/src/dbeel_client.cpp",
@@ -441,6 +442,59 @@ def test_parity_flags_cursor_arity_drift(tmp_path):
     findings = wire_parity.check(Repo(root))
     assert any(
         "scan-cursor arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_watch_feed_arity_drift(tmp_path):
+    # Watch/CDC plane (ISSUE 20): the WATCH_FEED peer frame's fixed
+    # arity is pinned between the encoder and shard.py's handler
+    # constant.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/shard.py",
+        "_WATCH_PEER_ARITY = 10",
+        "_WATCH_PEER_ARITY = 8",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "watch_feed peer-frame arity drift" in f.message
+        for f in findings
+    ), findings
+
+
+def test_parity_flags_watch_cursor_arity_drift(tmp_path):
+    # Watch/CDC plane (ISSUE 20): encode_cursor's packed field count
+    # must match the pinned _CURSOR_ARITY (what decode_cursor
+    # accepts) — a one-sided cursor field would strand every live
+    # subscription on its next poll.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/server/watch.py",
+        "_CURSOR_ARITY = 6",
+        "_CURSOR_ARITY = 5",
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "watch-cursor arity drift" in f.message for f in findings
+    ), findings
+
+
+def test_parity_flags_watch_cursor_version_lost_in_client(tmp_path):
+    # Watch/CDC plane (ISSUE 20): the Python client's read-only
+    # cursor peek recognizes the server's version token — if it
+    # drifts, the Watcher monotonicity audit passes vacuously.
+    root = _copy_fixture(tmp_path)
+    _edit(
+        root,
+        "dbeel_tpu/client/__init__.py",
+        '!= "w1"',
+        '!= "w0"',
+    )
+    findings = wire_parity.check(Repo(root))
+    assert any(
+        "watch-cursor version drift" in f.message for f in findings
     ), findings
 
 

